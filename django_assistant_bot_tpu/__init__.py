@@ -1,0 +1,23 @@
+"""django_assistant_bot_tpu — a TPU-native framework for RAG-powered assistant bots.
+
+A from-scratch rebuild of the capability surface of ``saninsteinn/django-assistant-bot``
+(reference at /root/reference), designed TPU-first:
+
+- the reference's CUDA/PyTorch ``gpu_service`` is replaced by a JAX/XLA serving stack
+  (:mod:`~django_assistant_bot_tpu.serving`): Flax-free functional model definitions
+  (:mod:`~django_assistant_bot_tpu.models`) sharded over a :class:`jax.sharding.Mesh`
+  (:mod:`~django_assistant_bot_tpu.parallel`), jit-compiled encode and prefill/decode
+  generation with continuous batching, and pallas TPU kernels for the hot ops
+  (:mod:`~django_assistant_bot_tpu.ops`);
+- the reference's Django ORM + pgvector plane is replaced by a zero-dependency sqlite
+  ORM-lite plus a TPU-resident brute-force cosine KNN index that rides the MXU
+  (:mod:`~django_assistant_bot_tpu.storage`);
+- the reference's Celery/Redis task plane is replaced by a durable sqlite-backed queue
+  with the same at-least-once semantics (:mod:`~django_assistant_bot_tpu.tasks`).
+
+The bot runtime, AI-provider abstraction, RAG pipeline, ingestion pipeline, platforms,
+HTTP API, CLI, and broadcasting planes mirror the reference's capabilities one-for-one
+(see SURVEY.md §2 for the inventory each module cites).
+"""
+
+__version__ = "0.1.0"

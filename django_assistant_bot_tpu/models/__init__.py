@@ -1,0 +1,19 @@
+"""TPU-native model definitions (functional: config + param pytree + pure apply fns).
+
+Replaces the reference's ``AutoModel``/``AutoModelForCausalLM`` torch path
+(reference: assistant/ai/embedders/transformers.py, assistant/ai/providers/transformers.py)
+with three families, all jit/pjit-first:
+
+- :mod:`.encoder` — BERT-family bidirectional encoder (ruBert-base / MiniLM class)
+  for embeddings; masked mean-pool matches the reference embedder's semantics.
+- :mod:`.llama`   — Llama-3-family decoder (RMSNorm, RoPE, GQA, SwiGLU), layers
+  stacked for ``lax.scan`` (fast compiles, PP-ready), KV-cache prefill/decode.
+- :mod:`.mixtral` — Mixtral-style MoE decoder: top-2 router with capacity-based
+  dense dispatch einsums (MXU-friendly), experts sharded over the ``expert`` axis.
+
+Parameters are plain pytrees of jnp arrays with a parallel pytree of logical axis
+names consumed by :mod:`..parallel.sharding`.
+"""
+
+from .config import DecoderConfig, EncoderConfig  # noqa: F401
+from . import encoder, llama, mixtral  # noqa: F401

@@ -1,0 +1,150 @@
+"""Model configs for the encoder / decoder / MoE families.
+
+``from_hf`` classmethods map Hugging Face ``config.json`` dicts (BertConfig /
+LlamaConfig / MixtralConfig) onto these, so checkpoints the reference serves
+(sberbank-ai/ruBert-base, Llama-3-8B, Mixtral-8x7B — see BASELINE.md configs) load
+without the transformers modelling code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    """BERT-family encoder (ruBert-base: 12L/768E/12H; MiniLM-L6: 6L/384E/12H)."""
+
+    vocab_size: int = 119_547
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_hf(cls, hf: Mapping[str, Any], dtype=jnp.bfloat16) -> "EncoderConfig":
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            max_position_embeddings=hf.get("max_position_embeddings", 512),
+            type_vocab_size=hf.get("type_vocab_size", 2),
+            layer_norm_eps=hf.get("layer_norm_eps", 1e-12),
+            pad_token_id=hf.get("pad_token_id", 0),
+            dtype=dtype,
+        )
+
+    @classmethod
+    def tiny(cls) -> "EncoderConfig":
+        """Test-size config (runs on the 8-device CPU mesh in milliseconds)."""
+        return cls(
+            vocab_size=512,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            max_position_embeddings=128,
+            dtype=jnp.float32,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    """Llama-3 family decoder; ``num_experts > 0`` turns the MLP into Mixtral MoE."""
+
+    vocab_size: int = 128_256
+    hidden_size: int = 4096
+    intermediate_size: int = 14_336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: Optional[int] = None
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    # MoE (Mixtral): 0 experts = dense SwiGLU MLP
+    num_experts: int = 0
+    experts_per_token: int = 2
+    expert_capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            object.__setattr__(self, "head_dim", self.hidden_size // self.num_heads)
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @classmethod
+    def from_hf(cls, hf: Mapping[str, Any], dtype=jnp.bfloat16) -> "DecoderConfig":
+        num_experts = hf.get("num_local_experts", 0)
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            max_seq_len=hf.get("max_position_embeddings", 8192),
+            rope_theta=hf.get("rope_theta", 500_000.0),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            num_experts=num_experts,
+            experts_per_token=hf.get("num_experts_per_tok", 2),
+            dtype=dtype,
+        )
+
+    @classmethod
+    def llama3_8b(cls, dtype=jnp.bfloat16) -> "DecoderConfig":
+        return cls(dtype=dtype)
+
+    @classmethod
+    def mixtral_8x7b(cls, dtype=jnp.bfloat16) -> "DecoderConfig":
+        return cls(
+            vocab_size=32_000,
+            hidden_size=4096,
+            intermediate_size=14_336,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            rope_theta=1e6,
+            num_experts=8,
+            experts_per_token=2,
+            max_seq_len=32_768,
+            dtype=dtype,
+        )
+
+    @classmethod
+    def tiny(cls, *, num_experts: int = 0) -> "DecoderConfig":
+        return cls(
+            vocab_size=512,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            max_seq_len=256,
+            rope_theta=10_000.0,
+            num_experts=num_experts,
+            dtype=jnp.float32,
+        )

@@ -1,0 +1,161 @@
+"""BERT-family bidirectional encoder for sentence embeddings.
+
+TPU-native replacement for the reference's torch embedder forward+mean-pool
+(reference: assistant/ai/embedders/transformers.py:15-29 — which embeds one text at a
+time; here ``encode`` is a single jit'd batched forward, the main docs/sec/chip win).
+
+Design: layer params stacked on a leading ``layer`` axis and iterated with
+``lax.scan`` (one compiled layer body regardless of depth); activations are
+bf16 with f32 LayerNorm stats; attention masks are additive and broadcast.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from ..ops.norms import layer_norm
+from ..parallel.sharding import with_constraint
+from .config import EncoderConfig
+
+Params = Dict[str, Any]
+
+
+def logical_axes(cfg: EncoderConfig) -> Params:
+    """Pytree of logical axis names, parallel to :func:`init` (leading None = layer)."""
+    E, F = "embed", "mlp"
+    return {
+        "tok_embed": ("vocab_in", E),
+        "pos_embed": ("pos", E),
+        "type_embed": (None, E),
+        "embed_ln_w": (E,),
+        "embed_ln_b": (E,),
+        "layers": {
+            "wq": (None, E, "heads"),
+            "bq": (None, "heads"),
+            "wk": (None, E, "heads"),
+            "bk": (None, "heads"),
+            "wv": (None, E, "heads"),
+            "bv": (None, "heads"),
+            "wo": (None, "heads", E),
+            "bo": (None, E),
+            "attn_ln_w": (None, E),
+            "attn_ln_b": (None, E),
+            "w1": (None, E, F),
+            "b1": (None, F),
+            "w2": (None, F, E),
+            "b2": (None, E),
+            "mlp_ln_w": (None, E),
+            "mlp_ln_b": (None, E),
+        },
+    }
+
+
+def init(cfg: EncoderConfig, rng: jax.Array) -> Params:
+    """Random init (tests / smoke); real weights come from models.hf_loader."""
+    k = jax.random.split(rng, 8)
+    E, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    s = E ** -0.5
+
+    def dense(key, shape):
+        return (jax.random.normal(key, shape) * s).astype(cfg.dtype)
+
+    lk = jax.random.split(k[5], 8)
+    return {
+        "tok_embed": dense(k[0], (cfg.vocab_size, E)),
+        "pos_embed": dense(k[1], (cfg.max_position_embeddings, E)),
+        "type_embed": dense(k[2], (cfg.type_vocab_size, E)),
+        "embed_ln_w": jnp.ones((E,), cfg.dtype),
+        "embed_ln_b": jnp.zeros((E,), cfg.dtype),
+        "layers": {
+            "wq": dense(lk[0], (L, E, E)),
+            "bq": jnp.zeros((L, E), cfg.dtype),
+            "wk": dense(lk[1], (L, E, E)),
+            "bk": jnp.zeros((L, E), cfg.dtype),
+            "wv": dense(lk[2], (L, E, E)),
+            "bv": jnp.zeros((L, E), cfg.dtype),
+            "wo": dense(lk[3], (L, E, E)),
+            "bo": jnp.zeros((L, E), cfg.dtype),
+            "attn_ln_w": jnp.ones((L, E), cfg.dtype),
+            "attn_ln_b": jnp.zeros((L, E), cfg.dtype),
+            "w1": dense(lk[4], (L, E, F)),
+            "b1": jnp.zeros((L, F), cfg.dtype),
+            "w2": dense(lk[5], (L, F, E)),
+            "b2": jnp.zeros((L, E), cfg.dtype),
+            "mlp_ln_w": jnp.ones((L, E), cfg.dtype),
+            "mlp_ln_b": jnp.zeros((L, E), cfg.dtype),
+        },
+    }
+
+
+def _layer(cfg: EncoderConfig, x: jnp.ndarray, p: Params, attn_bias: jnp.ndarray):
+    """One post-LN transformer layer.  x: [B,S,E]; attn_bias: [B,1,1,S] additive."""
+    B, S, E = x.shape
+    H, D = cfg.num_heads, cfg.head_dim
+
+    def proj(w, b):
+        y = jnp.einsum("bse,ehd->bshd", x, w.reshape(E, H, D)) + b.reshape(H, D)
+        return with_constraint(y, ("batch", "length", "heads", "head_dim"))
+
+    q = proj(p["wq"], p["bq"]).transpose(0, 2, 1, 3)
+    kk = proj(p["wk"], p["bk"]).transpose(0, 2, 1, 3)
+    vv = proj(p["wv"], p["bv"]).transpose(0, 2, 1, 3)
+    attn = dot_product_attention(q, kk, vv, mask=attn_bias)
+    attn = attn.transpose(0, 2, 1, 3)  # [B,S,H,D]
+    out = jnp.einsum("bshd,hde->bse", attn, p["wo"].reshape(H, D, E)) + p["bo"]
+    x = layer_norm(x + out, p["attn_ln_w"], p["attn_ln_b"], cfg.layer_norm_eps)
+
+    h = jax.nn.gelu(jnp.einsum("bse,ef->bsf", x, p["w1"]) + p["b1"], approximate=False)
+    h = with_constraint(h, ("batch", "length", "mlp"))
+    h = jnp.einsum("bsf,fe->bse", h, p["w2"]) + p["b2"]
+    x = layer_norm(x + h, p["mlp_ln_w"], p["mlp_ln_b"], cfg.layer_norm_eps)
+    return with_constraint(x, ("batch", "length", "embed"))
+
+
+def forward(
+    params: Params,
+    cfg: EncoderConfig,
+    input_ids: jnp.ndarray,  # [B, S] int32
+    attention_mask: jnp.ndarray,  # [B, S] 1=real, 0=pad
+) -> jnp.ndarray:
+    """Full encoder forward -> last hidden states [B, S, E]."""
+    B, S = input_ids.shape
+    x = (
+        params["tok_embed"][input_ids]
+        + params["pos_embed"][jnp.arange(S)][None]
+        + params["type_embed"][jnp.zeros_like(input_ids)]
+    )
+    x = layer_norm(x, params["embed_ln_w"], params["embed_ln_b"], cfg.layer_norm_eps)
+    x = with_constraint(x.astype(cfg.dtype), ("batch", "length", "embed"))
+
+    mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,S], True=keep
+
+    def body(x, layer_params):
+        return _layer(cfg, x, layer_params, mask), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+def encode(
+    params: Params,
+    cfg: EncoderConfig,
+    input_ids: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+    *,
+    normalize: bool = False,
+) -> jnp.ndarray:
+    """Masked mean-pool sentence embeddings [B, E] (float32).
+
+    Matches the reference's ``last_hidden_state.mean(dim=1)`` semantics but excludes
+    padding (the reference embeds unbatched so it never pads; batched we must mask).
+    """
+    hidden = forward(params, cfg, input_ids, attention_mask).astype(jnp.float32)
+    m = attention_mask.astype(jnp.float32)[..., None]
+    pooled = (hidden * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    if normalize:
+        pooled = pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+    return pooled

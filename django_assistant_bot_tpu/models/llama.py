@@ -1,0 +1,354 @@
+"""Llama-3-family decoder: RMSNorm + RoPE + GQA + SwiGLU, KV-cache prefill/decode.
+
+TPU-native replacement for the reference's ``AutoModelForCausalLM.generate`` single
+stream (reference: assistant/ai/providers/transformers.py:35-94).  Differences that
+matter on TPU:
+
+- layers stacked on a leading axis, iterated with ``lax.scan`` — one compiled body;
+- a slot-based, static-shape KV cache carried through the scan (continuous batching
+  updates per-slot positions with vmap'd ``dynamic_update_slice`` — no dynamic shapes
+  ever reach XLA);
+- prefill uses the pallas flash-attention kernel for long buckets; decode uses the
+  jnp path (projections dominate at Sq=1);
+- tensor parallelism: heads/mlp sharded over the ``model`` mesh axis via logical
+  axis annotations; XLA inserts the per-layer psums over ICI.
+
+MoE note: when ``cfg.is_moe``, the MLP block is delegated to
+:func:`.mixtral.moe_mlp` (experts sharded over ``expert``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention, dot_product_attention
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope, rope_frequencies
+from ..parallel.sharding import with_constraint
+from .config import DecoderConfig
+
+Params = Dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Static-shape slot cache.  k/v: [L, B, KH, S, D]; lengths: [B] tokens present."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    lengths: jnp.ndarray  # int32 [B]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[3]
+
+
+CACHE_AXES = KVCache(
+    k=(None, "batch", "kv_heads", None, "head_dim"),
+    v=(None, "batch", "kv_heads", None, "head_dim"),
+    lengths=("batch",),
+)
+
+
+def init_cache(cfg: DecoderConfig, batch: int, max_len: int, dtype=None) -> KVCache:
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, batch, cfg.num_kv_heads, max_len, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def logical_axes(cfg: DecoderConfig) -> Params:
+    E, F = "embed", "mlp"
+    layers: Dict[str, tuple] = {
+        "attn_norm": (None, E),
+        "wq": (None, E, "heads"),
+        "wk": (None, E, "kv_heads"),
+        "wv": (None, E, "kv_heads"),
+        "wo": (None, "heads", E),
+        "mlp_norm": (None, E),
+    }
+    if cfg.is_moe:
+        layers.update(
+            {
+                "router": (None, E, "expert"),
+                "w_gate": (None, "expert", E, F),
+                "w_up": (None, "expert", E, F),
+                "w_down": (None, "expert", F, E),
+            }
+        )
+    else:
+        layers.update(
+            {"w_gate": (None, E, F), "w_up": (None, E, F), "w_down": (None, F, E)}
+        )
+    axes = {
+        "tok_embed": ("vocab_in", E),
+        "final_norm": (E,),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = (E, "vocab_out")
+    return axes
+
+
+def init(cfg: DecoderConfig, rng: jax.Array) -> Params:
+    keys = jax.random.split(rng, 12)
+    E, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    H, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    s = E ** -0.5
+
+    def dense(key, shape, scale=None):
+        return (jax.random.normal(key, shape) * (scale or s)).astype(cfg.dtype)
+
+    layers = {
+        "attn_norm": jnp.ones((L, E), cfg.dtype),
+        "wq": dense(keys[0], (L, E, H * D)),
+        "wk": dense(keys[1], (L, E, KH * D)),
+        "wv": dense(keys[2], (L, E, KH * D)),
+        "wo": dense(keys[3], (L, H * D, E)),
+        "mlp_norm": jnp.ones((L, E), cfg.dtype),
+    }
+    if cfg.is_moe:
+        X = cfg.num_experts
+        layers.update(
+            {
+                "router": dense(keys[4], (L, E, X)),
+                "w_gate": dense(keys[5], (L, X, E, F)),
+                "w_up": dense(keys[6], (L, X, E, F)),
+                "w_down": dense(keys[7], (L, X, F, E), scale=F ** -0.5),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": dense(keys[5], (L, E, F)),
+                "w_up": dense(keys[6], (L, E, F)),
+                "w_down": dense(keys[7], (L, F, E), scale=F ** -0.5),
+            }
+        )
+    params = {
+        "tok_embed": dense(keys[8], (cfg.vocab_size, E), scale=1.0),
+        "final_norm": jnp.ones((E,), cfg.dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(keys[9], (E, cfg.vocab_size))
+    return params
+
+
+def _mlp(cfg: DecoderConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.is_moe:
+        from .mixtral import moe_mlp
+
+        return moe_mlp(cfg, p, x)
+    h = jax.nn.silu(jnp.einsum("bse,ef->bsf", x, p["w_gate"])) * jnp.einsum(
+        "bse,ef->bsf", x, p["w_up"]
+    )
+    h = with_constraint(h, ("batch", "length", "mlp"))
+    return jnp.einsum("bsf,fe->bse", h, p["w_down"])
+
+
+def _attn_proj(cfg: DecoderConfig, p: Params, x: jnp.ndarray, cos, sin):
+    """QKV projections + RoPE.  Returns q:[B,H,S,D], k/v:[B,KH,S,D]."""
+    B, S, E = x.shape
+    H, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    q = jnp.einsum("bse,eo->bso", x, p["wq"]).reshape(B, S, H, D)
+    k = jnp.einsum("bse,eo->bso", x, p["wk"]).reshape(B, S, KH, D)
+    v = jnp.einsum("bse,eo->bso", x, p["wv"]).reshape(B, S, KH, D)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    q = with_constraint(q.transpose(0, 2, 1, 3), ("batch", "heads", "length", "head_dim"))
+    k = with_constraint(k.transpose(0, 2, 1, 3), ("batch", "kv_heads", "length", "head_dim"))
+    v = with_constraint(v.transpose(0, 2, 1, 3), ("batch", "kv_heads", "length", "head_dim"))
+    return q, k, v
+
+
+def _repeat_kv(cfg: DecoderConfig, k: jnp.ndarray) -> jnp.ndarray:
+    """[B,KH,S,D] -> [B,H,S,D]; contiguous blocks so TP sharding stays aligned."""
+    if cfg.q_per_kv == 1:
+        return k
+    return jnp.repeat(k, cfg.q_per_kv, axis=1)
+
+
+def _rope_tables(cfg: DecoderConfig, max_len: int):
+    cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
+    return jnp.asarray(cos), jnp.asarray(sin)
+
+
+def forward(
+    params: Params,
+    cfg: DecoderConfig,
+    input_ids: jnp.ndarray,  # [B, S]
+    *,
+    mask: Optional[jnp.ndarray] = None,  # [B,1,1,S] or [B,1,S,S] keep-mask
+) -> jnp.ndarray:
+    """Training/eval forward over full sequences -> logits [B, S, V] (f32).
+
+    Causal masking always applies; ``mask`` adds padding masking on top.
+    """
+    B, S = input_ids.shape
+    cos, sin = _rope_tables(cfg, S)
+    x = params["tok_embed"][input_ids].astype(cfg.dtype)
+    x = with_constraint(x, ("batch", "length", "embed"))
+
+    def body(x, p):
+        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _attn_proj(cfg, p, h, cos, sin)
+        k, v = _repeat_kv(cfg, k), _repeat_kv(cfg, v)
+        if mask is None:
+            o = attention(q, k, v, causal=True)
+        else:
+            o = dot_product_attention(q, k, v, causal=True, mask=mask)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
+        x = x + jnp.einsum("bso,oe->bse", o, p["wo"])
+        h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(cfg, p, h)
+        return with_constraint(x, ("batch", "length", "embed")), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bse,ev->bsv", x, head.astype(cfg.dtype))
+    return with_constraint(logits.astype(jnp.float32), ("batch", "length", "vocab_out"))
+
+
+def _write_cache(cache_k, new_k, starts):
+    """vmap'd dynamic_update_slice: cache_k [B,KH,S,D], new_k [B,KH,Sn,D], starts [B]."""
+    def upd(c, n, s):
+        return jax.lax.dynamic_update_slice(c, n.astype(c.dtype), (0, s, 0))
+
+    return jax.vmap(upd)(cache_k, new_k, starts)
+
+
+def prefill(
+    params: Params,
+    cfg: DecoderConfig,
+    input_ids: jnp.ndarray,  # [B, S] right-padded bucket
+    lengths: jnp.ndarray,  # [B] true lengths
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run prompts through the model.
+
+    Returns (last-token logits [B,V] f32, ks [L,B,KH,S,D], vs) — the K/V tensors are
+    inserted into cache slots by :func:`insert_sequences` (prefill runs on its own
+    small batch so it never touches other live slots' cache rows).
+    """
+    B, S = input_ids.shape
+    cos, sin = _rope_tables(cfg, S)
+    x = params["tok_embed"][input_ids].astype(cfg.dtype)
+
+    def body(x, p):
+        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _attn_proj(cfg, p, h, cos, sin)
+        kr, vr = _repeat_kv(cfg, k), _repeat_kv(cfg, v)
+        # No pad mask needed: input is right-padded, so causal masking already
+        # restricts every real query to real keys; pad rows' outputs are discarded
+        # (lengths-1 gather below) and their cache entries are overwritten/masked at
+        # decode.  Keeping the call mask-free lets the flash kernel take long buckets.
+        o = attention(q, kr, vr, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
+        x = x + jnp.einsum("bso,oe->bse", o, p["wo"])
+        h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(cfg, p, h)
+        return with_constraint(x, ("batch", "length", "embed")), (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+    )[:, 0]  # [B, E]
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("be,ev->bv", last, head.astype(cfg.dtype))
+    return logits.astype(jnp.float32), ks, vs
+
+
+def insert_sequences(
+    cache: KVCache,
+    ks: jnp.ndarray,  # [L, B, KH, S, D] from prefill
+    vs: jnp.ndarray,
+    lengths: jnp.ndarray,  # [B]
+    slots: jnp.ndarray,  # [B] int32 target slot per prefilled row
+) -> KVCache:
+    """Write prefilled K/V rows into their cache slots (positions [0, S))."""
+
+    def write_one(cache_kv, row, slot):
+        # cache_kv: [L, Bc, KH, Sc, D]; row: [L, KH, S, D]
+        return jax.lax.dynamic_update_slice(
+            cache_kv,
+            row[:, None].astype(cache_kv.dtype),
+            (0, slot, 0, 0, 0),
+        )
+
+    k, v, cache_lengths = cache.k, cache.v, cache.lengths
+    for b in range(ks.shape[1]):
+        k = write_one(k, ks[:, b], slots[b])
+        v = write_one(v, vs[:, b], slots[b])
+        cache_lengths = cache_lengths.at[slots[b]].set(lengths[b])
+    return KVCache(k=k, v=v, lengths=cache_lengths)
+
+
+def decode_step(
+    params: Params,
+    cfg: DecoderConfig,
+    tokens: jnp.ndarray,  # [B] int32 — last sampled token per slot
+    cache: KVCache,
+    *,
+    active: Optional[jnp.ndarray] = None,  # [B] bool; inactive slots are frozen
+) -> tuple[jnp.ndarray, KVCache]:
+    """One autoregressive step for every active slot -> (logits [B,V] f32, cache)."""
+    B = tokens.shape[0]
+    if active is None:
+        active = jnp.ones((B,), bool)
+    # Freeze slots whose cache is full: dynamic_update_slice would silently clamp the
+    # write onto the last real entry.  The engine layer finishes such requests with
+    # length_limited=True; this guard keeps the cache sound regardless.
+    active = active & (cache.lengths < cache.max_len)
+    positions = jnp.minimum(cache.lengths, cache.max_len - 1)
+    cos_t, sin_t = _rope_tables(cfg, cache.max_len)
+    cos = cos_t[positions][:, None, :]  # [B,1,hd/2] — per-slot position
+    sin = sin_t[positions][:, None, :]
+
+    x = params["tok_embed"][tokens][:, None, :].astype(cfg.dtype)  # [B,1,E]
+    S = cache.max_len
+    kpos = jnp.arange(S)[None, :]
+    attn_mask = (kpos <= positions[:, None])[:, None, None, :]  # [B,1,1,S]
+
+    H, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    def body(x, inputs):
+        p, k_cache, v_cache = inputs
+        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+        q = jnp.einsum("bse,eo->bso", h, p["wq"]).reshape(B, 1, H, D)
+        k = jnp.einsum("bse,eo->bso", h, p["wk"]).reshape(B, 1, KH, D)
+        v = jnp.einsum("bse,eo->bso", h, p["wv"]).reshape(B, 1, KH, D)
+        q = apply_rope(q, cos, sin).transpose(0, 2, 1, 3)
+        k = apply_rope(k, cos, sin).transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        k_cache = _write_cache(k_cache, k, positions)
+        v_cache = _write_cache(v_cache, v, positions)
+        kr, vr = _repeat_kv(cfg, k_cache), _repeat_kv(cfg, v_cache)
+        o = dot_product_attention(q, kr, vr, mask=attn_mask)  # [B,H,1,D]
+        o = o.transpose(0, 2, 1, 3).reshape(B, 1, -1)
+        x = x + jnp.einsum("bso,oe->bse", o, p["wo"])
+        h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(cfg, p, h)
+        return x, (k_cache, v_cache)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    # Inactive (free) slots do get a garbage K/V write at their current `lengths`
+    # position, but their lengths don't advance and every new request's prefill
+    # overwrites the slot from 0 — so it is never read.  Skipping the masking keeps
+    # the decode step a pure scatter (no full-cache select), which matters at
+    # multi-GB cache sizes.
+    new_cache = KVCache(
+        k=ks,
+        v=vs,
+        lengths=jnp.where(active, cache.lengths + 1, cache.lengths),
+    )
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps)
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("be,ev->bv", x, head.astype(cfg.dtype))
+    return logits.astype(jnp.float32), new_cache

@@ -1,0 +1,71 @@
+"""Mixtral-style MoE MLP: top-k router with capacity-based dense dispatch.
+
+Per BASELINE.md config #5 (Mixtral 8x7B continuous batching).  TPU-first choices:
+
+- dispatch/combine are dense one-hot einsums (GShard/Switch style) — everything is a
+  static-shape matmul that tiles onto the MXU; no sorting/ragged gathers;
+- expert weight tensors carry a leading ``expert`` axis sharded over the mesh's
+  ``expert`` (or folded into ``model``) axis; the dispatch einsum makes XLA emit the
+  all-to-all over ICI;
+- over-capacity tokens are dropped (standard capacity-factor semantics) — the router
+  gates renormalise over the kept experts.
+
+The decoder (:mod:`.llama`) calls :func:`moe_mlp` in place of its dense SwiGLU when
+``cfg.is_moe``; everything else (attention, cache, generation) is shared.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import with_constraint
+from .config import DecoderConfig
+
+
+def expert_capacity(cfg: DecoderConfig, num_tokens: int) -> int:
+    cap = math.ceil(
+        num_tokens * cfg.experts_per_token / cfg.num_experts * cfg.expert_capacity_factor
+    )
+    # keep the MXU fed and the (8,128) tiling happy
+    return max(8, int(math.ceil(cap / 8) * 8))
+
+
+def moe_mlp(cfg: DecoderConfig, p, x: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, S, E] -> [B, S, E] through top-k routed experts."""
+    B, S, E = x.shape
+    T = B * S
+    X, K = cfg.num_experts, cfg.experts_per_token
+    C = expert_capacity(cfg, T)
+    xt = x.reshape(T, E)
+
+    router_logits = jnp.einsum("te,ex->tx", xt.astype(jnp.float32), p["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [T, X]
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # [T, K]
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((T, X, C), cfg.dtype)
+    combine = jnp.zeros((T, X, C), jnp.float32)
+    counts = jnp.zeros((X,), jnp.int32)
+    for choice in range(K):  # K is tiny and static (2)
+        onehot_e = jax.nn.one_hot(gate_idx[:, choice], X, dtype=jnp.int32)  # [T, X]
+        pos = jnp.cumsum(onehot_e, axis=0) - onehot_e + counts[None, :]
+        counts = counts + onehot_e.sum(axis=0)
+        pos_in_e = (pos * onehot_e).sum(-1)  # [T]
+        keep = pos_in_e < C
+        pos_oh = jax.nn.one_hot(pos_in_e, C, dtype=cfg.dtype) * keep[:, None]
+        slot = onehot_e.astype(cfg.dtype)[:, :, None] * pos_oh[:, None, :]
+        dispatch = dispatch + slot
+        combine = combine + gate_vals[:, choice, None, None] * slot.astype(jnp.float32)
+
+    xe = jnp.einsum("txc,te->xce", dispatch, xt)  # [X, C, E]
+    xe = with_constraint(xe, ("expert", None, "embed"))
+    h = jax.nn.silu(jnp.einsum("xce,xef->xcf", xe, p["w_gate"])) * jnp.einsum(
+        "xce,xef->xcf", xe, p["w_up"]
+    )
+    h = with_constraint(h, ("expert", None, "mlp"))
+    ye = jnp.einsum("xcf,xfe->xce", h, p["w_down"])  # [X, C, E]
+    out = jnp.einsum("txc,xce->te", combine.astype(cfg.dtype), ye)
+    return out.reshape(B, S, E)

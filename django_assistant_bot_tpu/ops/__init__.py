@@ -1,0 +1,15 @@
+"""TPU-first neural-net ops.
+
+The hot compute the reference delegates to torch (``model()`` forward and
+``model.generate`` in assistant/ai/embedders/transformers.py and
+assistant/ai/providers/transformers.py) lives here as jit-friendly JAX ops:
+fused-by-XLA norms and RoPE, a pallas flash-attention kernel for TPU (with a pure-jnp
+fallback used on CPU/in tests), shape-static nucleus sampling, and ring attention for
+sequence/context parallelism over the mesh ``seq`` axis.
+"""
+
+from .norms import layer_norm, rms_norm  # noqa: F401
+from .rope import apply_rope, rope_frequencies  # noqa: F401
+from .attention import dot_product_attention, flash_attention  # noqa: F401
+from .sampling import sample_logits  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

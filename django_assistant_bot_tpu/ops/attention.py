@@ -1,0 +1,174 @@
+"""Attention: pallas flash kernel for TPU + a jnp reference path.
+
+Replaces the torch SDPA the reference reaches through ``AutoModel`` forwards
+(reference: assistant/ai/embedders/transformers.py:15-29, providers/transformers.py:35-94).
+
+Two paths, one contract:
+
+- :func:`dot_product_attention` — pure jnp, f32 accumulation.  Used on CPU, in tests,
+  and for short decode steps where the MXU is already saturated by the projections.
+- :func:`flash_attention` — pallas TPU kernel, blocked online-softmax so the [S, S]
+  score matrix never materialises in HBM (O(S) memory; the win for long prefill).
+
+Both take ``[batch, heads, seq, head_dim]`` and support causal masking and GQA
+(kv heads broadcast by the caller via repeat — XLA dedups the memory).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # [B, H, Sq, D]
+    k: jnp.ndarray,  # [B, H, Sk, D]
+    v: jnp.ndarray,  # [B, H, Sk, D]
+    *,
+    causal: bool = False,
+    mask: Optional[jnp.ndarray] = None,  # broadcastable to [B, H, Sq, Sk]; True=keep
+    q_offset: int | jnp.ndarray = 0,  # absolute position of q[0] (decode w/ KV cache)
+) -> jnp.ndarray:
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        qpos = jnp.arange(q.shape[2]) + q_offset
+        kpos = jnp.arange(k.shape[2])
+        causal_mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(causal_mask[None, None], scores, NEG_INF)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_len: int, block_kv: int, causal: bool, q_block: int):
+    """One (batch*head, q-block) program: online softmax over kv blocks.
+
+    q_ref: [q_block, D]; k_ref/v_ref: [Sk, D]; o_ref: [q_block, D].
+    """
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32)
+    scale = q.shape[-1] ** -0.5
+    q = q * scale
+
+    m0 = jnp.full((q_block, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((q_block, 1), dtype=jnp.float32)
+    o0 = jnp.zeros((q_block, q.shape[-1]), dtype=jnp.float32)
+
+    num_kv_blocks = kv_len // block_kv
+    if causal:
+        # only kv blocks up to and including the diagonal participate
+        last_block = ((qi + 1) * q_block + block_kv - 1) // block_kv
+        num_iter = jnp.minimum(num_kv_blocks, last_block)
+    else:
+        num_iter = num_kv_blocks
+
+    def body(ki, carry):
+        m, l, o = carry
+        k_blk = k_ref[pl.ds(ki * block_kv, block_kv), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(ki * block_kv, block_kv), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [qb, kb]
+        if causal:
+            qpos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_kv), 0)
+            kpos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_kv), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = alpha * o + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l_new, o_new
+
+    m, l, o = jax.lax.fori_loop(0, num_iter, body, (m0, l0, o0))
+    o_ref[:] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_kv", "interpret"))
+def flash_attention(
+    q: jnp.ndarray,  # [B, H, Sq, D]
+    k: jnp.ndarray,  # [B, H, Sk, D]
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_q = min(block_q, Sq)
+    block_kv = min(block_kv, Sk)
+    if Sq % block_q or Sk % block_kv:
+        raise ValueError(f"seq lens ({Sq},{Sk}) must be multiples of blocks ({block_q},{block_kv})")
+    if block_q % 8 or block_kv % 8 or D % 128 and D != 64:
+        # Mosaic requires (8,128)-tile-aligned loads; reject early with a clear error
+        # instead of a deep compiler failure.  Callers pad to a bucket first.
+        raise ValueError(
+            f"flash_attention needs 8-aligned seq blocks and head_dim 64/128k, got "
+            f"blocks=({block_q},{block_kv}), head_dim={D}; pad sequences to a multiple of 8"
+        )
+
+    qf = q.reshape(B * H, Sq, D)
+    kf = k.reshape(B * H, Sk, D)
+    vf = v.reshape(B * H, Sk, D)
+
+    kernel = functools.partial(
+        _flash_kernel, kv_len=Sk, block_kv=block_kv, causal=causal, q_block=block_q
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, Sk, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, Sk, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, Sq, D)
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    mask: Optional[jnp.ndarray] = None,
+    q_offset: int | jnp.ndarray = 0,
+) -> jnp.ndarray:
+    """Dispatch: pallas flash kernel on TPU for long un-masked sequences, jnp otherwise.
+
+    Decode steps (Sq==1) and padded/masked batches use the jnp path — at those shapes
+    the projections dominate and XLA's fused softmax is already bandwidth-optimal.
+    """
+    D = q.shape[-1]
+    use_flash = (
+        jax.default_backend() == "tpu"
+        and mask is None
+        and q.shape[2] >= 256
+        and q.shape[2] % 128 == 0
+        and k.shape[2] % 128 == 0
+        and (D == 64 or D % 128 == 0)
+        and isinstance(q_offset, int)
+        and q_offset == 0
+    )
+    if use_flash:
+        return flash_attention(q, k, v, causal=causal)
+    return dot_product_attention(q, k, v, causal=causal, mask=mask, q_offset=q_offset)

@@ -1,0 +1,38 @@
+"""Rotary position embeddings (Llama-3 family, incl. the 500k theta variant).
+
+Frequencies are precomputed once on host and live in HBM; application is two fused
+elementwise multiplies — XLA folds them into the QK projection epilogue.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(
+    head_dim: int, max_len: int, theta: float = 500_000.0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Return (cos, sin) tables of shape [max_len, head_dim//2] in float32."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    t = np.arange(max_len, dtype=np.float64)
+    freqs = np.outer(t, inv_freq)
+    return np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [..., seq, heads, head_dim]
+    cos: jnp.ndarray,  # [seq, head_dim//2] (already gathered at positions)
+    sin: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rotate pairs (x[..i], x[..i+D/2]) — the half-split ("rotate_half") convention
+    HF Llama safetensors use.  Checkpoints in the interleaved GPT-J/NeoX layout must
+    be permuted at load time.  ``cos``/``sin`` broadcast over heads."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    # cos/sin: [seq, hd/2] -> [seq, 1, hd/2] to broadcast over the heads axis.
+    c = cos[..., :, None, :]
+    s = sin[..., :, None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

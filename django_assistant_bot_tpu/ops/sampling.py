@@ -1,0 +1,56 @@
+"""Shape-static token sampling (temperature / top-k / top-p) for the decode loop.
+
+The reference samples via torch ``generate(do_sample=True, top_p=0.95, top_k=50)``
+(reference: assistant/ai/providers/transformers.py:61-68).  Here sampling lives inside
+the jit'd decode step: all ops are static-shape (sort + cumsum masking), so the whole
+prefill→decode loop stays on-device with no host round-trip per token.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .attention import NEG_INF
+
+
+def sample_logits(
+    logits: jnp.ndarray,  # [batch, vocab] float
+    rng: jax.Array,
+    *,
+    temperature: jnp.ndarray | float = 1.0,  # [batch] or scalar; <=0 means greedy
+    top_k: int = 50,
+    top_p: jnp.ndarray | float = 0.95,  # [batch] or scalar
+) -> jnp.ndarray:
+    """Returns sampled token ids [batch] (int32).
+
+    Greedy is expressed per-row via temperature<=0 so one compiled fn serves mixed
+    batches (continuous batching requirement: different requests, one XLA program).
+    """
+    logits = logits.astype(jnp.float32)
+    temperature = jnp.asarray(temperature, dtype=jnp.float32)
+    temperature = jnp.broadcast_to(temperature, (logits.shape[0],))
+    top_p = jnp.broadcast_to(jnp.asarray(top_p, dtype=jnp.float32), (logits.shape[0],))
+
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+
+    if top_k and top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+
+    # top-p: sort desc, keep minimal prefix with cumprob <= p (always keep argmax)
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    keep_sorted = (cum - sorted_probs) < top_p[:, None]  # first token always kept
+    # threshold = smallest kept logit
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    scaled = jnp.where(scaled < threshold, NEG_INF, scaled)
+
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy_ids)

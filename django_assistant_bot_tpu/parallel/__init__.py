@@ -1,0 +1,22 @@
+"""Device-mesh and sharding plane.
+
+The reference has no device parallelism at all (SURVEY.md §2.3 — its "distributed"
+substrate is Celery+Redis and HTTP). Here parallelism is first-class: every model in
+:mod:`~django_assistant_bot_tpu.models` is defined against a named
+:class:`jax.sharding.Mesh` with axes ``("data", "seq", "model", "expert")`` and XLA
+collectives over ICI do the communication.
+"""
+
+from .mesh import (  # noqa: F401
+    MeshAxes,
+    best_mesh_shape,
+    get_mesh,
+    make_mesh,
+    local_device_count,
+)
+from .sharding import (  # noqa: F401
+    logical_to_pspec,
+    named_sharding,
+    shard_pytree,
+    with_constraint,
+)

@@ -1,0 +1,141 @@
+"""Mesh construction over TPU slices (and CPU fake meshes for tests).
+
+Replaces the reference's device discovery ``get_torch_device()`` cuda->mps->cpu
+(reference: assistant/ai/utils/transformers.py:9-22) with JAX mesh bootstrap: a single
+code path that works on one chip, a v5e-8 slice, or an 8-device fake CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``) used by the test suite.
+
+Axis conventions (aligned with the scaling-book recipe):
+
+- ``data``   — batch-dimension sharding (DP).  Collectives: psum of grads.
+- ``seq``    — sequence/context parallelism (ring attention rides this axis over ICI).
+- ``model``  — tensor parallelism of attention heads / MLP hidden (TP).
+- ``expert`` — expert parallelism for MoE layers (EP); folded into ``model`` when the
+  mesh is too small to give it its own axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+EXPERT_AXIS = "expert"
+
+AXIS_ORDER = (DATA_AXIS, SEQ_AXIS, MODEL_AXIS, EXPERT_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxes:
+    """A concrete mesh shape over the four logical axes."""
+
+    data: int = 1
+    seq: int = 1
+    model: int = 1
+    expert: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.data * self.seq * self.model * self.expert
+
+    def as_tuple(self) -> tuple[int, int, int, int]:
+        return (self.data, self.seq, self.model, self.expert)
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def best_mesh_shape(
+    n_devices: int,
+    *,
+    want_model: int = 1,
+    want_seq: int = 1,
+    want_expert: int = 1,
+) -> MeshAxes:
+    """Choose a mesh shape for ``n_devices``: satisfy the requested model/seq/expert
+    degrees (clamped to what divides ``n_devices``) and give the remainder to data.
+    """
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+
+    def clamp(want: int, available: int) -> int:
+        want = max(1, min(want, available))
+        while available % want != 0:
+            want -= 1
+        return want
+
+    model = clamp(want_model, n_devices)
+    rest = n_devices // model
+    seq = clamp(want_seq, rest)
+    rest //= seq
+    expert = clamp(want_expert, rest)
+    rest //= expert
+    return MeshAxes(data=rest, seq=seq, model=model, expert=expert)
+
+
+def make_mesh(
+    axes: Optional[MeshAxes] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a 4-axis :class:`Mesh`.
+
+    Device order matters for ICI locality: ``model`` (the chattiest axis — per-layer
+    all-reduces) is the innermost/fastest-varying axis so TP collectives ride
+    neighbouring ICI links; ``data`` is outermost (gradient/batch collectives are the
+    least frequent and can span DCN in multi-host deployments).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = best_mesh_shape(len(devices))
+    if axes.total != len(devices):
+        raise ValueError(
+            f"Mesh shape {axes} needs {axes.total} devices, have {len(devices)}"
+        )
+    dev_array = np.array(devices).reshape(axes.as_tuple())
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+_default_mesh_lock = threading.Lock()
+_default_mesh: Optional[Mesh] = None
+
+
+def get_mesh(
+    *,
+    want_model: Optional[int] = None,
+    want_seq: int = 1,
+    want_expert: int = 1,
+    refresh: bool = False,
+) -> Mesh:
+    """Process-wide default mesh (lazily built, thread-safe).
+
+    ``want_model`` defaults to the env var ``DABT_MODEL_PARALLEL`` or 1.  Serving code
+    calls this once at startup; tests build explicit meshes via :func:`make_mesh`.
+    """
+    global _default_mesh
+    with _default_mesh_lock:
+        if _default_mesh is None or refresh:
+            if want_model is None:
+                want_model = int(os.environ.get("DABT_MODEL_PARALLEL", "1"))
+            n = local_device_count()
+            axes = best_mesh_shape(
+                n, want_model=want_model, want_seq=want_seq, want_expert=want_expert
+            )
+            _default_mesh = make_mesh(axes)
+        return _default_mesh
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Round ``n`` up to a multiple (used to keep batch/seq dims divisible by mesh axes
+    and by the (8,128)/(16,128) TPU tile shapes)."""
+    return int(math.ceil(n / multiple) * multiple)

@@ -1,0 +1,95 @@
+"""Logical-axis → PartitionSpec mapping and pytree sharding helpers.
+
+Models annotate every parameter with *logical* axis names (e.g. ``("embed", "mlp")``);
+this module maps them to mesh axes and produces :class:`NamedSharding` trees that
+``jax.jit``'s ``in_shardings``/``out_shardings`` consume.  This is the scaling-book
+recipe: pick a mesh, annotate shardings, let XLA insert the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS
+
+# Default logical→mesh mapping.  "heads"/"mlp"/"vocab_out" shard over the TP axis;
+# "expert" over EP; "batch" over DP; "length" over SP.  Everything else replicates.
+DEFAULT_RULES: Mapping[str, Optional[str]] = {
+    "batch": DATA_AXIS,
+    "length": SEQ_AXIS,
+    "heads": MODEL_AXIS,
+    "kv_heads": MODEL_AXIS,
+    "mlp": MODEL_AXIS,
+    "vocab_out": MODEL_AXIS,
+    "expert": EXPERT_AXIS,
+    "embed": None,
+    "head_dim": None,
+    "vocab_in": None,
+    "pos": None,
+}
+
+
+def logical_to_pspec(
+    logical_axes: tuple[Optional[str], ...],
+    rules: Mapping[str, Optional[str]] = DEFAULT_RULES,
+) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec."""
+    return P(*(rules.get(a) if a is not None else None for a in logical_axes))
+
+
+def named_sharding(
+    mesh: Mesh,
+    logical_axes: tuple[Optional[str], ...],
+    rules: Mapping[str, Optional[str]] = DEFAULT_RULES,
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_pspec(logical_axes, rules))
+
+
+def tree_pspecs(logical_tree: Any, rules: Mapping[str, Optional[str]] = DEFAULT_RULES):
+    """Map a pytree whose leaves are logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: logical_to_pspec(axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def tree_shardings(
+    mesh: Mesh, logical_tree: Any, rules: Mapping[str, Optional[str]] = DEFAULT_RULES
+):
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_pspec(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def shard_pytree(
+    params: Any,
+    logical_tree: Any,
+    mesh: Mesh,
+    rules: Mapping[str, Optional[str]] = DEFAULT_RULES,
+):
+    """Device-put a parameter pytree according to its logical axis annotations.
+
+    Host→HBM transfer happens once here; afterwards jit-compiled steps consume the
+    already-resident sharded arrays (minimising host↔device traffic, the usual HBM
+    bottleneck — see SURVEY.md §7 hard parts).
+    """
+    shardings = tree_shardings(mesh, logical_tree, rules)
+    return jax.device_put(params, shardings)
+
+
+def with_constraint(
+    x: jax.Array,
+    logical_axes: tuple[Optional[str], ...],
+    rules: Mapping[str, Optional[str]] = DEFAULT_RULES,
+) -> jax.Array:
+    """`with_sharding_constraint` by logical axis names (no-op outside jit/mesh)."""
+    try:
+        return jax.lax.with_sharding_constraint(x, logical_to_pspec(logical_axes, rules))
+    except (ValueError, RuntimeError):
+        return x

@@ -1,0 +1,47 @@
+"""Test bootstrap: force an 8-device fake CPU mesh BEFORE jax is imported anywhere.
+
+This is the multi-chip test strategy SURVEY.md §4 calls for: the reference tests its
+distributed (Celery) path by direct function invocation; we do better — every sharding
+test runs against a real 8-device mesh with XLA collectives, on CPU.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon sitecustomize force-registers the TPU plugin and overrides jax_platforms
+# via jax.config — env vars alone are not enough; override the config back before any
+# backend initialisation.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from django_assistant_bot_tpu.parallel import best_mesh_shape, make_mesh
+
+    n = len(jax.devices())
+    return make_mesh(best_mesh_shape(n, want_model=2, want_seq=2))
+
+
+@pytest.fixture()
+def tmp_db(tmp_path, monkeypatch):
+    """Fresh sqlite database per test."""
+    db_path = tmp_path / "dabt.sqlite3"
+    monkeypatch.setenv("DABT_DB_PATH", str(db_path))
+    from django_assistant_bot_tpu.storage import db
+
+    db.reset_default_database()
+    yield db.get_database()
+    db.reset_default_database()

@@ -1,0 +1,181 @@
+"""Model correctness: parity vs HF transformers (torch CPU) + decode/forward agreement.
+
+This is the test style SURVEY.md §4 prescribes adapted to the model plane: real
+checkpoints are too big for CI, so tiny randomly-initialised HF models are saved to
+disk and loaded through the production safetensors loader — the full load→convert→
+forward path runs for real, only the scale is fake.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from django_assistant_bot_tpu.models import DecoderConfig, EncoderConfig, encoder, llama
+from django_assistant_bot_tpu.models.hf_loader import load_decoder, load_encoder
+
+
+@pytest.fixture(scope="module")
+def tiny_bert_dir(tmp_path_factory):
+    from transformers import BertConfig, BertModel
+
+    cfg = BertConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=64,
+        max_position_embeddings=64,
+    )
+    model = BertModel(cfg)
+    model.eval()
+    d = tmp_path_factory.mktemp("tiny_bert")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
+@pytest.fixture(scope="module")
+def tiny_llama_dir(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    d = tmp_path_factory.mktemp("tiny_llama")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
+def test_encoder_matches_hf(tiny_bert_dir):
+    import torch
+
+    d, hf_model = tiny_bert_dir
+    cfg, params = load_encoder(d, dtype=jnp.float32)
+    ids = np.array([[5, 9, 17, 3, 0, 0], [8, 2, 0, 0, 0, 0]], np.int32)
+    mask = np.array([[1, 1, 1, 1, 0, 0], [1, 1, 0, 0, 0, 0]], np.int32)
+
+    with torch.no_grad():
+        hf_out = hf_model(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state.numpy()
+
+    ours = np.asarray(encoder.forward(params, cfg, jnp.asarray(ids), jnp.asarray(mask)))
+    # padding positions diverge (we don't mask them out of the residual stream) —
+    # compare only real tokens
+    for b in range(ids.shape[0]):
+        n = mask[b].sum()
+        np.testing.assert_allclose(ours[b, :n], hf_out[b, :n], atol=2e-4, rtol=1e-3)
+
+
+def test_encoder_encode_pools_and_normalizes(tiny_bert_dir):
+    d, _ = tiny_bert_dir
+    cfg, params = load_encoder(d, dtype=jnp.float32)
+    ids = jnp.asarray(np.random.default_rng(0).integers(1, 100, (3, 8)), jnp.int32)
+    mask = jnp.ones((3, 8), jnp.int32)
+    out = encoder.encode(params, cfg, ids, mask, normalize=True)
+    assert out.shape == (3, cfg.hidden_size)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(out), axis=-1), 1.0, atol=1e-5)
+
+
+def test_llama_matches_hf(tiny_llama_dir):
+    import torch
+
+    d, hf_model = tiny_llama_dir
+    cfg, params = load_decoder(d, dtype=jnp.float32)
+    ids = np.array([[1, 5, 9, 17, 3, 25, 7, 2]], np.int32)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(llama.forward(params, cfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=1e-3)
+
+
+def test_prefill_decode_matches_forward(tiny_llama_dir):
+    """Greedy generation via prefill+decode must equal repeated full forwards."""
+    d, _ = tiny_llama_dir
+    cfg, params = load_decoder(d, dtype=jnp.float32)
+    prompt = np.array([[1, 5, 9, 17, 3]], np.int32)
+    n_new = 6
+
+    # ground truth: repeated full forward, greedy
+    seq = prompt.copy()
+    for _ in range(n_new):
+        logits = llama.forward(params, cfg, jnp.asarray(seq))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        seq = np.concatenate([seq, [[nxt]]], axis=1)
+    expected = seq[0, prompt.shape[1]:].tolist()
+
+    # engine path: prefill into slot 0 of a 2-slot cache, then decode steps
+    cache = llama.init_cache(cfg, batch=2, max_len=32, dtype=jnp.float32)
+    lengths = jnp.asarray([prompt.shape[1]], jnp.int32)
+    logits, ks, vs = llama.prefill(params, cfg, jnp.asarray(prompt), lengths)
+    cache = llama.insert_sequences(cache, ks, vs, lengths, jnp.asarray([0], jnp.int32))
+    got = []
+    tok = int(jnp.argmax(logits[0]))
+    got.append(tok)
+    tokens = jnp.zeros((2,), jnp.int32)
+    active = jnp.asarray([True, False])
+    for _ in range(n_new - 1):
+        tokens = tokens.at[0].set(tok)
+        logits, cache = llama.decode_step(params, cfg, tokens, cache, active=active)
+        tok = int(jnp.argmax(logits[0]))
+        got.append(tok)
+    assert got == expected
+
+
+def test_sharded_forward_matches_single_device(tiny_llama_dir, mesh8):
+    from django_assistant_bot_tpu.models.llama import logical_axes
+    from django_assistant_bot_tpu.parallel import shard_pytree
+
+    d, _ = tiny_llama_dir
+    cfg, params = load_decoder(d, dtype=jnp.float32)
+    ids = jnp.asarray(np.random.default_rng(1).integers(1, 100, (4, 16)), jnp.int32)
+    ref = np.asarray(llama.forward(params, cfg, ids))
+
+    with mesh8:
+        sharded = shard_pytree(params, logical_axes(cfg), mesh8)
+        out = jax.jit(lambda p, i: llama.forward(p, cfg, i))(sharded, ids)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=1e-3)
+
+
+def test_moe_forward_matches_hf_mixtral(tmp_path):
+    """Capacity set high enough that no token drops -> exact parity with HF."""
+    import torch
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    hf_cfg = MixtralConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=64,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        rope_theta=10000.0,
+        max_position_embeddings=128,
+    )
+    model = MixtralForCausalLM(hf_cfg)
+    model.eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg, params = load_decoder(str(tmp_path), dtype=jnp.float32)
+    # no-drop capacity: every token could route to the same expert
+    cfg = DecoderConfig(**{**cfg.__dict__, "expert_capacity_factor": float(cfg.num_experts)})
+    assert cfg.is_moe
+    ids = np.array([[1, 5, 9, 17, 3, 25]], np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(llama.forward(params, cfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, atol=5e-4, rtol=1e-3)

@@ -1,0 +1,88 @@
+"""Ops correctness: flash kernel vs reference, ring attention on the 8-device mesh,
+sampling semantics, norms/rope vs straightforward numpy."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from django_assistant_bot_tpu.ops import (
+    dot_product_attention,
+    flash_attention,
+    layer_norm,
+    ring_attention,
+    rms_norm,
+    sample_logits,
+)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    B, H, S, D = 2, 4, 256, 64
+    mk = lambda: jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(qkv, causal):
+    q, k, v = qkv
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(qkv, mesh8, causal):
+    q, k, v = qkv
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh8, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
+def test_decode_attention_with_offset():
+    """q_offset makes single-token decode equal the last row of full attention."""
+    rng = np.random.default_rng(1)
+    B, H, S, D = 1, 2, 16, 8
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    full = dot_product_attention(q, k, v, causal=True)
+    last = dot_product_attention(q[:, :, -1:], k, v, causal=True, q_offset=S - 1)
+    np.testing.assert_allclose(np.asarray(last[:, :, 0]), np.asarray(full[:, :, -1]), atol=1e-5)
+
+
+def test_sample_greedy_and_temperature():
+    logits = jnp.asarray([[0.0, 5.0, 1.0], [9.0, 0.0, 0.0]], jnp.float32)
+    toks = sample_logits(logits, jax.random.key(0), temperature=0.0, top_k=0, top_p=1.0)
+    assert toks.tolist() == [1, 0]
+    # mixed greedy/sampled batch compiles as one call
+    toks = sample_logits(
+        logits, jax.random.key(0), temperature=jnp.asarray([0.0, 1.0]), top_k=2, top_p=0.9
+    )
+    assert toks[0] == 1
+
+
+def test_top_p_restricts_support():
+    # one dominant token, p small -> always that token even at high temperature
+    logits = jnp.asarray([[10.0, 0.0, 0.0, 0.0]], jnp.float32)
+    for i in range(5):
+        t = sample_logits(logits, jax.random.key(i), temperature=2.0, top_k=0, top_p=0.5)
+        assert t.tolist() == [0]
+
+
+def test_norms_match_numpy():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(3, 7, 16)).astype(np.float32)
+    w = rng.normal(size=(16,)).astype(np.float32)
+    b = rng.normal(size=(16,)).astype(np.float32)
+
+    rms = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-5) * w
+    np.testing.assert_allclose(np.asarray(rms_norm(jnp.asarray(x), jnp.asarray(w))), rms, atol=1e-5)
+
+    mu, var = x.mean(-1, keepdims=True), x.var(-1, keepdims=True)
+    ln = (x - mu) / np.sqrt(var + 1e-12) * w + b
+    np.testing.assert_allclose(
+        np.asarray(layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))), ln, atol=1e-4
+    )
