@@ -1,0 +1,18 @@
+"""CLI plane — the reference's ``manage.py`` command surface, argparse edition.
+
+Commands (reference: SURVEY.md §2 item 21):
+
+- ``serve``         — run the TPU model server (replaces gunicorn+gpu_service)
+- ``chat``          — interactive console bot REPL
+- ``search``        — RAG search over the vector store
+- ``emb_test``      — embedding similarity probe
+- ``load_csv``      — CSV -> wiki document import
+- ``queue``         — task-queue inspection (list/clear/remove)
+- ``worker``        — run task-plane workers
+- ``telegram_poll`` — Telegram long polling
+- ``tester``        — AI-vs-AI dialog simulator + analyzer
+
+``python -m django_assistant_bot_tpu.cli <command> ...``
+"""
+
+from .main import main  # noqa: F401

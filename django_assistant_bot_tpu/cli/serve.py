@@ -1,0 +1,37 @@
+"""``serve`` — run the TPU model server (replaces the reference's
+``gunicorn -c gunicorn_conf.py main:app`` gpu_service entry)."""
+
+from __future__ import annotations
+
+
+def add_parser(sub):
+    p = sub.add_parser("serve", help="run the TPU model server")
+    p.add_argument("--config", help="TOML/JSON model config file", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=11435)
+    p.add_argument(
+        "--tiny",
+        action="store_true",
+        help="serve tiny random models (dev/testing without checkpoints)",
+    )
+    return p
+
+
+def run(args) -> int:
+    from ..serving.registry import ModelRegistry
+    from ..serving.server import load_config_file, run_server
+
+    if args.tiny:
+        registry = ModelRegistry.from_config(
+            {
+                "tiny-emb": {"kind": "encoder", "tiny": True, "normalize": False},
+                "tiny-chat": {"kind": "decoder", "tiny": True, "max_slots": 4, "max_seq_len": 256},
+            }
+        )
+    elif args.config:
+        registry = ModelRegistry.from_config(load_config_file(args.config))
+    else:
+        print("need --config or --tiny")
+        return 2
+    run_server(host=args.host, port=args.port, registry=registry)
+    return 0

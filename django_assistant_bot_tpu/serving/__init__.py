@@ -1,0 +1,19 @@
+"""TPU serving plane — the replacement for the reference's ``gpu_service``.
+
+The reference serves models from a FastAPI app with per-gunicorn-worker torch model
+replicas, an unbatched embedding loop, and single-stream ``generate``
+(reference: gpu_service/main.py:52-107, gpu_service/gunicorn_conf.py:9-16,
+assistant/ai/embedders/transformers.py:15-29 — SURVEY.md §3.3 calls out both
+deficiencies).  This plane is one process driving the whole TPU slice:
+
+- :mod:`.tokenizer` — HF tokenizer wrapper + byte-level fallback, chat templating;
+- :mod:`.engine`    — continuous-batching generation engine (slot-based KV cache,
+  bucketed prefill, jit decode tick) and a coalescing batched embedding engine;
+- :mod:`.registry`  — model registry loading checkpoints onto the mesh;
+- :mod:`.server`    — aiohttp app exposing the reference's exact HTTP contract
+  (``POST /embeddings/``, ``POST /dialog/``).
+"""
+
+from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer  # noqa: F401
+from .engine import EmbeddingEngine, GenerationEngine, GenerationResult  # noqa: F401
+from .registry import ModelRegistry, ModelSpec  # noqa: F401

@@ -1,0 +1,450 @@
+"""Continuous-batching generation engine + coalescing embedding engine.
+
+This is the serving-side fix for the two deficiencies SURVEY.md §3.3 flags in the
+reference's gpu_service: the unbatched per-text embedding loop
+(assistant/ai/embedders/transformers.py:15-29) and single-stream ``generate`` with no
+KV-cache reuse across requests (assistant/ai/providers/transformers.py:35-94).
+
+Design (TPU-first):
+
+- **Slot-based continuous batching.**  A fixed-size KV cache (``max_slots`` rows)
+  lives in HBM.  New requests are prefilled on their own small batch (bucketed
+  sequence lengths — a handful of compiled shapes, no dynamic shapes ever), then
+  their K/V rows are inserted into free slots; one jit'd ``decode_tick`` advances
+  *all* live slots a token per call.  Requests join and leave the batch without
+  recompilation or disturbing other streams.
+- **Sampling on device.**  temperature/top-p ride as [slots] arrays inside the tick;
+  only sampled token ids (a few ints) cross back to host per step.
+- **Cache donation.**  The decode tick donates the cache buffers, so XLA updates the
+  multi-GB cache in place instead of copying.
+- **Dedicated engine thread.**  Device steps are blocking; the engine runs them on
+  its own thread and talks to asyncio via thread-safe futures, so the HTTP event
+  loop never stalls (the reference instead forked gunicorn workers with a full model
+  replica each — gpu_service/gunicorn_conf.py:9).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import DecoderConfig, EncoderConfig, encoder, llama
+from ..ops.sampling import sample_logits
+from .tokenizer import Tokenizer
+
+logger = logging.getLogger(__name__)
+
+PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+def pick_bucket(n: int, buckets: Sequence[int], cap: int) -> int:
+    for b in buckets:
+        if n <= b and b <= cap:
+            return b
+    return cap
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    token_ids: List[int]
+    text: str
+    prompt_tokens: int
+    completion_tokens: int
+    length_limited: bool
+    ttft_s: float = 0.0
+    latency_s: float = 0.0
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt_ids: List[int]
+    max_tokens: int
+    temperature: float
+    top_p: float
+    future: Future
+    submitted_at: float
+    first_token_at: Optional[float] = None
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: _Request
+    generated: List[int] = dataclasses.field(default_factory=list)
+
+
+class GenerationEngine:
+    """Continuous-batching decode engine over one decoder model."""
+
+    def __init__(
+        self,
+        cfg: DecoderConfig,
+        params,
+        tokenizer: Tokenizer,
+        *,
+        max_slots: int = 8,
+        max_seq_len: Optional[int] = None,
+        top_k: int = 50,
+        prefill_buckets: Sequence[int] = PREFILL_BUCKETS,
+        idle_poll_s: float = 0.002,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_slots = max_slots
+        self.max_seq_len = int(min(max_seq_len or cfg.max_seq_len, cfg.max_seq_len))
+        self.top_k = top_k
+        self.prefill_buckets = tuple(b for b in prefill_buckets if b <= self.max_seq_len) or (
+            self.max_seq_len,
+        )
+        self.idle_poll_s = idle_poll_s
+
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._slots: List[Optional[_Slot]] = [None] * max_slots
+        self._cache = llama.init_cache(cfg, max_slots, self.max_seq_len)
+        self._tokens = np.zeros((max_slots,), np.int32)
+        self._temps = np.zeros((max_slots,), np.float32)
+        self._top_ps = np.ones((max_slots,), np.float32)
+        self._rng = jax.random.key(0)
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self.steps = 0
+
+        cfg_c = cfg
+        top_k_c = top_k
+
+        def _decode_tick(params, tokens, cache, active, temps, top_ps, rng):
+            logits, cache = llama.decode_step(params, cfg_c, tokens, cache, active=active)
+            nxt = sample_logits(
+                logits, rng, temperature=temps, top_k=top_k_c, top_p=top_ps
+            )
+            return nxt, cache
+
+        # donate the cache (argnum 2) — in-place HBM update, no copy
+        self._decode_tick = jax.jit(_decode_tick, donate_argnums=(2,))
+
+        def _prefill(params, ids, lengths):
+            return llama.prefill(params, cfg_c, ids, lengths)
+
+        self._prefill = jax.jit(_prefill)
+        # donate the cache here too: slot insertion is a scatter into HBM, not a copy
+        self._insert = jax.jit(llama.insert_sequences, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ public
+    def start(self) -> "GenerationEngine":
+        if self._running:
+            return self
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="gen-engine")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        *,
+        max_tokens: int = 1024,
+        temperature: float = 0.8,
+        top_p: float = 0.95,
+    ) -> Future:
+        """Thread-safe submission; returns a concurrent Future[GenerationResult]."""
+        prompt_ids = list(prompt_ids)
+        # keep room for at least one generated token
+        limit = self.max_seq_len - 1
+        if len(prompt_ids) > limit:
+            prompt_ids = prompt_ids[-limit:]
+        fut: Future = Future()
+        self._queue.put(
+            _Request(
+                prompt_ids=prompt_ids,
+                max_tokens=max_tokens,
+                temperature=temperature,
+                top_p=top_p,
+                future=fut,
+                submitted_at=time.monotonic(),
+            )
+        )
+        return fut
+
+    async def generate(
+        self,
+        prompt: str | Sequence[dict],
+        *,
+        max_tokens: int = 1024,
+        temperature: float = 0.8,
+        top_p: float = 0.95,
+    ) -> GenerationResult:
+        """Async convenience: tokenize (chat-templating message lists), run, decode."""
+        import asyncio
+
+        if isinstance(prompt, str):
+            text = prompt
+        else:
+            text = self.tokenizer.apply_chat(prompt)
+        ids = self.tokenizer.encode(text)
+        fut = self.submit(
+            ids, max_tokens=max_tokens, temperature=temperature, top_p=top_p
+        )
+        return await asyncio.wrap_future(fut)
+
+    @property
+    def num_active(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    # ---------------------------------------------------------------- internal
+    def _free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _loop(self):
+        while self._running:
+            try:
+                admitted = self._admit()
+                if self.num_active == 0:
+                    if not admitted:
+                        time.sleep(self.idle_poll_s)
+                    continue
+                self._tick()
+            except Exception:
+                logger.exception("engine loop error; failing active requests")
+                self._fail_all()
+
+    def _admit(self) -> bool:
+        admitted = False
+        free = self._free_slots()
+        while free and not self._queue.empty():
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req.future.cancelled():
+                continue
+            slot = free.pop(0)
+            self._start_request(slot, req)
+            admitted = True
+        return admitted
+
+    def _start_request(self, slot: int, req: _Request):
+        n = len(req.prompt_ids)
+        bucket = pick_bucket(n, self.prefill_buckets, self.max_seq_len)
+        ids = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+        ids[0, :n] = req.prompt_ids
+        lengths = jnp.asarray([n], jnp.int32)
+        logits, ks, vs = self._prefill(self.params, jnp.asarray(ids), lengths)
+        self._cache = self._insert(
+            self._cache, ks, vs, lengths, jnp.asarray([slot], jnp.int32)
+        )
+        self._rng, sub = jax.random.split(self._rng)
+        first = sample_logits(
+            logits,
+            sub,
+            temperature=jnp.asarray([req.temperature], jnp.float32),
+            top_k=self.top_k,
+            top_p=jnp.asarray([req.top_p], jnp.float32),
+        )
+        tok = int(first[0])
+        req.first_token_at = time.monotonic()
+        s = _Slot(request=req)
+        s.generated.append(tok)
+        self._slots[slot] = s
+        self._tokens[slot] = tok
+        self._temps[slot] = req.temperature
+        self._top_ps[slot] = req.top_p
+        if self._should_finish(slot, tok):
+            self._finish(slot)
+
+    def _active_mask(self) -> np.ndarray:
+        return np.asarray([s is not None for s in self._slots])
+
+    def _tick(self):
+        self._rng, sub = jax.random.split(self._rng)
+        nxt, self._cache = self._decode_tick(
+            self.params,
+            jnp.asarray(self._tokens),
+            self._cache,
+            jnp.asarray(self._active_mask()),
+            jnp.asarray(self._temps),
+            jnp.asarray(self._top_ps),
+            sub,
+        )
+        self.steps += 1
+        nxt = np.asarray(nxt)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            tok = int(nxt[i])
+            s.generated.append(tok)
+            self._tokens[i] = tok
+            if self._should_finish(i, tok):
+                self._finish(i)
+
+    def _should_finish(self, slot: int, tok: int) -> bool:
+        s = self._slots[slot]
+        assert s is not None
+        if tok == self.tokenizer.eos_id:
+            return True
+        if len(s.generated) >= s.request.max_tokens:
+            return True
+        # cache full -> decode_step freezes the slot; finish as length-limited
+        if len(s.request.prompt_ids) + len(s.generated) >= self.max_seq_len:
+            return True
+        return False
+
+    def _finish(self, slot: int):
+        s = self._slots[slot]
+        assert s is not None
+        self._slots[slot] = None
+        req = s.request
+        ids = s.generated
+        hit_eos = bool(ids) and ids[-1] == self.tokenizer.eos_id
+        if hit_eos:
+            ids = ids[:-1]
+        now = time.monotonic()
+        result = GenerationResult(
+            token_ids=ids,
+            text=self.tokenizer.decode(ids),
+            prompt_tokens=len(req.prompt_ids),
+            completion_tokens=len(ids),
+            length_limited=not hit_eos,
+            ttft_s=(req.first_token_at or now) - req.submitted_at,
+            latency_s=now - req.submitted_at,
+        )
+        if not req.future.cancelled():
+            req.future.set_result(result)
+
+    def _fail_all(self):
+        err = RuntimeError("generation engine failure")
+        for i, s in enumerate(self._slots):
+            if s is not None and not s.request.future.cancelled():
+                s.request.future.set_exception(err)
+            self._slots[i] = None
+        # the cache may have been donated into a failed call — rebuild it
+        self._cache = llama.init_cache(self.cfg, self.max_slots, self.max_seq_len)
+
+
+class EmbeddingEngine:
+    """Batched, coalescing sentence-embedding engine over one encoder model.
+
+    Requests from concurrent callers coalesce into one device batch (bucketed seq
+    len, padded batch) — the docs/sec/chip fix for the reference's one-text-at-a-time
+    loop.
+    """
+
+    def __init__(
+        self,
+        cfg: EncoderConfig,
+        params,
+        tokenizer: Tokenizer,
+        *,
+        max_batch: int = 64,
+        seq_buckets: Sequence[int] = (32, 64, 128, 256, 512),
+        normalize: bool = False,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_batch = max_batch
+        self.seq_buckets = tuple(
+            b for b in seq_buckets if b <= cfg.max_position_embeddings
+        ) or (cfg.max_position_embeddings,)
+        self.normalize = normalize
+        self._queue: "queue.Queue[tuple[List[str], Future]]" = queue.Queue()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+        cfg_c, norm_c = cfg, normalize
+
+        def _encode(params, ids, mask):
+            return encoder.encode(params, cfg_c, ids, mask, normalize=norm_c)
+
+        self._encode = jax.jit(_encode)
+
+    def start(self) -> "EmbeddingEngine":
+        if self._running:
+            return self
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="emb-engine")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def embed_sync(self, texts: Sequence[str]) -> List[List[float]]:
+        """Blocking batched embed (used by the engine thread and CLI paths)."""
+        out: List[List[float]] = []
+        for i in range(0, len(texts), self.max_batch):
+            out.extend(self._embed_batch(list(texts[i : i + self.max_batch])))
+        return out
+
+    async def embed(self, texts: Sequence[str]) -> List[List[float]]:
+        import asyncio
+
+        if not texts:
+            return []
+        fut: Future = Future()
+        self._queue.put((list(texts), fut))
+        if not self._running:
+            self.start()
+        return await asyncio.wrap_future(fut)
+
+    # ---------------------------------------------------------------- internal
+    def _loop(self):
+        while self._running:
+            try:
+                texts, fut = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            # coalesce whatever else is waiting right now
+            jobs: List[tuple[List[str], Future]] = [(texts, fut)]
+            total = len(texts)
+            while total < self.max_batch:
+                try:
+                    t2, f2 = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                jobs.append((t2, f2))
+                total += len(t2)
+            flat = [t for ts, _ in jobs for t in ts]
+            try:
+                embs = self.embed_sync(flat)
+            except Exception as e:
+                for _, f in jobs:
+                    if not f.cancelled():
+                        f.set_exception(e)
+                continue
+            pos = 0
+            for ts, f in jobs:
+                if not f.cancelled():
+                    f.set_result(embs[pos : pos + len(ts)])
+                pos += len(ts)
+
+    def _embed_batch(self, texts: List[str]) -> List[List[float]]:
+        cap = self.seq_buckets[-1]
+        encoded = [self.tokenizer.encode(t)[:cap] for t in texts]
+        longest = max((len(e) for e in encoded), default=1)
+        bucket = pick_bucket(longest, self.seq_buckets, cap)
+        B = len(encoded)
+        ids = np.full((B, bucket), self.tokenizer.pad_id, np.int32)
+        mask = np.zeros((B, bucket), np.int32)
+        for i, e in enumerate(encoded):
+            ids[i, : len(e)] = e
+            mask[i, : len(e)] = 1
+        embs = self._encode(self.params, jnp.asarray(ids), jnp.asarray(mask))
+        return np.asarray(embs, np.float32).tolist()

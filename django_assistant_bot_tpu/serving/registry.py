@@ -1,0 +1,119 @@
+"""Model registry: spec -> loaded engine on the mesh.
+
+Replaces the reference's module-level model lists + lifespan loading loop
+(reference: gpu_service/models.py:1-9, gpu_service/main.py:57-70).  Differences:
+one process drives the whole slice (no per-worker replicas), params are sharded
+onto the mesh at load, and a ``tiny: true`` spec gives every test/dev environment a
+random-weights model with the byte tokenizer — no checkpoint assets needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, Mapping, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    name: str
+    kind: str  # "encoder" | "decoder"
+    path: Optional[str] = None  # HF checkpoint dir; None + tiny=True -> random tiny
+    tiny: bool = False
+    dtype: str = "bfloat16"
+    max_slots: int = 8
+    max_seq_len: Optional[int] = None
+    max_batch: int = 64
+    normalize: bool = False
+    num_experts: int = 0
+
+    @classmethod
+    def from_dict(cls, name: str, d: Mapping[str, Any]) -> "ModelSpec":
+        return cls(name=name, **{k: v for k, v in d.items() if k != "name"})
+
+
+class ModelRegistry:
+    """Loads and owns engines; lookup is lowercase (as the reference's dicts are)."""
+
+    def __init__(self, specs: Optional[Mapping[str, ModelSpec]] = None, mesh=None):
+        from ..parallel import get_mesh
+
+        self.mesh = mesh if mesh is not None else get_mesh()
+        self.specs: Dict[str, ModelSpec] = {}
+        self.embedders: Dict[str, Any] = {}
+        self.generators: Dict[str, Any] = {}
+        for spec in (specs or {}).values():
+            self.load(spec)
+
+    @classmethod
+    def from_config(cls, config: Mapping[str, Any], mesh=None) -> "ModelRegistry":
+        """``config`` maps model name -> spec dict (parsed from TOML/JSON)."""
+        specs = {
+            name.lower(): ModelSpec.from_dict(name.lower(), d)
+            for name, d in config.items()
+        }
+        return cls(specs, mesh=mesh)
+
+    def load(self, spec: ModelSpec):
+        import jax.numpy as jnp
+
+        from ..models import DecoderConfig, EncoderConfig, encoder, llama
+        from ..models.hf_loader import load_decoder, load_encoder
+        from ..parallel import shard_pytree
+        from .engine import EmbeddingEngine, GenerationEngine
+        from .tokenizer import load_tokenizer
+
+        name = spec.name.lower()
+        dtype = getattr(jnp, spec.dtype)
+        tokenizer = load_tokenizer(spec.path)
+        logger.info("loading model %r (%s, tiny=%s)", name, spec.kind, spec.tiny)
+
+        if spec.kind == "encoder":
+            if spec.path:
+                cfg, params = load_encoder(spec.path, dtype=dtype)
+            elif spec.tiny:
+                cfg = EncoderConfig.tiny()
+                params = encoder.init(cfg, jax.random.key(0))
+            else:
+                raise ValueError(f"model {name}: need path or tiny=true")
+            with self.mesh:
+                params = shard_pytree(params, encoder.logical_axes(cfg), self.mesh)
+            eng = EmbeddingEngine(
+                cfg, params, tokenizer, max_batch=spec.max_batch, normalize=spec.normalize
+            ).start()
+            self.embedders[name] = eng
+        elif spec.kind == "decoder":
+            if spec.path:
+                cfg, params = load_decoder(spec.path, dtype=dtype)
+            elif spec.tiny:
+                cfg = DecoderConfig.tiny(num_experts=spec.num_experts)
+                params = llama.init(cfg, jax.random.key(0))
+            else:
+                raise ValueError(f"model {name}: need path or tiny=true")
+            with self.mesh:
+                params = shard_pytree(params, llama.logical_axes(cfg), self.mesh)
+            eng = GenerationEngine(
+                cfg,
+                params,
+                tokenizer,
+                max_slots=spec.max_slots,
+                max_seq_len=spec.max_seq_len,
+            ).start()
+            self.generators[name] = eng
+        else:
+            raise ValueError(f"model {name}: unknown kind {spec.kind!r}")
+        self.specs[name] = spec
+
+    def stop(self):
+        for eng in list(self.embedders.values()) + list(self.generators.values()):
+            eng.stop()
+
+    def get_embedder(self, model: str):
+        return self.embedders.get(model.lower())
+
+    def get_generator(self, model: str):
+        return self.generators.get(model.lower())

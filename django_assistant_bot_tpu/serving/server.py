@@ -1,0 +1,153 @@
+"""TPU model server — the reference gpu_service's HTTP contract, aiohttp edition.
+
+Endpoint parity (reference: gpu_service/main.py:75-107):
+
+- ``POST /embeddings/`` ``{model, texts}`` -> ``{"embeddings": [[...], ...]}``
+- ``POST /dialog/`` ``{model, messages, max_tokens, json_format}`` ->
+  ``{"response": {"result": str, "usage": {...}, "length_limited": bool}}``
+- 400 "Model is not supported" for unknown models; 500 with detail on failure.
+
+Extras the reference lacks: ``GET /healthz`` (engine/slot stats) and ``GET /models``.
+One process, one mesh, engines shared across all requests — the continuous batcher
+gives cross-request batching instead of gunicorn worker replicas.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Mapping
+
+from aiohttp import web
+
+from .registry import ModelRegistry
+
+logger = logging.getLogger(__name__)
+
+REGISTRY_KEY: web.AppKey[ModelRegistry] = web.AppKey("registry", ModelRegistry)
+
+
+def create_app(registry: ModelRegistry) -> web.Application:
+    app = web.Application()
+    app[REGISTRY_KEY] = registry
+
+    async def embeddings(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            model, texts = body["model"], body["texts"]
+        except Exception:
+            return web.json_response({"detail": "invalid request"}, status=422)
+        eng = registry.get_embedder(model)
+        if eng is None:
+            return web.json_response({"detail": "Model is not supported"}, status=400)
+        try:
+            embs = await eng.embed(texts)
+            return web.json_response({"embeddings": embs})
+        except Exception as e:
+            logger.exception("embeddings failed")
+            return web.json_response({"detail": str(e)}, status=500)
+
+    async def dialog(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            model = body["model"]
+            messages = body["messages"]
+            max_tokens = int(body.get("max_tokens", 1024))
+            json_format = bool(body.get("json_format", False))
+            temperature = float(body.get("temperature", 0.8))
+            top_p = float(body.get("top_p", 0.95))
+        except Exception:
+            return web.json_response({"detail": "invalid request"}, status=422)
+        eng = registry.get_generator(model)
+        if eng is None:
+            return web.json_response({"detail": "Model is not supported"}, status=400)
+        if json_format:
+            # decoder-side JSON steering: the reference relies on provider-side
+            # retries (assistant/ai/providers/ollama.py:49-86); we also bias the
+            # prompt.  Greedy-ish sampling makes JSON far more reliable.
+            temperature = min(temperature, 0.2)
+        try:
+            result = await eng.generate(
+                messages, max_tokens=max_tokens, temperature=temperature, top_p=top_p
+            )
+            usage = {
+                "model": model,
+                "prompt_tokens": result.prompt_tokens,
+                "completion_tokens": result.completion_tokens,
+                "total_tokens": result.prompt_tokens + result.completion_tokens,
+                "ttft_s": result.ttft_s,
+                "latency_s": result.latency_s,
+            }
+            return web.json_response(
+                {
+                    "response": {
+                        "result": result.text,
+                        "usage": usage,
+                        "length_limited": result.length_limited,
+                    }
+                }
+            )
+        except Exception as e:
+            logger.exception("dialog failed")
+            return web.json_response({"detail": str(e)}, status=500)
+
+    async def healthz(request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "status": "ok",
+                "models": sorted(registry.specs),
+                "generators": {
+                    name: {"active_slots": eng.num_active, "steps": eng.steps}
+                    for name, eng in registry.generators.items()
+                },
+            }
+        )
+
+    async def models(request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                name: {"kind": spec.kind, "path": spec.path, "tiny": spec.tiny}
+                for name, spec in registry.specs.items()
+            }
+        )
+
+    app.router.add_post("/embeddings/", embeddings)
+    app.router.add_post("/embeddings", embeddings)
+    app.router.add_post("/dialog/", dialog)
+    app.router.add_post("/dialog", dialog)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/models", models)
+
+    async def on_cleanup(app):
+        registry.stop()
+
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def load_config_file(path: str) -> Mapping[str, Any]:
+    """TOML or JSON model config: ``[models.<name>] kind=... path=...``."""
+    import json
+
+    if path.endswith(".toml"):
+        import tomllib
+
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+    else:
+        with open(path) as f:
+            data = json.load(f)
+    return data.get("models", data)
+
+
+def run_server(
+    config_path: str | None = None,
+    *,
+    host: str = "0.0.0.0",
+    port: int = 11435,
+    registry: ModelRegistry | None = None,
+):
+    """Blocking entry (CLI ``serve``).  Default port matches the reference (11435)."""
+    if registry is None:
+        config = load_config_file(config_path) if config_path else {}
+        registry = ModelRegistry.from_config(config)
+    web.run_app(create_app(registry), host=host, port=port)

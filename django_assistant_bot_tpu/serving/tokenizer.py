@@ -1,0 +1,82 @@
+"""Tokenisation for the serving plane.
+
+Wraps HF fast tokenizers when a checkpoint directory ships one; falls back to a
+byte-level tokenizer (vocab 256 + specials) so every code path — engine, server,
+providers, tests — runs without any tokenizer asset.  Also owns chat-prompt
+construction: HF chat templates when available, else the reference's plain
+``"role: content"`` join (reference: assistant/ai/providers/transformers.py:50).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str) -> List[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+    def apply_chat(self, messages: Sequence[dict]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0..255 are bytes; 256=pad, 257=bos, 258=eos."""
+
+    vocab_size = 259
+    pad_id = 256
+    bos_id = 257
+    eos_id = 258
+
+    def encode(self, text: str) -> List[int]:
+        return [self.bos_id] + list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+
+    def apply_chat(self, messages: Sequence[dict]) -> str:
+        return render_plain_chat(messages)
+
+
+class HFTokenizer:
+    """Wrapper over a transformers fast tokenizer loaded from a model directory."""
+
+    def __init__(self, tok):
+        self._tok = tok
+        self.eos_id = tok.eos_token_id if tok.eos_token_id is not None else -1
+        pad = tok.pad_token_id
+        self.pad_id = pad if pad is not None else (self.eos_id if self.eos_id >= 0 else 0)
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def apply_chat(self, messages: Sequence[dict]) -> str:
+        if getattr(self._tok, "chat_template", None):
+            return self._tok.apply_chat_template(
+                list(messages), tokenize=False, add_generation_prompt=True
+            )
+        return render_plain_chat(messages)
+
+
+def render_plain_chat(messages: Sequence[dict]) -> str:
+    """The reference's prompt construction: newline-joined "role: content" plus a
+    trailing assistant cue (reference: assistant/ai/providers/transformers.py:50)."""
+    lines = [f"{m['role']}: {m['content']}" for m in messages]
+    lines.append("assistant:")
+    return "\n".join(lines)
+
+
+def load_tokenizer(model_dir: Optional[str]) -> Tokenizer:
+    """HF tokenizer if the directory has one, else the byte fallback."""
+    if model_dir:
+        try:
+            from transformers import AutoTokenizer
+
+            return HFTokenizer(AutoTokenizer.from_pretrained(model_dir))
+        except Exception:
+            pass
+    return ByteTokenizer()

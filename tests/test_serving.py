@@ -1,0 +1,203 @@
+"""Serving plane: continuous-batching engine semantics + HTTP contract parity.
+
+The HTTP tests assert the exact reference gpu_service contract
+(reference: gpu_service/main.py:75-107): request/response field names, 400 on
+unknown model, trailing-slash paths.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from django_assistant_bot_tpu.models import DecoderConfig, llama
+from django_assistant_bot_tpu.serving import (
+    ByteTokenizer,
+    EmbeddingEngine,
+    GenerationEngine,
+    ModelRegistry,
+)
+from django_assistant_bot_tpu.serving.server import create_app
+
+
+@pytest.fixture(scope="module")
+def tiny_gen_engine():
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(0))
+    eng = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=4, max_seq_len=96
+    ).start()
+    yield eng, cfg, params
+    eng.stop()
+
+
+def test_engine_greedy_matches_forward(tiny_gen_engine):
+    """Greedy engine output == repeated full-forward argmax (continuous batching
+    must not change the math)."""
+    eng, cfg, params = tiny_gen_engine
+    tok = ByteTokenizer()
+    prompt = tok.encode("hello world")
+    n_new = 5
+
+    seq = np.asarray([prompt], np.int32)
+    expected = []
+    for _ in range(n_new):
+        logits = llama.forward(params, cfg, jnp.asarray(seq))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        expected.append(nxt)
+        seq = np.concatenate([seq, [[nxt]]], axis=1)
+
+    fut = eng.submit(prompt, max_tokens=n_new, temperature=0.0)
+    result = fut.result(timeout=120)
+    assert result.token_ids == expected
+    assert result.prompt_tokens == len(prompt)
+    assert result.completion_tokens == n_new
+    assert result.length_limited  # no EOS in 5 greedy tokens of a random model
+
+
+def test_engine_concurrent_requests_batch(tiny_gen_engine):
+    """Multiple in-flight requests share the decode loop and all complete; greedy
+    determinism holds under batching (each request unaffected by slot-mates)."""
+    eng, cfg, params = tiny_gen_engine
+    tok = ByteTokenizer()
+    prompts = [tok.encode(t) for t in ["aa", "bbbb", "cc dd ee", "f", "gg hh", "iii"]]
+    futs = [eng.submit(p, max_tokens=6, temperature=0.0) for p in prompts]
+    results = [f.result(timeout=120) for f in futs]
+
+    for p, r in zip(prompts, results):
+        seq = np.asarray([p], np.int32)
+        for _ in range(6):
+            logits = llama.forward(params, cfg, jnp.asarray(seq))
+            seq = np.concatenate([seq, [[int(jnp.argmax(logits[0, -1]))]]], axis=1)
+        assert r.token_ids == seq[0, len(p):].tolist()
+    assert eng.num_active == 0
+
+
+def test_engine_length_limit_on_full_cache(tiny_gen_engine):
+    eng, cfg, params = tiny_gen_engine
+    prompt = list(range(1, 90))  # near max_seq_len=96
+    r = eng.submit(prompt, max_tokens=1000, temperature=0.0).result(timeout=120)
+    assert r.length_limited
+    assert len(prompt) + r.completion_tokens <= 96
+
+
+def test_engine_long_prompt_truncated(tiny_gen_engine):
+    eng, *_ = tiny_gen_engine
+    r = eng.submit(list(range(1, 200)), max_tokens=2, temperature=0.0).result(timeout=120)
+    assert r.prompt_tokens <= 95
+
+
+def test_embedding_engine_batches_and_coalesces():
+    from django_assistant_bot_tpu.models import EncoderConfig, encoder
+
+    cfg = EncoderConfig.tiny()
+    params = encoder.init(cfg, jax.random.key(1))
+    eng = EmbeddingEngine(cfg, params, ByteTokenizer(), max_batch=8, normalize=True).start()
+    try:
+        async def go():
+            return await asyncio.gather(
+                eng.embed(["alpha", "beta"]),
+                eng.embed(["gamma"]),
+                eng.embed(["delta", "epsilon", "zeta"]),
+            )
+
+        r1, r2, r3 = asyncio.run(go())
+        assert len(r1) == 2 and len(r2) == 1 and len(r3) == 3
+        for v in r1 + r2 + r3:
+            assert len(v) == cfg.hidden_size
+            assert abs(np.linalg.norm(v) - 1.0) < 1e-4
+        # same text embeds identically regardless of batch-mates
+        solo = eng.embed_sync(["beta"])[0]
+        np.testing.assert_allclose(solo, r1[1], atol=1e-5)
+    finally:
+        eng.stop()
+
+
+@pytest.fixture(scope="module")
+def http_client():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    loop = asyncio.new_event_loop()
+    registry = ModelRegistry.from_config(
+        {
+            "tiny-emb": {"kind": "encoder", "tiny": True, "normalize": True},
+            "tiny-chat": {"kind": "decoder", "tiny": True, "max_slots": 2, "max_seq_len": 64},
+        }
+    )
+    client = TestClient(TestServer(create_app(registry)), loop=loop)
+    loop.run_until_complete(client.start_server())
+    yield loop, client
+    loop.run_until_complete(client.close())
+    loop.close()
+
+
+def test_http_embeddings_contract(http_client):
+    loop, client = http_client
+
+    async def go():
+        resp = await client.post(
+            "/embeddings/", json={"model": "Tiny-EMB", "texts": ["hello", "world"]}
+        )
+        assert resp.status == 200
+        data = await resp.json()
+        assert set(data) == {"embeddings"}
+        assert len(data["embeddings"]) == 2
+
+        resp = await client.post("/embeddings/", json={"model": "nope", "texts": ["x"]})
+        assert resp.status == 400
+        assert (await resp.json())["detail"] == "Model is not supported"
+
+        resp = await client.post("/embeddings/", json={"texts": ["x"]})
+        assert resp.status == 422
+
+    loop.run_until_complete(go())
+
+
+def test_http_dialog_contract(http_client):
+    loop, client = http_client
+
+    async def go():
+        resp = await client.post(
+            "/dialog/",
+            json={
+                "model": "tiny-chat",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+                "json_format": False,
+            },
+        )
+        assert resp.status == 200
+        data = await resp.json()
+        r = data["response"]
+        assert set(r) >= {"result", "usage", "length_limited"}
+        assert isinstance(r["result"], str)
+        assert r["usage"]["completion_tokens"] <= 4
+        assert r["usage"]["total_tokens"] == (
+            r["usage"]["prompt_tokens"] + r["usage"]["completion_tokens"]
+        )
+
+        resp = await client.post(
+            "/dialog/", json={"model": "missing", "messages": [], "max_tokens": 1}
+        )
+        assert resp.status == 400
+
+    loop.run_until_complete(go())
+
+
+def test_http_healthz_and_models(http_client):
+    loop, client = http_client
+
+    async def go():
+        resp = await client.get("/healthz")
+        assert resp.status == 200
+        data = await resp.json()
+        assert data["status"] == "ok"
+        assert "tiny-chat" in data["models"]
+
+        resp = await client.get("/models")
+        assert (await resp.json())["tiny-emb"]["kind"] == "encoder"
+
+    loop.run_until_complete(go())
