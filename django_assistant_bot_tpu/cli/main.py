@@ -33,7 +33,7 @@ def main(argv=None) -> int:
         except ImportError as e:
             # plane not built yet / optional dep missing: register an erroring stub
             p = sub.add_parser(name, help=f"(unavailable: {e})")
-            p.set_defaults(func=lambda args, _e=e: _unavailable(name, _e))
+            p.set_defaults(func=lambda args, _e=e, _n=name: _unavailable(_n, _e))
             continue
         p = mod.add_parser(sub)
         p.set_defaults(func=mod.run)

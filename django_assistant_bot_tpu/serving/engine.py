@@ -46,6 +46,17 @@ logger = logging.getLogger(__name__)
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
 
+def _safe_resolve(fut: Future, *, result=None, exc: Optional[BaseException] = None):
+    """set_result/set_exception tolerant of a client cancelling concurrently."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+    except Exception:  # InvalidStateError: future was cancelled mid-flight
+        pass
+
+
 def pick_bucket(n: int, buckets: Sequence[int], cap: int) -> int:
     for b in buckets:
         if n <= b and b <= cap:
@@ -152,6 +163,17 @@ class GenerationEngine:
         if self._thread:
             self._thread.join(timeout=10)
             self._thread = None
+        err = RuntimeError("generation engine stopped")
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                _safe_resolve(s.request.future, exc=err)
+                self._slots[i] = None
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            _safe_resolve(req.future, exc=err)
 
     def submit(
         self,
@@ -192,10 +214,9 @@ class GenerationEngine:
         import asyncio
 
         if isinstance(prompt, str):
-            text = prompt
+            ids = self.tokenizer.encode(prompt)
         else:
-            text = self.tokenizer.apply_chat(prompt)
-        ids = self.tokenizer.encode(text)
+            ids = self.tokenizer.encode_chat(prompt)
         fut = self.submit(
             ids, max_tokens=max_tokens, temperature=temperature, top_p=top_p
         )
@@ -322,14 +343,13 @@ class GenerationEngine:
             ttft_s=(req.first_token_at or now) - req.submitted_at,
             latency_s=now - req.submitted_at,
         )
-        if not req.future.cancelled():
-            req.future.set_result(result)
+        _safe_resolve(req.future, result=result)
 
     def _fail_all(self):
         err = RuntimeError("generation engine failure")
         for i, s in enumerate(self._slots):
-            if s is not None and not s.request.future.cancelled():
-                s.request.future.set_exception(err)
+            if s is not None:
+                _safe_resolve(s.request.future, exc=err)
             self._slots[i] = None
         # the cache may have been donated into a failed call — rebuild it
         self._cache = llama.init_cache(self.cfg, self.max_slots, self.max_seq_len)
@@ -385,6 +405,13 @@ class EmbeddingEngine:
         if self._thread:
             self._thread.join(timeout=10)
             self._thread = None
+        err = RuntimeError("embedding engine stopped")
+        while True:
+            try:
+                _, fut = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            _safe_resolve(fut, exc=err)
 
     def embed_sync(self, texts: Sequence[str]) -> List[List[float]]:
         """Blocking batched embed (used by the engine thread and CLI paths)."""
@@ -426,13 +453,11 @@ class EmbeddingEngine:
                 embs = self.embed_sync(flat)
             except Exception as e:
                 for _, f in jobs:
-                    if not f.cancelled():
-                        f.set_exception(e)
+                    _safe_resolve(f, exc=e)
                 continue
             pos = 0
             for ts, f in jobs:
-                if not f.cancelled():
-                    f.set_result(embs[pos : pos + len(ts)])
+                _safe_resolve(f, result=embs[pos : pos + len(ts)])
                 pos += len(ts)
 
     def _embed_batch(self, texts: List[str]) -> List[List[float]]:

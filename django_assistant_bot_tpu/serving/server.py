@@ -34,6 +34,8 @@ def create_app(registry: ModelRegistry) -> web.Application:
         try:
             body = await request.json()
             model, texts = body["model"], body["texts"]
+            if not isinstance(texts, list) or not all(isinstance(t, str) for t in texts):
+                raise ValueError("texts must be a list of strings")
         except Exception:
             return web.json_response({"detail": "invalid request"}, status=422)
         eng = registry.get_embedder(model)

@@ -17,6 +17,7 @@ class Tokenizer(Protocol):
     pad_id: int
 
     def encode(self, text: str) -> List[int]: ...
+    def encode_chat(self, messages: Sequence[dict]) -> List[int]: ...
     def decode(self, ids: Sequence[int]) -> str: ...
     def apply_chat(self, messages: Sequence[dict]) -> str: ...
 
@@ -37,6 +38,9 @@ class ByteTokenizer:
 
     def apply_chat(self, messages: Sequence[dict]) -> str:
         return render_plain_chat(messages)
+
+    def encode_chat(self, messages: Sequence[dict]) -> List[int]:
+        return self.encode(self.apply_chat(messages))
 
 
 class HFTokenizer:
@@ -60,6 +64,13 @@ class HFTokenizer:
                 list(messages), tokenize=False, add_generation_prompt=True
             )
         return render_plain_chat(messages)
+
+    def encode_chat(self, messages: Sequence[dict]) -> List[int]:
+        """Chat templates already render BOS text — encode without special tokens to
+        avoid the classic double-BOS degradation."""
+        if getattr(self._tok, "chat_template", None):
+            return self._tok.encode(self.apply_chat(messages), add_special_tokens=False)
+        return self.encode(render_plain_chat(messages))
 
 
 def render_plain_chat(messages: Sequence[dict]) -> str:
