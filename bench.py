@@ -1,0 +1,106 @@
+"""Headline benchmark: embedding docs/sec/chip (BASELINE.md config 1).
+
+Measures the jit-compiled TPU encoder (ruBert-base geometry, the reference
+gpu_service's shipped embedder — reference: gpu_service/models.py:1-3) against the
+reference's serving path re-created with torch/transformers on CPU, which loops one
+text at a time exactly like ``TransformersEmbedder`` does (reference:
+assistant/ai/embedders/transformers.py:15-29 — unbatched, O(n) forwards).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+SEQ = int(os.environ.get("BENCH_SEQ", "128"))
+ITERS = int(os.environ.get("BENCH_ITERS", "20"))
+BASELINE_ITERS = int(os.environ.get("BENCH_BASELINE_ITERS", "2"))
+
+
+def bench_tpu() -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from django_assistant_bot_tpu.models import EncoderConfig, encoder
+
+    cfg = EncoderConfig(dtype=jnp.bfloat16)  # ruBert-base geometry: 12L/768E/12H
+    params = encoder.init(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)
+    mask = jnp.ones((BATCH, SEQ), jnp.int32)
+
+    encode = jax.jit(lambda p, i, m: encoder.encode(p, cfg, i, m, normalize=True))
+    np.asarray(encode(params, ids, mask))  # compile + warm (fetch forces completion)
+    np.asarray(encode(params, ids, mask))
+
+    def run(iters: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = encode(params, ids, mask)
+        np.asarray(out)  # one fetch; device executed all iters serially before it
+        return time.perf_counter() - t0
+
+    # Two-run slope: under a remote-RPC device tunnel, a fixed round-trip latency
+    # rides on every timed region; (t(2N) - t(N)) / N cancels it.
+    t1 = run(ITERS)
+    t2 = run(2 * ITERS)
+    per_iter = max((t2 - t1) / ITERS, 1e-9)
+    # encode is an unsharded single-device jit: exactly one chip does the work,
+    # regardless of how many are visible.
+    return BATCH / per_iter
+
+
+def bench_torch_cpu() -> float:
+    """Reference serving path: per-text torch forward loop (unbatched), CPU."""
+    import torch
+    from transformers import BertConfig, BertModel
+
+    cfg = BertConfig(
+        vocab_size=119_547,
+        hidden_size=768,
+        num_hidden_layers=12,
+        num_attention_heads=12,
+        intermediate_size=3072,
+    )
+    model = BertModel(cfg)
+    model.eval()
+    ids = torch.randint(1, cfg.vocab_size, (BATCH, SEQ))
+    with torch.no_grad():
+        model(input_ids=ids[:1])  # warm
+        t0 = time.perf_counter()
+        for _ in range(BASELINE_ITERS):
+            for i in range(BATCH):
+                out = model(input_ids=ids[i : i + 1])
+                out.last_hidden_state.mean(dim=1)
+        dt = time.perf_counter() - t0
+    return (BATCH * BASELINE_ITERS) / dt
+
+
+def main() -> None:
+    value = bench_tpu()
+    try:
+        baseline = bench_torch_cpu()
+    except Exception:
+        baseline = None
+    print(
+        json.dumps(
+            {
+                "metric": "embedding_docs_per_sec_per_chip",
+                "value": round(value, 2),
+                "unit": "docs/s/chip",
+                "vs_baseline": round(value / baseline, 2) if baseline else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

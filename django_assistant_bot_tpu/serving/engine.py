@@ -30,7 +30,7 @@ import logging
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -53,7 +53,7 @@ def _safe_resolve(fut: Future, *, result=None, exc: Optional[BaseException] = No
             fut.set_exception(exc)
         else:
             fut.set_result(result)
-    except Exception:  # InvalidStateError: future was cancelled mid-flight
+    except InvalidStateError:  # future was cancelled mid-flight
         pass
 
 
@@ -168,6 +168,9 @@ class GenerationEngine:
             if s is not None:
                 _safe_resolve(s.request.future, exc=err)
                 self._slots[i] = None
+        self._drain_queue(err)
+
+    def _drain_queue(self, err: BaseException):
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -200,6 +203,12 @@ class GenerationEngine:
                 submitted_at=time.monotonic(),
             )
         )
+        # A stop() racing (or preceding) the put above would leave the request
+        # enqueued forever with no engine thread to fail it.  Re-checking after the
+        # put closes the race: either the engine was still draining (it resolves the
+        # future) or we drain it here — _safe_resolve makes double-resolution benign.
+        if not self._running:
+            self._drain_queue(RuntimeError("generation engine stopped"))
         return fut
 
     async def generate(

@@ -34,6 +34,8 @@ def create_app(registry: ModelRegistry) -> web.Application:
         try:
             body = await request.json()
             model, texts = body["model"], body["texts"]
+            if not isinstance(model, str):
+                raise ValueError("model must be a string")
             if not isinstance(texts, list) or not all(isinstance(t, str) for t in texts):
                 raise ValueError("texts must be a list of strings")
         except Exception:
@@ -52,6 +54,8 @@ def create_app(registry: ModelRegistry) -> web.Application:
         try:
             body = await request.json()
             model = body["model"]
+            if not isinstance(model, str):
+                raise ValueError("model must be a string")
             messages = body["messages"]
             max_tokens = int(body.get("max_tokens", 1024))
             json_format = bool(body.get("json_format", False))

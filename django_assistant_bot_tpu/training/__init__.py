@@ -1,0 +1,16 @@
+"""Training plane — sharded SPMD fine-tuning steps for the serving models.
+
+The reference is inference-only (SURVEY.md §5.4: "No model/optimizer checkpoints
+exist") — this plane is the TPU-native addition that makes the served checkpoints
+tunable in place, using the same model definitions, logical-axis shardings, and mesh
+the serving plane runs on.  Gradients are reduced by XLA-inserted collectives over
+ICI (data axis), tensor-parallel layers all-reduce over the ``model`` axis, MoE
+experts shard over ``expert``, and long sequences shard over ``seq``.
+"""
+
+from .train import (  # noqa: F401
+    TrainState,
+    init_train_state,
+    lm_loss,
+    make_train_step,
+)

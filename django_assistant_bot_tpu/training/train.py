@@ -1,0 +1,112 @@
+"""Causal-LM training step: loss, optimizer wiring, sharded jit compilation.
+
+Design (scaling-book recipe, SURVEY.md §7):
+
+- the loss reuses :func:`~django_assistant_bot_tpu.models.llama.forward` — one model
+  definition serves and trains;
+- parameters / optimizer state are sharded by the model's logical axes
+  (``heads``/``mlp``/``vocab_out`` → TP, ``expert`` → EP); the batch is sharded
+  ``("data", "seq")`` so DP and sequence parallelism both apply;
+- the whole step is one ``jax.jit`` — XLA inserts the gradient psums over the
+  ``data`` axis and the per-layer TP collectives over ``model``; nothing is
+  hand-scheduled;
+- ``jax.checkpoint`` (rematerialisation) can be applied by callers via
+  ``remat=True`` to trade FLOPs for HBM on long sequences.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama
+from ..models.config import DecoderConfig
+from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
+from ..parallel.sharding import shard_pytree
+
+Params = Any
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Params + optimizer state + step counter (a minimal flax-free TrainState)."""
+
+    params: Params
+    opt_state: optax.OptState
+    step: int = 0
+
+
+def lm_loss(
+    params: Params,
+    cfg: DecoderConfig,
+    input_ids: jnp.ndarray,  # [B, S]
+    loss_mask: jnp.ndarray,  # [B, S] 1 where the token counts toward the loss
+) -> jnp.ndarray:
+    """Next-token cross-entropy, mean over unmasked target positions."""
+    logits = llama.forward(params, cfg, input_ids)  # [B, S, V] f32
+    targets = input_ids[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_train_step(
+    cfg: DecoderConfig,
+    optimizer: optax.GradientTransformation,
+    *,
+    remat: bool = False,
+) -> Callable[[Params, optax.OptState, jnp.ndarray, jnp.ndarray], tuple]:
+    """Build a jittable ``(params, opt_state, input_ids, loss_mask) ->
+    (params, opt_state, metrics)`` step.
+
+    Call under a mesh with sharded inputs; XLA derives every collective.  With
+    ``remat=True`` the loss is wrapped in :func:`jax.checkpoint` so activations are
+    recomputed in the backward pass instead of held in HBM.
+    """
+    loss_fn = lm_loss
+    if remat:
+        loss_fn = jax.checkpoint(lm_loss, static_argnums=(1,))
+
+    def step(params, opt_state, input_ids, loss_mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, cfg, input_ids, loss_mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grads)
+        return params, opt_state, {"loss": loss, "grad_norm": gnorm}
+
+    return step
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Token batches shard over DP (rows) and SP (sequence dim)."""
+    return NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+
+
+def init_train_state(
+    cfg: DecoderConfig,
+    optimizer: optax.GradientTransformation,
+    *,
+    rng: Optional[jax.Array] = None,
+    params: Optional[Params] = None,
+    mesh: Optional[Mesh] = None,
+) -> TrainState:
+    """Initialise (or adopt) params and build matching sharded optimizer state.
+
+    ``optax`` state trees mirror the param tree (``zeros_like``), so initialising
+    them from already-sharded params yields identically-sharded state with no extra
+    sharding spec plumbing.
+    """
+    if params is None:
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        params = llama.init(cfg, rng)
+    if mesh is not None:
+        params = shard_pytree(params, llama.logical_axes(cfg), mesh)
+    opt_state = optimizer.init(params)
+    return TrainState(params=params, opt_state=opt_state, step=0)
