@@ -1,0 +1,17 @@
+"""AI provider abstraction — uniform async chat + embedding interface.
+
+Reference parity (assistant/ai/): the same two ABCs (`AIProvider`, `AIEmbedder`),
+the same prefix-routed factories, the same `AIResponse`/`Message` domain types and
+`AIDialog` wrapper — plus the new ``tpu:`` prefix that routes to the in-process
+TPU serving plane instead of an out-of-process microservice.
+"""
+
+from .dialog import AIDialog  # noqa: F401
+from .domain import AIResponse, Message, assistant_message, system_message, user_message  # noqa: F401
+from .providers.base import AIDebugger, AIEmbedder, AIProvider  # noqa: F401
+from .services.ai_service import (  # noqa: F401
+    calculate_ai_cost,
+    extract_tagged_text,
+    get_ai_embedder,
+    get_ai_provider,
+)

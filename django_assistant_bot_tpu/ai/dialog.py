@@ -1,0 +1,40 @@
+"""AIDialog — one-model conversation helper (reference: assistant/ai/dialog.py:11-45)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from .domain import AIResponse, Message
+from .providers.base import AIProvider
+from .services.ai_service import get_ai_provider
+
+
+class AIDialog(AIProvider):
+    def __init__(self, model: str):
+        self._model = model
+        self._provider = get_ai_provider(model)
+
+    async def prompt(self, context: str, role: str = "user", **kwargs) -> AIResponse:
+        return await self._provider.get_response(
+            messages=[Message(role=role, content=context)], **kwargs
+        )
+
+    @property
+    def calls_attempts(self):
+        return self._provider.calls_attempts
+
+    @calls_attempts.setter
+    def calls_attempts(self, value):
+        self._provider.calls_attempts = value
+
+    @property
+    def context_size(self) -> int:
+        return self._provider.context_size
+
+    def calculate_tokens(self, text: str) -> int:
+        return self._provider.calculate_tokens(text)
+
+    async def get_response(
+        self, messages: List[Message], max_tokens: int = 1024, json_format: bool = False
+    ) -> AIResponse:
+        return await self._provider.get_response(messages, max_tokens, json_format)

@@ -1,0 +1,34 @@
+"""AI domain types (reference: assistant/ai/domain.py:5-30)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, TypedDict, Union
+
+
+@dataclass
+class AIResponse:
+    result: Union[str, Dict]  # str, or dict when json_format=True
+    usage: Optional[Dict] = field(default=None)
+    length_limited: bool = False
+
+    @property
+    def model(self) -> Optional[str]:
+        return self.usage.get("model") if self.usage else None
+
+
+class Message(TypedDict):
+    role: str
+    content: str
+
+
+def user_message(content: str) -> Message:
+    return Message(role="user", content=content)
+
+
+def assistant_message(content: str) -> Message:
+    return Message(role="assistant", content=content)
+
+
+def system_message(content: str) -> Message:
+    return Message(role="system", content=content)

@@ -1,0 +1,1 @@
+from .base import AIDebugger, AIEmbedder, AIProvider  # noqa: F401

@@ -1,0 +1,93 @@
+"""Provider/embedder ABCs + AIDebugger (reference: assistant/ai/providers/base.py:8-71).
+
+Also home to the shared JSON-repair helper every provider uses for
+``json_format=True``: parse, strip code fences, retry-worthy error reporting —
+the reference implements this per-provider (ollama.py:49-107, groq.py:53-92); here
+it is one code path.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...utils.debug import TimeDebugger
+from ..domain import AIResponse, Message
+
+
+class AIProvider(ABC):
+    calls_attempts: List[int]
+
+    @property
+    @abstractmethod
+    def context_size(self) -> int: ...
+
+    @abstractmethod
+    def calculate_tokens(self, text: str) -> int: ...
+
+    @abstractmethod
+    async def get_response(
+        self,
+        messages: List[Message],
+        max_tokens: int = 1024,
+        json_format: bool = False,
+    ) -> AIResponse: ...
+
+
+class AIEmbedder(ABC):
+    @abstractmethod
+    async def embeddings(self, input: List[str]) -> List[List[float]]: ...
+
+
+class AIDebugger(TimeDebugger):
+    """Timing + attempt-count + model-name recorder around one provider call
+    (reference: assistant/ai/providers/base.py:48-71)."""
+
+    def __init__(self, ai: AIProvider, debug_info: Optional[Dict[str, Any]], key: str):
+        super().__init__(debug_info, key)
+        self.ai = ai
+
+    def __enter__(self) -> "AIDebugger":
+        self.ai.calls_attempts = []
+        return super().__enter__()  # type: ignore[return-value]
+
+    def __exit__(self, *exc) -> None:
+        super().__exit__(*exc)
+        attempts = getattr(self.ai, "calls_attempts", None)
+        self.node["attempts"] = sum(attempts) if attempts else None
+        self.node["model"] = getattr(self.ai, "_model", None)
+
+
+_FENCE_RE = re.compile(r"```(?:json)?\s*(.*?)\s*```", re.DOTALL)
+
+
+def parse_json_response(text: str) -> Tuple[Optional[Dict], Optional[str]]:
+    """Best-effort JSON extraction: direct parse, fenced block, first {...} span.
+
+    Returns (parsed, error).  ``error`` is a human-readable reason used by
+    retry loops when parsing fails.
+    """
+    if isinstance(text, dict):
+        return text, None
+    candidates = [text]
+    m = _FENCE_RE.search(text)
+    if m:
+        candidates.append(m.group(1))
+    start, end = text.find("{"), text.rfind("}")
+    if start != -1 and end > start:
+        candidates.append(text[start : end + 1])
+    for cand in candidates:
+        try:
+            parsed = json.loads(cand)
+            if isinstance(parsed, dict):
+                return parsed, None
+        except (json.JSONDecodeError, TypeError):
+            continue
+    return None, f"no valid JSON object in response ({text[:80]!r}...)"
+
+
+def approx_tokens(text: str) -> int:
+    """The reference's heuristic token count (len(split)//2 — openai.py:26)."""
+    return max(1, len(text.split()) // 2) if text else 0

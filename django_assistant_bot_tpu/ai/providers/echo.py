@@ -1,0 +1,79 @@
+"""Deterministic test provider/embedder (the reference's DEFAULT_AI_MODEL='test'
+strategy — tests/settings.py:132 — made executable).
+
+``EchoProvider`` answers with a canned or scripted response; ``HashEmbedder``
+maps text to a stable pseudo-random unit vector (same text -> same vector, so
+KNN behavior is deterministic in tests without any model).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..domain import AIResponse, Message
+from .base import AIEmbedder, AIProvider
+
+
+class EchoProvider(AIProvider):
+    """Scripted responses: pop from ``script`` if set, else echo the last user
+    message.  ``json_format=True`` returns the scripted dict or ``{"echo": ...}``."""
+
+    def __init__(self, model: str = "test", script: Optional[Sequence] = None):
+        self._model = model
+        self.script: List = list(script or [])
+        self.history: List[List[Message]] = []
+        self.calls_attempts: List[int] = []
+
+    @property
+    def context_size(self) -> int:
+        return 8000
+
+    def calculate_tokens(self, text: str) -> int:
+        return max(1, len(text.split()) // 2) if text else 0
+
+    async def get_response(
+        self,
+        messages: List[Message],
+        max_tokens: int = 1024,
+        json_format: bool = False,
+    ) -> AIResponse:
+        self.calls_attempts.append(1)
+        self.history.append(list(messages))
+        usage = {
+            "model": self._model,
+            "prompt_tokens": sum(self.calculate_tokens(m["content"]) for m in messages),
+            "completion_tokens": 1,
+            "total_tokens": 1,
+        }
+        if self.script:
+            item = self.script.pop(0)
+            if isinstance(item, AIResponse):
+                return item
+            if isinstance(item, dict) and not json_format:
+                return AIResponse(result=json.dumps(item), usage=usage)
+            return AIResponse(result=item, usage=usage)
+        last_user = next(
+            (m["content"] for m in reversed(messages) if m["role"] == "user"), ""
+        )
+        if json_format:
+            return AIResponse(result={"echo": last_user}, usage=usage)
+        return AIResponse(result=f"echo: {last_user}", usage=usage)
+
+
+class HashEmbedder(AIEmbedder):
+    def __init__(self, dim: int = 768):
+        self.dim = dim
+
+    def _vec(self, text: str) -> List[float]:
+        seed = int.from_bytes(hashlib.sha256(text.encode()).digest()[:8], "little")
+        rng = np.random.default_rng(seed)
+        v = rng.normal(size=self.dim).astype(np.float32)
+        v /= np.linalg.norm(v)
+        return v.tolist()
+
+    async def embeddings(self, input: List[str]) -> List[List[float]]:
+        return [self._vec(t) for t in input]

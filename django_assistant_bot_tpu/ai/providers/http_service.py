@@ -1,0 +1,74 @@
+"""`gpu_service:` provider/embedder — HTTP client for the gpu_service contract.
+
+Exact wire parity with the reference client (assistant/ai/providers/gpu_service.py:
+9-41, assistant/ai/embedders/gpu_service.py:8-28), so it interoperates with BOTH the
+reference's torch microservice and this framework's own TPU server
+(:mod:`~django_assistant_bot_tpu.serving.server`) unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import aiohttp
+
+from ..domain import AIResponse, Message
+from .base import AIEmbedder, AIProvider, approx_tokens, parse_json_response
+
+
+class GPUServiceProvider(AIProvider):
+    def __init__(self, base_url: str, model: str, timeout_s: float = 120.0):
+        self._base = base_url.rstrip("/")
+        self._model = model
+        self._timeout = aiohttp.ClientTimeout(total=timeout_s)
+        self.calls_attempts: List[int] = []
+
+    @property
+    def context_size(self) -> int:
+        return 8000  # reference hardcodes this (assistant/ai/providers/openai.py:22)
+
+    def calculate_tokens(self, text: str) -> int:
+        return approx_tokens(text)
+
+    async def get_response(
+        self,
+        messages: List[Message],
+        max_tokens: int = 1024,
+        json_format: bool = False,
+    ) -> AIResponse:
+        self.calls_attempts.append(1)
+        payload = {
+            "model": self._model,
+            "messages": list(messages),
+            "max_tokens": max_tokens,
+            "json_format": json_format,
+        }
+        async with aiohttp.ClientSession(timeout=self._timeout) as session:
+            async with session.post(f"{self._base}/dialog/", json=payload) as resp:
+                resp.raise_for_status()
+                data = await resp.json()
+        body = data["response"]
+        result = body["result"]
+        if json_format and isinstance(result, str):
+            parsed, _ = parse_json_response(result)
+            result = parsed if parsed is not None else {}
+        return AIResponse(
+            result=result,
+            usage=body.get("usage"),
+            length_limited=body.get("length_limited", False),
+        )
+
+
+class GPUServiceEmbedder(AIEmbedder):
+    def __init__(self, base_url: str, model: str, timeout_s: float = 120.0):
+        self._base = base_url.rstrip("/")
+        self._model = model
+        self._timeout = aiohttp.ClientTimeout(total=timeout_s)
+
+    async def embeddings(self, input: List[str]) -> List[List[float]]:
+        payload = {"model": self._model, "texts": list(input)}
+        async with aiohttp.ClientSession(timeout=self._timeout) as session:
+            async with session.post(f"{self._base}/embeddings/", json=payload) as resp:
+                resp.raise_for_status()
+                data = await resp.json()
+        return data["embeddings"]

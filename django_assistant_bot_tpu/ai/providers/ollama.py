@@ -1,0 +1,123 @@
+"""Ollama provider/embedder via the Ollama REST API.
+
+The reference uses the ollama SDK with a 5-attempt JSON-repair retry loop and a
+same-role merge guard (assistant/ai/providers/ollama.py:49-107); this speaks
+``/api/chat`` and ``/api/embeddings`` directly and keeps both behaviors.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import aiohttp
+
+from ...utils.repeat_until import RepeatUntilError, repeat_until
+from ..domain import AIResponse, Message
+from .base import AIEmbedder, AIProvider, approx_tokens, parse_json_response
+
+
+def merge_same_roles(messages: List[Message]) -> List[Message]:
+    """Ollama rejects consecutive same-role messages; merge them."""
+    out: List[Message] = []
+    for m in messages:
+        if out and out[-1]["role"] == m["role"]:
+            out[-1] = Message(
+                role=m["role"], content=out[-1]["content"] + "\n" + m["content"]
+            )
+        else:
+            out.append(dict(m))  # type: ignore[arg-type]
+    return out
+
+
+class OllamaAIProvider(AIProvider):
+    def __init__(self, model: str, host: str, timeout_s: float = 300.0):
+        self._model = model
+        self._host = host.rstrip("/")
+        self._timeout = aiohttp.ClientTimeout(total=timeout_s)
+        self.calls_attempts: List[int] = []
+
+    @property
+    def context_size(self) -> int:
+        return 8000  # reference parity (assistant/ai/providers/ollama.py:29-30)
+
+    def calculate_tokens(self, text: str) -> int:
+        return approx_tokens(text)
+
+    async def _chat(self, messages: List[Message], max_tokens: int, json_format: bool):
+        payload = {
+            "model": self._model,
+            "messages": merge_same_roles(messages),
+            "stream": False,
+            "options": {"num_predict": max_tokens},
+        }
+        if json_format:
+            payload["format"] = "json"
+        async with aiohttp.ClientSession(timeout=self._timeout) as session:
+            async with session.post(f"{self._host}/api/chat", json=payload) as resp:
+                resp.raise_for_status()
+                return await resp.json()
+
+    async def get_response(
+        self,
+        messages: List[Message],
+        max_tokens: int = 1024,
+        json_format: bool = False,
+    ) -> AIResponse:
+        attempts = 0
+
+        async def call() -> AIResponse:
+            nonlocal attempts
+            attempts += 1
+            data = await self._chat(messages, max_tokens, json_format)
+            text = data.get("message", {}).get("content", "")
+            usage = {
+                "model": self._model,
+                "prompt_tokens": data.get("prompt_eval_count", 0),
+                "completion_tokens": data.get("eval_count", 0),
+            }
+            usage["total_tokens"] = usage["prompt_tokens"] + usage["completion_tokens"]
+            return AIResponse(
+                result=text,
+                usage=usage,
+                length_limited=data.get("done_reason") == "length",
+            )
+
+        if not json_format:
+            resp = await call()
+            self.calls_attempts.append(attempts)
+            return resp
+
+        def valid(resp: AIResponse):
+            parsed, err = parse_json_response(resp.result)
+            if err:
+                return err
+            resp.result = parsed
+            return True
+
+        try:
+            resp = await repeat_until(call, condition=valid, max_attempts=5)
+        except RepeatUntilError as e:
+            resp = e.last_result
+            resp.result = {}
+        self.calls_attempts.append(attempts)
+        return resp
+
+
+class OllamaEmbedder(AIEmbedder):
+    def __init__(self, model: str, host: str, timeout_s: float = 300.0):
+        self._model = model
+        self._host = host.rstrip("/")
+        self._timeout = aiohttp.ClientTimeout(total=timeout_s)
+
+    async def embeddings(self, input: List[str]) -> List[List[float]]:
+        out: List[List[float]] = []
+        async with aiohttp.ClientSession(timeout=self._timeout) as session:
+            for text in input:  # per-text loop = reference behavior (embedders/ollama.py:8-23)
+                async with session.post(
+                    f"{self._host}/api/embeddings",
+                    json={"model": self._model, "prompt": text},
+                ) as resp:
+                    resp.raise_for_status()
+                    data = await resp.json()
+                out.append(data["embedding"])
+        return out

@@ -1,0 +1,134 @@
+"""OpenAI-compatible chat providers: OpenAI and Groq.
+
+The reference uses the vendor SDKs (assistant/ai/providers/openai.py:13-63,
+groq.py:18-132); neither SDK is in this image, so both speak the
+``/chat/completions`` REST contract directly via aiohttp.  Groq keeps the
+reference's extra behaviors: 2-second throttle and JSON-retry.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import aiohttp
+
+from ...utils.repeat_until import RepeatUntilError, repeat_until
+from ...utils.throttle import Throttle
+from ..domain import AIResponse, Message
+from .base import AIEmbedder, AIProvider, approx_tokens, parse_json_response
+
+
+class OpenAICompatProvider(AIProvider):
+    throttle_name: Optional[str] = None
+    throttle_period_s: float = 0.0
+
+    def __init__(self, model: str, api_key: Optional[str], base_url: str, timeout_s: float = 120.0):
+        self._model = model
+        self._api_key = api_key
+        self._base = base_url.rstrip("/")
+        self._timeout = aiohttp.ClientTimeout(total=timeout_s)
+        self.calls_attempts: List[int] = []
+
+    @property
+    def context_size(self) -> int:
+        return 8000  # reference parity (assistant/ai/providers/openai.py:22-23)
+
+    def calculate_tokens(self, text: str) -> int:
+        return approx_tokens(text)
+
+    async def _chat(self, messages: List[Message], max_tokens: int, json_format: bool) -> AIResponse:
+        payload = {
+            "model": self._model,
+            "messages": list(messages),
+            "max_tokens": max_tokens,
+        }
+        if json_format:
+            payload["response_format"] = {"type": "json_object"}
+        headers = {"Authorization": f"Bearer {self._api_key}"} if self._api_key else {}
+
+        async def post():
+            async with aiohttp.ClientSession(timeout=self._timeout) as session:
+                async with session.post(
+                    f"{self._base}/chat/completions", json=payload, headers=headers
+                ) as resp:
+                    resp.raise_for_status()
+                    return await resp.json()
+
+        if self.throttle_name:
+            async with Throttle.get(self.throttle_name, self.throttle_period_s):
+                data = await post()
+        else:
+            data = await post()
+        choice = data["choices"][0]
+        text = choice["message"]["content"]
+        usage = dict(data.get("usage") or {})
+        usage["model"] = self._model
+        return AIResponse(
+            result=text,
+            usage=usage,
+            length_limited=choice.get("finish_reason") == "length",
+        )
+
+    async def get_response(
+        self,
+        messages: List[Message],
+        max_tokens: int = 1024,
+        json_format: bool = False,
+    ) -> AIResponse:
+        attempts = 0
+
+        async def call() -> AIResponse:
+            nonlocal attempts
+            attempts += 1
+            return await self._chat(messages, max_tokens, json_format)
+
+        if not json_format:
+            resp = await call()
+            self.calls_attempts.append(attempts)
+            return resp
+
+        def valid(resp: AIResponse):
+            parsed, err = parse_json_response(resp.result)
+            if err:
+                return err
+            resp.result = parsed
+            return True
+
+        try:
+            resp = await repeat_until(call, condition=valid, max_attempts=5)
+        except RepeatUntilError as e:
+            resp = e.last_result
+            resp.result = {}
+        self.calls_attempts.append(attempts)
+        return resp
+
+
+class ChatGPTAIProvider(OpenAICompatProvider):
+    pass
+
+
+class GroqAIProvider(OpenAICompatProvider):
+    throttle_name = "groq"
+    throttle_period_s = 2.0  # reference: assistant/ai/providers/groq.py:24
+
+
+class OpenAIEmbedder(AIEmbedder):
+    """text-embedding-3* via /embeddings (reference: assistant/ai/embedders/openai.py)."""
+
+    def __init__(self, model: str, api_key: Optional[str], base_url: str, timeout_s: float = 120.0):
+        self._model = model
+        self._api_key = api_key
+        self._base = base_url.rstrip("/")
+        self._timeout = aiohttp.ClientTimeout(total=timeout_s)
+
+    async def embeddings(self, input: List[str]) -> List[List[float]]:
+        headers = {"Authorization": f"Bearer {self._api_key}"} if self._api_key else {}
+        async with aiohttp.ClientSession(timeout=self._timeout) as session:
+            async with session.post(
+                f"{self._base}/embeddings",
+                json={"model": self._model, "input": list(input)},
+                headers=headers,
+            ) as resp:
+                resp.raise_for_status()
+                data = await resp.json()
+        return [d["embedding"] for d in data["data"]]

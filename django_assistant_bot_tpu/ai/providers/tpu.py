@@ -1,0 +1,140 @@
+"""`tpu:` provider — in-process continuous-batching generation on the TPU mesh.
+
+The flagship provider: where the reference hops HTTP to a FastAPI+torch
+microservice (reference: assistant/ai/providers/gpu_service.py:9-41 →
+gpu_service/main.py:89-107), this drives the serving engine directly in-process —
+no serialization hop, shared mesh, cross-request continuous batching.
+
+The process-wide registry is built lazily from ``settings.TPU_SERVING_CONFIG``
+(TOML/JSON: model name -> ModelSpec dict) or falls back to tiny random-weight
+models so dev/test environments need no checkpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import List, Optional
+
+from ...conf import settings
+from ...utils.repeat_until import RepeatUntilError, repeat_until
+from ..domain import AIResponse, Message
+from .base import AIEmbedder, AIProvider, parse_json_response
+
+_registry = None
+_registry_lock = threading.Lock()
+
+
+def get_shared_registry():
+    """Process-wide ModelRegistry for all `tpu:` providers/embedders."""
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            from ...serving.registry import ModelRegistry
+
+            config = {}
+            path = settings.TPU_SERVING_CONFIG
+            if path:
+                if path.endswith(".toml"):
+                    import tomllib
+
+                    with open(path, "rb") as f:
+                        config = tomllib.load(f).get("models", {})
+                else:
+                    with open(path) as f:
+                        config = json.load(f).get("models", {})
+            _registry = ModelRegistry.from_config(config)
+        return _registry
+
+
+def reset_shared_registry():
+    global _registry
+    with _registry_lock:
+        if _registry is not None:
+            _registry.stop()
+        _registry = None
+
+
+def _ensure_loaded(name: str, kind: str):
+    """Load on first use; unknown names load as tiny random models (dev mode)."""
+    from ...serving.registry import ModelSpec
+
+    reg = get_shared_registry()
+    getter = reg.get_embedder if kind == "encoder" else reg.get_generator
+    eng = getter(name)
+    if eng is None:
+        reg.load(ModelSpec(name=name.lower(), kind=kind, tiny=True, dtype="float32"))
+        eng = getter(name)
+    return eng
+
+
+class TPUProvider(AIProvider):
+    def __init__(self, model: str):
+        self._model = model
+        self.calls_attempts: List[int] = []
+        self._engine = _ensure_loaded(model, "decoder")
+
+    @property
+    def context_size(self) -> int:
+        return self._engine.max_seq_len
+
+    def calculate_tokens(self, text: str) -> int:
+        return len(self._engine.tokenizer.encode(text))
+
+    async def get_response(
+        self,
+        messages: List[Message],
+        max_tokens: int = 1024,
+        json_format: bool = False,
+    ) -> AIResponse:
+        attempts = 0
+
+        async def call() -> AIResponse:
+            nonlocal attempts
+            attempts += 1
+            result = await self._engine.generate(
+                list(messages),
+                max_tokens=max_tokens,
+                temperature=0.2 if json_format else 0.8,
+            )
+            usage = {
+                "model": self._model,
+                "prompt_tokens": result.prompt_tokens,
+                "completion_tokens": result.completion_tokens,
+                "total_tokens": result.prompt_tokens + result.completion_tokens,
+                "ttft_s": result.ttft_s,
+                "latency_s": result.latency_s,
+            }
+            return AIResponse(
+                result=result.text, usage=usage, length_limited=result.length_limited
+            )
+
+        if not json_format:
+            resp = await call()
+            self.calls_attempts.append(attempts)
+            return resp
+
+        def valid_json(resp: AIResponse):
+            parsed, err = parse_json_response(resp.result)
+            if err:
+                return err
+            resp.result = parsed
+            return True
+
+        try:
+            resp = await repeat_until(call, condition=valid_json, max_attempts=5)
+        except RepeatUntilError as e:
+            resp = e.last_result
+            parsed, _ = parse_json_response(resp.result)
+            resp.result = parsed if parsed is not None else {}
+        self.calls_attempts.append(attempts)
+        return resp
+
+
+class TPUEmbedder(AIEmbedder):
+    def __init__(self, model: str):
+        self._model = model
+        self._engine = _ensure_loaded(model, "encoder")
+
+    async def embeddings(self, input: List[str]) -> List[List[float]]:
+        return await self._engine.embed(list(input))

@@ -1,0 +1,6 @@
+from .ai_service import (  # noqa: F401
+    calculate_ai_cost,
+    extract_tagged_text,
+    get_ai_embedder,
+    get_ai_provider,
+)

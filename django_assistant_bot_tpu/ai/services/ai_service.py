@@ -1,0 +1,122 @@
+"""Prefix-routed provider/embedder factories + cost/usage accounting.
+
+Routing parity with the reference (assistant/ai/services/ai_service.py:14-74) plus
+the new ``tpu:`` prefix and a ``test`` model for deterministic tests:
+
+providers: ``tpu:`` | ``groq:`` | ``gpu_service:`` | ``ollama:``/``llama*`` |
+``test`` | else OpenAI.
+embedders: ``tpu:`` | ``text-embedding-3*`` -> OpenAI | ``gpu_service:`` |
+``test`` | else Ollama.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Dict, Optional
+
+from ...conf import settings
+from ..providers.base import AIEmbedder, AIProvider
+
+logger = logging.getLogger(__name__)
+
+
+def get_ai_provider(model: str) -> AIProvider:
+    logger.debug("getting AI provider for model %s", model)
+    if model.startswith("tpu:"):
+        from ..providers.tpu import TPUProvider
+
+        return TPUProvider(model[len("tpu:"):])
+    if model.startswith("groq:"):
+        from ..providers.openai_api import GroqAIProvider
+
+        return GroqAIProvider(
+            model[len("groq:"):],
+            api_key=settings.GROQ_API_KEY,
+            base_url=settings.GROQ_BASE_URL,
+        )
+    if model.startswith("gpu_service:"):
+        from ..providers.http_service import GPUServiceProvider
+
+        return GPUServiceProvider(
+            base_url=settings.GPU_SERVICE_ENDPOINT, model=model[len("gpu_service:"):]
+        )
+    if model.startswith("ollama:") or model.startswith("llama"):
+        from ..providers.ollama import OllamaAIProvider
+
+        name = model[len("ollama:"):] if model.startswith("ollama:") else model
+        return OllamaAIProvider(model=name, host=settings.OLLAMA_ENDPOINT)
+    if model == "test" or model.startswith("test:"):
+        from ..providers.echo import EchoProvider
+
+        return EchoProvider(model)
+    from ..providers.openai_api import ChatGPTAIProvider
+
+    return ChatGPTAIProvider(
+        model, api_key=settings.OPENAI_API_KEY, base_url=settings.OPENAI_BASE_URL
+    )
+
+
+def get_ai_embedder(model: Optional[str] = None) -> AIEmbedder:
+    if not model:
+        model = "nomic-embed-text"
+    if model.startswith("tpu:"):
+        from ..providers.tpu import TPUEmbedder
+
+        return TPUEmbedder(model[len("tpu:"):])
+    if model.startswith("text-embedding-3"):
+        from ..providers.openai_api import OpenAIEmbedder
+
+        return OpenAIEmbedder(
+            model, api_key=settings.OPENAI_API_KEY, base_url=settings.OPENAI_BASE_URL
+        )
+    if model.startswith("gpu_service:"):
+        from ..providers.http_service import GPUServiceEmbedder
+
+        return GPUServiceEmbedder(
+            base_url=settings.GPU_SERVICE_ENDPOINT, model=model[len("gpu_service:"):]
+        )
+    if model == "test" or model.startswith("test:"):
+        from ..providers.echo import HashEmbedder
+
+        return HashEmbedder()
+    from ..providers.ollama import OllamaEmbedder
+
+    return OllamaEmbedder(model=model, host=settings.OLLAMA_ENDPOINT)
+
+
+# Backwards-compatible alias: the reference misspells this factory
+# (assistant/ai/services/ai_service.py:51 `get_ai_embdedder`).
+get_ai_embdedder = get_ai_embedder
+
+
+def extract_tagged_text(text: str) -> Dict[str, str]:
+    """``#tag content`` sections -> {tag: content} (reference ai_service.py:77-86)."""
+    pattern = r"#(\w+)\s?(.*?)(?=\s#|$)"
+    matches = re.findall(pattern, text or "", re.DOTALL)
+    return {tag.lower(): body.strip() for tag, body in matches}
+
+
+# $/1K tokens (prompt, completion) — reference table: ai_service.py:89-122,
+# extended with current OpenAI models; tpu/local models cost 0.
+_COST_PER_1K: Dict[str, tuple] = {
+    "gpt-3.5-turbo": (0.001, 0.002),
+    "gpt-4-": (0.01, 0.03),
+    "gpt-4o-mini": (0.00015, 0.0006),
+    "gpt-4o": (0.0025, 0.01),
+}
+
+
+def calculate_ai_cost(usage: Dict) -> float:
+    model = usage.get("model") or ""
+    prompt = usage.get("prompt_tokens", 0) or 0
+    completion = usage.get("completion_tokens", 0) or 0
+    for prefix, (p_in, p_out) in sorted(
+        _COST_PER_1K.items(), key=lambda kv: -len(kv[0])
+    ):
+        if model.startswith(prefix):
+            return (prompt * p_in + completion * p_out) / 1000.0
+    # Anything else is a locally-served model (tpu/ollama/gpu_service providers
+    # strip their routing prefix before writing usage["model"]) — cost 0.
+    logger.debug("model %s not in cost table; charging 0", model)
+    return 0.0

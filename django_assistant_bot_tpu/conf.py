@@ -1,0 +1,77 @@
+"""Settings — the django-environ replacement (reference flag surface: SURVEY.md §5.6).
+
+Every flag the reference reads from Django settings/.env exists here, read from
+``DABT_*`` environment variables with the same semantics: per-role model selection,
+backend endpoints, resource dirs, and the ``BOTS`` registry mapping codenames to
+bot classes + platform tokens.  ``settings.override(...)`` is the test hook.
+
+Model-string prefix routing doubles as provider selection exactly like the
+reference (reference: assistant/ai/services/ai_service.py:14-74): ``tpu:`` (new,
+in-process TPU serving), ``gpu_service:`` (HTTP to a gpu_service-contract server —
+including our own), ``groq:``, ``ollama:``/``llama*``, ``test``, else OpenAI.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import importlib
+import os
+from typing import Any, Dict, Iterator, Optional
+
+
+def _env(name: str, default: Any = None) -> Any:
+    return os.environ.get(f"DABT_{name}", os.environ.get(name, default))
+
+
+class Settings:
+    def __init__(self) -> None:
+        self.reload()
+
+    def reload(self) -> None:
+        # per-role model selection (reference: .env.example:12-19)
+        self.DEFAULT_AI_MODEL: str = _env("DEFAULT_AI_MODEL", "test")
+        self.EMBEDDING_AI_MODEL: str = _env("EMBEDDING_AI_MODEL", "test")
+        self.DIALOG_FAST_AI_MODEL: str = _env("DIALOG_FAST_AI_MODEL", self.DEFAULT_AI_MODEL)
+        self.DIALOG_STRONG_AI_MODEL: str = _env("DIALOG_STRONG_AI_MODEL", self.DEFAULT_AI_MODEL)
+        self.SPLIT_AI_MODEL: str = _env("SPLIT_AI_MODEL", self.DEFAULT_AI_MODEL)
+        self.FORMAT_AI_MODEL: str = _env("FORMAT_AI_MODEL", self.DEFAULT_AI_MODEL)
+        self.SENTENCES_AI_MODEL: str = _env("SENTENCES_AI_MODEL", self.DEFAULT_AI_MODEL)
+        self.QUESTIONS_AI_MODEL: str = _env("QUESTIONS_AI_MODEL", self.DEFAULT_AI_MODEL)
+        # backend endpoints
+        self.OLLAMA_ENDPOINT: str = _env("OLLAMA_ENDPOINT", "http://localhost:11434")
+        self.GPU_SERVICE_ENDPOINT: str = _env("GPU_SERVICE_ENDPOINT", "http://localhost:11435")
+        self.OPENAI_API_KEY: Optional[str] = _env("OPENAI_API_KEY")
+        self.OPENAI_BASE_URL: str = _env("OPENAI_BASE_URL", "https://api.openai.com/v1")
+        self.GROQ_API_KEY: Optional[str] = _env("GROQ_API_KEY")
+        self.GROQ_BASE_URL: str = _env("GROQ_BASE_URL", "https://api.groq.com/openai/v1")
+        # resources + registries
+        self.RESOURCES_DIR: Optional[str] = _env("RESOURCES_DIR")
+        self.BOTS: Dict[str, Dict[str, Any]] = {}
+        # TPU serving config (model registry TOML/JSON path for the `tpu:` provider)
+        self.TPU_SERVING_CONFIG: Optional[str] = _env("TPU_SERVING_CONFIG")
+        # task plane
+        self.TASK_DB_PATH: Optional[str] = _env("TASK_DB_PATH")
+        self.TASK_ALWAYS_EAGER: bool = str(_env("TASK_ALWAYS_EAGER", "0")) in ("1", "true", "True")
+        # dialog lifecycle
+        self.DIALOG_TTL_S: int = int(_env("DIALOG_TTL_S", 24 * 3600))
+        # vector schema (reference fixes 768 for ruBert — assistant/storage/models.py:13;
+        # configurable here so tiny dev models and other embedders fit the same schema)
+        self.EMBEDDING_DIM: int = int(_env("EMBEDDING_DIM", 768))
+
+    def import_string(self, path: str):
+        module, _, name = path.rpartition(".")
+        return getattr(importlib.import_module(module), name)
+
+    @contextlib.contextmanager
+    def override(self, **kw) -> Iterator["Settings"]:
+        old = {k: getattr(self, k) for k in kw}
+        for k, v in kw.items():
+            setattr(self, k, v)
+        try:
+            yield self
+        finally:
+            for k, v in old.items():
+                setattr(self, k, v)
+
+
+settings = Settings()

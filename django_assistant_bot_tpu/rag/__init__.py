@@ -1,0 +1,19 @@
+"""RAG plane — embedding search over the knowledge schema.
+
+Reference parity (assistant/rag/services/search_service.py): the same search
+surface (`get_embedding`, `embedding_search`, `embedding_search_questions/
+sentences/documents`) and the same doc-level aggregation
+``1 - mean(top max_scores_n distances)``, but the ANN substrate is the
+MXU-resident exact index (:class:`~django_assistant_bot_tpu.storage.knn.VectorIndex`)
+instead of pgvector HNSW inside Postgres.
+"""
+
+from .index_registry import get_index, invalidate_index  # noqa: F401
+from .services.search_service import (  # noqa: F401
+    embedding_search,
+    embedding_search_documents,
+    embedding_search_questions,
+    embedding_search_sentences,
+    embeddings_similarity,
+    get_embedding,
+)

@@ -1,0 +1,53 @@
+"""Process-wide vector-index cache per (model, field).
+
+pgvector maintains its HNSW incrementally inside Postgres; here each index is an
+MXU-resident matrix rebuilt lazily from sqlite after writers call
+:func:`invalidate_index` (ingestion does this once per batch — the rebuild is one
+table scan + one host->HBM transfer, amortised across every subsequent query).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple, Type
+
+from ..storage.knn import VectorIndex
+from ..storage.orm import Model
+
+_indexes: Dict[Tuple[str, str], VectorIndex] = {}
+_generation: Dict[Tuple[str, str], int] = {}  # bumped by invalidate_index
+_built_generation: Dict[Tuple[str, str], int] = {}  # generation each index was built at
+_lock = threading.Lock()
+
+
+def get_index(model_cls: Type[Model], field: str = "embedding") -> VectorIndex:
+    key = (model_cls.__name__, field)
+    with _lock:
+        index = _indexes.get(key)
+        gen = _generation.get(key, 0)
+        needs_build = index is None or _built_generation.get(key, -1) != gen
+    if needs_build:
+        fresh = VectorIndex.from_model(model_cls, field=field)
+        with _lock:
+            # only adopt if no invalidation landed during the rebuild; otherwise
+            # keep the stale marker so the next caller rebuilds again
+            if _generation.get(key, 0) == gen:
+                _indexes[key] = fresh
+                _built_generation[key] = gen
+                index = fresh
+            else:
+                index = _indexes.get(key) or fresh
+    return index
+
+
+def invalidate_index(model_cls: Type[Model], field: str = "embedding") -> None:
+    with _lock:
+        key = (model_cls.__name__, field)
+        _generation[key] = _generation.get(key, 0) + 1
+
+
+def reset_indexes() -> None:
+    with _lock:
+        _indexes.clear()
+        _generation.clear()
+        _built_generation.clear()

@@ -1,0 +1,138 @@
+"""Embedding search service (reference: assistant/rag/services/search_service.py).
+
+Search results carry ``obj.distance`` (cosine distance, lower = closer) exactly
+like the reference's ``CosineDistance`` annotation, so downstream aggregation
+code reads identically.  The candidate over-fetch factor
+(``max_scores_n * top_n * 10``) is kept (reference :129-131).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import defaultdict
+from typing import List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from ...conf import settings
+from ...storage.models import Document, Question, Sentence
+from ...storage.orm import Model
+from ..index_registry import get_index
+
+logger = logging.getLogger(__name__)
+
+
+def cosine_similarity(a: np.ndarray, b: np.ndarray) -> float:
+    a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+    return float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+
+def embeddings_similarity(a: Sequence[float], b: Sequence[float]) -> float:
+    return cosine_similarity(np.asarray(a), np.asarray(b))
+
+
+async def get_embedding(text: str) -> List[float]:
+    from ...ai.services.ai_service import get_ai_embedder
+
+    embedder = get_ai_embedder(settings.EMBEDDING_AI_MODEL)
+    return (await embedder.embeddings([text]))[0]
+
+
+async def _objects_embedding_search(
+    query_embedding: Sequence[float],
+    model_cls: Type[Model],
+    n: int = 10,
+    field: str = "embedding",
+    allowed_ids: Optional[set] = None,
+) -> List[Model]:
+    """Top-n rows by cosine distance, each annotated with ``.distance``."""
+
+    def run() -> List[Model]:
+        index = get_index(model_cls, field)
+        # with an allowlist, rank the WHOLE index (exact KNN is one matmul; any
+        # smaller k silently drops allowed rows ranked below the global top-k)
+        k = n if allowed_ids is None else max(len(index), 1)
+        hits = index.search(np.asarray(query_embedding, np.float32), k=k)
+        if allowed_ids is not None:
+            hits = [h for h in hits if h[0] in allowed_ids]
+        hits = hits[:n]
+        by_id = {
+            obj.id: obj
+            for obj in model_cls.objects.filter(id__in=[h[0] for h in hits])
+        }
+        out = []
+        for oid, sim in hits:
+            obj = by_id.get(oid)
+            if obj is not None:
+                obj.distance = 1.0 - sim
+                out.append(obj)
+        return out
+
+    return await asyncio.to_thread(run)
+
+
+async def embedding_search_questions(
+    query_embedding: Sequence[float],
+    n: int = 10,
+    allowed_ids: Optional[set] = None,
+) -> List[Question]:
+    return await _objects_embedding_search(query_embedding, Question, n, allowed_ids=allowed_ids)
+
+
+async def embedding_search_sentences(
+    query_embedding: Sequence[float],
+    n: int = 10,
+    allowed_ids: Optional[set] = None,
+) -> List[Sentence]:
+    return await _objects_embedding_search(query_embedding, Sentence, n, allowed_ids=allowed_ids)
+
+
+async def embedding_search_documents(
+    query_embedding: Sequence[float],
+    n: int = 10,
+    allowed_ids: Optional[set] = None,
+) -> List[Document]:
+    return await _objects_embedding_search(
+        query_embedding, Document, n, field="content_embedding", allowed_ids=allowed_ids
+    )
+
+
+async def embedding_search(
+    query: str,
+    model_cls: Type[Model] = Question,
+    max_scores_n: int = 10,
+    top_n: int = 10,
+    allowed_ids: Optional[set] = None,
+) -> List[Tuple[Document, float]]:
+    """Doc-level search: KNN over sentence/question vectors, then per-document
+    score ``1 - mean(top max_scores_n distances)`` over docs with enough hits
+    (reference: search_service.py:111-152)."""
+    logger.info("embedding search for query: %s", query)
+    query_embedding = await get_embedding(query)
+    top_objects = await _objects_embedding_search(
+        query_embedding,
+        model_cls,
+        n=max_scores_n * top_n * 10,
+        allowed_ids=allowed_ids,
+    )
+
+    docs = defaultdict(list)
+    for obj in top_objects:
+        docs[obj.document_id].append(obj)
+
+    doc_scores = {
+        doc_id: 1 - sum(o.distance for o in v[:max_scores_n]) / max_scores_n
+        for doc_id, v in docs.items()
+        if len(v) >= max_scores_n
+    }
+    if not doc_scores:
+        return []
+
+    def fetch() -> List[Document]:
+        return Document.objects.filter(id__in=list(doc_scores.keys())).all()
+
+    documents = await asyncio.to_thread(fetch)
+    result = [(d, doc_scores[d.id]) for d in documents]
+    result.sort(key=lambda x: x[1], reverse=True)
+    return result[:top_n]

@@ -1,0 +1,21 @@
+"""Storage plane — zero-dependency sqlite ORM-lite + TPU-resident vector index.
+
+Replaces the reference's Django ORM + PostgreSQL + pgvector substrate
+(reference: assistant/storage/models.py, assistant/bot/models.py):
+
+- :mod:`.db` / :mod:`.orm` — a small declarative ORM over sqlite (WAL mode,
+  per-thread connections) covering the query surface the framework needs:
+  get_or_create idempotence, unique constraints, JSON state fields, FK cascades;
+- :mod:`.models` — the full reference schema: bot plane (Bot, BotUser, Role,
+  Instance, Dialog, Message) and knowledge plane (WikiDocument tree, Document,
+  Sentence, Question, WikiDocumentProcessing);
+- :mod:`.knn` — the pgvector-HNSW replacement: an exact brute-force cosine KNN
+  whose score matrix rides the MXU (one [N,768]x[768,Q] matmul + lax.top_k),
+  device-resident between queries;
+- :mod:`.locks` — per-instance advisory locks (sync + async) standing in for
+  Postgres ``pg_advisory_lock`` (reference: assistant/bot/services/instance_service.py).
+"""
+
+from . import db, models  # noqa: F401
+from .knn import VectorIndex  # noqa: F401
+from .locks import InstanceLock, InstanceLockAsync  # noqa: F401

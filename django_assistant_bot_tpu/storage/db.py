@@ -1,0 +1,91 @@
+"""Sqlite database handle: WAL mode, per-thread connections, env-configurable path.
+
+The default database lives at ``$DABT_DB_PATH`` (or ``./dabt.sqlite3``).  Tests point
+``DABT_DB_PATH`` at a tmpdir and call :func:`reset_default_database` between tests.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Iterable, Optional
+
+
+class Database:
+    """One sqlite file, one connection per thread, serialized writes via WAL."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or os.environ.get("DABT_DB_PATH", "dabt.sqlite3")
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._created_tables: set[str] = set()
+
+    def connection(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path, timeout=30.0)
+            conn.row_factory = sqlite3.Row
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            conn.execute("PRAGMA foreign_keys=ON")
+            self._local.conn = conn
+        return conn
+
+    def execute(self, sql: str, params: Iterable = ()) -> sqlite3.Cursor:
+        conn = self.connection()
+        cur = conn.execute(sql, tuple(params))
+        conn.commit()
+        return cur
+
+    def query(self, sql: str, params: Iterable = ()) -> list[sqlite3.Row]:
+        return self.connection().execute(sql, tuple(params)).fetchall()
+
+    def ensure_table(self, model_cls, _visiting: Optional[set] = None) -> None:
+        name = model_cls.table_name()
+        if name in self._created_tables:
+            return
+        # FK targets first (REFERENCES needs the parent table); _visiting guards
+        # self-references (WikiDocument.parent) and cycles.
+        visiting = _visiting if _visiting is not None else set()
+        if name in visiting:
+            return
+        visiting.add(name)
+        from .orm import ForeignKey
+
+        for f in model_cls._fields.values():
+            if isinstance(f, ForeignKey):
+                self.ensure_table(f.to, visiting)
+        with self._lock:
+            if name not in self._created_tables:
+                for stmt in model_cls.schema_sql():
+                    self.connection().execute(stmt)
+                self.connection().commit()
+                self._created_tables.add(name)
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+_default: Optional[Database] = None
+_default_lock = threading.Lock()
+
+
+def get_database() -> Database:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Database()
+        return _default
+
+
+def reset_default_database() -> None:
+    """Drop the cached handle (tests re-point DABT_DB_PATH between runs)."""
+    global _default
+    with _default_lock:
+        if _default is not None:
+            _default.close()
+        _default = None

@@ -1,0 +1,110 @@
+"""Per-instance advisory locks — the Postgres ``pg_advisory_lock`` replacement.
+
+The reference serializes concurrent updates per conversation with session-scoped
+Postgres advisory locks (reference: assistant/bot/services/instance_service.py:15-65).
+Here the shared substrate is sqlite, so the lock is a row in a dedicated table:
+acquire = INSERT of the unique key (spin with backoff until it lands), release =
+DELETE.  Stale rows (holder died without releasing) are stolen after ``stale_s``.
+Both a sync context manager and an async variant (thread-offloaded) are provided.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import Union
+
+from .db import get_database
+from .orm import IntegrityError
+
+_SCHEMA = (
+    "CREATE TABLE IF NOT EXISTS advisory_lock ("
+    "key TEXT PRIMARY KEY, pid INTEGER, acquired_at REAL)"
+)
+
+
+def _key_of(instance_or_key: Union[str, int, object]) -> str:
+    if isinstance(instance_or_key, (str, int)):
+        return str(instance_or_key)
+    return f"instance:{instance_or_key.id}"
+
+
+class InstanceLock:
+    """``with InstanceLock(instance):`` — cross-process mutual exclusion."""
+
+    def __init__(
+        self,
+        instance_or_key: Union[str, int, object],
+        *,
+        timeout: float = 60.0,
+        stale_s: float = 300.0,
+        poll_s: float = 0.05,
+    ):
+        self.key = _key_of(instance_or_key)
+        self.timeout = timeout
+        self.stale_s = stale_s
+        self.poll_s = poll_s
+        self._held = False
+        self._stamp: float = 0.0
+
+    def acquire(self) -> None:
+        db = get_database()
+        db.connection().execute(_SCHEMA)
+        deadline = time.monotonic() + self.timeout
+        while True:
+            now = time.time()
+            conn = db.connection()
+            try:
+                conn.execute(
+                    "INSERT INTO advisory_lock (key, pid, acquired_at) VALUES (?, ?, ?)",
+                    (self.key, os.getpid(), now),
+                )
+                conn.commit()
+                self._held = True
+                self._stamp = now
+                return
+            except Exception:
+                conn.rollback()
+            # steal stale locks from dead holders
+            cur = conn.execute(
+                "DELETE FROM advisory_lock WHERE key = ? AND acquired_at < ?",
+                (self.key, now - self.stale_s),
+            )
+            conn.commit()
+            if cur.rowcount == 0 and time.monotonic() > deadline:
+                raise TimeoutError(f"could not acquire lock {self.key!r}")
+            if cur.rowcount == 0:
+                time.sleep(self.poll_s)
+
+    def release(self) -> None:
+        # Ownership-checked delete: if this holder overran stale_s and another
+        # process stole the lock, the (pid, acquired_at) predicate keeps this
+        # release from deleting the new holder's row.
+        if self._held:
+            get_database().execute(
+                "DELETE FROM advisory_lock WHERE key = ? AND pid = ? AND acquired_at = ?",
+                (self.key, os.getpid(), self._stamp),
+            )
+            self._held = False
+
+    def __enter__(self) -> "InstanceLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class InstanceLockAsync:
+    """``async with InstanceLockAsync(instance):`` — same lock, thread-offloaded."""
+
+    def __init__(self, instance_or_key, **kw):
+        self._lock = InstanceLock(instance_or_key, **kw)
+
+    async def __aenter__(self) -> "InstanceLockAsync":
+        await asyncio.to_thread(self._lock.acquire)
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await asyncio.to_thread(self._lock.release)

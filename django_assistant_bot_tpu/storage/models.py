@@ -1,0 +1,179 @@
+"""The framework schema: bot plane + knowledge plane.
+
+Field-level parity with the reference (bot plane: assistant/bot/models.py:10-86;
+knowledge plane: assistant/storage/models.py:7-87), with sqlite-native choices:
+integer autoincrement PKs everywhere (the reference's UUID Dialog PK adds nothing
+over an int id + created_at here), float32 BLOB vectors instead of pgvector columns
+(ANN queries go through :class:`~django_assistant_bot_tpu.storage.knn.VectorIndex`,
+the MXU-resident HNSW replacement), and an adjacency-list tree instead of MPTT.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import List, Optional
+
+from .orm import (
+    BoolField,
+    DateTimeField,
+    FloatField,
+    ForeignKey,
+    IntField,
+    JSONField,
+    Model,
+    TextField,
+    VectorField,
+)
+
+from ..conf import settings
+
+EMBEDDING_DIM = settings.EMBEDDING_DIM  # 768 default (reference: assistant/storage/models.py:13)
+
+
+# --------------------------------------------------------------------- bot plane
+class Bot(Model):
+    codename = TextField(unique=True)
+    username = TextField()
+    telegram_token = TextField()
+    system_text = TextField()
+    start_text = TextField()
+    help_text = TextField()
+    is_whitelist_enabled = BoolField(default=False)
+    telegram_whitelist = TextField()
+
+    def whitelist(self) -> List[str]:
+        if not self.telegram_whitelist:
+            return []
+        return [u.strip() for u in self.telegram_whitelist.split(",") if u.strip()]
+
+
+class BotUser(Model):
+    created_at = DateTimeField(auto_now_add=True)
+    user_id = TextField(null=False)
+    platform = TextField(null=False)
+    username = TextField()
+    first_name = TextField()
+    last_name = TextField()
+    language = TextField()
+    phone_number = TextField()
+    unique_together = (("user_id", "platform"),)
+
+
+class Instance(Model):
+    """One (bot, user) conversation context; ``state`` is the durable checkpoint
+    (mode, chosen model, debug_info — reference: assistant/bot/models.py:49-57)."""
+
+    created_at = DateTimeField(auto_now_add=True)
+    bot = ForeignKey(Bot)
+    user = ForeignKey(BotUser)
+    state = JSONField(default=dict)
+    is_unavailable = BoolField(default=False, index=True)
+    unique_together = (("bot", "user"),)
+
+
+class Dialog(Model):
+    created_at = DateTimeField(auto_now_add=True)
+    instance = ForeignKey(Instance)
+    is_completed = BoolField(default=False, index=True)
+    state = JSONField(default=dict)
+
+
+class Role(Model):
+    name = TextField(unique=True)
+
+    @classmethod
+    def get_cached(cls, name: str) -> "Role":
+        role, _ = cls.objects.get_or_create(name=name)
+        return role
+
+
+class Message(Model):
+    timestamp = DateTimeField(auto_now_add=True)
+    message_id = IntField(index=True)
+    dialog = ForeignKey(Dialog)
+    role = ForeignKey(Role)
+    text = TextField()
+    photo = TextField()  # path/URL; the reference stores an ImageField path
+    cost_details = JSONField(default=dict)
+    cost = FloatField()
+    unique_together = (("dialog", "message_id"),)
+
+
+# --------------------------------------------------------------- knowledge plane
+class WikiDocument(Model):
+    """Source document tree (adjacency list; reference uses MPTT —
+    assistant/storage/models.py:61-77)."""
+
+    bot = ForeignKey(Bot)
+    parent = ForeignKey("WikiDocument")
+    url = TextField()
+    title = TextField(default="")
+    description = TextField(default="")
+    content = TextField(default="")
+    created_at = DateTimeField(auto_now_add=True)
+    updated_at = DateTimeField()
+
+    def save(self):
+        self.updated_at = _dt.datetime.now(_dt.timezone.utc)
+        return super().save()
+
+    @property
+    def path(self) -> str:
+        """'root / child / leaf' ancestor chain (reference WikiDocument.path)."""
+        parts, node = [], self
+        seen = set()
+        while node is not None and node.id not in seen:
+            seen.add(node.id)
+            parts.append(node.title or "")
+            node = node.parent
+        return " / ".join(reversed(parts))
+
+    def children(self) -> List["WikiDocument"]:
+        return WikiDocument.objects.filter(parent=self).order_by("id").all()
+
+    def descendants(self) -> List["WikiDocument"]:
+        out: List[WikiDocument] = []
+        stack = self.children()
+        while stack:
+            node = stack.pop(0)
+            out.append(node)
+            stack.extend(node.children())
+        return out
+
+
+class WikiDocumentProcessing(Model):
+    """Ingestion status row; document granularity makes reprocessing idempotent
+    (reference: assistant/storage/models.py:79-87)."""
+
+    IN_PROGRESS = "in_progress"
+    COMPLETED = "completed"
+    FAILED = "failed"
+
+    created_at = DateTimeField(auto_now_add=True)
+    wiki_document = ForeignKey(WikiDocument)
+    status = TextField(default=IN_PROGRESS, index=True)
+
+
+class Document(Model):
+    """A processed section of a WikiDocument (reference: assistant/storage/models.py:7-17)."""
+
+    wiki = ForeignKey(WikiDocument)
+    processing = ForeignKey(WikiDocumentProcessing)
+    name = TextField(null=False)
+    description = TextField(default="")
+    content = TextField(default="")
+    content_embedding = VectorField(EMBEDDING_DIM)
+
+
+class Sentence(Model):
+    document = ForeignKey(Document)
+    text = TextField(null=False)
+    order = IntField(default=0)
+    embedding = VectorField(EMBEDDING_DIM)
+
+
+class Question(Model):
+    document = ForeignKey(Document)
+    text = TextField(null=False)
+    order = IntField(default=0)
+    embedding = VectorField(EMBEDDING_DIM)

@@ -1,0 +1,570 @@
+"""Declarative ORM-lite over sqlite.
+
+Covers the query surface the reference framework actually uses from the Django ORM
+(reference: assistant/bot/services/dialog_service.py, assistant/storage/models.py):
+``create / get / get_or_none / get_or_create / filter(**eq) / exclude / order_by /
+limit / count / delete / update``, unique-together constraints, JSON fields,
+datetime fields, float32-vector BLOB fields, and FK cascades.  Lookups support
+Django-style suffixes: ``field__lt/lte/gt/gte/ne/in/isnull/contains``.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from .db import Database, get_database
+
+
+class DoesNotExist(Exception):
+    pass
+
+
+class IntegrityError(Exception):
+    pass
+
+
+class Field:
+    sql_type = "TEXT"
+
+    def __init__(
+        self,
+        *,
+        pk: bool = False,
+        null: bool = True,
+        default: Any = None,
+        unique: bool = False,
+        index: bool = False,
+    ):
+        self.pk = pk
+        self.null = null
+        self.default = default
+        self.unique = unique
+        self.index = index
+        self.name: str = ""  # set by ModelMeta
+
+    def to_db(self, value: Any) -> Any:
+        return value
+
+    def from_db(self, value: Any) -> Any:
+        return value
+
+    def column_sql(self) -> str:
+        parts = [f'"{self.name}"', self.sql_type]
+        if self.pk:
+            parts.append("PRIMARY KEY")
+            if self.sql_type == "INTEGER":
+                parts.append("AUTOINCREMENT")
+        if not self.null and not self.pk:
+            parts.append("NOT NULL")
+        if self.unique:
+            parts.append("UNIQUE")
+        return " ".join(parts)
+
+
+class IntField(Field):
+    sql_type = "INTEGER"
+
+
+class FloatField(Field):
+    sql_type = "REAL"
+
+
+class TextField(Field):
+    sql_type = "TEXT"
+
+
+class BoolField(Field):
+    sql_type = "INTEGER"
+
+    def to_db(self, value):
+        return None if value is None else int(bool(value))
+
+    def from_db(self, value):
+        return None if value is None else bool(value)
+
+
+class DateTimeField(Field):
+    """Stored as ISO-8601 TEXT (UTC).  ``auto_now_add`` stamps on first save."""
+
+    sql_type = "TEXT"
+
+    def __init__(self, *, auto_now_add: bool = False, **kw):
+        super().__init__(**kw)
+        self.auto_now_add = auto_now_add
+
+    def to_db(self, value):
+        if value is None:
+            return None
+        if isinstance(value, str):
+            return value
+        return value.isoformat()
+
+    def from_db(self, value):
+        if value is None:
+            return None
+        return _dt.datetime.fromisoformat(value)
+
+
+class JSONField(Field):
+    sql_type = "TEXT"
+
+    def to_db(self, value):
+        return None if value is None else json.dumps(value, ensure_ascii=False)
+
+    def from_db(self, value):
+        return None if value is None else json.loads(value)
+
+
+class VectorField(Field):
+    """float32 vector as BLOB (the pgvector-column analog; dim checked on write)."""
+
+    sql_type = "BLOB"
+
+    def __init__(self, dim: int, **kw):
+        super().__init__(**kw)
+        self.dim = dim
+
+    def to_db(self, value):
+        if value is None:
+            return None
+        arr = np.asarray(value, np.float32)
+        if arr.shape != (self.dim,):
+            raise ValueError(f"{self.name}: expected dim {self.dim}, got {arr.shape}")
+        return arr.tobytes()
+
+    def from_db(self, value):
+        if value is None:
+            return None
+        return np.frombuffer(value, np.float32).copy()
+
+
+class ForeignKey(IntField):
+    """Stored as ``<name>_id`` INTEGER with ON DELETE CASCADE."""
+
+    def __init__(self, to: "str | Type[Model]", **kw):
+        super().__init__(**kw)
+        self._to = to
+
+    def to_db(self, value):
+        if isinstance(value, Model):
+            return value.id
+        return value
+
+    @property
+    def to(self) -> Type["Model"]:
+        if isinstance(self._to, str):
+            self._to = MODEL_REGISTRY[self._to]
+        return self._to
+
+    def column_sql(self) -> str:
+        base = super().column_sql()
+        return f"{base} REFERENCES {self.to.table_name()}(id) ON DELETE CASCADE"
+
+
+MODEL_REGISTRY: Dict[str, Type["Model"]] = {}
+
+_OPS = {
+    "lt": "<",
+    "lte": "<=",
+    "gt": ">",
+    "gte": ">=",
+    "ne": "!=",
+}
+
+
+def _split_lookup(key: str) -> Tuple[str, str]:
+    if "__" in key:
+        field, op = key.rsplit("__", 1)
+        if op in _OPS or op in ("in", "isnull", "contains"):
+            return field, op
+    return key, "eq"
+
+
+class QuerySet:
+    def __init__(self, model: Type["Model"], db: Database):
+        self.model = model
+        self.db = db
+        self._where: List[str] = []
+        self._params: List[Any] = []
+        self._order: Optional[str] = None
+        self._limit: Optional[int] = None
+        self._offset: Optional[int] = None
+
+    def _clone(self) -> "QuerySet":
+        qs = QuerySet(self.model, self.db)
+        qs._where = list(self._where)
+        qs._params = list(self._params)
+        qs._order, qs._limit, qs._offset = self._order, self._limit, self._offset
+        return qs
+
+    def _add(self, negate: bool, **kw) -> "QuerySet":
+        qs = self._clone()
+        for key, value in kw.items():
+            field, op = _split_lookup(key)
+            if field == "id" or field in self.model._fields:
+                col = field
+            else:
+                col = f"{field}_id"
+            if col not in self.model._fields and col != "id":
+                raise ValueError(f"unknown field {field} on {self.model.__name__}")
+            f = self.model._fields.get(col)
+            if op == "eq":
+                if value is None:
+                    clause = f'"{col}" IS NULL'
+                else:
+                    clause = f'"{col}" = ?'
+                    qs._params.append(f.to_db(value) if f else value)
+            elif op == "in":
+                vals = [f.to_db(v) if f else v for v in value]
+                if not vals:
+                    clause = "0 = 1"
+                else:
+                    clause = f'"{col}" IN ({",".join("?" * len(vals))})'
+                    qs._params.extend(vals)
+            elif op == "isnull":
+                clause = f'"{col}" IS NULL' if value else f'"{col}" IS NOT NULL'
+            elif op == "contains":
+                clause = f'"{col}" LIKE ?'
+                qs._params.append(f"%{value}%")
+            else:
+                clause = f'"{col}" {_OPS[op]} ?'
+                qs._params.append(f.to_db(value) if f else value)
+            qs._where.append(f"NOT ({clause})" if negate else clause)
+        return qs
+
+    def filter(self, **kw) -> "QuerySet":
+        return self._add(False, **kw)
+
+    def exclude(self, **kw) -> "QuerySet":
+        return self._add(True, **kw)
+
+    def order_by(self, *cols: str) -> "QuerySet":
+        qs = self._clone()
+        parts = []
+        for c in cols:
+            desc = c.startswith("-")
+            name = c.lstrip("-")
+            col = name if name in self.model._fields or name == "id" else f"{name}_id"
+            parts.append(f'"{col}" DESC' if desc else f'"{col}" ASC')
+        qs._order = ", ".join(parts)
+        return qs
+
+    def limit(self, n: int, offset: int = 0) -> "QuerySet":
+        qs = self._clone()
+        qs._limit, qs._offset = n, offset
+        return qs
+
+    def __getitem__(self, item):
+        if isinstance(item, slice):
+            start = item.start or 0
+            stop = item.stop
+            return self.limit((stop - start) if stop is not None else -1, start).all()
+        return self.all()[item]
+
+    def _sql(self, select: str = "*") -> Tuple[str, List[Any]]:
+        sql = f"SELECT {select} FROM {self.model.table_name()}"
+        if self._where:
+            sql += " WHERE " + " AND ".join(self._where)
+        if self._order:
+            sql += f" ORDER BY {self._order}"
+        if self._limit is not None:
+            sql += f" LIMIT {self._limit}"
+            if self._offset:
+                sql += f" OFFSET {self._offset}"
+        return sql, self._params
+
+    def all(self) -> List["Model"]:
+        sql, params = self._sql()
+        return [self.model._from_row(r) for r in self.db.query(sql, params)]
+
+    def __iter__(self) -> Iterator["Model"]:
+        return iter(self.all())
+
+    def first(self) -> Optional["Model"]:
+        got = self.limit(1).all()
+        return got[0] if got else None
+
+    def last(self) -> Optional["Model"]:
+        qs = self._clone()
+        qs._order = qs._order or "id ASC"
+        flipped = ", ".join(
+            p.replace(" ASC", " \0").replace(" DESC", " ASC").replace(" \0", " DESC")
+            for p in qs._order.split(", ")
+        )
+        qs._order = flipped
+        return qs.first()
+
+    def count(self) -> int:
+        sql, params = self._sql("COUNT(*)")
+        return self.db.query(sql, params)[0][0]
+
+    def exists(self) -> bool:
+        return self.count() > 0
+
+    def delete(self) -> int:
+        sql = f"DELETE FROM {self.model.table_name()}"
+        if self._where:
+            sql += " WHERE " + " AND ".join(self._where)
+        return self.db.execute(sql, self._params).rowcount
+
+    def update(self, **kw) -> int:
+        sets, params = [], []
+        for key, value in kw.items():
+            col = key if key in self.model._fields else f"{key}_id"
+            f = self.model._fields.get(col)
+            sets.append(f'"{col}" = ?')
+            params.append(f.to_db(value) if f else value)
+        sql = f"UPDATE {self.model.table_name()} SET {', '.join(sets)}"
+        if self._where:
+            sql += " WHERE " + " AND ".join(self._where)
+        return self.db.execute(sql, params + self._params).rowcount
+
+    def values_list(self, *cols: str, flat: bool = False) -> List[Any]:
+        names = [c if c in self.model._fields or c == "id" else f"{c}_id" for c in cols]
+        sql, params = self._sql(", ".join(f'"{n}"' for n in names))
+        rows = self.db.query(sql, params)
+        if flat:
+            if len(names) != 1:
+                raise ValueError("flat=True requires exactly one column")
+            f = self.model._fields.get(names[0])
+            return [f.from_db(r[0]) if f else r[0] for r in rows]
+        out = []
+        for r in rows:
+            vals = []
+            for i, n in enumerate(names):
+                f = self.model._fields.get(n)
+                vals.append(f.from_db(r[i]) if f else r[i])
+            out.append(tuple(vals))
+        return out
+
+
+class Manager:
+    def __init__(self, model: Type["Model"]):
+        self.model = model
+
+    @property
+    def db(self) -> Database:
+        db = get_database()
+        db.ensure_table(self.model)
+        return db
+
+    def qs(self) -> QuerySet:
+        return QuerySet(self.model, self.db)
+
+    def all(self) -> QuerySet:
+        return self.qs()
+
+    def filter(self, **kw) -> QuerySet:
+        return self.qs().filter(**kw)
+
+    def exclude(self, **kw) -> QuerySet:
+        return self.qs().exclude(**kw)
+
+    def count(self) -> int:
+        return self.qs().count()
+
+    def get(self, **kw) -> "Model":
+        got = self.qs().filter(**kw).limit(2).all()
+        if not got:
+            raise DoesNotExist(f"{self.model.__name__} matching {kw}")
+        if len(got) > 1:
+            raise IntegrityError(f"multiple {self.model.__name__} match {kw}")
+        return got[0]
+
+    def get_or_none(self, **kw) -> Optional["Model"]:
+        try:
+            return self.get(**kw)
+        except DoesNotExist:
+            return None
+
+    def create(self, **kw) -> "Model":
+        obj = self.model(**kw)
+        obj.save()
+        return obj
+
+    def get_or_create(self, defaults: Optional[dict] = None, **kw):
+        """Idempotent create: unique constraints turn a lost race into a re-get
+        (the reference's Message (dialog, message_id) idempotence —
+        assistant/bot/services/dialog_service.py:108-118)."""
+        try:
+            return self.get(**kw), False
+        except DoesNotExist:
+            pass
+        try:
+            return self.create(**{**(defaults or {}), **kw}), True
+        except IntegrityError:
+            return self.get(**kw), False
+
+    def bulk_create(self, objs: Sequence["Model"]) -> List["Model"]:
+        for o in objs:
+            o.save()
+        return list(objs)
+
+
+class ModelMeta(type):
+    def __new__(mcls, name, bases, ns):
+        fields: Dict[str, Field] = {}
+        for base in bases:
+            fields.update(getattr(base, "_fields", {}))
+        for key, value in list(ns.items()):
+            if isinstance(value, Field):
+                col = f"{key}_id" if isinstance(value, ForeignKey) else key
+                value.name = col
+                fields[col] = value
+                ns.pop(key)
+                if isinstance(value, ForeignKey):
+                    ns[key] = _fk_accessor(key, col, value)
+        ns["_fields"] = fields
+        cls = super().__new__(mcls, name, bases, ns)
+        if name != "Model":
+            cls.objects = Manager(cls)
+            MODEL_REGISTRY[name] = cls
+        return cls
+
+
+def _fk_accessor(attr: str, col: str, fk: ForeignKey):
+    """``obj.dialog`` lazily loads the related row from ``obj.dialog_id``."""
+
+    def getter(self):
+        rid = getattr(self, col)
+        if rid is None:
+            return None
+        cache = self.__dict__.setdefault("_fk_cache", {})
+        if cache.get(attr, (None, None))[0] != rid:
+            cache[attr] = (rid, fk.to.objects.get(id=rid))
+        return cache[attr][1]
+
+    def setter(self, value):
+        self.__dict__.setdefault("_fk_cache", {})[attr] = (
+            getattr(value, "id", None),
+            value,
+        )
+        setattr(self, col, getattr(value, "id", None))
+
+    return property(getter, setter)
+
+
+class Model(metaclass=ModelMeta):
+    id: Optional[int]
+    unique_together: Sequence[Sequence[str]] = ()
+    objects: Manager  # populated per-subclass by ModelMeta
+
+    def __init__(self, **kw):
+        self.id = kw.pop("id", None)
+        for col, f in self._fields.items():
+            if col == "id":
+                continue
+            attr = col[:-3] if isinstance(f, ForeignKey) else col
+            if attr in kw:
+                value = kw.pop(attr)
+                if isinstance(f, ForeignKey) and isinstance(value, Model):
+                    setattr(self, attr, value)
+                else:
+                    setattr(self, col, value)
+            elif col in kw:
+                setattr(self, col, kw.pop(col))
+            else:
+                default = f.default() if callable(f.default) else f.default
+                setattr(self, col, default)
+        if kw:
+            raise TypeError(f"unknown fields for {type(self).__name__}: {sorted(kw)}")
+
+    # ---------------------------------------------------------------- schema
+    @classmethod
+    def table_name(cls) -> str:
+        return cls.__name__.lower()
+
+    @classmethod
+    def schema_sql(cls) -> List[str]:
+        cols = ["id INTEGER PRIMARY KEY AUTOINCREMENT"]
+        for col, f in cls._fields.items():
+            if col != "id":
+                cols.append(f.column_sql())
+        for group in cls.unique_together:
+            names = [c if c in cls._fields else f"{c}_id" for c in group]
+            quoted = ", ".join('"' + n + '"' for n in names)
+            cols.append(f"UNIQUE ({quoted})")
+        stmts = [f"CREATE TABLE IF NOT EXISTS {cls.table_name()} ({', '.join(cols)})"]
+        for col, f in cls._fields.items():
+            if f.index and not f.unique:
+                stmts.append(
+                    f"CREATE INDEX IF NOT EXISTS idx_{cls.table_name()}_{col} "
+                    f'ON {cls.table_name()}("{col}")'
+                )
+        return stmts
+
+    # ---------------------------------------------------------------- row mapping
+    @classmethod
+    def _from_row(cls, row) -> "Model":
+        obj = cls.__new__(cls)
+        obj.id = row["id"]
+        for col, f in cls._fields.items():
+            if col != "id":
+                setattr(obj, col, f.from_db(row[col]))
+        return obj
+
+    def save(self) -> "Model":
+        import sqlite3 as _sq
+
+        db = get_database()
+        db.ensure_table(type(self))
+        cols, vals = [], []
+        for col, f in self._fields.items():
+            if col == "id":
+                continue
+            value = getattr(self, col)
+            if value is None and isinstance(f, DateTimeField) and f.auto_now_add:
+                value = _dt.datetime.now(_dt.timezone.utc)
+                setattr(self, col, value)
+            cols.append(col)
+            vals.append(f.to_db(value))
+        try:
+            if self.id is None:
+                quoted = ", ".join('"' + c + '"' for c in cols)
+                sql = (
+                    f"INSERT INTO {self.table_name()} ({quoted}) "
+                    f"VALUES ({', '.join('?' * len(cols))})"
+                )
+                cur = db.execute(sql, vals)
+                self.id = cur.lastrowid
+            else:
+                sets = ", ".join(f'"{c}" = ?' for c in cols)
+                db.execute(
+                    f"UPDATE {self.table_name()} SET {sets} WHERE id = ?",
+                    vals + [self.id],
+                )
+        except _sq.IntegrityError as e:
+            raise IntegrityError(str(e)) from e
+        return self
+
+    def delete(self) -> None:
+        if self.id is not None:
+            get_database().execute(
+                f"DELETE FROM {self.table_name()} WHERE id = ?", [self.id]
+            )
+            self.id = None
+
+    def refresh(self) -> "Model":
+        fresh = type(self).objects.get(id=self.id)
+        for col in self._fields:
+            if col != "id":
+                setattr(self, col, getattr(fresh, col))
+        self.__dict__.pop("_fk_cache", None)
+        return self
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} id={self.id}>"
+
+    def __eq__(self, other) -> bool:
+        return (
+            type(self) is type(other)
+            and self.id is not None
+            and self.id == other.id
+        )
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self.id))

@@ -1,0 +1,7 @@
+"""Cross-cutting utilities (reference: assistant/utils/)."""
+
+from .debug import TimeDebugger  # noqa: F401
+from .language import get_language, is_cjk  # noqa: F401
+from .repeat_until import repeat_until, retry_call  # noqa: F401
+from .text import truncate_text  # noqa: F401
+from .throttle import Throttle  # noqa: F401

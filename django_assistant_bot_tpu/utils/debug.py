@@ -1,0 +1,36 @@
+"""Request-scoped timing tree (reference: assistant/utils/debug.py:5-31).
+
+``TimeDebugger`` context managers nest: each records wall seconds into a shared
+``debug_info`` dict under its key, so a whole conversational turn produces one
+tree that is persisted into ``Instance.state['debug_info']`` and surfaced via the
+``/debug`` command.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+
+class TimeDebugger:
+    def __init__(self, debug_info: Optional[Dict[str, Any]], key: str):
+        self.debug_info = debug_info if debug_info is not None else {}
+        self.key = key
+        self._t0 = 0.0
+
+    @property
+    def node(self) -> Dict[str, Any]:
+        return self.debug_info.setdefault(self.key, {})
+
+    def __enter__(self) -> "TimeDebugger":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.node["time"] = round(time.monotonic() - self._t0, 4)
+
+    async def __aenter__(self) -> "TimeDebugger":
+        return self.__enter__()
+
+    async def __aexit__(self, *exc) -> None:
+        self.__exit__()

@@ -1,0 +1,44 @@
+"""Language detection (reference: assistant/utils/language.py:13-31).
+
+The reference uses langid (en/ru) plus a CJK regex.  langid is not in this image,
+so detection is heuristic: CJK scripts by codepoint range, Cyrillic ratio for ru,
+default en.  Same call surface: ``get_language(text) -> 'en' | 'ru' | 'zh' | ...``.
+"""
+
+from __future__ import annotations
+
+import re
+
+_CJK_RE = re.compile(
+    "["
+    "一-鿿"  # CJK unified
+    "㐀-䶿"  # CJK ext A
+    "぀-ヿ"  # hiragana + katakana
+    "가-힯"  # hangul
+    "]"
+)
+_CYRILLIC_RE = re.compile("[Ѐ-ӿ]")
+_LATIN_RE = re.compile("[A-Za-z]")
+
+
+def is_cjk(text: str) -> bool:
+    return bool(_CJK_RE.search(text or ""))
+
+
+def get_language(text: str) -> str:
+    text = text or ""
+    if not text.strip():
+        return "en"
+    cjk = _CJK_RE.findall(text)
+    if cjk:
+        sample = cjk[0]
+        if "぀" <= sample <= "ヿ":
+            return "ja"
+        if "가" <= sample <= "힯":
+            return "ko"
+        return "zh"
+    cyr = len(_CYRILLIC_RE.findall(text))
+    lat = len(_LATIN_RE.findall(text))
+    if cyr > lat:
+        return "ru"
+    return "en"

@@ -1,0 +1,86 @@
+"""Condition-based retry combinators (reference: assistant/utils/repeat_until.py:6-54).
+
+``repeat_until(coro_fn, *args, condition=..., max_attempts=5)`` re-invokes an async
+callable until every condition passes; used around every LLM step so malformed
+model output is retried rather than propagated (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Awaitable, Callable, Iterable, Union
+
+logger = logging.getLogger(__name__)
+
+Condition = Callable[[Any], Union[bool, str, None]]
+
+
+class RepeatUntilError(Exception):
+    def __init__(self, attempts: int, last_result: Any, reason: str = ""):
+        super().__init__(
+            f"condition not met after {attempts} attempts"
+            + (f" ({reason})" if reason else "")
+        )
+        self.attempts = attempts
+        self.last_result = last_result
+
+
+async def repeat_until(
+    fn: Callable[..., Awaitable[Any]],
+    *args,
+    condition: Union[Condition, Iterable[Condition]],
+    max_attempts: int = 5,
+    delay_s: float = 0.0,
+    **kwargs,
+) -> Any:
+    """Await ``fn`` until every condition returns truthy-pass.
+
+    A condition returns True/None to pass, False to fail, or a string describing
+    the failure (logged, counts as fail).
+    """
+    conditions = [condition] if callable(condition) else list(condition)
+    result = None
+    reason = ""
+    for attempt in range(1, max_attempts + 1):
+        result = await fn(*args, **kwargs)
+        reason = ""
+        for cond in conditions:
+            verdict = cond(result)
+            if verdict is False:
+                reason = getattr(cond, "__name__", "condition")
+                break
+            if isinstance(verdict, str):
+                reason = verdict
+                break
+        if not reason:
+            if attempt > 1:
+                logger.info("repeat_until succeeded on attempt %d", attempt)
+            return result
+        logger.warning("repeat_until attempt %d/%d failed: %s", attempt, max_attempts, reason)
+        if delay_s:
+            await asyncio.sleep(delay_s)
+    raise RepeatUntilError(max_attempts, result, reason)
+
+
+def retry_call(
+    fn: Callable[..., Any],
+    *args,
+    exceptions: tuple = (Exception,),
+    max_attempts: int = 3,
+    delay_s: float = 0.0,
+    **kwargs,
+) -> Any:
+    """Sync retry on exception (reference retry_call)."""
+    import time
+
+    last: Exception
+    for attempt in range(1, max_attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except exceptions as e:
+            last = e
+            logger.warning("retry_call attempt %d/%d: %s", attempt, max_attempts, e)
+            if delay_s and attempt < max_attempts:
+                time.sleep(delay_s)
+    raise last

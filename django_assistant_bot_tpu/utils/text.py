@@ -1,0 +1,13 @@
+"""Text helpers (reference: assistant/bot/utils.py truncate_text)."""
+
+from __future__ import annotations
+
+
+def truncate_text(text: str, max_length: int, suffix: str = "…") -> str:
+    if text is None:
+        return ""
+    if len(text) <= max_length:
+        return text
+    if max_length <= len(suffix):
+        return text[:max_length]
+    return text[: max_length - len(suffix)] + suffix

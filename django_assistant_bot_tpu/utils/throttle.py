@@ -1,0 +1,39 @@
+"""Async rate limiter (reference: assistant/utils/throttle.py:10-30)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+class Throttle:
+    """``async with Throttle.get('groq', 2.0):`` — at most one entry per period.
+
+    Named instances are shared process-wide so every caller of the same backend
+    respects the same budget (the reference throttles Groq at 1 req / 2 s).
+    """
+
+    _instances: dict[str, "Throttle"] = {}
+
+    def __init__(self, period_s: float):
+        self.period_s = period_s
+        self._last = 0.0
+        self._lock = asyncio.Lock()
+
+    @classmethod
+    def get(cls, name: str, period_s: float) -> "Throttle":
+        inst = cls._instances.get(name)
+        if inst is None or inst.period_s != period_s:
+            inst = cls._instances[name] = cls(period_s)
+        return inst
+
+    async def __aenter__(self) -> "Throttle":
+        async with self._lock:
+            wait = self._last + self.period_s - time.monotonic()
+            if wait > 0:
+                await asyncio.sleep(wait)
+            self._last = time.monotonic()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        return None

@@ -1,0 +1,163 @@
+"""AI plane: factory routing, JSON repair, tagged-text extraction, cost table,
+retry combinators, language detection, TPU provider end-to-end on tiny models."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from django_assistant_bot_tpu.ai import (
+    AIDialog,
+    AIResponse,
+    calculate_ai_cost,
+    extract_tagged_text,
+    get_ai_embedder,
+    get_ai_provider,
+)
+from django_assistant_bot_tpu.ai.providers.base import parse_json_response
+from django_assistant_bot_tpu.ai.providers.echo import EchoProvider, HashEmbedder
+from django_assistant_bot_tpu.ai.providers.ollama import merge_same_roles
+from django_assistant_bot_tpu.utils import get_language, repeat_until, truncate_text
+from django_assistant_bot_tpu.utils.repeat_until import RepeatUntilError
+
+
+def test_factory_prefix_routing():
+    from django_assistant_bot_tpu.ai.providers.http_service import (
+        GPUServiceEmbedder,
+        GPUServiceProvider,
+    )
+    from django_assistant_bot_tpu.ai.providers.openai_api import (
+        ChatGPTAIProvider,
+        GroqAIProvider,
+        OpenAIEmbedder,
+    )
+    from django_assistant_bot_tpu.ai.providers.ollama import OllamaAIProvider, OllamaEmbedder
+
+    assert isinstance(get_ai_provider("groq:llama3-70b"), GroqAIProvider)
+    assert isinstance(get_ai_provider("gpu_service:x"), GPUServiceProvider)
+    assert isinstance(get_ai_provider("ollama:mistral"), OllamaAIProvider)
+    assert isinstance(get_ai_provider("llama3.1:8b"), OllamaAIProvider)
+    assert isinstance(get_ai_provider("gpt-4o"), ChatGPTAIProvider)
+    assert isinstance(get_ai_provider("test"), EchoProvider)
+    assert isinstance(get_ai_embedder("text-embedding-3-small"), OpenAIEmbedder)
+    assert isinstance(get_ai_embedder("gpu_service:rubert"), GPUServiceEmbedder)
+    assert isinstance(get_ai_embedder("nomic-embed-text"), OllamaEmbedder)
+    assert isinstance(get_ai_embedder("test"), HashEmbedder)
+
+
+def test_parse_json_response_variants():
+    assert parse_json_response('{"a": 1}')[0] == {"a": 1}
+    assert parse_json_response('```json\n{"a": 1}\n```')[0] == {"a": 1}
+    assert parse_json_response('prefix {"a": {"b": 2}} suffix')[0] == {"a": {"b": 2}}
+    parsed, err = parse_json_response("not json at all")
+    assert parsed is None and "no valid JSON" in err
+
+
+def test_extract_tagged_text():
+    out = extract_tagged_text("#THINK some reasoning #TEXT the answer")
+    assert out == {"think": "some reasoning", "text": "the answer"}
+
+
+def test_calculate_ai_cost():
+    assert calculate_ai_cost(
+        {"model": "gpt-4o-mini", "prompt_tokens": 1000, "completion_tokens": 1000}
+    ) == pytest.approx(0.00075)
+    assert calculate_ai_cost({"model": "llama3.1:8b", "prompt_tokens": 10}) == 0.0
+    assert calculate_ai_cost({"model": "tpu:tiny", "prompt_tokens": 10}) == 0.0
+
+
+def test_echo_provider_scripted():
+    provider = EchoProvider(script=["first", {"intent": "greet"}])
+    r1 = asyncio.run(provider.get_response([{"role": "user", "content": "hi"}]))
+    assert r1.result == "first"
+    r2 = asyncio.run(
+        provider.get_response([{"role": "user", "content": "x"}], json_format=True)
+    )
+    assert r2.result == {"intent": "greet"}
+    r3 = asyncio.run(provider.get_response([{"role": "user", "content": "ping"}]))
+    assert r3.result == "echo: ping"
+
+
+def test_ai_dialog_wraps_provider():
+    dialog = AIDialog("test")
+    resp = asyncio.run(dialog.prompt("hello"))
+    assert isinstance(resp, AIResponse)
+    assert resp.result == "echo: hello"
+    assert resp.usage["model"] == "test"
+
+
+def test_hash_embedder_deterministic():
+    emb = HashEmbedder(dim=64)
+    a1, a2, b = asyncio.run(emb.embeddings(["alpha", "alpha", "beta"]))
+    np.testing.assert_array_equal(a1, a2)
+    assert not np.allclose(a1, b)
+    assert np.linalg.norm(a1) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_merge_same_roles():
+    msgs = [
+        {"role": "user", "content": "a"},
+        {"role": "user", "content": "b"},
+        {"role": "assistant", "content": "c"},
+    ]
+    merged = merge_same_roles(msgs)
+    assert len(merged) == 2
+    assert merged[0]["content"] == "a\nb"
+
+
+def test_repeat_until_retries_then_succeeds():
+    calls = []
+
+    async def flaky():
+        calls.append(1)
+        return "ok" if len(calls) >= 3 else "bad"
+
+    result = asyncio.run(
+        repeat_until(flaky, condition=lambda r: r == "ok", max_attempts=5)
+    )
+    assert result == "ok" and len(calls) == 3
+
+    async def always_bad():
+        return "bad"
+
+    with pytest.raises(RepeatUntilError):
+        asyncio.run(repeat_until(always_bad, condition=lambda r: r == "ok", max_attempts=2))
+
+
+def test_language_detection():
+    assert get_language("hello world") == "en"
+    assert get_language("привет мир") == "ru"
+    assert get_language("你好世界") == "zh"
+    assert get_language("こんにちは") == "ja"
+    assert get_language("안녕하세요") == "ko"
+    assert get_language("") == "en"
+
+
+def test_truncate_text():
+    assert truncate_text("abcdef", 10) == "abcdef"
+    assert truncate_text("abcdefghij", 5) == "abcd…"
+
+
+def test_tpu_provider_tiny_end_to_end():
+    """tpu: prefix loads a tiny random decoder and generates through the
+    continuous-batching engine — the full in-process serving path."""
+    from django_assistant_bot_tpu.ai.providers.tpu import reset_shared_registry
+
+    reset_shared_registry()
+    try:
+        provider = get_ai_provider("tpu:tiny-chat")
+        resp = asyncio.run(
+            provider.get_response(
+                [{"role": "user", "content": "hello"}], max_tokens=8
+            )
+        )
+        assert isinstance(resp.result, str)
+        assert resp.usage["completion_tokens"] >= 1
+        assert provider.calculate_tokens("some text") > 0
+        assert provider.context_size > 0
+
+        embedder = get_ai_embedder("tpu:tiny-emb")
+        vecs = asyncio.run(embedder.embeddings(["a", "b"]))
+        assert len(vecs) == 2 and len(vecs[0]) > 0
+    finally:
+        reset_shared_registry()

@@ -1,0 +1,99 @@
+"""RAG plane: object search annotation, doc-score aggregation, index invalidation."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from django_assistant_bot_tpu.ai.providers.echo import HashEmbedder
+from django_assistant_bot_tpu.rag import (
+    embedding_search,
+    embedding_search_questions,
+    get_embedding,
+    invalidate_index,
+)
+from django_assistant_bot_tpu.rag.index_registry import reset_indexes
+from django_assistant_bot_tpu.storage import models
+
+
+@pytest.fixture(autouse=True)
+def fresh_indexes():
+    reset_indexes()
+    yield
+    reset_indexes()
+
+
+def _seed_questions(n_docs=3, per_doc=12):
+    """Each doc's questions cluster around a distinct direction; returns the
+    center texts so queries can target a known doc."""
+    bot = models.Bot.objects.create(codename="rag-bot")
+    wiki = models.WikiDocument.objects.create(bot=bot, title="wiki")
+    emb = HashEmbedder(dim=768)
+    docs, centers = [], []
+    for d in range(n_docs):
+        doc = models.Document.objects.create(wiki=wiki, name=f"doc{d}", content=f"content {d}")
+        center_text = f"topic-{d}"
+        center = np.asarray(asyncio.run(emb.embeddings([center_text]))[0])
+        for i in range(per_doc):
+            noise = np.random.default_rng(d * 100 + i).normal(size=768) * 0.05
+            vec = center + noise
+            models.Question.objects.create(
+                document=doc, text=f"q{d}-{i}", order=i, embedding=vec.astype(np.float32)
+            )
+        docs.append(doc)
+        centers.append(center_text)
+    return docs, centers
+
+
+def test_objects_search_sets_distance(tmp_db):
+    _seed_questions()
+    q_emb = asyncio.run(get_embedding("topic-1"))
+    hits = asyncio.run(embedding_search_questions(q_emb, n=5))
+    assert len(hits) == 5
+    assert all(hasattr(h, "distance") for h in hits)
+    assert hits[0].distance <= hits[-1].distance
+    # nearest questions must come from doc index 1
+    assert all(h.text.startswith("q1-") for h in hits[:3])
+
+
+def test_embedding_search_doc_aggregation(tmp_db):
+    docs, centers = _seed_questions()
+    results = asyncio.run(embedding_search(centers[2], max_scores_n=5, top_n=3))
+    assert results
+    top_doc, score = results[0]
+    assert top_doc.id == docs[2].id
+    assert 0.0 < score <= 1.0
+    scores = [s for _, s in results]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_index_invalidation_picks_up_new_rows(tmp_db):
+    docs, centers = _seed_questions(n_docs=1, per_doc=12)
+    q_emb = asyncio.run(get_embedding("brand-new-question"))
+    hits = asyncio.run(embedding_search_questions(q_emb, n=1))
+    assert hits and hits[0].distance > 0.1  # nothing similar yet
+
+    new_q = models.Question.objects.create(
+        document=docs[0],
+        text="brand-new-question",
+        embedding=np.asarray(q_emb, np.float32),
+    )
+    # without invalidation the cached index misses the new row
+    hits_stale = asyncio.run(embedding_search_questions(q_emb, n=1))
+    assert hits_stale[0].id != new_q.id
+    invalidate_index(models.Question)
+    hits_fresh = asyncio.run(embedding_search_questions(q_emb, n=1))
+    assert hits_fresh[0].id == new_q.id
+    assert hits_fresh[0].distance == pytest.approx(0.0, abs=2e-2)
+
+
+def test_allowed_ids_restriction(tmp_db):
+    _seed_questions(n_docs=2, per_doc=12)
+    allowed = {
+        q.id
+        for q in models.Question.objects.all()
+        if q.text.startswith("q0-")
+    }
+    q_emb = asyncio.run(get_embedding("topic-1"))
+    hits = asyncio.run(embedding_search_questions(q_emb, n=5, allowed_ids=allowed))
+    assert hits and all(h.id in allowed for h in hits)

@@ -1,0 +1,199 @@
+"""Storage plane: ORM CRUD/idempotence, schema parity, KNN exactness, locks.
+
+Mirrors the reference's factory/fixture strategy (SURVEY.md §4) without Django:
+fresh sqlite per test via the ``tmp_db`` fixture.
+"""
+
+import datetime as dt
+import threading
+
+import numpy as np
+import pytest
+
+from django_assistant_bot_tpu.storage import InstanceLock, VectorIndex, models
+from django_assistant_bot_tpu.storage.orm import DoesNotExist, IntegrityError
+
+
+@pytest.fixture()
+def bot(tmp_db):
+    return models.Bot.objects.create(codename="testbot", system_text="sys")
+
+
+@pytest.fixture()
+def instance(bot):
+    user = models.BotUser.objects.create(user_id="u1", platform="telegram")
+    return models.Instance.objects.create(bot=bot, user=user)
+
+
+@pytest.fixture()
+def dialog(instance):
+    return models.Dialog.objects.create(instance=instance)
+
+
+def test_crud_roundtrip(bot):
+    got = models.Bot.objects.get(codename="testbot")
+    assert got.id == bot.id and got.system_text == "sys"
+    got.system_text = "updated"
+    got.save()
+    assert models.Bot.objects.get(id=bot.id).system_text == "updated"
+    assert models.Bot.objects.count() == 1
+    got.delete()
+    assert models.Bot.objects.count() == 0
+
+
+def test_unique_together_message_idempotence(dialog):
+    role = models.Role.get_cached("user")
+    m1, created1 = models.Message.objects.get_or_create(
+        dialog=dialog, message_id=42, defaults={"role": role, "text": "hi"}
+    )
+    m2, created2 = models.Message.objects.get_or_create(
+        dialog=dialog, message_id=42, defaults={"role": role, "text": "dupe"}
+    )
+    assert created1 and not created2
+    assert m1.id == m2.id and m2.text == "hi"
+    with pytest.raises(IntegrityError):
+        models.Message.objects.create(dialog=dialog, message_id=42, role=role)
+
+
+def test_filter_lookups_and_ordering(dialog):
+    role = models.Role.get_cached("user")
+    for i in range(5):
+        models.Message.objects.create(dialog=dialog, message_id=i, role=role, text=f"m{i}")
+    qs = models.Message.objects.filter(dialog=dialog, message_id__gte=2)
+    assert qs.count() == 3
+    ordered = qs.order_by("-message_id").all()
+    assert [m.message_id for m in ordered] == [4, 3, 2]
+    assert models.Message.objects.filter(message_id__in=[0, 4]).count() == 2
+    assert models.Message.objects.filter(text__contains="m3").count() == 1
+    first = models.Message.objects.filter(dialog=dialog).order_by("message_id").first()
+    assert first.message_id == 0
+    last = models.Message.objects.filter(dialog=dialog).order_by("message_id").last()
+    assert last.message_id == 4
+
+
+def test_fk_cascade_and_accessor(dialog):
+    role = models.Role.get_cached("assistant")
+    msg = models.Message.objects.create(dialog=dialog, message_id=1, role=role, text="x")
+    assert msg.dialog.id == dialog.id  # lazy FK accessor
+    assert msg.role.name == "assistant"
+    dialog.instance.delete()  # cascades instance -> dialog -> message
+    assert models.Message.objects.count() == 0
+    assert models.Dialog.objects.count() == 0
+
+
+def test_json_and_datetime_fields(instance):
+    instance.state = {"mode": "chat", "debug_info": {"t": 1.5}}
+    instance.save()
+    fresh = models.Instance.objects.get(id=instance.id)
+    assert fresh.state["debug_info"]["t"] == 1.5
+    assert isinstance(fresh.created_at, dt.datetime)
+    assert fresh.created_at.tzinfo is not None
+
+
+def test_wiki_tree_path(tmp_db, bot=None):
+    bot = models.Bot.objects.create(codename="b")
+    root = models.WikiDocument.objects.create(bot=bot, title="Root")
+    child = models.WikiDocument.objects.create(bot=bot, parent=root, title="Child")
+    leaf = models.WikiDocument.objects.create(bot=bot, parent=child, title="Leaf")
+    assert leaf.path == "Root / Child / Leaf"
+    assert [d.id for d in root.descendants()] == [child.id, leaf.id]
+
+
+def test_vector_field_roundtrip(tmp_db):
+    bot = models.Bot.objects.create(codename="b")
+    wiki = models.WikiDocument.objects.create(bot=bot, title="w")
+    doc = models.Document.objects.create(wiki=wiki, name="d")
+    vec = np.random.default_rng(0).normal(size=768).astype(np.float32)
+    q = models.Question.objects.create(document=doc, text="q?", embedding=vec)
+    got = models.Question.objects.get(id=q.id)
+    np.testing.assert_array_equal(got.embedding, vec)
+    with pytest.raises(ValueError):
+        models.Question.objects.create(document=doc, text="bad", embedding=vec[:10])
+
+
+def test_knn_exact_top1():
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(size=(500, 64)).astype(np.float32)
+    index = VectorIndex(64)
+    index.add(list(range(1, 501)), vecs)
+    # query = exact copy of row 123 (id 124) -> top-1 must be itself with sim ~1
+    hits = index.search(vecs[123], k=5)
+    assert hits[0][0] == 124
+    assert hits[0][1] == pytest.approx(1.0, abs=2e-2)  # bf16 scoring
+    # brute-force numpy agreement on the full top-5 id set
+    normed = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+    expected = set(np.argsort(-(normed @ normed[123]))[:5] + 1)
+    assert {h[0] for h in hits} == expected
+
+
+def test_knn_mutation_and_growth():
+    index = VectorIndex(16)
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(200, 16)).astype(np.float32)
+    index.add(list(range(200)), a)
+    assert len(index) == 200
+    index.remove([0, 1, 2])
+    assert len(index) == 197
+    hits = index.search(a[0], k=3)
+    assert all(h[0] not in (0, 1, 2) for h in hits)
+    # grow past the 128/256 pad boundary — results still exact for a fresh row
+    b = rng.normal(size=(300, 16)).astype(np.float32)
+    index.add(list(range(1000, 1300)), b)
+    hits = index.search(b[50], k=1)
+    assert hits[0][0] == 1050
+
+
+def test_knn_from_model(tmp_db):
+    bot = models.Bot.objects.create(codename="b")
+    wiki = models.WikiDocument.objects.create(bot=bot, title="w")
+    doc = models.Document.objects.create(wiki=wiki, name="d")
+    rng = np.random.default_rng(2)
+    ids = []
+    for i in range(10):
+        q = models.Question.objects.create(
+            document=doc, text=f"q{i}", embedding=rng.normal(size=768).astype(np.float32)
+        )
+        ids.append(q.id)
+    models.Question.objects.create(document=doc, text="no-emb")  # must be skipped
+    index = VectorIndex.from_model(models.Question)
+    assert len(index) == 10
+    target = models.Question.objects.get(id=ids[3])
+    assert index.search(target.embedding, k=1)[0][0] == ids[3]
+
+
+def test_instance_lock_mutual_exclusion(tmp_db):
+    order = []
+
+    def worker(name):
+        with InstanceLock("conv:1", timeout=10):
+            order.append(f"{name}-in")
+            import time as _t
+
+            _t.sleep(0.05)
+            order.append(f"{name}-out")
+
+    threads = [threading.Thread(target=worker, args=(f"t{i}",)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # critical sections never interleave: every -in is followed by its own -out
+    for i in range(0, 6, 2):
+        assert order[i].split("-")[0] == order[i + 1].split("-")[0]
+
+
+def test_instance_lock_steals_stale(tmp_db):
+    lock1 = InstanceLock("conv:2", stale_s=0.01)
+    lock1.acquire()  # never released — simulates a dead holder
+    lock2 = InstanceLock("conv:2", timeout=5, stale_s=0.01)
+    import time as _t
+
+    _t.sleep(0.05)
+    lock2.acquire()
+    lock2.release()
+
+
+def test_get_returns_error_on_missing(tmp_db):
+    with pytest.raises(DoesNotExist):
+        models.Bot.objects.get(codename="nope")
+    assert models.Bot.objects.get_or_none(codename="nope") is None
