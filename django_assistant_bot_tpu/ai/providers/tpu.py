@@ -56,15 +56,21 @@ def reset_shared_registry():
 
 
 def _ensure_loaded(name: str, kind: str):
-    """Load on first use; unknown names load as tiny random models (dev mode)."""
+    """Load on first use; unknown names load as tiny random models (dev mode).
+
+    Check-and-load runs under the registry lock: concurrent first-use of the
+    same model must not allocate two engines (the loser would leak its device
+    memory and batcher thread).
+    """
     from ...serving.registry import ModelSpec
 
     reg = get_shared_registry()
     getter = reg.get_embedder if kind == "encoder" else reg.get_generator
-    eng = getter(name)
-    if eng is None:
-        reg.load(ModelSpec(name=name.lower(), kind=kind, tiny=True, dtype="float32"))
+    with _registry_lock:
         eng = getter(name)
+        if eng is None:
+            reg.load(ModelSpec(name=name.lower(), kind=kind, tiny=True, dtype="float32"))
+            eng = getter(name)
     return eng
 
 

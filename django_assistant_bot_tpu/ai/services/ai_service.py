@@ -101,6 +101,7 @@ def extract_tagged_text(text: str) -> Dict[str, str]:
 # extended with current OpenAI models; tpu/local models cost 0.
 _COST_PER_1K: Dict[str, tuple] = {
     "gpt-3.5-turbo": (0.001, 0.002),
+    "gpt-4": (0.03, 0.06),  # plain gpt-4 (longest-prefix match keeps this last)
     "gpt-4-": (0.01, 0.03),
     "gpt-4o-mini": (0.00015, 0.0006),
     "gpt-4o": (0.0025, 0.01),

@@ -1,0 +1,26 @@
+"""Bot runtime plane — the dialog engine.
+
+Reference parity (assistant/bot/): platform-neutral domain types and the two
+framework ABCs, the AssistantBot engine (commands, whitelist, history assembly,
+think-tag extraction, typing loop, idempotence guards), the ContextService RAG
+enrichment pipeline, dialog/instance services, and per-bot file resources.
+"""
+
+from .domain import (  # noqa: F401
+    Answer,
+    Audio,
+    Bot,
+    BotPlatform,
+    Button,
+    CallbackQuery,
+    MultiPartAnswer,
+    NoMessageFound,
+    NoResourceFound,
+    Photo,
+    SingleAnswer,
+    UnknownUpdate,
+    Update,
+    User,
+    UserUnavailableError,
+    answer_from_dict,
+)

@@ -1,0 +1,469 @@
+"""AssistantBot — the default dialog engine (reference: assistant/bot/assistant_bot.py:30-517).
+
+Behavior parity: whitelist gate, command routing (/start /help /new /model(s)
+/debug /doc /wiki /continue /test_message + regex-decorated custom commands),
+dialog-history assembly with same-role merge and command filtering,
+``<think>``/``#text`` tag extraction, typing-indicator loop, unavailable-instance
+auto-unmark, idempotence guards (already_answered / has_new_messages), durable
+debug_info checkpoint into ``Instance.state``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+import re
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from ..ai.dialog import AIDialog
+from ..ai.domain import AIResponse, Message as GPTMessage
+from ..ai.services.ai_service import extract_tagged_text
+from ..conf import settings
+from ..storage.models import Bot as BotModel, BotUser, Dialog, Instance, Message, Role
+from .domain import (
+    Answer,
+    Bot,
+    BotPlatform,
+    Button,
+    MultiPartAnswer,
+    NoMessageFound,
+    Photo,
+    SingleAnswer,
+    Update,
+)
+from .platforms.telegram.format import TelegramMarkdownV2FormattedText
+from .resource_manager import ResourceManager
+from .services.dialog_service import (
+    create_bot_message,
+    get_gpt_messages,
+    have_existing_answers,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class AssistantBot(Bot):
+    DEFAULT_LANGUAGE = "ru"
+    SERVICE_TAG_REGEXP = re.compile(r"#service", re.I)
+
+    allowed_commands: Optional[List[str]] = None
+    _command_handlers: List[tuple] = []
+
+    def __init__(self, dialog: Dialog, platform: BotPlatform):
+        self.dialog = dialog
+        self.instance: Instance = dialog.instance
+        self.bot: BotModel = self.instance.bot
+        self.bot_user: BotUser = self.instance.user
+        self.platform = platform
+        self.messages: List[GPTMessage] = []
+        self.debug_info: Dict = {}
+        self.resource_manager: Optional[ResourceManager] = None
+
+    def __init_subclass__(cls, **kwargs):
+        # each subclass gets its own command table (the reference shares one
+        # mutable class attribute across all bots — a latent cross-bot leak)
+        super().__init_subclass__(**kwargs)
+        cls._command_handlers = list(cls._command_handlers)
+
+    @classmethod
+    def command(cls, pattern: str):
+        """Decorator registering a regex command handler on this bot class."""
+
+        def decorator(func: Callable):
+            cls._command_handlers.append((re.compile(pattern), func))
+            return func
+
+        return decorator
+
+    # ------------------------------------------------------------------ entry
+    async def handle_update(self, update: Update) -> Optional[Answer]:
+        if self.instance.is_unavailable:
+            logger.info(
+                "user %s wrote; unmarking instance %s available",
+                update.user.id if update.user else "?",
+                self.instance.id,
+            )
+            self.instance.is_unavailable = False
+            self.instance.save()
+
+        self.resource_manager = ResourceManager(
+            codename=self.bot.codename,
+            language=(self.bot_user.language or self.DEFAULT_LANGUAGE),
+        )
+
+        if self.bot.is_whitelist_enabled:
+            whitelist = self.whitelist()
+            uid = update.user.id if update.user else None
+            uname = update.user.username if update.user else None
+            if not (uid in whitelist or uname in whitelist):
+                return SingleAnswer("`Authorization required.`", no_store=True)
+
+        logger.info("instance %s text: %s", self.instance.id, update.text)
+
+        answer_task = asyncio.create_task(self._get_answer(self.dialog, update))
+        typing_task = asyncio.create_task(self.delayed_typing(update.chat_id, answer_task))
+        try:
+            await answer_task
+        finally:
+            typing_task.cancel()
+        answer = answer_task.result()
+        if answer is None:
+            return None
+        if getattr(answer, "state", None):
+            await self.update_state(answer.state)
+        return answer
+
+    def whitelist(self) -> Set[str]:
+        return set(self.bot.whitelist())
+
+    async def on_instance_created(self) -> None:
+        pass
+
+    async def on_answer_sent(self, answer: Answer) -> None:
+        if answer.no_store:
+            return
+        parts = answer.parts if isinstance(answer, MultiPartAnswer) else [answer]
+        for part in parts:
+            if part.raw_text:
+                create_bot_message(self.dialog, part)
+
+    async def delayed_typing(self, chat_id: str, answer_task: asyncio.Task) -> None:
+        await asyncio.sleep(1)
+        while not answer_task.done():
+            await self.platform.action_typing(chat_id)
+            await asyncio.sleep(random.choice([8, 9]))
+
+    # ------------------------------------------------------------------ answer
+    async def _get_answer(self, dialog: Dialog, update: Update) -> Optional[Answer]:
+        message_id = update.message_id
+        text = update.text
+        photo = update.photo
+        phone_number = update.phone_number
+
+        if not text and not photo and not phone_number:
+            return SingleAnswer(
+                "`Sorry, only text messages, photos, or contact shares are supported.`",
+                no_store=True,
+            )
+
+        if self.instance.state.get("mode") == "image_creation":
+            if text and text.startswith("/"):
+                await self.update_state({"mode": "text"})
+            else:
+                text = f"/image {text}"
+
+        self.messages = self._get_messages()
+        self.debug_info = {"state": {k: v for k, v in self.instance.state.items() if k != "debug_info"}}
+        t0 = time.time()
+
+        if text and text.startswith("/"):
+            answer = await self.handle_command(dialog, message_id, text)
+        elif phone_number:
+            answer = await self.handle_phone_number(dialog, message_id, phone_number)
+        else:
+            answer = await self.handle_message(dialog, message_id, text, photo)
+
+        self.debug_info["total"] = {"took": time.time() - t0}
+        await self.update_state(
+            {"debug_info": json.dumps(self.debug_info, ensure_ascii=False, indent=2)}
+        )
+        return answer
+
+    def _get_messages(self) -> List[GPTMessage]:
+        messages_from_db = get_gpt_messages(self.dialog, self._get_system_text())
+        messages: List[GPTMessage] = []
+        for m in messages_from_db:
+            if m["role"] == "user" and m["content"] and m["content"].startswith("/"):
+                continue
+            if not messages or messages[-1]["role"] != m["role"]:
+                messages.append(m)
+            else:
+                messages[-1] = self._merge_messages(messages[-1], m)
+        return messages
+
+    def _merge_messages(self, *messages: GPTMessage) -> GPTMessage:
+        return GPTMessage(
+            role=messages[0]["role"],
+            content="\n".join(m["content"] for m in messages if m["content"]),
+        )
+
+    async def handle_message(
+        self,
+        dialog: Dialog,
+        message_id: Optional[int],
+        text: Optional[str] = None,
+        photo: Optional[Photo] = None,
+    ) -> Optional[SingleAnswer]:
+        user_role = Role.get_cached("user")
+        user_message = (
+            Message.objects.filter(dialog=dialog, role=user_role)
+            .order_by("timestamp", "id")
+            .last()
+        )
+        if not user_message:
+            return None
+        if await self.already_answered(user_message):
+            return None
+
+        try:
+            async def do_interrupt() -> bool:
+                return await self.already_answered(user_message)
+
+            answer = await self.get_answer_to_messages(
+                self.messages, self.debug_info, do_interrupt
+            )
+        except Exception:
+            logger.exception("failed to handle dialog")
+            return None
+
+        if await self.has_new_messages(message_id):
+            logger.warning("user sent new messages during processing")
+            return None
+        if answer is not None and await self.already_answered(user_message):
+            logger.warning("wasted request: message %s already answered", message_id)
+            return None
+        return answer
+
+    async def handle_phone_number(
+        self, dialog: Dialog, message_id: Optional[int], phone_number: str
+    ) -> Optional[SingleAnswer]:
+        raise NotImplementedError("phone number handling is not implemented")
+
+    async def has_new_messages(self, message_id: Optional[int]) -> bool:
+        if message_id is None:
+            return False
+        return (
+            Message.objects.filter(dialog=self.dialog, message_id__gt=message_id).count()
+            > 0
+        )
+
+    async def already_answered(self, user_message: Message) -> bool:
+        return have_existing_answers(user_message)
+
+    async def get_answer_to_messages(
+        self, messages, debug_info, do_interrupt
+    ) -> Optional[Answer]:
+        from .chat_completion import ChatCompletion
+
+        chat_completion = ChatCompletion(
+            bot=self.bot,
+            fast_ai_model=self._get_fast_ai_model(),
+            strong_ai_model=self._get_strong_ai_model(),
+            resource_manager=self.resource_manager,
+        )
+        ai_answer = await chat_completion.generate_answer(
+            messages, debug_info=debug_info, do_interrupt=do_interrupt
+        )
+        return self._ai_response_to_answer(ai_answer)
+
+    # ------------------------------------------------------------ tag handling
+    def _extract_thinking_tag(self, text: str) -> Optional[str]:
+        match = re.search(r"<think>(.*?)</think>", text, flags=re.DOTALL)
+        return match.group(1).strip() if match else None
+
+    def _clean_thinking(self, text: str) -> str:
+        return re.sub(r".*?</think>", "", text, flags=re.DOTALL)
+
+    def _extract_text_tag(self, text: str) -> Optional[str]:
+        tagged = extract_tagged_text(text)
+        return tagged.get("text")
+
+    def _ai_response_to_answer(self, ai_response: AIResponse) -> Optional[Answer]:
+        original_text = ai_response.result
+        thinking = self._extract_thinking_tag(original_text)
+        cleaned_text = self._clean_thinking(original_text)
+        if text_tag := self._extract_text_tag(cleaned_text):
+            cleaned_text = text_tag
+        cleaned_text = cleaned_text.strip() if cleaned_text else None
+        if not cleaned_text:
+            return None
+        return SingleAnswer(
+            text=cleaned_text,
+            thinking=thinking,
+            raw_text=original_text,
+            usage=[ai_response.usage] if ai_response.usage else None,
+            buttons=(
+                [[Button(self.resource_manager.get_phrase("Continue"), callback_data="/continue")]]
+                if ai_response.length_limited
+                else None
+            ),
+        )
+
+    # ------------------------------------------------------------------ models
+    @property
+    def vision_enabled(self) -> bool:
+        return False
+
+    @property
+    def _fast_ai(self) -> AIDialog:
+        return AIDialog(self._get_fast_ai_model())
+
+    @property
+    def _strong_ai(self) -> AIDialog:
+        return AIDialog(self._get_strong_ai_model())
+
+    def _get_fast_ai_model(self) -> str:
+        return settings.DIALOG_FAST_AI_MODEL
+
+    def _get_strong_ai_model(self) -> str:
+        return self.instance.state.get("model", settings.DIALOG_STRONG_AI_MODEL)
+
+    # ---------------------------------------------------------------- commands
+    async def handle_command(
+        self, dialog: Dialog, message_id: Optional[int], text: str
+    ) -> Optional[SingleAnswer]:
+        if self.allowed_commands is not None and not any(
+            text.startswith(prefix) for prefix in self.allowed_commands
+        ):
+            logger.warning("command %r not allowed for bot %s", text, self.bot.codename)
+            return None
+        try:
+            if text.startswith("/start"):
+                return await self.command_start(text)
+            if text == "/help":
+                return await self.command_help()
+            if text == "/continue":
+                return await self.command_continue(dialog, message_id)
+            if text == "/test_message":
+                return SingleAnswer(
+                    self.resource_manager.get_message("TestMessage.txt"), no_store=True
+                )
+            if text == "/new":
+                return self.command_new_dialog()
+            if text.startswith("/model "):
+                return await self.command_select_model(text)
+            if text == "/model":
+                return self.command_show_model()
+            if text == "/models":
+                return self.command_show_models()
+            if text.startswith("/debug"):
+                return self.command_debug()
+            if text.startswith("/doc ") or text.startswith("/document "):
+                return self.command_show_document(text)
+            if text.startswith("/wiki "):
+                return self.command_show_wiki(text)
+            for pattern, handler in self._command_handlers:
+                match = pattern.match(text)
+                if match:
+                    if asyncio.iscoroutinefunction(handler):
+                        return await handler(self, match, message_id)
+                    return handler(self, match, message_id)
+            return SingleAnswer("`Unknown command.`", no_store=True)
+        except Exception:
+            logger.exception("failed to handle command")
+            return None
+
+    async def command_start(self, text: str) -> Optional[Answer]:
+        answer = self.command_new_dialog()
+        if self.bot.start_text:
+            return SingleAnswer(self.bot.start_text, no_store=True)
+        if self.bot.help_text:
+            return SingleAnswer(self.bot.help_text, no_store=True)
+        return answer
+
+    async def command_help(self) -> Optional[SingleAnswer]:
+        if self.bot.help_text:
+            return SingleAnswer(self.bot.help_text, no_store=True)
+        return None
+
+    async def command_continue(
+        self, dialog: Dialog, message_id: Optional[int]
+    ) -> Optional[SingleAnswer]:
+        return await self.handle_message(dialog, message_id, "/continue")
+
+    def command_new_dialog(self) -> SingleAnswer:
+        Dialog.objects.filter(instance=self.instance, is_completed=False).update(
+            is_completed=True
+        )
+        return SingleAnswer("`New dialog started.`", no_store=True)
+
+    async def command_select_model(self, text: str) -> SingleAnswer:
+        model_id = text.split()[1].strip()
+        await self.update_state({"model": model_id})
+        return SingleAnswer(
+            f"`Model` *{TelegramMarkdownV2FormattedText(model_id)}* `selected.`",
+            no_store=True,
+        )
+
+    def command_show_model(self) -> SingleAnswer:
+        model = self._get_strong_ai_model()
+        return SingleAnswer(f"*{TelegramMarkdownV2FormattedText(model)}*", no_store=True)
+
+    def available_models(self) -> List[str]:
+        return ["tpu:llama-3-8b", "llama3.1:8b", "llama3.1:70b"]
+
+    def command_show_models(self) -> SingleAnswer:
+        from ..utils.text import truncate_text
+
+        models = self.available_models()
+        buttons = [
+            [Button(truncate_text(m, 64), callback_data=f"/model {m}")] for m in models
+        ]
+        current_model = self._get_strong_ai_model()
+        return SingleAnswer(
+            f"`Current AI model:` {current_model}\n`You can change the model to:`",
+            buttons=buttons,
+            no_store=True,
+        )
+
+    def command_debug(self) -> SingleAnswer:
+        debug = self.instance.state.get("debug_info", "{}")
+        return SingleAnswer(
+            text=f"```json\n{debug}\n```\n",
+            no_store=True,
+            debug_info=debug if isinstance(debug, dict) else {},
+        )
+
+    def command_show_document(self, text: str) -> SingleAnswer:
+        from ..storage.models import Document, WikiDocument
+
+        doc_id = text.split()[1].strip()
+        doc = Document.objects.get_or_none(id=int(doc_id)) if doc_id.isdigit() else None
+        wiki = WikiDocument.objects.get_or_none(id=doc.wiki_id) if doc and doc.wiki_id else None
+        if doc is None or wiki is None or wiki.bot_id != self.bot.id:
+            return SingleAnswer("`Document not found.`", no_store=True)
+        return SingleAnswer(
+            text=(
+                f"*`ID:`* {doc.id}\n"
+                f"*`Wiki ID:`* {doc.wiki_id}\n"
+                f"*`Wiki Path:`* {TelegramMarkdownV2FormattedText(wiki.path)}\n"
+                f"*`Name:`* {TelegramMarkdownV2FormattedText(doc.name)}\n"
+                f"*`Content:`*\n{TelegramMarkdownV2FormattedText(doc.content)}"
+            ),
+            no_store=True,
+        )
+
+    def command_show_wiki(self, text: str) -> SingleAnswer:
+        from ..storage.models import WikiDocument
+
+        wiki_id = text.split()[1].strip()
+        wiki = WikiDocument.objects.get_or_none(id=int(wiki_id)) if wiki_id.isdigit() else None
+        if wiki is None or wiki.bot_id != self.bot.id:
+            return SingleAnswer("`Wiki not found.`", no_store=True)
+        return SingleAnswer(
+            text=(
+                f"*`ID:`* {wiki.id}\n"
+                f"*`Path:`* {TelegramMarkdownV2FormattedText(wiki.path)}\n"
+                f"*`Content:`*\n{TelegramMarkdownV2FormattedText(wiki.content)}"
+            ),
+            no_store=True,
+        )
+
+    # ------------------------------------------------------------------- state
+    async def close_dialog(self) -> None:
+        self.dialog.is_completed = True
+        self.dialog.save()
+
+    def _get_system_text(self) -> Optional[str]:
+        return self.bot.system_text
+
+    async def update_state(self, state: Dict) -> None:
+        self.instance.state.update(state)
+        self.instance.save()
+
+    async def clear_state(self) -> None:
+        self.instance.state = {}
+        self.instance.save()

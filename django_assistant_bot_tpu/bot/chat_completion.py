@@ -1,0 +1,54 @@
+"""ChatCompletion — enrichment + final strong-model call
+(reference: assistant/bot/chat_completion.py:24-45)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from ..ai.domain import AIResponse, Message
+from ..ai.providers.base import AIDebugger
+from ..ai.services.ai_service import get_ai_provider
+from ..storage.models import Bot
+from .resource_manager import ResourceManager
+from .services.context_service.service import ContextService
+
+logger = logging.getLogger(__name__)
+
+
+class ChatCompletion:
+    def __init__(
+        self,
+        bot: Bot,
+        resource_manager: ResourceManager,
+        fast_ai_model: str,
+        strong_ai_model: str,
+    ):
+        self.bot = bot
+        self.fast_ai_model = fast_ai_model
+        self.strong_ai_model = strong_ai_model
+        self.resource_manager = resource_manager
+
+    async def generate_answer(
+        self,
+        messages: List[Message],
+        debug_info: Optional[Dict] = None,
+        do_interrupt: Optional[Callable[[], Awaitable[bool]]] = None,
+    ) -> AIResponse:
+        debug_info = debug_info if debug_info is not None else {}
+        if messages:
+            debug_info["query"] = messages[-1]["content"]
+
+        context_service = ContextService(
+            bot=self.bot,
+            fast_ai_model=self.fast_ai_model,
+            strong_ai_model=self.strong_ai_model,
+            messages=messages,
+            debug_info=debug_info,
+            do_interrupt=do_interrupt,
+        )
+        enriched_messages = await context_service.enrich()
+
+        strong_ai = get_ai_provider(self.strong_ai_model)
+        with AIDebugger(strong_ai, debug_info, "final"):
+            return await strong_ai.get_response(enriched_messages)

@@ -1,0 +1,311 @@
+"""Platform-neutral value types + the two framework ABCs.
+
+Capability parity with reference assistant/bot/domain.py:26-310: `Update`/`User`/
+`Photo`/`Audio`/`CallbackQuery`/`Button` value objects with dict round-tripping
+(binary payloads base64-encoded for queue transport), `SingleAnswer`/
+`MultiPartAnswer` with raw_text/final_model/no_store semantics, and the
+`BotPlatform`/`Bot` ABCs every adapter and engine implement.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List, Optional, Union
+
+
+class NoMessageFound(Exception):
+    pass
+
+
+class NoResourceFound(Exception):
+    pass
+
+
+class UnknownUpdate(Exception):
+    pass
+
+
+class UserUnavailableError(Exception):
+    """Raised by platforms when the user blocked the bot / left the chat
+    (reference: assistant/bot/domain.py + platforms/telegram/platform.py:135-145)."""
+
+
+@dataclasses.dataclass
+class User:
+    id: str
+    username: Optional[str] = None
+    first_name: Optional[str] = None
+    last_name: Optional[str] = None
+    language_code: Optional[str] = None
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "User":
+        return cls(**data)
+
+
+@dataclasses.dataclass
+class CallbackQuery:
+    id: str
+    from_user: User
+    message: str
+    data: str
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "CallbackQuery":
+        data = dict(data)
+        data["from_user"] = User.from_dict(data["from_user"])
+        return cls(**data)
+
+
+@dataclasses.dataclass
+class Audio:
+    content: bytes
+    filename: Optional[str] = None
+
+    def to_dict(self) -> Dict:
+        return {
+            "content": base64.b64encode(self.content).decode("utf-8"),
+            "filename": self.filename,
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "Audio":
+        data = dict(data)
+        data["content"] = base64.b64decode(data["content"])
+        return cls(**data)
+
+
+@dataclasses.dataclass
+class Photo:
+    file_id: str
+    extension: str
+    content: bytes
+
+    def to_dict(self) -> Dict:
+        res = dataclasses.asdict(self)
+        res["content"] = base64.b64encode(bytes(self.content)).decode("utf-8")
+        return res
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "Photo":
+        data = dict(data)
+        data["content"] = base64.b64decode(data["content"])
+        return cls(**data)
+
+
+@dataclasses.dataclass
+class Update:
+    chat_id: str
+    message_id: Optional[int]
+    text: Optional[str]
+    photo: Optional[Photo] = None
+    user: Optional[User] = None
+    callback_query: Optional[CallbackQuery] = None
+    phone_number: Optional[str] = None
+
+    def to_dict(self) -> Dict:
+        res = dataclasses.asdict(self)
+        res["photo"] = self.photo.to_dict() if self.photo else None
+        res["user"] = self.user.to_dict() if self.user else None
+        res["callback_query"] = self.callback_query.to_dict() if self.callback_query else None
+        return res
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "Update":
+        data = dict(data)
+        if data.get("user"):
+            data["user"] = User.from_dict(data["user"])
+        if data.get("photo"):
+            data["photo"] = Photo.from_dict(data["photo"])
+        if data.get("callback_query"):
+            data["callback_query"] = CallbackQuery.from_dict(data["callback_query"])
+        return cls(**data)
+
+
+@dataclasses.dataclass
+class Button:
+    text: str
+    callback_data: Optional[str] = None
+    url: Optional[str] = None
+    request_contact: Optional[bool] = None
+    request_location: Optional[bool] = None
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "Button":
+        return cls(**data)
+
+
+class SingleAnswer:
+    """One outgoing message: text + optional thinking trace, keyboards, audio.
+
+    ``raw_text`` preserves the model's unprocessed output for history storage;
+    ``no_store`` marks service messages that must not enter dialog history;
+    ``usage`` accumulates per-call token/cost dicts; ``state`` requests an
+    instance-state update after delivery.
+    """
+
+    def __init__(
+        self,
+        text: Optional[str] = None,
+        thinking: Optional[str] = None,
+        image_url: Optional[str] = None,
+        is_markdown: bool = False,
+        reply_keyboard: Any = None,
+        buttons: Optional[List[List[Button]]] = None,
+        state: Optional[Dict] = None,
+        raw_text: Optional[str] = None,
+        usage: Optional[List[Dict]] = None,
+        debug_info: Optional[Dict] = None,
+        no_store: bool = False,
+        audio: Optional[Audio] = None,
+        disable_web_page_preview: Optional[bool] = None,
+    ):
+        self.text = text
+        self.thinking = thinking
+        self.image_url = image_url
+        self.is_markdown = is_markdown
+        self.reply_keyboard = reply_keyboard
+        self.buttons = buttons
+        self.state = state
+        self.usage = usage or []
+        self.debug_info = debug_info or {}
+        self.no_store = no_store
+        self.audio = audio
+        self.disable_web_page_preview = disable_web_page_preview
+        self._raw_text = raw_text
+
+    @property
+    def raw_text(self) -> Optional[str]:
+        return self._raw_text if self._raw_text else self.text
+
+    @raw_text.setter
+    def raw_text(self, value: Optional[str]) -> None:
+        self._raw_text = value
+
+    @property
+    def final_model(self) -> Optional[str]:
+        return self.usage[-1].get("model") if self.usage else None
+
+    def to_dict(self) -> Dict:
+        return {
+            "text": self.text,
+            "thinking": self.thinking,
+            "image_url": self.image_url,
+            "is_markdown": self.is_markdown,
+            "reply_keyboard": self.reply_keyboard,
+            "buttons": (
+                [[b.to_dict() for b in row] for row in self.buttons]
+                if self.buttons
+                else None
+            ),
+            "state": self.state,
+            "usage": self.usage,
+            "debug_info": self.debug_info,
+            "no_store": self.no_store,
+            "raw_text": self._raw_text,
+            "audio": self.audio.to_dict() if self.audio else None,
+            "disable_web_page_preview": self.disable_web_page_preview,
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "SingleAnswer":
+        data = dict(data)
+        if data.get("buttons"):
+            data["buttons"] = [
+                [Button.from_dict(b) for b in row] for row in data["buttons"]
+            ]
+        if data.get("audio"):
+            data["audio"] = Audio.from_dict(data["audio"])
+        return cls(**data)
+
+
+class MultiPartAnswer:
+    """Several SingleAnswers delivered in order as one logical reply."""
+
+    def __init__(
+        self,
+        parts: Optional[List[SingleAnswer]] = None,
+        no_store: bool = False,
+        state: Optional[Dict] = None,
+    ):
+        self.parts: List[SingleAnswer] = parts or []
+        self.state: Dict = state or {}
+        if no_store:
+            self.no_store = True
+
+    def add_part(self, answer: SingleAnswer) -> None:
+        self.parts.append(answer)
+
+    def get_parts(self) -> List[SingleAnswer]:
+        return self.parts
+
+    @property
+    def no_store(self) -> bool:
+        return all(part.no_store for part in self.parts)
+
+    @no_store.setter
+    def no_store(self, value: bool) -> None:
+        for part in self.parts:
+            part.no_store = value
+
+    def to_dict(self) -> Dict:
+        return {
+            "parts": [part.to_dict() for part in self.parts],
+            "no_store": self.no_store,
+            "state": self.state,
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "MultiPartAnswer":
+        data = dict(data)
+        parts = [SingleAnswer.from_dict(p) for p in data.pop("parts", [])]
+        data.pop("no_store", None)
+        return cls(parts=parts, **data)
+
+
+Answer = Union[SingleAnswer, MultiPartAnswer]
+
+
+def answer_from_dict(data: Dict) -> Answer:
+    if "parts" in data:
+        return MultiPartAnswer.from_dict(data)
+    return SingleAnswer.from_dict(data)
+
+
+class BotPlatform(ABC):
+    """Adapter between a messaging platform and the engine
+    (reference: assistant/bot/domain.py:281-300)."""
+
+    @property
+    @abstractmethod
+    def codename(self) -> str: ...
+
+    @abstractmethod
+    async def get_update(self, request: Any) -> Update: ...
+
+    @abstractmethod
+    async def post_answer(self, chat_id: str, answer: SingleAnswer) -> None: ...
+
+    @abstractmethod
+    async def action_typing(self, chat_id: str) -> None: ...
+
+
+class Bot(ABC):
+    """The engine contract (reference: assistant/bot/domain.py:303-310)."""
+
+    @abstractmethod
+    async def handle_update(self, update: Update) -> Optional[Answer]: ...
+
+    @abstractmethod
+    async def on_answer_sent(self, answer: Answer) -> None: ...

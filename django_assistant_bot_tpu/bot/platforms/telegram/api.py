@@ -1,0 +1,121 @@
+"""Minimal async Telegram Bot API client (aiohttp).
+
+The reference uses the python-telegram-bot SDK; it is not in this image, so this
+client speaks the HTTP API directly.  Only the calls the platform adapter needs:
+sendMessage, sendAudio, sendChatAction, getFile + file download, getUpdates
+(long polling), setWebhook, answerCallbackQuery.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+
+logger = logging.getLogger(__name__)
+
+
+class TelegramAPIError(Exception):
+    def __init__(self, status: int, description: str):
+        super().__init__(f"telegram api error {status}: {description}")
+        self.status = status
+        self.description = description
+
+
+class TelegramForbidden(TelegramAPIError):
+    """403 — bot blocked / kicked / user deactivated."""
+
+
+class TelegramBadRequest(TelegramAPIError):
+    """400 — e.g. "Can't parse entities" for broken MarkdownV2."""
+
+
+class TelegramAPI:
+    def __init__(self, token: str, base_url: str = "https://api.telegram.org", timeout_s: float = 60.0):
+        self.token = token
+        self.base = base_url.rstrip("/")
+        self._timeout = aiohttp.ClientTimeout(total=timeout_s)
+
+    def _url(self, method: str) -> str:
+        return f"{self.base}/bot{self.token}/{method}"
+
+    async def call(self, method: str, **params) -> Any:
+        payload = {k: v for k, v in params.items() if v is not None}
+        async with aiohttp.ClientSession(timeout=self._timeout) as session:
+            async with session.post(self._url(method), json=payload) as resp:
+                data = await resp.json(content_type=None)
+        if not data.get("ok"):
+            desc = data.get("description", "")
+            code = data.get("error_code", 0)
+            if code == 403:
+                raise TelegramForbidden(code, desc)
+            if code == 400:
+                raise TelegramBadRequest(code, desc)
+            raise TelegramAPIError(code, desc)
+        return data["result"]
+
+    async def send_message(
+        self,
+        chat_id: str,
+        text: str,
+        *,
+        parse_mode: Optional[str] = None,
+        reply_markup: Optional[Dict] = None,
+        disable_web_page_preview: Optional[bool] = None,
+    ) -> Dict:
+        return await self.call(
+            "sendMessage",
+            chat_id=chat_id,
+            text=text,
+            parse_mode=parse_mode,
+            reply_markup=reply_markup,
+            disable_web_page_preview=disable_web_page_preview,
+        )
+
+    async def send_audio(
+        self, chat_id: str, audio: bytes, filename: Optional[str] = None, reply_markup=None
+    ) -> Dict:
+        form = aiohttp.FormData()
+        form.add_field("chat_id", str(chat_id))
+        form.add_field("audio", audio, filename=filename or "audio.mp3")
+        if reply_markup is not None:
+            import json as _json
+
+            form.add_field("reply_markup", _json.dumps(reply_markup))
+        async with aiohttp.ClientSession(timeout=self._timeout) as session:
+            async with session.post(self._url("sendAudio"), data=form) as resp:
+                data = await resp.json(content_type=None)
+        if not data.get("ok"):
+            code = data.get("error_code", 0)
+            desc = data.get("description", "")
+            if code == 403:
+                raise TelegramForbidden(code, desc)
+            if code == 400:
+                raise TelegramBadRequest(code, desc)
+            raise TelegramAPIError(code, desc)
+        return data["result"]
+
+    async def send_chat_action(self, chat_id: str, action: str = "typing") -> Any:
+        return await self.call("sendChatAction", chat_id=chat_id, action=action)
+
+    async def get_file(self, file_id: str) -> Dict:
+        return await self.call("getFile", file_id=file_id)
+
+    async def download_file(self, file_path: str) -> bytes:
+        url = f"{self.base}/file/bot{self.token}/{file_path}"
+        async with aiohttp.ClientSession(timeout=self._timeout) as session:
+            async with session.get(url) as resp:
+                resp.raise_for_status()
+                return await resp.read()
+
+    async def get_updates(
+        self, offset: Optional[int] = None, timeout: int = 30
+    ) -> List[Dict]:
+        return await self.call("getUpdates", offset=offset, timeout=timeout)
+
+    async def set_webhook(self, url: str) -> Any:
+        return await self.call("setWebhook", url=url)
+
+    async def answer_callback_query(self, callback_query_id: str) -> Any:
+        return await self.call("answerCallbackQuery", callback_query_id=callback_query_id)

@@ -1,0 +1,95 @@
+"""Markdown -> Telegram MarkdownV2 renderer (reference: platforms/telegram/format.py:12-426).
+
+The reference pipes markdown2 -> BeautifulSoup -> a recursive formatter-node tree.
+Neither markdown2 nor the heavyweight tree is needed for the MarkdownV2 subset
+Telegram accepts; this renderer works directly on the markdown source:
+
+- code fences / inline code are extracted first and re-inserted verbatim (their
+  contents only escape `` ` `` and ``\\``);
+- bold/italic/strikethrough/links are converted token-wise;
+- every other MarkdownV2-special character is escaped;
+- any failure falls back to fully-escaped plain text (the reference's fallback).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import List
+
+logger = logging.getLogger(__name__)
+
+_SPECIAL = r"_*[]()~`>#+-=|{}.!"
+
+
+def escape_markdown_v2(text: str) -> str:
+    return "".join("\\" + c if c in _SPECIAL else c for c in text)
+
+
+def _escape_code(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("`", "\\`")
+
+
+_FENCE_RE = re.compile(r"```(\w*)\n(.*?)```", re.DOTALL)
+_INLINE_CODE_RE = re.compile(r"`([^`\n]+)`")
+_BOLD_RE = re.compile(r"\*\*(.+?)\*\*|__(.+?)__")
+_ITALIC_RE = re.compile(r"(?<!\*)\*([^*\n]+)\*(?!\*)|(?<!_)_([^_\n]+)_(?!_)")
+_STRIKE_RE = re.compile(r"~~(.+?)~~")
+_LINK_RE = re.compile(r"\[([^\]]+)\]\(([^)]+)\)")
+_HEADER_RE = re.compile(r"^#{1,6}\s+(.*)$", re.MULTILINE)
+
+
+def format_markdown_v2(text: str) -> str:
+    """Render common markdown into MarkdownV2; escape-all on any error."""
+    try:
+        return _format(text)
+    except Exception:
+        logger.exception("markdown render failed; falling back to escaped text")
+        return escape_markdown_v2(text)
+
+
+def _format(text: str) -> str:
+    placeholders: List[str] = []
+
+    def stash(rendered: str) -> str:
+        placeholders.append(rendered)
+        return f"\x00{len(placeholders) - 1}\x00"
+
+    # 1) protect code blocks / inline code
+    text = _FENCE_RE.sub(
+        lambda m: stash(f"```{m.group(1)}\n{_escape_code(m.group(2))}```"), text
+    )
+    text = _INLINE_CODE_RE.sub(lambda m: stash(f"`{_escape_code(m.group(1))}`"), text)
+    # 2) structural markdown -> placeholders with escaped inner text
+    text = _LINK_RE.sub(
+        lambda m: stash(
+            f"[{escape_markdown_v2(m.group(1))}]({_escape_link(m.group(2))})"
+        ),
+        text,
+    )
+    text = _BOLD_RE.sub(
+        lambda m: stash(f"*{escape_markdown_v2(m.group(1) or m.group(2))}*"), text
+    )
+    text = _STRIKE_RE.sub(lambda m: stash(f"~{escape_markdown_v2(m.group(1))}~"), text)
+    text = _ITALIC_RE.sub(
+        lambda m: stash(f"_{escape_markdown_v2(m.group(1) or m.group(2))}_"), text
+    )
+    text = _HEADER_RE.sub(lambda m: stash(f"*{escape_markdown_v2(m.group(1))}*"), text)
+    # 3) escape everything else
+    text = escape_markdown_v2(text)
+    # 4) restore
+    for i, rendered in enumerate(placeholders):
+        text = text.replace(f"\x00{i}\x00", rendered)
+    return text
+
+
+def _escape_link(url: str) -> str:
+    return url.replace("\\", "\\\\").replace(")", "\\)")
+
+
+class TelegramMarkdownV2FormattedText(str):
+    """str subclass rendering its content as escaped MarkdownV2 when formatted
+    into an f-string (reference class of the same name)."""
+
+    def __new__(cls, text: str):
+        return super().__new__(cls, escape_markdown_v2(str(text)))

@@ -1,0 +1,192 @@
+"""Telegram platform adapter (reference: assistant/bot/platforms/telegram/platform.py:13-199).
+
+Behavior parity: webhook-JSON → Update conversion (message / callback / photo /
+contact), MarkdownV2 send with plain-text retry on parse failure, inline + reply
+keyboards, audio, Forbidden → UserUnavailableError mapping (except
+kicked/deleted/deactivated chats), typing action.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from ...domain import (
+    BotPlatform,
+    Photo,
+    SingleAnswer,
+    UnknownUpdate,
+    Update,
+    User,
+    UserUnavailableError,
+)
+from .api import TelegramAPI, TelegramBadRequest, TelegramForbidden
+from .format import format_markdown_v2
+
+logger = logging.getLogger(__name__)
+
+_PERMANENT_FORBIDDEN = ("bot was kicked", "group chat was deleted", "user is deactivated")
+
+
+class TelegramBotPlatform(BotPlatform):
+    def __init__(self, token: str, api: Optional[TelegramAPI] = None):
+        self.api = api or TelegramAPI(token)
+
+    @property
+    def codename(self) -> str:
+        return "telegram"
+
+    # -------------------------------------------------------------- inbound
+    async def convert_telegram_update(self, data: Dict) -> Update:
+        """Webhook update JSON -> platform-neutral Update."""
+        message = data.get("message")
+        callback = data.get("callback_query")
+        if message:
+            user_data = message.get("from")
+        elif callback:
+            user_data = callback.get("from")
+        else:
+            raise UnknownUpdate("unknown update type")
+
+        user = (
+            User(
+                id=str(user_data["id"]),
+                username=user_data.get("username"),
+                first_name=user_data.get("first_name"),
+                last_name=user_data.get("last_name"),
+                language_code=user_data.get("language_code"),
+            )
+            if user_data
+            else None
+        )
+
+        photo = None
+        phone_number = None
+        if message:
+            chat_id = message["chat"]["id"]
+            message_id = message.get("message_id")
+            text = message.get("text")
+            if message.get("contact"):
+                phone_number = message["contact"].get("phone_number")
+            if message.get("photo"):
+                largest = message["photo"][-1]
+                file_info = await self.api.get_file(largest["file_id"])
+                content = await self.api.download_file(file_info["file_path"])
+                photo = Photo(
+                    file_id=largest.get("file_unique_id", largest["file_id"]),
+                    extension=file_info["file_path"].rsplit(".", 1)[-1],
+                    content=content,
+                )
+                if not text:
+                    text = message.get("caption")
+        else:
+            chat_id = callback["from"]["id"]
+            message_id = callback["message"]["message_id"]
+            text = callback.get("data")
+
+        return Update(
+            chat_id=str(chat_id),
+            message_id=message_id,
+            text=text,
+            photo=photo,
+            user=user,
+            phone_number=phone_number,
+        )
+
+    async def get_update(self, request: Any) -> Update:
+        """``request`` is the parsed webhook JSON dict (or exposes ``.data``)."""
+        data = request if isinstance(request, dict) else getattr(request, "data", request)
+        return await self.convert_telegram_update(data)
+
+    # ------------------------------------------------------------- outbound
+    def _reply_markup(self, answer: SingleAnswer) -> Dict:
+        if answer.buttons:
+            return {
+                "inline_keyboard": [
+                    [
+                        {
+                            k: v
+                            for k, v in {
+                                "text": b.text,
+                                "callback_data": b.callback_data,
+                                "url": b.url,
+                            }.items()
+                            if v is not None
+                        }
+                        for b in row
+                    ]
+                    for row in answer.buttons
+                ]
+            }
+        if answer.reply_keyboard:
+            all_buttons = [b for row in answer.reply_keyboard for b in row]
+            request_contact = any(b.request_contact for b in all_buttons)
+            request_location = any(b.request_location for b in all_buttons)
+            return {
+                "keyboard": [
+                    [
+                        {
+                            "text": b.text,
+                            "request_contact": request_contact,
+                            "request_location": request_location,
+                        }
+                        for b in row
+                    ]
+                    for row in answer.reply_keyboard
+                ],
+                "one_time_keyboard": request_contact or request_location,
+                "resize_keyboard": True,
+            }
+        return {"remove_keyboard": True}
+
+    def _check_forbidden(self, e: TelegramForbidden, chat_id: str) -> None:
+        desc = e.description.lower()
+        if not any(reason in desc for reason in _PERMANENT_FORBIDDEN):
+            logger.warning("user %s unavailable: %s", chat_id, e.description)
+            raise UserUnavailableError(chat_id) from e
+        logger.warning("send forbidden to %s (%s); not marking unavailable", chat_id, e.description)
+
+    async def post_answer(self, chat_id: str, answer: SingleAnswer) -> None:
+        reply_markup = self._reply_markup(answer)
+
+        if answer.audio:
+            try:
+                await self.api.send_audio(
+                    chat_id,
+                    bytes(answer.audio.content),
+                    filename=answer.audio.filename,
+                    reply_markup=None if answer.text else reply_markup,
+                )
+            except TelegramForbidden as e:
+                self._check_forbidden(e, chat_id)
+            except TelegramBadRequest as e:
+                logger.error("audio send failed to %s: %s", chat_id, e)
+
+        if not answer.text:
+            return
+        rendered = format_markdown_v2(answer.text)
+        for parse_mode, text in (("MarkdownV2", rendered), (None, answer.text)):
+            try:
+                await self.api.send_message(
+                    chat_id,
+                    text,
+                    parse_mode=parse_mode,
+                    reply_markup=reply_markup,
+                    disable_web_page_preview=answer.disable_web_page_preview,
+                )
+                return
+            except TelegramBadRequest as e:
+                if "can't parse" in e.description.lower() and parse_mode == "MarkdownV2":
+                    logger.warning("MarkdownV2 parse failed; retrying plain: %s", e)
+                    continue
+                logger.error("send failed to %s: %s", chat_id, e)
+                return
+            except TelegramForbidden as e:
+                self._check_forbidden(e, chat_id)
+                return
+
+    async def action_typing(self, chat_id: str) -> None:
+        try:
+            await self.api.send_chat_action(chat_id, "typing")
+        except Exception:
+            logger.debug("typing action failed", exc_info=True)

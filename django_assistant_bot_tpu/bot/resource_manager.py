@@ -1,0 +1,64 @@
+"""Per-bot/per-language file resources (reference: assistant/bot/resource_manager.py:13-57).
+
+Layout under ``settings.RESOURCES_DIR/<codename>/``: ``prompts/``,
+``messages/<lang>/``, ``phrases/<lang>.json``.  Messages and phrases fall back to
+the default language; phrases fall back to the literal key when missing.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+from ..conf import settings
+from .domain import NoMessageFound, NoResourceFound
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_LANGUAGE = "ru"  # reference default (assistant_bot.py DEFAULT_LANGUAGE)
+
+
+class ResourceManager:
+    def __init__(self, codename: str, language: str, default_language: str = DEFAULT_LANGUAGE):
+        self.codename = codename
+        self.language = language or default_language
+        self.default_language = default_language
+
+    def get_resource(self, path: str) -> str:
+        if not settings.RESOURCES_DIR:
+            raise NoResourceFound(f"RESOURCES_DIR unset (wanted {path})")
+        file_path = os.path.join(settings.RESOURCES_DIR, self.codename, path)
+        try:
+            with open(file_path, "r", encoding="utf-8") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise NoResourceFound(file_path)
+
+    def get_prompt(self, path: str) -> str:
+        return self.get_resource(f"prompts/{path}")
+
+    def get_message(self, path: str) -> str:
+        try:
+            return self.get_resource(f"messages/{self.language}/{path}")
+        except NoResourceFound as e:
+            logger.warning("no message %s for language %s: %s", path, self.language, e)
+            try:
+                return self.get_resource(f"messages/{self.default_language}/{path}")
+            except NoResourceFound as e2:
+                raise NoMessageFound(str(e2))
+
+    def get_phrase(self, phrase: str) -> str:
+        for lang in (self.language, self.default_language):
+            try:
+                raw = self.get_resource(f"phrases/{lang}.json")
+            except NoResourceFound:
+                continue
+            try:
+                phrases = json.loads(raw)
+            except json.JSONDecodeError:
+                logger.exception("failed to parse phrases for %s", lang)
+                continue
+            if phrase in phrases:
+                return phrases[phrase]
+        return phrase

@@ -1,0 +1,2 @@
+from .service import ContextService  # noqa: F401
+from .state import ContextProcessingState  # noqa: F401

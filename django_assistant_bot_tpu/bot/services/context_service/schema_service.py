@@ -1,0 +1,16 @@
+"""json_prompt() bound to the bot plane's schemas directory
+(reference: assistant/bot/services/schema_service.py)."""
+
+from __future__ import annotations
+
+import os
+
+from ....utils.json_schema import JSONSchema
+
+SCHEMA_DIR = os.path.join(os.path.dirname(os.path.realpath(__file__)), "..", "..", "schemas")
+
+_json_schema = JSONSchema(SCHEMA_DIR)
+
+
+def json_prompt(name, *args, **kwargs) -> str:
+    return _json_schema.get_prompt(name, *args, **kwargs)

@@ -1,0 +1,83 @@
+"""The enrichment pipeline runner (reference: .../context_service/service.py:20-83).
+
+Steps inside one group run concurrently via ``asyncio.gather`` (the reference's
+Classify ∥ Embeddings hot pair); between groups the pipeline early-exits on
+``state.done`` or the external interrupt callback (an answer already landed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Dict, List, Optional, Type, Union
+
+from ....ai.domain import Message
+from ....storage.models import Bot
+from .state import ContextProcessingState
+from .steps.base import ContextProcessingStep
+from .steps.choose_known_question import ChooseKnownQuestionStep
+from .steps.classify import ClassifyStep
+from .steps.embeddings import EmbeddingsStep
+from .steps.fill_info import FillInfoStep
+from .steps.final_prompt import FinalPromptStep
+from .steps.interruptions import InterruptIfSmallTalkStep
+
+logger = logging.getLogger(__name__)
+
+StepOrGroup = Union[Type[ContextProcessingStep], List[Type[ContextProcessingStep]]]
+
+DEFAULT_PIPELINE: List[StepOrGroup] = [
+    [ClassifyStep, EmbeddingsStep],
+    InterruptIfSmallTalkStep,
+    ChooseKnownQuestionStep,
+    FillInfoStep,
+    FinalPromptStep,
+]
+
+
+class ContextService:
+    def __init__(
+        self,
+        bot: Bot,
+        fast_ai_model: str,
+        strong_ai_model: str,
+        messages: List[Message],
+        debug_info: Optional[Dict] = None,
+        do_interrupt: Optional[Callable[[], Awaitable[bool]]] = None,
+        pipeline: Optional[List[StepOrGroup]] = None,
+    ):
+        self._bot = bot
+        self._fast_ai_model = fast_ai_model
+        self._strong_ai_model = strong_ai_model
+        self._debug_info = debug_info if debug_info is not None else {}
+        self._do_interrupt = do_interrupt
+        self._pipeline_spec = pipeline if pipeline is not None else DEFAULT_PIPELINE
+        self._state = ContextProcessingState()
+        self._state.messages = messages
+
+    async def enrich(self) -> List[Message]:
+        await self._run_pipeline(self._pipeline_spec)
+        return self._state.messages
+
+    async def _run_pipeline(self, pipeline: List[StepOrGroup]) -> None:
+        for steps in pipeline:
+            if not isinstance(steps, list):
+                steps = [steps]
+            await self._run_steps(steps)
+            if self._do_interrupt and await self._do_interrupt():
+                break
+            if self._state.done:
+                break
+
+    async def _run_steps(self, step_cls_list: List[Type[ContextProcessingStep]]) -> None:
+        steps = [
+            step_cls(
+                bot=self._bot,
+                state=self._state,
+                fast_ai_model=self._fast_ai_model,
+                strong_ai_model=self._strong_ai_model,
+                debug_info=self._debug_info,
+            )
+            for step_cls in step_cls_list
+        ]
+        await asyncio.gather(*(step.run() for step in steps))

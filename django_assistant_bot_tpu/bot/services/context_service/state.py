@@ -1,0 +1,28 @@
+"""Shared mutable state flowing through the enrichment pipeline
+(reference: assistant/bot/services/context_service/state.py:7-25)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ....ai.domain import Message
+from ....storage.models import Document, Question, WikiDocument
+
+
+class ContextProcessingState:
+    def __init__(self) -> None:
+        self.messages: List[Message] = []
+        self.topic: Optional[WikiDocument] = None
+        self.related_questions: List[Question] = []
+        self.documents: List[Document] = []
+        self.final_info: Optional[str] = None
+        self.context_is_ok: Optional[bool] = None
+        self.done: bool = False
+
+    @property
+    def user_question(self) -> str:
+        return self.messages[-1]["content"].strip()
+
+    @user_question.setter
+    def user_question(self, value: str) -> None:
+        self.messages[-1]["content"] = value

@@ -1,0 +1,42 @@
+"""Context-sufficiency check — dormant in the default pipeline
+(reference: .../steps/check_context.py:7-44)."""
+
+from __future__ import annotations
+
+from .....utils.repeat_until import repeat_until
+from ..schema_service import json_prompt
+from ..utils import add_system_message
+from .base import ContextProcessingStep, ai_debugger
+
+
+class CheckContextStep(ContextProcessingStep):
+    debug_info_key = "check_context"
+
+    @ai_debugger
+    async def run(self) -> None:
+        if not self._state.final_info:
+            self._state.context_is_ok = False
+            return
+        new_messages = add_system_message(
+            self._state.messages,
+            (
+                "You must find out if the information below contains an answer to "
+                "the user's question.\n"
+                f"{self._state.final_info}\n"
+                "Do check if the information above contains an answer to the "
+                "user's question.\n"
+                "As you remember, the user's question is:\n"
+                f"```\n{self._state.user_question}\n```\n"
+                "If the information is enough just answer `true`.\n"
+                "If the information does not contain the answer, answer `false`.\n"
+                f"{json_prompt('check_context')}"
+            ),
+        )
+        response = await repeat_until(
+            self._fast_ai.get_response,
+            new_messages,
+            max_tokens=256,
+            json_format=True,
+            condition=lambda resp: "result" in resp.result,
+        )
+        self._state.context_is_ok = response.result["result"]

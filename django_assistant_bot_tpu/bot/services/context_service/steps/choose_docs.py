@@ -1,0 +1,62 @@
+"""LLM document selection — dormant in the default pipeline
+(reference: .../steps/choose_docs.py:13-80)."""
+
+from __future__ import annotations
+
+from .....storage.models import WikiDocument
+from .....utils.repeat_until import repeat_until
+from ..schema_service import json_prompt
+from ..utils import add_system_message, fuzzy_best_match
+from .base import ContextProcessingStep, ai_debugger
+
+
+class ChooseDocsStep(ContextProcessingStep):
+    debug_info_key = "choice"
+
+    def _doc_title(self, doc) -> str:
+        wiki = WikiDocument.objects.get_or_none(id=doc.wiki_id) if doc.wiki_id else None
+        path = wiki.path if wiki else doc.name
+        return path.replace(" / ", ". ")
+
+    @ai_debugger
+    async def run(self) -> None:
+        documents = self._state.documents[:10]
+        if not documents:
+            return
+        doc_titles = [self._doc_title(d) for d in documents]
+        title_choices = "\n".join(f"- {t}" for t in doc_titles)
+        new_messages = add_system_message(
+            self._state.messages,
+            (
+                "You can answer the user using information from these documents:\n"
+                f"{title_choices}\n"
+                "However, you must choose up to 3 documents from the list above to "
+                "get details.\n"
+                "Give the rows from the list above that relate to the user's question:\n"
+                f"```\n{self._state.user_question}\n```\n"
+                "Give each selected row in full - EXACTLY as it represented in the list.\n"
+                "Do not hesitate to provide MULTIPLE rows if necessary.\n"
+                "If none of the documents are relevant to the user's question, "
+                "just provide an empty list.\n"
+                f"{json_prompt(['choose_documents'])}"
+            ),
+        )
+        response = await repeat_until(
+            self._fast_ai.get_response,
+            new_messages,
+            json_format=True,
+            condition=lambda r: "documents" in r.result
+            and isinstance(r.result["documents"], list),
+        )
+        chosen_titles = response.result["documents"]
+        self._debug_info["chosen"] = chosen_titles
+        if not chosen_titles:
+            self._state.documents = []
+            return
+        picked = []
+        for title in chosen_titles[:3]:
+            best = fuzzy_best_match(str(title), doc_titles)
+            doc = documents[doc_titles.index(best)]
+            if doc not in picked:
+                picked.append(doc)
+        self._state.documents = picked

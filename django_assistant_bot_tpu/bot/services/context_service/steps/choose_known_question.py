@@ -1,0 +1,51 @@
+"""Known-question matching step (reference: .../steps/choose_known_question.py:9-66)."""
+
+from __future__ import annotations
+
+from .....storage.models import Document
+from .....utils.repeat_until import repeat_until
+from ..schema_service import json_prompt
+from ..utils import add_system_message, get_numerical_list_str
+from .base import ContextProcessingStep, ai_debugger
+
+
+class ChooseKnownQuestionStep(ContextProcessingStep):
+    debug_info_key = "known_question_choice"
+
+    @ai_debugger
+    async def run(self) -> None:
+        questions = self._state.related_questions
+        if not questions:
+            return
+        prompt = (
+            "The user asked a question:\n"
+            f"```\n{self._state.user_question}\n```\n\n"
+            "Your task is to determine if any of the known questions below have "
+            "the same meaning as the user's question. Two questions have the same "
+            "meaning if the answer to the user's question would also correctly "
+            "answer the known question. Only consider questions to be the same if "
+            "their answers would be identical.\n"
+            "Here are the known questions:\n"
+            f"```\n{get_numerical_list_str([q.text for q in questions[:5]])}\n```\n"
+            "Please provide the number of the known question that matches the "
+            "user's question in meaning. If none of the known questions match the "
+            "user's question in meaning, provide `null`.\n"
+            f"{json_prompt(['choose_known_question'])}"
+        )
+        new_messages = add_system_message([], prompt)
+        response = await repeat_until(
+            self._fast_ai.get_response,
+            new_messages,
+            json_format=True,
+            condition=lambda r: "question" in r.result
+            and (isinstance(r.result["question"], int) or r.result["question"] is None),
+        )
+        chosen = response.result["question"]
+        if chosen and 1 <= chosen <= len(questions[:5]):
+            q = questions[chosen - 1]
+            self._debug_info["the_same_question"] = q.text
+            document = Document.objects.get(id=q.document_id)
+            self._debug_info["document"] = f"[{document.id}] {document.name}"
+            self._state.documents = [document]
+        else:
+            self._debug_info["the_same_question"] = None

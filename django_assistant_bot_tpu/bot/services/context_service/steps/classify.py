@@ -1,0 +1,97 @@
+"""Topic classification step (reference: .../steps/classify.py:13-96).
+
+Fast-LLM JSON call choosing among root wiki topics + "Small talk"; fuzzy-matches
+the model's answer back onto the topic list; example questions are sampled from
+each topic's subtree.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Tuple
+
+from .....storage.models import Question, WikiDocument
+from .....utils.repeat_until import repeat_until
+from ..schema_service import json_prompt
+from ..utils import add_system_message, fuzzy_best_match, get_list_str
+from .base import (
+    ContextProcessingStep,
+    ai_debugger,
+    completed_wiki_ids,
+    documents_for_wikis,
+)
+
+SMALLTALK = "Small talk"
+
+
+class ClassifyStep(ContextProcessingStep):
+    debug_info_key = "classify"
+
+    _offtopic_examples = [
+        ("Hello", SMALLTALK),
+        ("How are you?", SMALLTALK),
+        ("What's the weather in Moscow?", SMALLTALK),
+    ]
+
+    @ai_debugger
+    async def run(self) -> None:
+        done_ids = completed_wiki_ids(self._bot)
+        roots = [
+            w
+            for w in WikiDocument.objects.filter(bot=self._bot, parent=None).order_by("id")
+            if w.id in done_ids
+        ]
+        topics = [SMALLTALK] + [t.title for t in roots]
+        examples = self._offtopic_examples + self._examples(roots)
+        new_messages = add_system_message(
+            self._state.messages, self.prompt(topics, examples, self._state.user_question)
+        )
+        response = await repeat_until(
+            self._fast_ai.get_response,
+            new_messages,
+            max_tokens=256,
+            json_format=True,
+            condition=self._condition,
+        )
+        topic = response.result["topic"]
+        self._logger.info("classified question: %s", topic)
+        best_title = fuzzy_best_match(topic, topics)
+        if best_title == SMALLTALK:
+            self._debug_info["topic"] = SMALLTALK
+            return
+        wd = roots[topics.index(best_title) - 1]
+        self._debug_info["topic"] = wd.title
+        self._state.topic = wd
+
+    @staticmethod
+    def prompt(topics: List[str], examples: List[Tuple[str, str]], user_question: str) -> str:
+        topics_str = get_list_str(topics)
+        examples_str = get_list_str([f'"{q}" -> "{t}"' for q, t in examples])
+        return (
+            "Classify the user's question in a way that will help to search answer "
+            "in the database by sentence embeddings.\n"
+            "Do not answer the question, but just classify to provide the search query.\n\n"
+            f"Possible topics:\n{topics_str}\n"
+            f"Examples:\n{examples_str}\n\n"
+            "Please, provide the topic name that is relevant to the user question:\n"
+            f"```\n{user_question}\n```\n"
+            "Give only the topic name in the original spelling including language.\n"
+            f"{json_prompt(['classify'])}"
+        )
+
+    def _examples(self, roots: List[WikiDocument], numbers_per_topic: int = 2) -> List[Tuple[str, str]]:
+        result: List[Tuple[str, str]] = []
+        for wiki in roots:
+            subtree_ids = {wiki.id} | {d.id for d in wiki.descendants()}
+            doc_ids = [d.id for d in documents_for_wikis(subtree_ids)]
+            if not doc_ids:
+                continue
+            questions = Question.objects.filter(document__in=doc_ids).all()
+            random.shuffle(questions)
+            for q in questions[:numbers_per_topic]:
+                result.append((q.text, wiki.title))
+        return result
+
+    @staticmethod
+    def _condition(response) -> bool:
+        return "topic" in response.result and isinstance(response.result["topic"], str)

@@ -1,0 +1,57 @@
+"""Embedding-search step (reference: .../steps/embeddings.py:11-69).
+
+One query embedding, KNN over the bot's question vectors (TPU exact index), then
+either a direct document hit (distance < 0.05 — "the same question") or a broad
+doc-score search.
+"""
+
+from __future__ import annotations
+
+from .....rag.services.search_service import (
+    embedding_search,
+    embedding_search_questions,
+    get_embedding,
+)
+from .....storage.models import Document, Question
+from .base import ContextProcessingStep, question_ids_for_bot, time_debugger
+
+SAME_QUESTION_DISTANCE = 0.05
+
+
+class EmbeddingsStep(ContextProcessingStep):
+    debug_info_key = "embedding_search"
+
+    @time_debugger
+    async def run(self) -> None:
+        search_query = self._state.user_question
+        self._logger.debug("search query: %s", search_query)
+
+        allowed = question_ids_for_bot(self._bot)
+        query_embedding = await get_embedding(search_query)
+        questions = await embedding_search_questions(
+            query_embedding, n=5, allowed_ids=allowed
+        )
+        self._state.related_questions = questions
+        self._debug_info["related_questions"] = [
+            f"[{q.id} {1 - q.distance}] {q.text}" for q in questions[:5]
+        ]
+
+        if questions and questions[0].distance < SAME_QUESTION_DISTANCE:
+            self._debug_info["the_same_question"] = questions[0].text
+            doc = Document.objects.get(id=questions[0].document_id)
+            documents = [(doc, 1 - questions[0].distance)]
+        else:
+            documents = await embedding_search(
+                search_query,
+                Question,
+                max_scores_n=5,
+                top_n=5,
+                allowed_ids=allowed,
+            )
+
+        # uniq by doc id, keep best score order
+        documents = list({doc.id: (doc, score) for doc, score in documents}.values())
+        self._debug_info["documents"] = [
+            f"[{d.id} {score}] {d.name}" for d, score in documents
+        ]
+        self._state.documents = [d for d, _ in documents]

@@ -1,0 +1,44 @@
+"""Token-budgeted document packing (reference: .../steps/fill_info.py:6-33).
+
+Packs at most ``max_documents`` docs into ``max_tokens_share`` of the fast
+model's context window.
+"""
+
+from __future__ import annotations
+
+from .....storage.models import WikiDocument
+from .base import ContextProcessingStep
+
+
+class FillInfoStep(ContextProcessingStep):
+    max_tokens_share = 0.15
+    max_documents = 3
+
+    async def run(self) -> None:
+        documents = list(self._state.documents)
+        if not documents:
+            return
+        max_tokens = int(self._fast_ai.context_size * self.max_tokens_share)
+        output = ""
+        n = 0
+        while documents and n < self.max_documents:
+            document = documents.pop(0)
+            wiki = (
+                WikiDocument.objects.get_or_none(id=document.wiki_id)
+                if document.wiki_id
+                else None
+            )
+            path = wiki.path if wiki else document.name
+            new_output = f"{output}# {path}:\n```\n{document.content}\n```\n"
+            if output and self._fast_ai.calculate_tokens(new_output) > max_tokens:
+                break
+            output = new_output
+            n += 1
+        self._logger.info(
+            "filled output with %d documents, %d tokens",
+            n,
+            self._fast_ai.calculate_tokens(output),
+        )
+        self._state.documents = self._state.documents[:n]
+        self._state.final_info = output
+        self._state.context_is_ok = True

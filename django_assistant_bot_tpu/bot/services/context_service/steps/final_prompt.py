@@ -1,0 +1,47 @@
+"""Final system-prompt injection (reference: .../steps/final_prompt.py:7-44)."""
+
+from __future__ import annotations
+
+from datetime import datetime
+
+from ..utils import add_system_message
+from .base import ContextProcessingStep, ai_debugger
+
+
+class FinalPromptStep(ContextProcessingStep):
+    debug_info_key = "final"
+
+    @ai_debugger
+    async def run(self) -> None:
+        if self._state.context_is_ok:
+            self._state.messages = add_system_message(
+                self._state.messages,
+                (
+                    "You must answer the user only using the following information:\n"
+                    "```\n"
+                    f"{self._state.final_info}\n"
+                    f"# Current date: `{datetime.now().strftime('%Y-%m-%d %H:%M:%S')}`\n\n"
+                    "```\n"
+                    "As you remember, the question from the user is:\n"
+                    f"```\n{self._state.user_question}\n```\n"
+                    "If that information does not contain the answer, you must say "
+                    "that you don't have information like \"I'm sorry, I don't have "
+                    "enough information to answer your question.\" (but in user's "
+                    "language).\n"
+                    "Follow the original wording as much as possible.\n"
+                    "It would be ideal if your answer was an exact and complete "
+                    "quote from the document. Don't leave out details in your answer.\n"
+                ),
+            )
+        else:
+            self._state.messages = add_system_message(
+                self._state.messages,
+                (
+                    "Unfortunately, there is not enough information to answer the "
+                    "user's question for you.\n"
+                    "Answer the user that you could not help with the question.\n"
+                ),
+            )
+        self._debug_info["input"] = [
+            f"[{doc.id}] {doc.name}" for doc in self._state.documents
+        ]

@@ -1,0 +1,11 @@
+"""Small-talk early exit (reference: .../steps/interruptions.py:4-10)."""
+
+from __future__ import annotations
+
+from .base import ContextProcessingStep
+
+
+class InterruptIfSmallTalkStep(ContextProcessingStep):
+    async def run(self) -> None:
+        if self._state.topic is None:
+            self._state.done = True

@@ -1,0 +1,38 @@
+"""Query reformulation step — dormant in the default pipeline
+(reference: .../steps/reformulate_question.py:7-34)."""
+
+from __future__ import annotations
+
+from .....utils.repeat_until import repeat_until
+from ..schema_service import json_prompt
+from ..utils import add_system_message
+from .base import ContextProcessingStep, ai_debugger
+
+
+class ReformulateQuestionStep(ContextProcessingStep):
+    debug_info_key = "reformulate_question"
+
+    @ai_debugger
+    async def run(self) -> None:
+        new_messages = add_system_message(
+            self._state.messages,
+            (
+                "Reformulate the user's question in a way that will help to search "
+                "answer in the database by sentence embeddings.\n"
+                "Do not answer the question, but just reformulate to provide the "
+                "search query.\n"
+                "You must use the original query language.\n"
+                f"{json_prompt(['reformulate'])}"
+            ),
+        )
+        response = await repeat_until(
+            self._fast_ai.get_response,
+            new_messages,
+            max_tokens=256,
+            json_format=True,
+            condition=lambda resp: "query" in resp.result,
+        )
+        query = response.result["query"]
+        self._logger.info("reformulated question: %s", query)
+        self._debug_info["new_question"] = query
+        self._state.user_question = query

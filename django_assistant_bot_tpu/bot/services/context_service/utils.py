@@ -1,0 +1,37 @@
+"""Prompt-assembly helpers (reference: assistant/bot/services/context_service/utils.py)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ....ai.domain import Message
+
+
+def add_system_message(messages: List[Message], content: str) -> List[Message]:
+    return list(messages) + [Message(role="system", content=content)]
+
+
+def get_list_str(items: List[str]) -> str:
+    return "\n".join(f"- {s}" for s in items)
+
+
+def get_numerical_list_str(items: List[str]) -> str:
+    return "\n".join(f"{i + 1}. `{s}`" for i, s in enumerate(items))
+
+
+def fuzzy_best_match(query: str, choices: List[str]) -> str:
+    """Closest choice by similarity ratio (the fuzzywuzzy-extractBests analog,
+    difflib-based since fuzzywuzzy isn't in this image)."""
+    import difflib
+
+    if not choices:
+        return query
+    query_l = query.lower().strip()
+    for c in choices:  # exact (case-insensitive) wins outright
+        if c.lower().strip() == query_l:
+            return c
+    scored = [
+        (difflib.SequenceMatcher(None, query_l, c.lower()).ratio(), c) for c in choices
+    ]
+    scored.sort(key=lambda x: -x[0])
+    return scored[0][1]

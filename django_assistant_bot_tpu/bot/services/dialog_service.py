@@ -1,0 +1,128 @@
+"""Dialog lifecycle services (reference: assistant/bot/services/dialog_service.py:17-135).
+
+DB-backed: dialog TTL rollover, idempotent message creation (unique
+``(dialog, message_id)``), GPT-message assembly (``/continue`` becomes a system
+"Continue" nudge; photos attach as base64 image payloads), answered-checks, and
+per-message cost rollup.  sqlite calls are in-process and microsecond-fast, so
+these are plain sync functions; async engine code calls them directly.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+import logging
+import os
+from typing import List, Optional
+
+from ...ai.domain import Message as GPTMessage
+from ...ai.services.ai_service import calculate_ai_cost
+from ...conf import settings
+from ...storage.models import Dialog, Instance, Message, Role
+from ..domain import Photo, SingleAnswer
+
+logger = logging.getLogger(__name__)
+
+
+def get_gpt_messages(
+    dialog: Dialog, system_text: Optional[str], last_message_id: Optional[int] = None
+) -> List[GPTMessage]:
+    messages: List[GPTMessage] = (
+        [{"role": "system", "content": system_text}] if system_text else []
+    )
+    for message in Message.objects.filter(dialog=dialog).order_by("timestamp", "id"):
+        if last_message_id and message.id > last_message_id:
+            continue
+        if message.text and message.text == "/continue":
+            messages.append({"role": "system", "content": "Continue"})
+            continue
+        entry: GPTMessage = {
+            "role": message.role.name if message.role_id else "user",
+            "content": message.text,
+        }
+        if message.photo and os.path.exists(message.photo):
+            with open(message.photo, "rb") as f:
+                entry["images"] = [base64.b64encode(f.read()).decode("utf-8")]
+        messages.append(entry)
+    return messages
+
+
+def get_dialog(instance: Instance, ttl: Optional[_dt.timedelta] = None) -> Dialog:
+    """Current open dialog, rolled over when the last message is older than ttl
+    (reference :71-83)."""
+    open_ids = [
+        d.id for d in Dialog.objects.filter(instance=instance, is_completed=False)
+    ]
+    last_message = (
+        Message.objects.filter(dialog__in=open_ids).order_by("-timestamp", "-id").first()
+        if open_ids
+        else None
+    )
+    now = _dt.datetime.now(_dt.timezone.utc)
+    if last_message and (ttl is None or last_message.timestamp > now - ttl):
+        return last_message.dialog
+    if last_message:
+        Dialog.objects.filter(id=last_message.dialog_id).update(is_completed=True)
+    return Dialog.objects.create(instance=instance)
+
+
+def get_last_message(dialog: Dialog) -> Optional[Message]:
+    return Message.objects.filter(dialog=dialog).order_by("-timestamp", "-id").first()
+
+
+def _save_photo(photo: Photo) -> Optional[str]:
+    media_dir = os.environ.get("DABT_MEDIA_DIR", os.path.join(os.getcwd(), "media", "photos"))
+    try:
+        os.makedirs(media_dir, exist_ok=True)
+        path = os.path.join(media_dir, f"{photo.file_id}.{photo.extension}")
+        with open(path, "wb") as f:
+            f.write(bytes(photo.content))
+        return path
+    except OSError:
+        logger.exception("failed to persist photo %s", photo.file_id)
+        return None
+
+
+def create_user_message(
+    dialog: Dialog,
+    message_id: Optional[int],
+    text: Optional[str] = None,
+    photo: Optional[Photo] = None,
+    phone_number: Optional[str] = None,
+) -> Message:
+    user_role = Role.get_cached("user")
+    photo_path = _save_photo(photo) if photo else None
+    if phone_number and not text:
+        text = f"Phone number: {phone_number}"
+    elif phone_number:
+        text = f"{text}\nPhone number: {phone_number}"
+    m, _ = Message.objects.get_or_create(
+        dialog=dialog,
+        message_id=message_id,
+        defaults={"role": user_role, "text": text, "photo": photo_path},
+    )
+    return m
+
+
+def create_bot_message(dialog: Dialog, answer: SingleAnswer) -> Message:
+    assistant_role = Role.get_cached("assistant")
+    m, _ = Message.objects.get_or_create(
+        dialog=dialog,
+        role=assistant_role,
+        text=answer.raw_text,
+        defaults={
+            "cost_details": answer.usage,
+            "cost": sum(calculate_ai_cost(u) for u in answer.usage),
+        },
+    )
+    return m
+
+
+def have_existing_answers(user_message: Message) -> bool:
+    assistant_role = Role.get_cached("assistant")
+    return (
+        Message.objects.filter(
+            dialog=user_message.dialog_id, role=assistant_role, id__gt=user_message.id
+        ).count()
+        > 0
+    )

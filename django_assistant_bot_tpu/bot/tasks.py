@@ -1,0 +1,126 @@
+"""Answer task plane (reference: assistant/bot/tasks.py:21-128).
+
+``answer_task`` is the queue entry for every conversational turn: rebuild the
+Update, take the per-instance advisory lock, run the engine, deliver the answer,
+roll up costs; Forbidden delivery marks the instance unavailable.
+``send_answer_task`` delivers one pre-built answer (broadcasting uses it).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+from ..storage.locks import InstanceLockAsync
+from ..storage.models import Bot as BotModel, BotUser, Dialog, Instance, Message
+from ..tasks.queue import CeleryQueues, task
+from .domain import (
+    Answer,
+    BotPlatform,
+    MultiPartAnswer,
+    Update,
+    UserUnavailableError,
+    answer_from_dict,
+)
+from .utils import get_bot_class, get_bot_platform
+
+logger = logging.getLogger(__name__)
+
+
+@task(queue=CeleryQueues.QUERY.value)
+def answer_task(bot_codename: str, dialog_id: int, platform_codename: str, update: Dict):
+    logger.info("answer task started (dialog %s)", dialog_id)
+    return asyncio.run(_answer_task(bot_codename, dialog_id, platform_codename, update))
+
+
+async def _answer_task(
+    bot_codename: str,
+    dialog_id: int,
+    platform_codename: str,
+    update: Dict,
+    platform: Optional[BotPlatform] = None,
+):
+    upd: Update = Update.from_dict(update)
+    platform = platform or get_bot_platform(bot_codename, platform_codename)
+    dialog = Dialog.objects.get(id=dialog_id)
+
+    bot_cls = get_bot_class(bot_codename)
+    bot = bot_cls(dialog=dialog, platform=platform)
+
+    async with InstanceLockAsync(dialog.instance):
+        dialog_ids = [
+            d.id for d in Dialog.objects.filter(instance=dialog.instance_id)
+        ]
+        message_count = (
+            Message.objects.filter(dialog__in=dialog_ids).limit(2).count()
+            if dialog_ids
+            else 0
+        )
+        if message_count <= 1:
+            await bot.on_instance_created()
+        answer = await bot.handle_update(upd)
+
+    if answer:
+        try:
+            await _post_answer(platform, upd.chat_id, answer)
+            await bot.on_answer_sent(answer)
+        except UserUnavailableError:
+            logger.warning(
+                "user %s unavailable; marking instance %s",
+                upd.chat_id,
+                dialog.instance_id,
+            )
+            instance = dialog.instance
+            instance.is_unavailable = True
+            instance.save()
+        except Exception as e:
+            logger.error("error while sending answer: %s", e)
+    return None
+
+
+async def _post_answer(platform: BotPlatform, chat_id: str, answer: Answer) -> None:
+    parts = answer.parts if isinstance(answer, MultiPartAnswer) else [answer]
+    for part in parts:
+        await platform.post_answer(chat_id, part)
+
+
+@task(queue=CeleryQueues.QUERY.value)
+def send_answer_task(bot_codename: str, platform_codename: str, chat_id: str, answer_data: Dict):
+    logger.info("send answer task started (chat %s)", chat_id)
+    return asyncio.run(
+        _send_answer_task(bot_codename, platform_codename, chat_id, answer_data)
+    )
+
+
+async def _send_answer_task(
+    bot_codename: str,
+    platform_codename: str,
+    chat_id: str,
+    answer_data: Dict,
+    platform: Optional[BotPlatform] = None,
+):
+    instance: Optional[Instance] = None
+    bot_user = BotUser.objects.get_or_none(user_id=chat_id, platform=platform_codename)
+    bot_model = BotModel.objects.get_or_none(codename=bot_codename)
+    if bot_user and bot_model:
+        instance = Instance.objects.get_or_none(bot=bot_model, user=bot_user)
+    if instance and instance.is_unavailable:
+        logger.info("skipping unavailable user %s (instance %s)", chat_id, instance.id)
+        return
+
+    platform = platform or get_bot_platform(bot_codename, platform_codename)
+    try:
+        answer = answer_from_dict(answer_data)
+    except Exception as e:
+        logger.error("could not deserialize answer: %s", e)
+        return
+    try:
+        await _post_answer(platform, chat_id, answer)
+    except UserUnavailableError:
+        logger.warning("user %s became unavailable during send", chat_id)
+        if instance:
+            instance.is_unavailable = True
+            instance.save()
+    except Exception as e:
+        logger.error("error sending answer to %s: %s", chat_id, e)

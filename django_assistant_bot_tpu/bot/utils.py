@@ -1,0 +1,45 @@
+"""Bot/platform registries (reference: assistant/bot/utils.py:21-71).
+
+``settings.BOTS`` maps codename -> {"class": "dotted.path.Bot", "platforms":
+{"telegram": {"token": ...}}, ...}; unknown codenames fall back to rows in the
+Bot table with `AssistantBot` as the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Type
+
+from ..conf import settings
+from ..storage.models import Bot as BotModel
+from .domain import Bot, BotPlatform
+
+
+def get_bot_class(codename: str) -> Type[Bot]:
+    entry = settings.BOTS.get(codename) or {}
+    class_path = entry.get("class")
+    if class_path:
+        if isinstance(class_path, type):
+            return class_path
+        return settings.import_string(class_path)
+    from .assistant_bot import AssistantBot
+
+    return AssistantBot
+
+
+def get_bot_model(codename: str) -> Optional[BotModel]:
+    return BotModel.objects.get_or_none(codename=codename)
+
+
+def get_bot_platform(codename: str, platform: str = "telegram") -> BotPlatform:
+    entry = settings.BOTS.get(codename) or {}
+    token = entry.get("telegram_token")
+    if not token:
+        bot = get_bot_model(codename)
+        token = bot.telegram_token if bot else None
+    if platform == "telegram":
+        from .platforms.telegram.platform import TelegramBotPlatform
+
+        if not token:
+            raise ValueError(f"no telegram token for bot {codename!r}")
+        return TelegramBotPlatform(token)
+    raise ValueError(f"unknown platform {platform!r}")

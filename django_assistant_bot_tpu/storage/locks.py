@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import sqlite3
 import time
 from typing import Union
 
@@ -64,7 +65,8 @@ class InstanceLock:
                 self._held = True
                 self._stamp = now
                 return
-            except Exception:
+            except sqlite3.IntegrityError:
+                # key exists -> lock held by someone else; spin below
                 conn.rollback()
             # steal stale locks from dead holders
             cur = conn.execute(

@@ -27,7 +27,11 @@ from .orm import (
 
 from ..conf import settings
 
-EMBEDDING_DIM = settings.EMBEDDING_DIM  # 768 default (reference: assistant/storage/models.py:13)
+
+def EMBEDDING_DIM() -> int:
+    """Resolved per use so settings.override(EMBEDDING_DIM=...) works after
+    import.  768 default (reference: assistant/storage/models.py:13)."""
+    return settings.EMBEDDING_DIM
 
 
 # --------------------------------------------------------------------- bot plane
@@ -42,9 +46,14 @@ class Bot(Model):
     telegram_whitelist = TextField()
 
     def whitelist(self) -> List[str]:
+        """Newline-separated entries, '@' stripped (reference: assistant_bot.py:108-113)."""
         if not self.telegram_whitelist:
             return []
-        return [u.strip() for u in self.telegram_whitelist.split(",") if u.strip()]
+        return [
+            u.strip().strip("@")
+            for u in self.telegram_whitelist.split("\n")
+            if u.strip()
+        ]
 
 
 class BotUser(Model):

@@ -120,13 +120,21 @@ class JSONField(Field):
 
 
 class VectorField(Field):
-    """float32 vector as BLOB (the pgvector-column analog; dim checked on write)."""
+    """float32 vector as BLOB (the pgvector-column analog; dim checked on write).
+
+    ``dim`` may be a callable resolved per use, so ``settings.override(
+    EMBEDDING_DIM=...)`` takes effect even after models were imported.
+    """
 
     sql_type = "BLOB"
 
-    def __init__(self, dim: int, **kw):
+    def __init__(self, dim, **kw):
         super().__init__(**kw)
-        self.dim = dim
+        self._dim = dim
+
+    @property
+    def dim(self) -> int:
+        return self._dim() if callable(self._dim) else self._dim
 
     def to_db(self, value):
         if value is None:
@@ -228,8 +236,14 @@ class QuerySet:
             elif op == "isnull":
                 clause = f'"{col}" IS NULL' if value else f'"{col}" IS NOT NULL'
             elif op == "contains":
-                clause = f'"{col}" LIKE ?'
-                qs._params.append(f"%{value}%")
+                clause = f'"{col}" LIKE ? ESCAPE \'\\\''
+                escaped = (
+                    str(value)
+                    .replace("\\", "\\\\")
+                    .replace("%", "\\%")
+                    .replace("_", "\\_")
+                )
+                qs._params.append(f"%{escaped}%")
             else:
                 clause = f'"{col}" {_OPS[op]} ?'
                 qs._params.append(f.to_db(value) if f else value)
@@ -299,6 +313,11 @@ class QuerySet:
         return qs.first()
 
     def count(self) -> int:
+        if self._limit is not None:
+            # LIMIT inside COUNT(*) caps result rows, not the count — wrap in a
+            # subquery so qs[:n].count() honors the slice (Django contract)
+            inner, params = self._sql("1")
+            return self.db.query(f"SELECT COUNT(*) FROM ({inner})", params)[0][0]
         sql, params = self._sql("COUNT(*)")
         return self.db.query(sql, params)[0][0]
 

@@ -1,0 +1,28 @@
+"""Task plane — the Celery+Redis replacement.
+
+The reference distributes work through Celery over Redis with three named queues
+and beat-scheduled cron jobs (reference: assistant/assistant/queue.py:4-7,
+assistant/processing/tasks.py:15-21, example/example/settings.py:55-60).  Here the
+broker is the same sqlite substrate the framework already owns:
+
+- durable task rows with lease-based claiming — a worker that dies mid-task lets
+  its lease expire and the row is re-dispatched (``acks_late`` +
+  ``reject_on_worker_lost`` semantics);
+- ``autoretry_for`` equivalents: per-task ``max_retries`` / ``retry_delay`` with
+  scheduled ``eta`` re-runs;
+- ``group`` + chord ``chain`` primitives (the ingestion fan-out uses them);
+- eager mode (``settings.TASK_ALWAYS_EAGER``) executing ``delay()`` inline — the
+  reference tests use exactly this shape by invoking task bodies directly;
+- a beat scheduler for periodic jobs (broadcasting's scheduled-campaign check).
+"""
+
+from .queue import (  # noqa: F401
+    CeleryQueues,
+    Task,
+    TaskRecord,
+    Worker,
+    get_task,
+    group,
+    task,
+)
+from .beat import Beat  # noqa: F401

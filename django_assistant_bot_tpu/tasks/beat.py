@@ -1,0 +1,70 @@
+"""Beat — periodic task scheduler (the celery-beat analog).
+
+The reference schedules ``check_scheduled_broadcasts`` every N seconds via
+``CELERY_BEAT_SCHEDULE`` (reference: example/example/settings.py:55-60).
+``Beat.add(task, every_s)`` + ``start()`` reproduces that: each entry enqueues
+its task at its cadence from one daemon thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import List
+
+from .queue import Task
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class _Entry:
+    task: Task
+    every_s: float
+    args: tuple = ()
+    kwargs: dict = dataclasses.field(default_factory=dict)
+    next_run: float = 0.0
+
+
+class Beat:
+    def __init__(self):
+        self._entries: List[_Entry] = []
+        self._stop = threading.Event()
+        self._thread = None
+
+    def add(self, task: Task, every_s: float, *args, **kwargs) -> "Beat":
+        self._entries.append(_Entry(task=task, every_s=every_s, args=args, kwargs=kwargs))
+        return self
+
+    def tick(self, now: float | None = None) -> int:
+        """Enqueue every due entry; returns how many fired (test hook)."""
+        now = now if now is not None else time.monotonic()
+        fired = 0
+        for e in self._entries:
+            if now >= e.next_run:
+                try:
+                    e.task.delay(*e.args, **e.kwargs)
+                    fired += 1
+                except Exception:
+                    logger.exception("beat enqueue failed for %s", e.task.name)
+                e.next_run = now + e.every_s
+        return fired
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.tick()
+            self._stop.wait(0.5)
+
+    def start(self) -> "Beat":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="beat")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,358 @@
+"""Durable sqlite task queue: registration, dispatch, leases, retries, chords."""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as _dt
+import enum
+import functools
+import inspect
+import json
+import logging
+import os
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..conf import settings
+from ..storage.orm import (
+    DateTimeField,
+    FloatField,
+    IntField,
+    JSONField,
+    Model,
+    TextField,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class CeleryQueues(str, enum.Enum):
+    """Queue names (reference: assistant/assistant/queue.py:4-7)."""
+
+    QUERY = "query"
+    PROCESSING = "processing"
+    BROADCASTING = "broadcasting"
+
+
+class TaskRecord(Model):
+    """One enqueued invocation."""
+
+    queue = TextField(null=False, index=True)
+    name = TextField(null=False)
+    args = JSONField(default=list)
+    kwargs = JSONField(default=dict)
+    status = TextField(default="pending", index=True)  # pending|running|done|failed
+    attempts = IntField(default=0)
+    max_retries = IntField(default=3)
+    retry_delay = FloatField(default=60.0)
+    eta = TextField(index=True)  # ISO ts; run at/after this time
+    lease_expires = FloatField()  # unix ts while running
+    created_at = DateTimeField(auto_now_add=True)
+    error = TextField()
+    result = JSONField()
+    group_id = TextField(index=True)
+    chord_task = JSONField()  # {"name":..., "args":..., "kwargs":...} fired when group drains
+
+
+REGISTRY: Dict[str, "Task"] = {}
+
+
+class Task:
+    """A registered task function; ``.delay()`` enqueues, ``.apply()`` runs inline."""
+
+    def __init__(
+        self,
+        fn: Callable,
+        *,
+        queue: str = CeleryQueues.QUERY.value,
+        max_retries: int = 3,
+        retry_delay: float = 60.0,
+        name: Optional[str] = None,
+    ):
+        self.fn = fn
+        self.queue = str(queue.value if isinstance(queue, CeleryQueues) else queue)
+        self.max_retries = max_retries
+        self.retry_delay = retry_delay
+        self.name = name or f"{fn.__module__}.{fn.__qualname__}"
+        functools.update_wrapper(self, fn)
+        REGISTRY[self.name] = self
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+    def apply(self, *args, **kwargs):
+        """Run inline (possibly async)."""
+        result = self.fn(*args, **kwargs)
+        if inspect.iscoroutine(result):
+            return asyncio.run(result)
+        return result
+
+    def delay(self, *args, **kwargs) -> Optional[TaskRecord]:
+        if settings.TASK_ALWAYS_EAGER:
+            self.apply(*args, **kwargs)
+            return None
+        return TaskRecord.objects.create(
+            queue=self.queue,
+            name=self.name,
+            args=list(args),
+            kwargs=dict(kwargs),
+            max_retries=self.max_retries,
+            retry_delay=self.retry_delay,
+            eta=_now_iso(),
+        )
+
+    def apply_async(self, args: Sequence = (), kwargs: Optional[dict] = None, countdown: float = 0):
+        if settings.TASK_ALWAYS_EAGER:
+            self.apply(*args, **(kwargs or {}))
+            return None
+        eta = _dt.datetime.now(_dt.timezone.utc) + _dt.timedelta(seconds=countdown)
+        return TaskRecord.objects.create(
+            queue=self.queue,
+            name=self.name,
+            args=list(args),
+            kwargs=dict(kwargs or {}),
+            max_retries=self.max_retries,
+            retry_delay=self.retry_delay,
+            eta=eta.isoformat(),
+        )
+
+
+def task(
+    queue: str = CeleryQueues.QUERY.value,
+    *,
+    max_retries: int = 3,
+    retry_delay: float = 60.0,
+    name: Optional[str] = None,
+) -> Callable[[Callable], Task]:
+    """``@task(queue='processing', max_retries=10, retry_delay=60)`` — the
+    ``@shared_task`` analog (reference: assistant/processing/tasks.py:15-21)."""
+
+    def decorator(fn: Callable) -> Task:
+        return Task(fn, queue=queue, max_retries=max_retries, retry_delay=retry_delay, name=name)
+
+    return decorator
+
+
+def get_task(name: str) -> Optional[Task]:
+    return REGISTRY.get(name)
+
+
+def _now_iso() -> str:
+    return _dt.datetime.now(_dt.timezone.utc).isoformat()
+
+
+def group(
+    invocations: Sequence[tuple],
+    *,
+    chord: Optional[tuple] = None,
+) -> List[Optional[TaskRecord]]:
+    """Enqueue ``[(task, args, kwargs), ...]`` as a group; when every member
+    finishes (done or exhausted retries), ``chord=(task, args, kwargs)`` fires —
+    the celery ``chain(group(...), finalize)`` shape the ingestion pipeline uses
+    (reference: assistant/processing/tasks.py:30-38)."""
+    if settings.TASK_ALWAYS_EAGER:
+        for t, args, kwargs in invocations:
+            t.apply(*args, **(kwargs or {}))
+        if chord:
+            t, args, kwargs = chord
+            t.apply(*args, **(kwargs or {}))
+        return []
+    gid = uuid.uuid4().hex
+    chord_payload = None
+    if chord:
+        ct, cargs, ckwargs = chord
+        chord_payload = {"name": ct.name, "args": list(cargs), "kwargs": dict(ckwargs or {})}
+    records = []
+    for t, args, kwargs in invocations:
+        records.append(
+            TaskRecord.objects.create(
+                queue=t.queue,
+                name=t.name,
+                args=list(args),
+                kwargs=dict(kwargs or {}),
+                max_retries=t.max_retries,
+                retry_delay=t.retry_delay,
+                eta=_now_iso(),
+                group_id=gid,
+                chord_task=chord_payload,
+            )
+        )
+    if not records and chord:
+        ct, cargs, ckwargs = chord
+        ct.delay(*cargs, **(ckwargs or {}))
+    return records
+
+
+class Worker:
+    """Polling worker: claims leases, executes, retries, fires chords.
+
+    At-least-once: a claim sets ``lease_expires``; rows whose lease lapsed (their
+    worker died) return to ``pending`` on the next poll.
+    """
+
+    def __init__(
+        self,
+        queues: Optional[Sequence[str]] = None,
+        *,
+        poll_s: float = 0.1,
+        lease_s: float = 300.0,
+        concurrency: int = 1,
+    ):
+        self.queues = [
+            str(q.value if isinstance(q, CeleryQueues) else q)
+            for q in (queues or [q.value for q in CeleryQueues])
+        ]
+        self.poll_s = poll_s
+        self.lease_s = lease_s
+        self.concurrency = concurrency
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------------ claims
+    def _reclaim_expired(self) -> None:
+        now = time.time()
+        TaskRecord.objects.filter(
+            status="running", lease_expires__lt=now
+        ).update(status="pending")
+
+    def claim(self) -> Optional[TaskRecord]:
+        """Atomically claim one due pending row (sqlite UPDATE is serialized)."""
+        from ..storage.db import get_database
+
+        self._reclaim_expired()
+        db = get_database()
+        db.ensure_table(TaskRecord)
+        now_iso = _now_iso()
+        placeholders = ",".join("?" * len(self.queues))
+        row = db.query(
+            f"SELECT id FROM taskrecord WHERE status='pending' AND queue IN ({placeholders}) "
+            f"AND (eta IS NULL OR eta <= ?) ORDER BY id LIMIT 1",
+            [*self.queues, now_iso],
+        )
+        if not row:
+            return None
+        task_id = row[0][0]
+        cur = db.execute(
+            "UPDATE taskrecord SET status='running', lease_expires=? "
+            "WHERE id=? AND status='pending'",
+            [time.time() + self.lease_s, task_id],
+        )
+        if cur.rowcount != 1:
+            return None  # lost the race to another worker
+        return TaskRecord.objects.get(id=task_id)
+
+    # --------------------------------------------------------------- execution
+    def run_one(self) -> bool:
+        record = self.claim()
+        if record is None:
+            return False
+        self.execute(record)
+        return True
+
+    def execute(self, record: TaskRecord) -> None:
+        t = get_task(record.name)
+        # persist the attempt BEFORE running: a task that kills its worker (OOM,
+        # SIGKILL) must still consume an attempt when the lease reclaim requeues
+        # it, or a poison task loops forever past max_retries
+        record.attempts += 1
+        record.save()
+        if record.attempts > record.max_retries + 1:
+            record.status = "failed"
+            record.error = (record.error or "") + "\nretries exhausted after worker loss"
+            record.save()
+            self._maybe_fire_chord(record)
+            return
+        if t is None:
+            record.status = "failed"
+            record.error = f"unknown task {record.name}"
+            record.save()
+            self._maybe_fire_chord(record)
+            return
+        try:
+            result = t.apply(*record.args, **(record.kwargs or {}))
+            record.status = "done"
+            try:
+                json.dumps(result)
+                record.result = result
+            except (TypeError, ValueError):
+                record.result = None
+            record.error = None
+            record.save()
+            self._maybe_fire_chord(record)
+        except Exception:
+            err = traceback.format_exc()
+            logger.exception("task %s failed (attempt %d)", record.name, record.attempts)
+            if record.attempts <= record.max_retries:
+                eta = _dt.datetime.now(_dt.timezone.utc) + _dt.timedelta(
+                    seconds=record.retry_delay
+                )
+                record.status = "pending"
+                record.eta = eta.isoformat()
+            else:
+                record.status = "failed"
+            record.error = err[-4000:]
+            record.save()
+            if record.status == "failed":
+                self._maybe_fire_chord(record)
+
+    def _maybe_fire_chord(self, record: TaskRecord) -> None:
+        if not record.group_id or not record.chord_task:
+            return
+        remaining = (
+            TaskRecord.objects.filter(group_id=record.group_id)
+            .exclude(status__in=["done", "failed"])
+            .count()
+        )
+        if remaining:
+            return
+        # exactly-once chord fire: first worker to flip the sentinel row wins
+        from ..storage.db import get_database
+
+        db = get_database()
+        cur = db.execute(
+            "UPDATE taskrecord SET chord_task=NULL WHERE group_id=? AND chord_task IS NOT NULL",
+            [record.group_id],
+        )
+        if cur.rowcount > 0:
+            chord = record.chord_task
+            t = get_task(chord["name"])
+            if t is not None:
+                t.delay(*chord.get("args", []), **chord.get("kwargs", {}))
+            else:
+                logger.error("chord task %s not registered", chord["name"])
+
+    # ------------------------------------------------------------------- loop
+    def run_until_idle(self, max_tasks: Optional[int] = None) -> int:
+        """Drain due work synchronously (test/CLI helper)."""
+        n = 0
+        while self.run_one():
+            n += 1
+            if max_tasks is not None and n >= max_tasks:
+                break
+        return n
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if not self.run_one():
+                    self._stop.wait(self.poll_s)
+            except Exception:
+                logger.exception("worker loop error")
+                self._stop.wait(1.0)
+
+    def start(self) -> "Worker":
+        self._stop.clear()
+        for i in range(self.concurrency):
+            th = threading.Thread(target=self._loop, daemon=True, name=f"task-worker-{i}")
+            th.start()
+            self._threads.append(th)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for th in self._threads:
+            th.join(timeout=5)
+        self._threads.clear()

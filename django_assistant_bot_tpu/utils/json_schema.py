@@ -1,0 +1,37 @@
+"""JSON few-shot response-format prompt builder (reference: assistant/utils/json_schema.py:5-34).
+
+Schemas are example-JSON files; ``get_prompt`` renders one or several into a
+"answer with JSON matching this example" instruction block.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Union
+
+
+class JSONSchema:
+    def __init__(self, schemas_dir: str):
+        self._schemas_dir = schemas_dir
+
+    def get_schema(self, name: str) -> str:
+        with open(os.path.join(self._schemas_dir, f"{name}.json"), encoding="utf-8") as f:
+            body = f.read().strip()
+        return f"```json\n{body}\n```\n"
+
+    def get_prompt(self, schema: Union[str, List[str]], do_escape: bool = False) -> str:
+        escape_note = (
+            "Do not forget to escape special characters in the JSON like \\n.\n"
+            if do_escape
+            else ""
+        )
+        if isinstance(schema, list):
+            blocks = "".join(self.get_schema(s) for s in schema)
+            return (
+                "Answer with a JSON response that strictly matches one of the "
+                f"following examples:\n{blocks}" + escape_note
+            )
+        return (
+            "Answer with a JSON response that strictly matches the following "
+            f"example:\n{self.get_schema(schema)}" + escape_note
+        )

@@ -1,0 +1,335 @@
+"""Bot runtime plane: domain round-trips, MarkdownV2 rendering, resources,
+dialog services, AssistantBot engine, ContextService pipeline.
+
+Test strategy mirrors the reference (SURVEY.md §4): the engine runs real against
+sqlite; AI is cut at provider level (scripted EchoProvider) or at
+`get_answer_to_messages` (reference tests/bot_tests/test_assistant_bot.py:83-107).
+"""
+
+import asyncio
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from django_assistant_bot_tpu.ai.providers.echo import EchoProvider, HashEmbedder
+from django_assistant_bot_tpu.bot import (
+    Button,
+    MultiPartAnswer,
+    Photo,
+    SingleAnswer,
+    Update,
+    User,
+    answer_from_dict,
+)
+from django_assistant_bot_tpu.bot.assistant_bot import AssistantBot
+from django_assistant_bot_tpu.bot.domain import BotPlatform
+from django_assistant_bot_tpu.bot.platforms.telegram.format import (
+    escape_markdown_v2,
+    format_markdown_v2,
+)
+from django_assistant_bot_tpu.bot.resource_manager import ResourceManager
+from django_assistant_bot_tpu.bot.services.dialog_service import (
+    create_bot_message,
+    create_user_message,
+    get_dialog,
+    get_gpt_messages,
+    have_existing_answers,
+)
+from django_assistant_bot_tpu.conf import settings
+from django_assistant_bot_tpu.storage import models
+
+
+class StubPlatform(BotPlatform):
+    def __init__(self):
+        self.posted = []
+        self.typing = 0
+
+    @property
+    def codename(self):
+        return "stub"
+
+    async def get_update(self, request):
+        raise NotImplementedError
+
+    async def post_answer(self, chat_id, answer):
+        self.posted.append((chat_id, answer))
+
+    async def action_typing(self, chat_id):
+        self.typing += 1
+
+
+@pytest.fixture()
+def instance(tmp_db):
+    bot = models.Bot.objects.create(codename="tb", system_text="You are helpful.")
+    user = models.BotUser.objects.create(user_id="u1", platform="telegram", language="en")
+    return models.Instance.objects.create(bot=bot, user=user)
+
+
+@pytest.fixture()
+def dialog(instance):
+    return models.Dialog.objects.create(instance=instance)
+
+
+@pytest.fixture()
+def bot_engine(dialog):
+    return AssistantBot(dialog, StubPlatform())
+
+
+# --------------------------------------------------------------------- domain
+def test_update_round_trip():
+    upd = Update(
+        chat_id="c1",
+        message_id=5,
+        text="hi",
+        photo=Photo(file_id="f", extension="jpg", content=b"\x01\x02"),
+        user=User(id="u", username="name"),
+    )
+    restored = Update.from_dict(upd.to_dict())
+    assert restored.chat_id == "c1" and restored.message_id == 5
+    assert restored.photo.content == b"\x01\x02"
+    assert restored.user.username == "name"
+
+
+def test_answer_round_trip():
+    ans = SingleAnswer(
+        text="hello",
+        raw_text="#text hello",
+        buttons=[[Button("Go", callback_data="/go")]],
+        usage=[{"model": "m", "prompt_tokens": 1}],
+    )
+    restored = answer_from_dict(ans.to_dict())
+    assert restored.text == "hello" and restored.raw_text == "#text hello"
+    assert restored.buttons[0][0].callback_data == "/go"
+    assert restored.final_model == "m"
+
+    multi = MultiPartAnswer(parts=[ans, SingleAnswer(text="b")])
+    restored = answer_from_dict(multi.to_dict())
+    assert isinstance(restored, MultiPartAnswer) and len(restored.parts) == 2
+
+
+# ------------------------------------------------------------------- markdown
+def test_markdown_v2_escaping_and_structure():
+    assert escape_markdown_v2("a.b!c") == "a\\.b\\!c"
+    out = format_markdown_v2("**bold** and `code_x` plus plain. text")
+    assert "*bold*" in out
+    assert "`code_x`" in out
+    assert "plain\\. text" in out
+    fenced = format_markdown_v2("```python\nx = a.b\n```")
+    assert "```python\nx = a.b\n```" in fenced
+
+
+# ------------------------------------------------------------------ resources
+def test_resource_manager_language_fallback(tmp_path):
+    bot_dir = tmp_path / "mybot"
+    (bot_dir / "messages" / "ru").mkdir(parents=True)
+    (bot_dir / "phrases").mkdir()
+    (bot_dir / "messages" / "ru" / "Hello.txt").write_text("privet")
+    (bot_dir / "phrases" / "ru.json").write_text('{"Continue": "Prodolzhit"}')
+    with settings.override(RESOURCES_DIR=str(tmp_path)):
+        rm = ResourceManager("mybot", language="en")
+        assert rm.get_message("Hello.txt") == "privet"  # en -> ru fallback
+        assert rm.get_phrase("Continue") == "Prodolzhit"
+        assert rm.get_phrase("Missing") == "Missing"  # literal fallback
+
+
+# ------------------------------------------------------------- dialog service
+def test_get_dialog_ttl_rollover(instance):
+    d1 = get_dialog(instance, ttl=dt.timedelta(days=1))
+    create_user_message(d1, 1, "hi")
+    assert get_dialog(instance, ttl=dt.timedelta(days=1)).id == d1.id
+    # age the message beyond the TTL -> new dialog, old completed
+    old = (dt.datetime.now(dt.timezone.utc) - dt.timedelta(days=2)).isoformat()
+    models.Message.objects.filter(dialog=d1).update(timestamp=old)
+    d2 = get_dialog(instance, ttl=dt.timedelta(days=1))
+    assert d2.id != d1.id
+    assert models.Dialog.objects.get(id=d1.id).is_completed
+
+
+def test_message_idempotence_and_answers(dialog):
+    m1 = create_user_message(dialog, 10, "hello")
+    m2 = create_user_message(dialog, 10, "hello again")
+    assert m1.id == m2.id  # get_or_create on (dialog, message_id)
+    assert not have_existing_answers(m1)
+    create_bot_message(dialog, SingleAnswer(text="answer", usage=[{"model": "test"}]))
+    assert have_existing_answers(m1)
+
+
+def test_get_gpt_messages_continue_and_system(dialog):
+    create_user_message(dialog, 1, "question")
+    create_user_message(dialog, 2, "/continue")
+    msgs = get_gpt_messages(dialog, "SYS")
+    assert msgs[0] == {"role": "system", "content": "SYS"}
+    assert msgs[1]["role"] == "user" and msgs[1]["content"] == "question"
+    assert msgs[2] == {"role": "system", "content": "Continue"}
+
+
+# ------------------------------------------------------------------- engine
+def _run_update(bot, text, message_id=1):
+    create_user_message(bot.dialog, message_id, text)
+    upd = Update(chat_id="c", message_id=message_id, text=text, user=User(id="u1"))
+    return asyncio.run(bot.handle_update(upd))
+
+
+def test_handle_update_with_mocked_completion(bot_engine, monkeypatch):
+    async def fake_answer(self, messages, debug_info, do_interrupt):
+        return SingleAnswer(text="mocked!", usage=[{"model": "test"}])
+
+    monkeypatch.setattr(AssistantBot, "get_answer_to_messages", fake_answer)
+    answer = _run_update(bot_engine, "what is up?")
+    assert answer.text == "mocked!"
+    # debug checkpoint persisted into instance state
+    state = models.Instance.objects.get(id=bot_engine.instance.id).state
+    assert "debug_info" in state
+
+
+def test_handle_update_unmarks_unavailable(bot_engine, monkeypatch):
+    bot_engine.instance.is_unavailable = True
+    bot_engine.instance.save()
+
+    async def fake_answer(self, messages, debug_info, do_interrupt):
+        return SingleAnswer(text="ok")
+
+    monkeypatch.setattr(AssistantBot, "get_answer_to_messages", fake_answer)
+    _run_update(bot_engine, "hello")
+    assert models.Instance.objects.get(id=bot_engine.instance.id).is_unavailable is False
+
+
+def test_whitelist_blocks_unknown_user(bot_engine):
+    bot_engine.bot.is_whitelist_enabled = True
+    bot_engine.bot.telegram_whitelist = "someoneelse"
+    bot_engine.bot.save()
+    answer = _run_update(bot_engine, "hi")
+    assert "Authorization required" in answer.text
+    assert answer.no_store
+
+
+def test_command_new_dialog(bot_engine):
+    answer = _run_update(bot_engine, "/new")
+    assert "New dialog started" in answer.text
+    assert models.Dialog.objects.get(id=bot_engine.dialog.id).is_completed
+
+
+def test_command_model_selection(bot_engine):
+    answer = _run_update(bot_engine, "/model tpu:llama-3-8b")
+    assert "selected" in answer.text
+    state = models.Instance.objects.get(id=bot_engine.instance.id).state
+    assert state["model"] == "tpu:llama-3-8b"
+    assert bot_engine._get_strong_ai_model() == "tpu:llama-3-8b"
+
+
+def test_command_unknown(bot_engine):
+    answer = _run_update(bot_engine, "/definitely_not_a_command")
+    assert "Unknown command" in answer.text
+
+
+def test_custom_command_decorator(dialog):
+    class MyBot(AssistantBot):
+        pass
+
+    @MyBot.command(r"/task (\w+)")
+    async def task_cmd(self, match, message_id):
+        return SingleAnswer(text=f"task:{match.group(1)}", no_store=True)
+
+    bot = MyBot(dialog, StubPlatform())
+    answer = _run_update(bot, "/task build")
+    assert answer.text == "task:build"
+    # the base class table must not see the subclass command
+    assert all(p.pattern != r"/task (\w+)" for p, _ in AssistantBot._command_handlers)
+
+
+def test_think_and_text_tag_extraction(bot_engine):
+    from django_assistant_bot_tpu.ai.domain import AIResponse
+
+    bot_engine.resource_manager = ResourceManager("tb", "en")
+    resp = AIResponse(
+        result="<think>step by step</think>#text The answer is 42",
+        usage={"model": "test"},
+    )
+    answer = bot_engine._ai_response_to_answer(resp)
+    assert answer.text == "The answer is 42"
+    assert answer.thinking == "step by step"
+    assert answer.raw_text.startswith("<think>")
+
+
+def test_idempotence_already_answered(bot_engine, monkeypatch):
+    calls = []
+
+    async def fake_answer(self, messages, debug_info, do_interrupt):
+        calls.append(1)
+        return SingleAnswer(text="a")
+
+    monkeypatch.setattr(AssistantBot, "get_answer_to_messages", fake_answer)
+    create_user_message(bot_engine.dialog, 1, "q")
+    create_bot_message(bot_engine.dialog, SingleAnswer(text="already", usage=[]))
+    upd = Update(chat_id="c", message_id=1, text="q", user=User(id="u1"))
+    answer = asyncio.run(bot_engine.handle_update(upd))
+    assert answer is None  # guarded: the question already has an answer
+    assert not calls
+
+
+# ------------------------------------------------------------ context service
+def _seed_kb(bot):
+    """Wiki root (completed processing) with one doc + clustered questions."""
+    wiki = models.WikiDocument.objects.create(bot=bot, title="Billing")
+    models.WikiDocumentProcessing.objects.create(
+        wiki_document=wiki, status=models.WikiDocumentProcessing.COMPLETED
+    )
+    doc = models.Document.objects.create(
+        wiki=wiki, name="Billing FAQ", content="Pay invoices in the portal."
+    )
+    emb = HashEmbedder(dim=768)
+    for i, q in enumerate(["How to pay invoice?", "Where to update card?"] * 6):
+        vec = np.asarray(asyncio.run(emb.embeddings([q]))[0], np.float32)
+        models.Question.objects.create(document=doc, text=f"{q} #{i}", order=i, embedding=vec)
+    return wiki, doc
+
+
+def test_context_service_smalltalk_short_circuits(instance, monkeypatch):
+    from django_assistant_bot_tpu.bot.services.context_service.service import ContextService
+    from django_assistant_bot_tpu.bot.services.context_service.steps import base as steps_base
+    from django_assistant_bot_tpu.rag.index_registry import reset_indexes
+
+    reset_indexes()
+    _seed_kb(instance.bot)
+    scripted = EchoProvider(script=[{"topic": "Small talk"}])
+    monkeypatch.setattr(steps_base, "get_ai_provider", lambda model: scripted)
+
+    messages = [{"role": "user", "content": "hey there!"}]
+    service = ContextService(
+        bot=instance.bot,
+        fast_ai_model="test",
+        strong_ai_model="test",
+        messages=list(messages),
+        debug_info={},
+    )
+    enriched = asyncio.run(service.enrich())
+    # small talk -> pipeline interrupted -> no system enrichment appended
+    assert enriched == messages
+
+
+def test_context_service_knowledge_path(instance, monkeypatch):
+    from django_assistant_bot_tpu.bot.services.context_service.service import ContextService
+    from django_assistant_bot_tpu.bot.services.context_service.steps import base as steps_base
+    from django_assistant_bot_tpu.rag.index_registry import reset_indexes
+
+    reset_indexes()
+    wiki, doc = _seed_kb(instance.bot)
+    # classify -> Billing topic; choose_known_question -> null (use doc search)
+    scripted = EchoProvider(script=[{"topic": "Billing"}, {"question": None}])
+    monkeypatch.setattr(steps_base, "get_ai_provider", lambda model: scripted)
+
+    debug = {}
+    service = ContextService(
+        bot=instance.bot,
+        fast_ai_model="test",
+        strong_ai_model="test",
+        messages=[{"role": "user", "content": "How to pay invoice? #3"}],
+        debug_info=debug,
+    )
+    enriched = asyncio.run(service.enrich())
+    final_system = enriched[-1]
+    assert final_system["role"] == "system"
+    assert "Pay invoices in the portal." in final_system["content"]
+    assert debug["classify"]["topic"] == "Billing"
+    assert debug["embedding_search"]["related_questions"]

@@ -1,0 +1,214 @@
+"""Answer task plane + Telegram adapter: the reference's test_answer_task shape —
+the worker coroutine is driven in-process with a fake platform (SURVEY.md §4)."""
+
+import asyncio
+
+import pytest
+
+from django_assistant_bot_tpu.bot.domain import (
+    BotPlatform,
+    Button,
+    SingleAnswer,
+    Update,
+    User,
+    UserUnavailableError,
+)
+from django_assistant_bot_tpu.bot.platforms.telegram.api import (
+    TelegramBadRequest,
+    TelegramForbidden,
+)
+from django_assistant_bot_tpu.bot.platforms.telegram.platform import TelegramBotPlatform
+from django_assistant_bot_tpu.bot.tasks import _answer_task, _send_answer_task
+from django_assistant_bot_tpu.storage import models
+
+
+class RecordingPlatform(BotPlatform):
+    def __init__(self, fail_with=None):
+        self.posted = []
+        self.fail_with = fail_with
+
+    @property
+    def codename(self):
+        return "telegram"
+
+    async def get_update(self, request):
+        raise NotImplementedError
+
+    async def post_answer(self, chat_id, answer):
+        if self.fail_with:
+            raise self.fail_with
+        self.posted.append((chat_id, answer))
+
+    async def action_typing(self, chat_id):
+        pass
+
+
+class FakeAPI:
+    """Scripted TelegramAPI double."""
+
+    def __init__(self, errors=None):
+        self.calls = []
+        self.errors = list(errors or [])
+
+    async def send_message(self, chat_id, text, parse_mode=None, reply_markup=None, disable_web_page_preview=None):
+        self.calls.append(("send_message", chat_id, text, parse_mode, reply_markup))
+        if self.errors:
+            raise self.errors.pop(0)
+        return {"message_id": 1}
+
+    async def send_audio(self, chat_id, audio, filename=None, reply_markup=None):
+        self.calls.append(("send_audio", chat_id, filename))
+        return {"message_id": 2}
+
+    async def send_chat_action(self, chat_id, action):
+        self.calls.append(("action", chat_id, action))
+
+    async def get_file(self, file_id):
+        return {"file_path": "photos/x.jpg", "file_id": file_id}
+
+    async def download_file(self, file_path):
+        return b"JPEGDATA"
+
+
+@pytest.fixture()
+def seeded(tmp_db, monkeypatch):
+    from django_assistant_bot_tpu.bot.assistant_bot import AssistantBot
+
+    bot = models.Bot.objects.create(codename="tb")
+    user = models.BotUser.objects.create(user_id="u1", platform="telegram")
+    instance = models.Instance.objects.create(bot=bot, user=user)
+    dialog = models.Dialog.objects.create(instance=instance)
+
+    async def fake_answer(self, messages, debug_info, do_interrupt):
+        return SingleAnswer(text="task answer", usage=[{"model": "test"}])
+
+    monkeypatch.setattr(AssistantBot, "get_answer_to_messages", fake_answer)
+    return bot, instance, dialog
+
+
+def _update_dict(message_id=1, text="hello"):
+    return Update(
+        chat_id="u1", message_id=message_id, text=text, user=User(id="u1")
+    ).to_dict()
+
+
+def test_answer_task_end_to_end(seeded):
+    bot, instance, dialog = seeded
+    from django_assistant_bot_tpu.bot.services.dialog_service import create_user_message
+
+    create_user_message(dialog, 1, "hello")
+    platform = RecordingPlatform()
+    asyncio.run(_answer_task("tb", dialog.id, "telegram", _update_dict(), platform=platform))
+    assert platform.posted and platform.posted[0][1].text == "task answer"
+    # bot message persisted with cost rollup
+    msgs = models.Message.objects.filter(dialog=dialog).order_by("id").all()
+    assert msgs[-1].text == "task answer"
+
+
+def test_answer_task_marks_unavailable_on_forbidden(seeded):
+    bot, instance, dialog = seeded
+    from django_assistant_bot_tpu.bot.services.dialog_service import create_user_message
+
+    create_user_message(dialog, 1, "hello")
+    platform = RecordingPlatform(fail_with=UserUnavailableError("u1"))
+    asyncio.run(_answer_task("tb", dialog.id, "telegram", _update_dict(), platform=platform))
+    assert models.Instance.objects.get(id=instance.id).is_unavailable
+
+
+def test_send_answer_task_skips_unavailable(seeded):
+    bot, instance, dialog = seeded
+    instance.is_unavailable = True
+    instance.save()
+    platform = RecordingPlatform()
+    asyncio.run(
+        _send_answer_task(
+            "tb", "telegram", "u1", SingleAnswer(text="bcast").to_dict(), platform=platform
+        )
+    )
+    assert platform.posted == []
+
+
+def test_send_answer_task_delivers(seeded):
+    platform = RecordingPlatform()
+    asyncio.run(
+        _send_answer_task(
+            "tb", "telegram", "u1", SingleAnswer(text="bcast").to_dict(), platform=platform
+        )
+    )
+    assert platform.posted[0][1].text == "bcast"
+
+
+# ----------------------------------------------------------- telegram adapter
+def test_convert_message_update():
+    platform = TelegramBotPlatform("tok", api=FakeAPI())
+    data = {
+        "message": {
+            "message_id": 7,
+            "chat": {"id": 123},
+            "text": "hi there",
+            "from": {"id": 42, "username": "alice", "first_name": "A", "language_code": "en"},
+        }
+    }
+    upd = asyncio.run(platform.get_update(data))
+    assert upd.chat_id == "123" and upd.message_id == 7 and upd.text == "hi there"
+    assert upd.user.username == "alice"
+
+
+def test_convert_callback_and_photo_updates():
+    platform = TelegramBotPlatform("tok", api=FakeAPI())
+    cb = {
+        "callback_query": {
+            "id": "cb1",
+            "from": {"id": 42, "username": "alice"},
+            "message": {"message_id": 9},
+            "data": "/continue",
+        }
+    }
+    upd = asyncio.run(platform.get_update(cb))
+    assert upd.text == "/continue" and upd.message_id == 9
+
+    photo = {
+        "message": {
+            "message_id": 10,
+            "chat": {"id": 1},
+            "from": {"id": 42},
+            "photo": [{"file_id": "small"}, {"file_id": "big", "file_unique_id": "bu"}],
+            "caption": "see this",
+        }
+    }
+    upd = asyncio.run(platform.get_update(photo))
+    assert upd.photo.content == b"JPEGDATA"
+    assert upd.photo.extension == "jpg"
+    assert upd.text == "see this"
+
+
+def test_markdown_fallback_on_parse_error():
+    api = FakeAPI(errors=[TelegramBadRequest(400, "Bad Request: can't parse entities")])
+    platform = TelegramBotPlatform("tok", api=api)
+    asyncio.run(platform.post_answer("1", SingleAnswer(text="broken *md")))
+    # first MarkdownV2 attempt failed, second plain attempt went through
+    assert len(api.calls) == 2
+    assert api.calls[0][3] == "MarkdownV2" and api.calls[1][3] is None
+    assert api.calls[1][2] == "broken *md"
+
+
+def test_forbidden_raises_user_unavailable():
+    api = FakeAPI(errors=[TelegramForbidden(403, "Forbidden: bot was blocked by the user")])
+    platform = TelegramBotPlatform("tok", api=api)
+    with pytest.raises(UserUnavailableError):
+        asyncio.run(platform.post_answer("1", SingleAnswer(text="x")))
+
+
+def test_forbidden_kicked_does_not_raise():
+    api = FakeAPI(errors=[TelegramForbidden(403, "Forbidden: bot was kicked from the group chat")])
+    platform = TelegramBotPlatform("tok", api=api)
+    asyncio.run(platform.post_answer("1", SingleAnswer(text="x")))  # no raise
+
+
+def test_inline_keyboard_markup():
+    api = FakeAPI()
+    platform = TelegramBotPlatform("tok", api=api)
+    answer = SingleAnswer(text="pick", buttons=[[Button("Go", callback_data="/go")]])
+    asyncio.run(platform.post_answer("1", answer))
+    markup = api.calls[0][4]
+    assert markup == {"inline_keyboard": [[{"text": "Go", "callback_data": "/go"}]]}

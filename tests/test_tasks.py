@@ -1,0 +1,144 @@
+"""Task plane: dispatch, retries, lease reclaim, groups/chords, eager mode, beat.
+
+The reference tests its Celery path by invoking task bodies directly (SURVEY.md
+§4); here the broker is in-process sqlite so the REAL dispatch path runs in tests.
+"""
+
+import time
+
+import pytest
+
+from django_assistant_bot_tpu.conf import settings
+from django_assistant_bot_tpu.tasks import Beat, TaskRecord, Worker, group, task
+
+calls = []
+
+
+@task(queue="query", max_retries=2, retry_delay=0.0)
+def add_task(a, b):
+    calls.append(("add", a, b))
+    return a + b
+
+
+@task(queue="processing", max_retries=2, retry_delay=0.0)
+def flaky_task(fail_times):
+    calls.append(("flaky",))
+    if len([c for c in calls if c == ("flaky",)]) <= fail_times:
+        raise RuntimeError("boom")
+    return "ok"
+
+
+@task(queue="processing")
+def member_task(n):
+    calls.append(("member", n))
+
+
+@task(queue="processing")
+def finalize_task():
+    calls.append(("finalize",))
+
+
+@task(queue="query")
+async def async_task(x):
+    calls.append(("async", x))
+    return x * 2
+
+
+@pytest.fixture(autouse=True)
+def _fresh(tmp_db):
+    calls.clear()
+    yield
+
+
+def test_delay_and_worker_executes():
+    rec = add_task.delay(2, 3)
+    assert rec.status == "pending"
+    n = Worker(["query"]).run_until_idle()
+    assert n == 1
+    rec.refresh()
+    assert rec.status == "done" and rec.result == 5
+    assert calls == [("add", 2, 3)]
+
+
+def test_async_task_body():
+    async_task.delay(21)
+    Worker(["query"]).run_until_idle()
+    assert calls == [("async", 21)]
+
+
+def test_retry_then_success():
+    rec = flaky_task.delay(2)
+    w = Worker(["processing"])
+    for _ in range(5):
+        w.run_until_idle()
+    rec.refresh()
+    assert rec.status == "done" and rec.result == "ok"
+    assert len(calls) == 3  # 2 failures + 1 success
+
+
+def test_retries_exhausted_marks_failed():
+    rec = flaky_task.delay(99)
+    w = Worker(["processing"])
+    for _ in range(6):
+        w.run_until_idle()
+    rec.refresh()
+    assert rec.status == "failed"
+    assert "boom" in rec.error
+    assert len(calls) == 3  # initial + 2 retries
+
+
+def test_lease_reclaim_on_worker_death():
+    rec = add_task.delay(1, 1)
+    # simulate a worker that claimed the row then died: lease in the past
+    w = Worker(["query"], lease_s=-1.0)
+    claimed = w.claim()
+    assert claimed.id == rec.id
+    rec.refresh()
+    assert rec.status == "running"
+    # another worker's poll reclaims and executes it
+    n = Worker(["query"]).run_until_idle()
+    assert n == 1
+    rec.refresh()
+    assert rec.status == "done"
+
+
+def test_group_chord_fires_once_after_all_members():
+    group(
+        [(member_task, (i,), {}) for i in range(3)],
+        chord=(finalize_task, (), {}),
+    )
+    w = Worker(["processing"])
+    w.run_until_idle()
+    # chord enqueued after last member; drain again
+    w.run_until_idle()
+    members = [c for c in calls if c[0] == "member"]
+    finals = [c for c in calls if c[0] == "finalize"]
+    assert len(members) == 3 and len(finals) == 1
+    # finalize ran after every member
+    assert calls.index(finals[0]) > max(calls.index(m) for m in members)
+
+
+def test_eager_mode_runs_inline():
+    with settings.override(TASK_ALWAYS_EAGER=True):
+        rec = add_task.delay(4, 5)
+    assert rec is None
+    assert calls == [("add", 4, 5)]
+    assert TaskRecord.objects.count() == 0
+
+
+def test_queue_isolation():
+    add_task.delay(1, 2)
+    member_task.delay(7)
+    Worker(["query"]).run_until_idle()
+    assert ("add", 1, 2) in calls and ("member", 7) not in calls
+    Worker(["processing"]).run_until_idle()
+    assert ("member", 7) in calls
+
+
+def test_beat_enqueues_on_cadence():
+    beat = Beat().add(add_task, 1000.0, 1, 1)
+    now = time.monotonic()
+    assert beat.tick(now) == 1  # fires immediately
+    assert beat.tick(now + 1) == 0  # not due
+    assert beat.tick(now + 1001) == 1
+    assert TaskRecord.objects.filter(name=add_task.name).count() == 2
