@@ -1,0 +1,4 @@
+"""HTTP API plane — webhook + REST (reference: assistant/bot/views.py,
+assistant/bot/api/, assistant/storage/api/)."""
+
+from .app import create_api_app  # noqa: F401

@@ -1,0 +1,293 @@
+"""aiohttp application exposing the reference's HTTP surface.
+
+Routes (reference parity):
+
+- ``POST /telegram/{codename}/`` — Telegram webhook: convert, persist the user
+  message, enqueue ``answer_task``, return 200 immediately
+  (reference: assistant/bot/views.py:25-120);
+- ``GET /api/v1/bots/`` + ``GET /api/v1/bots/{codename}/`` — read-only by
+  codename (reference: assistant/bot/api/views.py BotViewSet);
+- ``GET|POST /api/v1/dialogs/``, ``GET|DELETE /api/v1/dialogs/{id}/`` — CRUD
+  (DialogViewSet);
+- ``GET|POST /api/v1/dialogs/{id}/messages/`` — POST runs the whole bot
+  synchronously under the instance lock and returns the user message joined
+  with the assistant's answers (MessageViewSet.create + AnsweredMessageSerializer,
+  reference: assistant/bot/api/views.py:168-223, serializers.py:96-115);
+- ``GET|POST /api/v1/wiki/`` + ``POST /api/v1/wiki/bulk/`` — wiki documents
+  with bot filter + page pagination (reference: assistant/storage/api/views.py:13-30).
+
+Auth: optional static token (``DABT_API_AUTH_TOKEN``) via
+``Authorization: Token <...>`` — the reference defaults to DRF TokenAuth.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ..conf import settings
+from ..storage import models
+from ..storage.locks import InstanceLockAsync
+
+logger = logging.getLogger(__name__)
+
+PAGE_SIZE = 50
+
+
+def _dt(value) -> Optional[str]:
+    return value.isoformat() if value else None
+
+
+def bot_to_dict(b: models.Bot) -> dict:
+    return {"id": b.id, "codename": b.codename, "username": b.username}
+
+
+def dialog_to_dict(d: models.Dialog) -> dict:
+    return {
+        "id": d.id,
+        "instance_id": d.instance_id,
+        "is_completed": bool(d.is_completed),
+        "created_at": _dt(d.created_at),
+        "state": d.state or {},
+    }
+
+
+def message_to_dict(m: models.Message) -> dict:
+    return {
+        "id": m.id,
+        "message_id": m.message_id,
+        "dialog_id": m.dialog_id,
+        "role": m.role.name if m.role_id else None,
+        "text": m.text,
+        "timestamp": _dt(m.timestamp),
+        "cost": m.cost,
+        "cost_details": m.cost_details or {},
+    }
+
+
+def wiki_to_dict(w: models.WikiDocument) -> dict:
+    return {
+        "id": w.id,
+        "bot_id": w.bot_id,
+        "parent_id": w.parent_id,
+        "title": w.title,
+        "description": w.description,
+        "content": w.content,
+        "url": w.url,
+        "path": w.path,
+        "created_at": _dt(w.created_at),
+        "updated_at": _dt(w.updated_at),
+    }
+
+
+def _page_qs(request: web.Request, qs, serialize) -> dict:
+    """Paginate in SQL (count + LIMIT/OFFSET), not by materializing the table."""
+    try:
+        page = max(1, int(request.query.get("page", 1)))
+    except ValueError:
+        page = 1
+    return {
+        "count": qs.count(),
+        "page": page,
+        "results": [serialize(row) for row in qs.limit(PAGE_SIZE, (page - 1) * PAGE_SIZE)],
+    }
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    token = getattr(settings, "API_AUTH_TOKEN", None)
+    exempt = request.path.startswith("/telegram/") or request.path == "/healthz"
+    if token and not exempt:
+        got = request.headers.get("Authorization", "")
+        if got != f"Token {token}":
+            return web.json_response({"detail": "Unauthorized"}, status=401)
+    return await handler(request)
+
+
+def create_api_app() -> web.Application:
+    app = web.Application(middlewares=[auth_middleware])
+
+    # ---------------------------------------------------------------- webhook
+    async def telegram_webhook(request: web.Request) -> web.Response:
+        codename = request.match_info["codename"]
+        bot = models.Bot.objects.get_or_none(codename=codename)
+        if bot is None:
+            return web.json_response({"detail": "bot not found"}, status=404)
+        from ..bot.domain import UnknownUpdate
+        from ..bot.services.ingest_service import ingest_update
+        from ..bot.utils import get_bot_platform
+
+        try:
+            data = await request.json()
+        except Exception:
+            return web.json_response({"detail": "invalid json"}, status=400)
+        platform = get_bot_platform(codename, "telegram")
+        try:
+            update = await platform.convert_telegram_update(data)
+        except UnknownUpdate:
+            return web.json_response({"ok": True})  # ignore unsupported updates
+        ingest_update(codename, "telegram", update)
+        return web.json_response({"ok": True})
+
+    # ------------------------------------------------------------------- bots
+    async def list_bots(request: web.Request) -> web.Response:
+        return web.json_response(
+            _page_qs(request, models.Bot.objects.all().order_by("id"), bot_to_dict)
+        )
+
+    async def get_bot(request: web.Request) -> web.Response:
+        bot = models.Bot.objects.get_or_none(codename=request.match_info["codename"])
+        if bot is None:
+            return web.json_response({"detail": "not found"}, status=404)
+        return web.json_response(bot_to_dict(bot))
+
+    # ---------------------------------------------------------------- dialogs
+    async def list_dialogs(request: web.Request) -> web.Response:
+        qs = models.Dialog.objects.all()
+        if "instance" in request.query:
+            qs = qs.filter(instance=int(request.query["instance"]))
+        return web.json_response(_page_qs(request, qs.order_by("-id"), dialog_to_dict))
+
+    async def create_dialog(request: web.Request) -> web.Response:
+        body = await request.json()
+        instance = models.Instance.objects.get_or_none(id=body.get("instance_id"))
+        if instance is None:
+            return web.json_response({"detail": "instance not found"}, status=400)
+        dialog = models.Dialog.objects.create(instance=instance, state=body.get("state") or {})
+        return web.json_response(dialog_to_dict(dialog), status=201)
+
+    def _dialog_or_none(request: web.Request) -> Optional[models.Dialog]:
+        try:
+            return models.Dialog.objects.get_or_none(id=int(request.match_info["id"]))
+        except ValueError:
+            return None
+
+    async def get_dialog_view(request: web.Request) -> web.Response:
+        dialog = _dialog_or_none(request)
+        if dialog is None:
+            return web.json_response({"detail": "not found"}, status=404)
+        return web.json_response(dialog_to_dict(dialog))
+
+    async def delete_dialog(request: web.Request) -> web.Response:
+        dialog = _dialog_or_none(request)
+        if dialog is None:
+            return web.json_response({"detail": "not found"}, status=404)
+        dialog.delete()
+        return web.json_response({}, status=204)
+
+    # --------------------------------------------------------------- messages
+    async def list_messages(request: web.Request) -> web.Response:
+        dialog = _dialog_or_none(request)
+        if dialog is None:
+            return web.json_response({"detail": "not found"}, status=404)
+        qs = models.Message.objects.filter(dialog=dialog).order_by("id")
+        return web.json_response(_page_qs(request, qs, message_to_dict))
+
+    async def create_message(request: web.Request) -> web.Response:
+        """Synchronous serve path: run the engine inline, return the user message
+        + assistant answers (reference: MessageViewSet.create)."""
+        dialog = _dialog_or_none(request)
+        if dialog is None:
+            return web.json_response({"detail": "not found"}, status=404)
+        body = await request.json()
+        text = body.get("text")
+        if not text:
+            return web.json_response({"detail": "text required"}, status=400)
+
+        from ..bot.domain import MultiPartAnswer, Update, User
+        from ..bot.services.dialog_service import create_user_message
+        from ..bot.utils import get_bot_class
+
+        instance = dialog.instance
+        bot_model = instance.bot
+        last = (
+            models.Message.objects.filter(dialog=dialog).order_by("-message_id").first()
+        )
+        message_id = body.get("message_id") or ((last.message_id or 0) + 1 if last else 1)
+        user_message = create_user_message(dialog, message_id, text)
+
+        from ..cli.utils import ConsolePlatform
+
+        platform = ConsolePlatform(echo=False)
+        bot_cls = get_bot_class(bot_model.codename)
+        bot = bot_cls(dialog=dialog, platform=platform)
+        update = Update(
+            chat_id=str(instance.user_id),
+            message_id=message_id,
+            text=text,
+            user=User(id=str(instance.user_id)),
+        )
+        async with InstanceLockAsync(instance):
+            answer = await bot.handle_update(update)
+        answers = []
+        if answer is not None:
+            await bot.on_answer_sent(answer)
+            parts = answer.parts if isinstance(answer, MultiPartAnswer) else [answer]
+            answers = [
+                {"text": p.text, "thinking": p.thinking, "usage": p.usage} for p in parts
+            ]
+        return web.json_response(
+            {"message": message_to_dict(user_message), "answers": answers}, status=201
+        )
+
+    # ------------------------------------------------------------------- wiki
+    async def list_wiki(request: web.Request) -> web.Response:
+        qs = models.WikiDocument.objects.all()
+        if "bot" in request.query:
+            bot = models.Bot.objects.get_or_none(codename=request.query["bot"])
+            if bot is None:
+                return web.json_response({"detail": "bot not found"}, status=404)
+            qs = qs.filter(bot=bot)
+        return web.json_response(_page_qs(request, qs.order_by("id"), wiki_to_dict))
+
+    def _create_wiki(body: dict) -> models.WikiDocument | web.Response:
+        bot = None
+        if body.get("bot"):
+            bot = models.Bot.objects.get_or_none(codename=body["bot"])
+            if bot is None:
+                return web.json_response({"detail": "bot not found"}, status=400)
+        return models.WikiDocument.objects.create(
+            bot=bot,
+            parent=body.get("parent_id"),
+            title=body.get("title", ""),
+            description=body.get("description", ""),
+            content=body.get("content", ""),
+            url=body.get("url"),
+        )
+
+    async def create_wiki(request: web.Request) -> web.Response:
+        result = _create_wiki(await request.json())
+        if isinstance(result, web.Response):
+            return result
+        return web.json_response(wiki_to_dict(result), status=201)
+
+    async def bulk_wiki(request: web.Request) -> web.Response:
+        body = await request.json()
+        items = body if isinstance(body, list) else body.get("items", [])
+        created = []
+        for item in items:
+            result = _create_wiki(item)
+            if isinstance(result, web.Response):
+                return result
+            created.append(wiki_to_dict(result))
+        return web.json_response({"created": created}, status=201)
+
+    async def healthz(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    app.router.add_post("/telegram/{codename}/", telegram_webhook)
+    app.router.add_get("/api/v1/bots/", list_bots)
+    app.router.add_get("/api/v1/bots/{codename}/", get_bot)
+    app.router.add_get("/api/v1/dialogs/", list_dialogs)
+    app.router.add_post("/api/v1/dialogs/", create_dialog)
+    app.router.add_get("/api/v1/dialogs/{id}/", get_dialog_view)
+    app.router.add_delete("/api/v1/dialogs/{id}/", delete_dialog)
+    app.router.add_get("/api/v1/dialogs/{id}/messages/", list_messages)
+    app.router.add_post("/api/v1/dialogs/{id}/messages/", create_message)
+    app.router.add_get("/api/v1/wiki/", list_wiki)
+    app.router.add_post("/api/v1/wiki/", create_wiki)
+    app.router.add_post("/api/v1/wiki/bulk/", bulk_wiki)
+    app.router.add_get("/healthz", healthz)
+    return app

@@ -63,10 +63,27 @@ class AssistantBot(Bot):
         self.resource_manager: Optional[ResourceManager] = None
 
     def __init_subclass__(cls, **kwargs):
-        # each subclass gets its own command table (the reference shares one
-        # mutable class attribute across all bots — a latent cross-bot leak)
+        # Each subclass gets its own command table (the reference shares one
+        # mutable class attribute across all bots — a latent cross-bot leak).
+        # Decorators written inside the subclass body as @AssistantBot.command
+        # register on the base before the subclass exists; relocate those
+        # entries here by matching functions defined in this class body.
         super().__init_subclass__(**kwargs)
-        cls._command_handlers = list(cls._command_handlers)
+        own_funcs = {v for v in cls.__dict__.values() if callable(v)}
+        moved = []
+        for base in cls.__mro__[1:]:
+            table = base.__dict__.get("_command_handlers")
+            if not table:
+                continue
+            for entry in [e for e in table if e[1] in own_funcs]:
+                table.remove(entry)
+                moved.append(entry)
+        inherited = []
+        for base in cls.__mro__[1:]:
+            for entry in base.__dict__.get("_command_handlers", []):
+                if entry not in inherited:
+                    inherited.append(entry)
+        cls._command_handlers = inherited + moved
 
     @classmethod
     def command(cls, pattern: str):

@@ -1,0 +1,64 @@
+"""Shared inbound-update ingestion used by the webhook view and the polling
+runner: persist the user message, open the dialog, dispatch the answer task.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ...storage.models import BotUser, Dialog, Instance
+from ..domain import BotPlatform, Update
+from .dialog_service import create_user_message, get_dialog
+
+
+def ingest_update(
+    bot_codename: str,
+    platform_codename: str,
+    update: Update,
+    *,
+    enqueue: bool = True,
+) -> Tuple[Dialog, Optional[object]]:
+    """Persist the update's user message and (optionally) enqueue answer_task.
+
+    Returns (dialog, task_record_or_None).
+    """
+    import datetime as dt
+
+    from ...conf import settings
+    from ...storage.models import Bot
+
+    bot, _ = Bot.objects.get_or_create(codename=bot_codename)
+    user, _ = BotUser.objects.get_or_create(
+        user_id=update.chat_id, platform=platform_codename
+    )
+    if update.user:
+        changed = False
+        for src, dst in (
+            ("username", "username"),
+            ("first_name", "first_name"),
+            ("last_name", "last_name"),
+            ("language_code", "language"),
+        ):
+            value = getattr(update.user, src)
+            if value and getattr(user, dst) != value:
+                setattr(user, dst, value)
+                changed = True
+        if changed:
+            user.save()
+    instance, _ = Instance.objects.get_or_create(bot=bot, user=user)
+    dialog = get_dialog(instance, ttl=dt.timedelta(seconds=settings.DIALOG_TTL_S))
+    create_user_message(
+        dialog,
+        update.message_id,
+        update.text,
+        photo=update.photo,
+        phone_number=update.phone_number,
+    )
+    record = None
+    if enqueue:
+        from ..tasks import answer_task
+
+        record = answer_task.delay(
+            bot_codename, dialog.id, platform_codename, update.to_dict()
+        )
+    return dialog, record

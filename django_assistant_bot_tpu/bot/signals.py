@@ -1,0 +1,35 @@
+"""Telegram webhook auto-registration on Bot save
+(reference: assistant/bot/signals.py:14-47).
+
+Import this module to activate: saving a Bot with a telegram token calls
+``setWebhook`` pointing at ``settings.WEBHOOK_BASE_URL/telegram/<codename>/``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import requests
+
+from ..conf import settings
+from ..storage.models import Bot
+from ..storage.orm import post_save
+
+logger = logging.getLogger(__name__)
+
+
+@post_save(Bot)
+def register_telegram_webhook(instance: Bot, created: bool) -> None:
+    base = getattr(settings, "WEBHOOK_BASE_URL", None)
+    if not base or not instance.telegram_token:
+        return
+    url = f"{base.rstrip('/')}/telegram/{instance.codename}/"
+    try:
+        resp = requests.post(
+            f"https://api.telegram.org/bot{instance.telegram_token}/setWebhook",
+            json={"url": url},
+            timeout=10,
+        )
+        logger.info("setWebhook %s -> %s", url, resp.status_code)
+    except requests.RequestException as e:
+        logger.warning("setWebhook failed for %s: %s", instance.codename, e)

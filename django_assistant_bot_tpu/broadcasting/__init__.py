@@ -1,0 +1,3 @@
+"""Broadcasting plane — campaign fan-out delivery (reference: assistant/broadcasting/)."""
+
+from .models import BroadcastCampaign  # noqa: F401

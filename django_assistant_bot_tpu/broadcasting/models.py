@@ -1,0 +1,56 @@
+"""Campaign model + status machine (reference: assistant/broadcasting/models.py:14-113).
+
+DRAFT -> SCHEDULED -> SENDING -> {COMPLETED, PARTIAL_FAILURE, FAILED, CANCELED}.
+The schedule<->status sync the reference does in a pre_save signal lives in
+``sync_status_with_schedule`` (called by save()).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+
+from ..storage.models import Bot
+from ..storage.orm import (
+    DateTimeField,
+    ForeignKey,
+    IntField,
+    Model,
+    TextField,
+)
+
+
+class BroadcastCampaign(Model):
+    DRAFT = "DRAFT"
+    SCHEDULED = "SCHEDULED"
+    SENDING = "SENDING"
+    COMPLETED = "COMPLETED"
+    PARTIAL_FAILURE = "PARTIAL_FAILURE"
+    FAILED = "FAILED"
+    CANCELED = "CANCELED"
+
+    name = TextField()
+    message_text = TextField(null=False, default="")
+    bot = ForeignKey(Bot)
+    platform = TextField(default="telegram")
+    status = TextField(default=DRAFT, index=True)
+    scheduled_at = DateTimeField(index=True)
+    started_at = DateTimeField()
+    completed_at = DateTimeField()
+    total_recipients = IntField()
+    successful_sents = IntField(default=0)
+    failed_sents = IntField(default=0)
+    created_at = DateTimeField(auto_now_add=True)
+    updated_at = DateTimeField()
+
+    def sync_status_with_schedule(self) -> None:
+        """DRAFT+scheduled_at -> SCHEDULED; SCHEDULED-scheduled_at -> DRAFT
+        (reference: assistant/broadcasting/signals.py:6-52)."""
+        if self.scheduled_at and self.status == self.DRAFT:
+            self.status = self.SCHEDULED
+        elif self.scheduled_at is None and self.status == self.SCHEDULED:
+            self.status = self.DRAFT
+
+    def save(self):
+        self.sync_status_with_schedule()
+        self.updated_at = _dt.datetime.now(_dt.timezone.utc)
+        return super().save()

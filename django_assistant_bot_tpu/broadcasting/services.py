@@ -1,0 +1,127 @@
+"""Campaign services (reference: assistant/broadcasting/services.py:21-291).
+
+Target resolution (all available instances of the bot), transactional initiation
+(status gate SCHEDULED -> SENDING), batch dispatch (100 recipients/task), atomic
+stat counters with finalize trigger, and finalization status logic.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+from typing import List, Optional, Tuple
+
+from ..storage.locks import InstanceLock
+from ..storage.models import BotUser, Instance
+from .models import BroadcastCampaign
+
+logger = logging.getLogger(__name__)
+
+BATCH_SIZE = 100  # reference: services.py:153
+
+
+def _now():
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def resolve_target_chat_ids(campaign: BroadcastCampaign) -> List[str]:
+    """Every available instance of the campaign's bot -> platform chat ids.
+
+    Errors propagate: a transient DB failure must fail (and retry) the
+    initiating task, not silently finalize the campaign as COMPLETED with zero
+    recipients.
+    """
+    instances = Instance.objects.filter(bot=campaign.bot_id, is_unavailable=False).all()
+    user_ids = [i.user_id for i in instances]
+    users = (
+        BotUser.objects.filter(id__in=user_ids, platform=campaign.platform).all()
+        if user_ids
+        else []
+    )
+    return [u.user_id for u in users]
+
+
+def schedule_campaign_sending(campaign: BroadcastCampaign) -> bool:
+    """DRAFT -> SCHEDULED (immediately due when no scheduled_at)."""
+    if campaign.status != BroadcastCampaign.DRAFT:
+        logger.warning("campaign %s not DRAFT (%s); cannot schedule", campaign.id, campaign.status)
+        return False
+    if not campaign.scheduled_at:
+        campaign.scheduled_at = _now()
+    campaign.status = BroadcastCampaign.SCHEDULED
+    campaign.save()
+    return True
+
+
+def initiate_campaign_sending(campaign_id: int) -> Optional[Tuple[BroadcastCampaign, List[List[str]]]]:
+    """SCHEDULED -> SENDING under the campaign lock; returns (campaign, batches)
+    or None when aborted.  Caller dispatches one send task per batch."""
+    with InstanceLock(f"broadcast:{campaign_id}"):
+        campaign = BroadcastCampaign.objects.get_or_none(id=campaign_id)
+        if campaign is None:
+            logger.error("campaign %s not found", campaign_id)
+            return None
+        if campaign.status != BroadcastCampaign.SCHEDULED:
+            logger.warning(
+                "campaign %s not SCHEDULED (%s); aborting", campaign_id, campaign.status
+            )
+            return None
+        chat_ids = resolve_target_chat_ids(campaign)
+        campaign.status = BroadcastCampaign.SENDING
+        campaign.started_at = _now()
+        campaign.total_recipients = len(chat_ids)
+        campaign.save()
+    if not chat_ids:
+        finalize_campaign(campaign_id)
+        return campaign, []
+    batches = [chat_ids[i : i + BATCH_SIZE] for i in range(0, len(chat_ids), BATCH_SIZE)]
+    return campaign, batches
+
+
+def record_batch_results(campaign_id: int, successful: int, failed: int) -> bool:
+    """Atomic stat update; returns True when the campaign just completed and
+    must be finalized (reference: services.py:195-240)."""
+    with InstanceLock(f"broadcast:{campaign_id}"):
+        campaign = BroadcastCampaign.objects.get_or_none(id=campaign_id)
+        if campaign is None:
+            logger.error("campaign %s not found for batch results", campaign_id)
+            return False
+        if campaign.status != BroadcastCampaign.SENDING:
+            logger.warning(
+                "campaign %s not SENDING (%s); ignoring results", campaign_id, campaign.status
+            )
+            return False
+        campaign.successful_sents += successful
+        campaign.failed_sents += failed
+        campaign.save()
+        processed = campaign.successful_sents + campaign.failed_sents
+        return campaign.total_recipients is not None and processed >= campaign.total_recipients
+
+
+def finalize_campaign(campaign_id: int) -> bool:
+    """Set completed_at + the final status from the counters
+    (reference: services.py:240-291)."""
+    with InstanceLock(f"broadcast:{campaign_id}"):
+        campaign = BroadcastCampaign.objects.get_or_none(id=campaign_id)
+        if campaign is None:
+            return False
+        if campaign.status not in (BroadcastCampaign.SENDING, BroadcastCampaign.FAILED):
+            if campaign.completed_at is not None:
+                return True  # already finalized
+            logger.warning(
+                "campaign %s not finalizable from %s", campaign_id, campaign.status
+            )
+            return False
+        if not campaign.total_recipients:
+            final = BroadcastCampaign.COMPLETED
+        elif campaign.failed_sents == campaign.total_recipients:
+            final = BroadcastCampaign.FAILED
+        elif campaign.failed_sents > 0:
+            final = BroadcastCampaign.PARTIAL_FAILURE
+        else:
+            final = BroadcastCampaign.COMPLETED
+        campaign.status = final
+        campaign.completed_at = _now()
+        campaign.save()
+        logger.info("campaign %s finalized: %s", campaign_id, final)
+        return True

@@ -1,0 +1,131 @@
+"""Broadcasting tasks (reference: assistant/broadcasting/tasks.py:28-232).
+
+check_scheduled_broadcasts is beat-driven; start -> per-batch send tasks ->
+record results -> finalize when all recipients processed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as _dt
+import logging
+from typing import Dict, List, Optional
+
+from ..bot.domain import BotPlatform, SingleAnswer, UserUnavailableError, answer_from_dict
+from ..bot.utils import get_bot_platform
+from ..storage.models import Bot, BotUser, Instance
+from ..tasks.queue import CeleryQueues, task
+from .models import BroadcastCampaign
+from .services import (
+    finalize_campaign,
+    initiate_campaign_sending,
+    record_batch_results,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@task(queue=CeleryQueues.BROADCASTING.value)
+def check_scheduled_broadcasts():
+    """Beat-driven: start every due SCHEDULED campaign (reference: tasks.py:154-178)."""
+    now = _dt.datetime.now(_dt.timezone.utc)
+    due = BroadcastCampaign.objects.filter(
+        status=BroadcastCampaign.SCHEDULED, scheduled_at__lte=now
+    ).all()
+    for campaign in due:
+        logger.info("starting due campaign %s", campaign.id)
+        start_campaign_sending_task.delay(campaign.id)
+    return len(due)
+
+
+@task(queue=CeleryQueues.BROADCASTING.value)
+def start_campaign_sending_task(campaign_id: int):
+    try:
+        result = initiate_campaign_sending(campaign_id)
+        if result is None:
+            return
+        campaign, batches = result
+        answer_data = SingleAnswer(text=campaign.message_text, no_store=True).to_dict()
+        for batch in batches:
+            send_broadcast_batch.delay(
+                campaign.id, campaign.bot.codename, campaign.platform, batch, answer_data
+            )
+    except Exception:
+        logger.exception("initiation failed for campaign %s", campaign_id)
+        campaign = BroadcastCampaign.objects.get_or_none(id=campaign_id)
+        if campaign and campaign.status not in (
+            BroadcastCampaign.COMPLETED,
+            BroadcastCampaign.FAILED,
+        ):
+            campaign.status = BroadcastCampaign.FAILED
+            campaign.completed_at = _dt.datetime.now(_dt.timezone.utc)
+            campaign.save()
+
+
+@task(queue=CeleryQueues.BROADCASTING.value)
+def send_broadcast_batch(
+    campaign_id: int,
+    bot_codename: str,
+    platform_codename: str,
+    chat_ids: List[str],
+    message_content_data: Dict,
+):
+    return asyncio.run(
+        _send_broadcast_batch_async(
+            campaign_id, bot_codename, platform_codename, chat_ids, message_content_data
+        )
+    )
+
+
+async def _send_broadcast_batch_async(
+    campaign_id: int,
+    bot_codename: str,
+    platform_codename: str,
+    chat_ids: List[str],
+    message_content_data: Dict,
+    platform: Optional[BotPlatform] = None,
+):
+    platform = platform or get_bot_platform(bot_codename, platform_codename)
+    answer = answer_from_dict(message_content_data)
+    successful = 0
+    unavailable: List[str] = []
+    for chat_id in chat_ids:
+        try:
+            from ..bot.domain import MultiPartAnswer
+
+            parts = answer.parts if isinstance(answer, MultiPartAnswer) else [answer]
+            for part in parts:
+                await platform.post_answer(chat_id, part)
+            successful += 1
+        except UserUnavailableError:
+            unavailable.append(chat_id)
+        except Exception as e:
+            logger.error("broadcast send failed to %s: %s", chat_id, e)
+            unavailable.append(chat_id)
+    if unavailable:
+        _mark_users_unavailable(bot_codename, platform_codename, unavailable)
+    record_batch_results_task.delay(campaign_id, successful, len(chat_ids) - successful)
+
+
+def _mark_users_unavailable(
+    bot_codename: str, platform_codename: str, user_ids: List[str]
+) -> None:
+    bot = Bot.objects.get_or_none(codename=bot_codename)
+    if bot is None:
+        return
+    for uid in user_ids:
+        user = BotUser.objects.get_or_none(user_id=uid, platform=platform_codename)
+        if user is None:
+            continue
+        Instance.objects.filter(bot=bot, user=user).update(is_unavailable=True)
+
+
+@task(queue=CeleryQueues.BROADCASTING.value)
+def record_batch_results_task(campaign_id: int, successful: int, failed: int):
+    if record_batch_results(campaign_id, successful, failed):
+        finalize_campaign_task.delay(campaign_id)
+
+
+@task(queue=CeleryQueues.BROADCASTING.value)
+def finalize_campaign_task(campaign_id: int):
+    finalize_campaign(campaign_id)

@@ -1,0 +1,29 @@
+"""Bot API server runner (webhook + REST)."""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def add_parser(sub):
+    p = sub.add_parser("api", help="run the bot HTTP API (webhook + REST)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    return p
+
+
+def run(args) -> int:
+    from aiohttp import web
+
+    # activate post_save hooks in THIS process: wiki ingestion triggers for the
+    # REST wiki endpoints, telegram webhook auto-registration on Bot saves
+    from ..bot import signals as bot_signals  # noqa: F401
+    from ..processing import signals as processing_signals  # noqa: F401
+
+    from ..api import create_api_app
+
+    app = create_api_app()
+    web.run_app(app, host=args.host, port=args.port)
+    return 0

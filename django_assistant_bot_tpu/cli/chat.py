@@ -1,0 +1,85 @@
+"""Interactive console chat (reference: assistant/bot/management/commands/chat.py:37-243).
+
+REPL: read a line, run the full engine path (lock -> AssistantBot -> platform),
+print the answer; JSONL history appended per turn.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+
+from ..bot.services.dialog_service import create_user_message
+from ..bot.utils import get_bot_class
+from ..storage.locks import InstanceLockAsync
+from .utils import ConsolePlatform, get_instance, open_dialog
+
+HISTORY_FILE_NAME = ".chat_history.jsonl"
+
+
+def add_parser(sub):
+    p = sub.add_parser("chat", help="interactive console chat with a bot")
+    p.add_argument("bot_codename")
+    p.add_argument("--no-history", action="store_true", help="skip history file")
+    return p
+
+
+def log_history(role: str, text: str, enabled: bool = True) -> None:
+    if not enabled:
+        return
+    with open(HISTORY_FILE_NAME, "a", encoding="utf-8") as f:
+        f.write(json.dumps({"ts": time.time(), "role": role, "text": text}, ensure_ascii=False) + "\n")
+
+
+async def process_message(bot_codename: str, text: str, chat_id: str, platform: ConsolePlatform):
+    bot_model, instance = get_instance(bot_codename, chat_id)
+    dialog = open_dialog(instance)
+    message_id = int(time.time() * 1000) % 10**12
+    create_user_message(dialog, message_id, text)
+
+    from ..bot.domain import Update, User
+
+    update = Update(chat_id=chat_id, message_id=message_id, text=text, user=User(id=chat_id))
+    bot_cls = get_bot_class(bot_codename)
+    bot = bot_cls(dialog=dialog, platform=platform)
+    async with InstanceLockAsync(instance):
+        answer = await bot.handle_update(update)
+    if answer:
+        from ..bot.domain import MultiPartAnswer
+
+        parts = answer.parts if isinstance(answer, MultiPartAnswer) else [answer]
+        for part in parts:
+            await platform.post_answer(chat_id, part)
+        await bot.on_answer_sent(answer)
+    return answer
+
+
+def run(args) -> int:
+    chat_id = str(uuid.uuid4())
+    platform = ConsolePlatform()
+    print(f"Interactive chat with bot {args.bot_codename!r} (type 'exit' to quit)")
+    loop = asyncio.new_event_loop()
+    try:
+        while True:
+            try:
+                text = input("\nYou: ")
+            except (EOFError, KeyboardInterrupt):
+                print("\nBye.")
+                break
+            if text.strip().lower() in ("exit", "quit"):
+                break
+            if not text.strip():
+                continue
+            log_history("user", text, not args.no_history)
+            answer = loop.run_until_complete(
+                process_message(args.bot_codename, text, chat_id, platform)
+            )
+            if answer is None:
+                print("(no answer)")
+            else:
+                log_history("assistant", answer.text or "", not args.no_history)
+    finally:
+        loop.close()
+    return 0

@@ -1,0 +1,26 @@
+"""Embedding similarity CLI (reference: assistant/storage/management/commands/emb_test.py)."""
+
+from __future__ import annotations
+
+import asyncio
+
+
+def add_parser(sub):
+    p = sub.add_parser("emb_test", help="cosine similarity of two texts")
+    p.add_argument("query1")
+    p.add_argument("query2")
+    p.add_argument("--model", default=None)
+    return p
+
+
+def run(args) -> int:
+    from ..ai.services.ai_service import get_ai_embedder
+    from ..conf import settings
+    from ..rag.services.search_service import embeddings_similarity
+
+    model = args.model or settings.EMBEDDING_AI_MODEL
+    embedder = get_ai_embedder(model)
+    embeddings = asyncio.run(embedder.embeddings([args.query1, args.query2]))
+    score = embeddings_similarity(embeddings[0], embeddings[1])
+    print(f"Score: {score}")
+    return 0

@@ -1,0 +1,34 @@
+"""CSV import CLI (reference: assistant/loading/management/commands/load_csv.py)."""
+
+from __future__ import annotations
+
+
+def add_parser(sub):
+    p = sub.add_parser("load_csv", help="import topic,title,content rows into the wiki tree")
+    p.add_argument("bot_codename")
+    p.add_argument("path")
+    p.add_argument(
+        "--no-process",
+        action="store_true",
+        help="do not trigger ingestion on import (signals disabled)",
+    )
+    return p
+
+
+def run(args) -> int:
+    from ..loading import CSVLoader
+    from ..storage.models import Bot
+    from ..storage.orm import disable_signals
+
+    if not args.no_process:
+        from ..processing import signals  # noqa: F401 — activate ingestion trigger
+
+    bot, _ = Bot.objects.get_or_create(codename=args.bot_codename)
+    loader = CSVLoader(bot)
+    if args.no_process:
+        with disable_signals():
+            n = loader.load(args.path)
+    else:
+        n = loader.load(args.path)
+    print(f"Loaded {n} documents for bot {args.bot_codename!r}")
+    return 0

@@ -10,6 +10,7 @@ import sys
 # command name -> module under this package exposing add_parser(subparsers)
 COMMANDS = {
     "serve": ".serve",
+    "api": ".api",
     "chat": ".chat",
     "search": ".search",
     "emb_test": ".emb_test",

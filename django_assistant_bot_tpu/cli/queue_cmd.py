@@ -1,0 +1,41 @@
+"""Queue inspection CLI (reference: assistant/admin/management/commands/queue.py:15-74)."""
+
+from __future__ import annotations
+
+
+def add_parser(sub):
+    p = sub.add_parser("queue", help="list/clear/remove queued tasks")
+    p.add_argument("action", choices=("list", "clear", "remove"), nargs="?", default="list")
+    p.add_argument("--queue", default=None, help="restrict to one queue")
+    p.add_argument("--id", type=int, default=None, help="task id (for remove)")
+    p.add_argument("--status", default=None, help="filter by status")
+    return p
+
+
+def run(args) -> int:
+    from ..tasks.queue import TaskRecord
+
+    qs = TaskRecord.objects.all()
+    if args.queue:
+        qs = qs.filter(queue=args.queue)
+    if args.status:
+        qs = qs.filter(status=args.status)
+
+    if args.action == "list":
+        rows = qs.order_by("id").all()
+        if not rows:
+            print("(empty)")
+        for t in rows:
+            print(
+                f"{t.id:6d}  {t.queue:12s}  {t.status:8s}  attempts={t.attempts}  {t.name}"
+            )
+    elif args.action == "clear":
+        n = qs.delete()
+        print(f"deleted {n} tasks")
+    elif args.action == "remove":
+        if args.id is None:
+            print("--id required for remove")
+            return 1
+        n = TaskRecord.objects.filter(id=args.id).delete()
+        print(f"deleted {n} task(s)")
+    return 0

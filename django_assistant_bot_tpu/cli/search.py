@@ -1,0 +1,31 @@
+"""RAG search CLI (reference: assistant/storage/management/commands/search.py)."""
+
+from __future__ import annotations
+
+import asyncio
+
+
+def add_parser(sub):
+    p = sub.add_parser("search", help="embedding search over the knowledge base")
+    p.add_argument("query")
+    p.add_argument("--field", choices=("sentences", "questions"), default="questions")
+    p.add_argument("--max-scores-n", type=int, default=5)
+    p.add_argument("--n", type=int, default=10)
+    return p
+
+
+def run(args) -> int:
+    from ..rag.services.search_service import embedding_search
+    from ..storage.models import Question, Sentence
+
+    model_cls = Question if args.field == "questions" else Sentence
+    results = asyncio.run(
+        embedding_search(
+            args.query, model_cls, max_scores_n=args.max_scores_n, top_n=args.n
+        )
+    )
+    for document, score in results:
+        print(f"{document.id}  {score:.4f}  {document.name}")
+    if not results:
+        print("(no results)")
+    return 0

@@ -1,0 +1,61 @@
+"""Telegram long-polling runner (reference: assistant/bot/management/commands/telegram_poll.py:25-218).
+
+``--sync`` runs the answer coroutine inline (no queue); default mode enqueues
+``answer_task`` and expects a worker to drain the ``query`` queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def add_parser(sub):
+    p = sub.add_parser("telegram_poll", help="run a bot on Telegram long polling")
+    p.add_argument("bot_codename")
+    p.add_argument("--sync", action="store_true", help="answer inline, no task queue")
+    p.add_argument("--poll-timeout", type=int, default=30)
+    return p
+
+
+async def _poll_loop(args) -> None:
+    from ..bot import tasks as bot_tasks
+    from ..bot.domain import UnknownUpdate
+    from ..bot.services.ingest_service import ingest_update
+    from ..bot.utils import get_bot_platform
+
+    platform = get_bot_platform(args.bot_codename, "telegram")
+    offset = None
+    print(f"polling telegram for bot {args.bot_codename!r} (sync={args.sync})")
+    while True:
+        try:
+            updates = await platform.api.get_updates(offset=offset, timeout=args.poll_timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.warning("getUpdates failed: %s", e)
+            await asyncio.sleep(3)
+            continue
+        for raw in updates:
+            offset = raw["update_id"] + 1
+            try:
+                update = await platform.convert_telegram_update(raw)
+            except UnknownUpdate:
+                continue
+            dialog, _ = ingest_update(
+                args.bot_codename, "telegram", update, enqueue=not args.sync
+            )
+            if args.sync:
+                await bot_tasks._answer_task(
+                    args.bot_codename, dialog.id, "telegram", update.to_dict(), platform=platform
+                )
+
+
+def run(args) -> int:
+    try:
+        asyncio.run(_poll_loop(args))
+    except KeyboardInterrupt:
+        print("stopped.")
+    return 0

@@ -1,0 +1,131 @@
+"""AI-vs-AI dialog simulator + LLM QA analyzer
+(reference: assistant/bot/management/commands/tester.py:43-453).
+
+``run`` mode: N simulated dialogs — a persona-driven "user" LLM talks to the real
+bot stack in-process; transcripts are saved as JSONL.
+``analyze`` mode: an analyzer LLM scores each saved dialog (JSON verdict) and an
+aggregate report with RICE-style improvement suggestions is printed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+import uuid
+from typing import List
+
+PERSONAS = [
+    "an impatient customer who writes short, terse messages",
+    "a polite elderly user unfamiliar with technology",
+    "a power user asking detailed technical questions",
+    "a confused user who mixes several questions in one message",
+    "a skeptical user who doubts the bot's answers",
+]
+
+
+def add_parser(sub):
+    p = sub.add_parser("tester", help="AI-vs-AI dialog simulation + QA analysis")
+    p.add_argument("bot_codename")
+    p.add_argument("--mode", choices=("run", "analyze"), default="run")
+    p.add_argument("--dialogs", type=int, default=3)
+    p.add_argument("--turns", type=int, default=4)
+    p.add_argument("--model", default=None, help="simulator/analyzer model")
+    p.add_argument("--out", default="tester_dialogs.jsonl")
+    return p
+
+
+async def _simulate_dialog(args, model: str, persona: str) -> List[dict]:
+    from ..ai.dialog import AIDialog
+    from .chat import process_message
+    from .utils import ConsolePlatform
+
+    simulator = AIDialog(model)
+    chat_id = f"tester-{uuid.uuid4()}"
+    platform = ConsolePlatform(echo=False)
+    transcript: List[dict] = [{"persona": persona}]
+    last_bot = None
+    for turn in range(args.turns):
+        if last_bot is None:
+            sim_prompt = (
+                f"You are {persona}. Start a conversation with a support bot with "
+                "one realistic question or request. Answer with the message only."
+            )
+        else:
+            sim_prompt = (
+                f"You are {persona}. The support bot replied:\n```\n{last_bot}\n```\n"
+                "Continue the conversation with one short realistic message. "
+                "Answer with the message only."
+            )
+        user_msg = (await simulator.prompt(sim_prompt)).result
+        transcript.append({"role": "user", "text": user_msg})
+        answer = await process_message(args.bot_codename, user_msg, chat_id, platform)
+        last_bot = answer.text if answer else "(no answer)"
+        transcript.append({"role": "assistant", "text": last_bot})
+    return transcript
+
+
+async def _run(args) -> int:
+    from ..conf import settings
+
+    model = args.model or settings.DIALOG_FAST_AI_MODEL
+    with open(args.out, "a", encoding="utf-8") as f:
+        for i in range(args.dialogs):
+            persona = random.choice(PERSONAS)
+            print(f"dialog {i + 1}/{args.dialogs} (persona: {persona})")
+            transcript = await _simulate_dialog(args, model, persona)
+            f.write(json.dumps({"ts": time.time(), "transcript": transcript}, ensure_ascii=False) + "\n")
+    print(f"saved {args.dialogs} dialogs to {args.out}")
+    return 0
+
+
+async def _analyze(args) -> int:
+    from ..ai.dialog import AIDialog
+    from ..conf import settings
+
+    model = args.model or settings.DIALOG_FAST_AI_MODEL
+    analyzer = AIDialog(model)
+    dialogs = []
+    with open(args.out, encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                dialogs.append(json.loads(line))
+    if not dialogs:
+        print("no dialogs to analyze")
+        return 1
+
+    verdicts = []
+    for i, d in enumerate(dialogs):
+        rendered = "\n".join(
+            f"{m.get('role', 'meta')}: {m.get('text', m.get('persona', ''))}"
+            for m in d["transcript"]
+        )
+        resp = await analyzer.prompt(
+            "You are a QA analyst reviewing a support-bot dialog:\n"
+            f"```\n{rendered}\n```\n"
+            "Rate the bot's performance and answer with JSON matching:\n"
+            "```json\n"
+            '{"score": 7, "issues": ["..."], "suggestion": "..."}\n'
+            "```\n",
+            json_format=True,
+        )
+        verdict = resp.result if isinstance(resp.result, dict) else {}
+        verdicts.append(verdict)
+        print(f"dialog {i + 1}: score={verdict.get('score')} issues={verdict.get('issues')}")
+
+    scores = [v.get("score") for v in verdicts if isinstance(v.get("score"), (int, float))]
+    if scores:
+        print(f"\naverage score: {sum(scores) / len(scores):.2f} over {len(scores)} dialogs")
+    suggestions = [v.get("suggestion") for v in verdicts if v.get("suggestion")]
+    if suggestions:
+        print("improvement suggestions (by frequency):")
+        for s in suggestions:
+            print(f"- {s}")
+    return 0
+
+
+def run(args) -> int:
+    if args.mode == "run":
+        return asyncio.run(_run(args))
+    return asyncio.run(_analyze(args))

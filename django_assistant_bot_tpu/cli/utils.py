@@ -1,0 +1,59 @@
+"""Shared CLI helpers: console platform + instance bootstrap
+(reference: assistant/bot/management/commands/utils.py:5-32, chat.py:92-151)."""
+
+from __future__ import annotations
+
+import datetime as dt
+from typing import Optional, Tuple
+
+from ..bot.domain import BotPlatform, SingleAnswer, Update
+from ..storage.models import Bot, BotUser, Dialog, Instance
+
+
+class ConsolePlatform(BotPlatform):
+    """Prints answers to stdout; used by `chat` and `tester`."""
+
+    def __init__(self, echo: bool = True):
+        self.echo = echo
+        self.answers: list[SingleAnswer] = []
+
+    @property
+    def codename(self) -> str:
+        return "console"
+
+    async def get_update(self, request) -> Update:
+        raise NotImplementedError
+
+    async def post_answer(self, chat_id: str, answer: SingleAnswer) -> None:
+        self.answers.append(answer)
+        if self.echo:
+            print(f"\nBot: {answer.text}")
+            if answer.thinking:
+                print(f"  [thinking] {answer.thinking}")
+            if answer.buttons:
+                for row in answer.buttons:
+                    for b in row:
+                        print(f"  [{b.text}] -> {b.callback_data}")
+
+    async def action_typing(self, chat_id: str) -> None:
+        pass
+
+
+def get_instance(
+    bot_codename: str, chat_id: str, platform: str = "console"
+) -> Tuple[Bot, Instance]:
+    """Bootstrap Bot/BotUser/Instance rows (auto-creates the Bot row like the
+    reference chat command does)."""
+    bot, _ = Bot.objects.get_or_create(codename=bot_codename)
+    user, _ = BotUser.objects.get_or_create(user_id=chat_id, platform=platform)
+    instance, created = Instance.objects.get_or_create(bot=bot, user=user)
+    if instance.state is None:
+        instance.state = {}
+    return bot, instance
+
+
+def open_dialog(instance: Instance, ttl_s: Optional[int] = 24 * 3600) -> Dialog:
+    from ..bot.services.dialog_service import get_dialog
+
+    ttl = dt.timedelta(seconds=ttl_s) if ttl_s else None
+    return get_dialog(instance, ttl=ttl)

@@ -1,0 +1,46 @@
+"""Task worker runner — the `celery worker` analog."""
+
+from __future__ import annotations
+
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+
+def add_parser(sub):
+    p = sub.add_parser("worker", help="run a task-queue worker (+ optional beat)")
+    p.add_argument("--queues", default=None, help="comma-separated queue names")
+    p.add_argument("--concurrency", type=int, default=2)
+    p.add_argument("--beat", action="store_true", help="also run periodic schedule")
+    return p
+
+
+def run(args) -> int:
+    # register all task modules
+    from ..bot import tasks as bot_tasks  # noqa: F401
+    from ..processing import signals, tasks as processing_tasks  # noqa: F401
+    from ..tasks import Worker
+
+    try:
+        from ..broadcasting import tasks as broadcasting_tasks  # noqa: F401
+    except ImportError:
+        broadcasting_tasks = None
+
+    queues = args.queues.split(",") if args.queues else None
+    worker = Worker(queues, concurrency=args.concurrency).start()
+    beat = None
+    if args.beat and broadcasting_tasks is not None:
+        from ..tasks import Beat
+
+        beat = Beat().add(broadcasting_tasks.check_scheduled_broadcasts, 30.0).start()
+    print(f"worker started (queues={worker.queues}, concurrency={args.concurrency})")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        print("stopping...")
+        worker.stop()
+        if beat:
+            beat.stop()
+    return 0

@@ -46,9 +46,17 @@ class Settings:
         self.GROQ_BASE_URL: str = _env("GROQ_BASE_URL", "https://api.groq.com/openai/v1")
         # resources + registries
         self.RESOURCES_DIR: Optional[str] = _env("RESOURCES_DIR")
+        self.API_AUTH_TOKEN: Optional[str] = _env("API_AUTH_TOKEN")
+        self.WEBHOOK_BASE_URL: Optional[str] = _env("WEBHOOK_BASE_URL")
         self.BOTS: Dict[str, Dict[str, Any]] = {}
         # TPU serving config (model registry TOML/JSON path for the `tpu:` provider)
         self.TPU_SERVING_CONFIG: Optional[str] = _env("TPU_SERVING_CONFIG")
+        # ingestion plane
+        self.DOCUMENT_MAX_LENGTH: int = int(_env("DOCUMENT_MAX_LENGTH", 1000))
+        # None -> derive the expected language per document from its source text;
+        # the reference hardcodes 'ru' in its repeat_until conditions
+        self.DOCUMENT_LANGUAGE: Optional[str] = _env("DOCUMENT_LANGUAGE")
+        self.DOCUMENT_PROCESSOR_CLASSES: Dict[str, str] = {}
         # task plane
         self.TASK_DB_PATH: Optional[str] = _env("TASK_DB_PATH")
         self.TASK_ALWAYS_EAGER: bool = str(_env("TASK_ALWAYS_EAGER", "0")) in ("1", "true", "True")

@@ -1,0 +1,3 @@
+"""Loading plane — bulk imports into the wiki tree (reference: assistant/loading/)."""
+
+from .csv import CSVLoader  # noqa: F401

@@ -1,0 +1,54 @@
+"""CSV import building a 2-level WikiDocument tree
+(reference: assistant/loading/csv.py:14-47).
+
+Rows: ``topic,title,content`` (header optional).  Each distinct topic becomes a
+root WikiDocument; each row becomes a child under its topic.  Saves fire the
+processing signal, so importing triggers ingestion automatically when
+``processing.signals`` is active.
+"""
+
+from __future__ import annotations
+
+import csv
+import logging
+from typing import Optional
+
+from ..storage.models import Bot, WikiDocument
+
+logger = logging.getLogger(__name__)
+
+
+class CSVLoader:
+    def __init__(self, bot: Bot):
+        self.bot = bot
+
+    def load(self, path: str, *, has_header: Optional[bool] = None) -> int:
+        with open(path, newline="", encoding="utf-8") as f:
+            rows = list(csv.reader(f))
+        if not rows:
+            return 0
+        if has_header is None:
+            first = [c.lower().strip() for c in rows[0]]
+            has_header = "topic" in first or "title" in first
+        if has_header:
+            rows = rows[1:]
+
+        roots: dict[str, WikiDocument] = {}
+        count = 0
+        for row in rows:
+            if len(row) < 3:
+                logger.warning("skipping short row: %r", row)
+                continue
+            topic, title, content = row[0].strip(), row[1].strip(), row[2]
+            root = roots.get(topic)
+            if root is None:
+                root = WikiDocument.objects.get_or_none(bot=self.bot, title=topic, parent=None)
+                if root is None:
+                    root = WikiDocument.objects.create(bot=self.bot, title=topic)
+                roots[topic] = root
+            WikiDocument.objects.create(
+                bot=self.bot, parent=root, title=title, content=content
+            )
+            count += 1
+        logger.info("loaded %d rows into %d topics", count, len(roots))
+        return count

@@ -1,0 +1,8 @@
+"""Ingestion plane — the "train"-analog batch path (reference: assistant/processing/).
+
+WikiDocument save -> split into section Documents (LLM) -> per-document pipeline
+(format -> sentences -> questions -> embeddings -> question dedup) fanned out over
+the task plane, finalized by an atomic status flip.  TPU-first difference from the
+reference: embedding steps feed the coalescing TPU embedding engine, so concurrent
+document tasks batch onto the MXU instead of issuing per-document HTTP calls.
+"""

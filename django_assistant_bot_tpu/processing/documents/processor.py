@@ -1,0 +1,64 @@
+"""Per-document step pipeline (reference: assistant/processing/documents/processor.py:33-73).
+
+Pluggable per bot via ``settings.DOCUMENT_PROCESSOR_CLASSES[codename]``.
+"""
+
+from __future__ import annotations
+
+import logging
+from abc import ABC, abstractmethod
+from functools import lru_cache
+from typing import List, Type
+
+from ...conf import settings
+from ...storage.models import Document, WikiDocument
+from .steps.base import DocumentProcessingStep
+from .steps.embeddings import QuestionsEmbeddingsStep, SentencesEmbeddingsStep
+from .steps.formatter import DocumentFormatStep
+from .steps.questions import GenerateQuestionsStep, MergeQuestionsStep
+from .steps.sentences import ExtractSentencesStep
+
+logger = logging.getLogger(__name__)
+
+
+class DocumentProcessor(ABC):
+    @property
+    @abstractmethod
+    def steps(self) -> List[Type[DocumentProcessingStep]]: ...
+
+    async def process(self, document: Document) -> None:
+        for step_cls in self.steps:
+            await step_cls(document=document).run()
+
+
+class DefaultDocumentProcessor(DocumentProcessor):
+    @property
+    def steps(self) -> List[Type[DocumentProcessingStep]]:
+        return [
+            DocumentFormatStep,
+            ExtractSentencesStep,
+            GenerateQuestionsStep,
+            SentencesEmbeddingsStep,
+            QuestionsEmbeddingsStep,
+            MergeQuestionsStep,
+        ]
+
+
+async def process_document(document: Document) -> None:
+    wiki = WikiDocument.objects.get_or_none(id=document.wiki_id) if document.wiki_id else None
+    codename = ""
+    if wiki and wiki.bot_id:
+        bot = wiki.bot
+        codename = bot.codename if bot else ""
+    processor = get_document_processor(codename)
+    await processor.process(document)
+
+
+@lru_cache
+def get_document_processor(bot_codename: str) -> DocumentProcessor:
+    path = settings.DOCUMENT_PROCESSOR_CLASSES.get(bot_codename)
+    if path:
+        logger.info("using document processor %s for bot %s", path, bot_codename)
+        cls = settings.import_string(path) if isinstance(path, str) else path
+        return cls()
+    return DefaultDocumentProcessor()

@@ -1,0 +1,9 @@
+from .base import DocumentProcessingStep  # noqa: F401
+from .embeddings import (  # noqa: F401
+    ContentEmbeddingsStep,
+    QuestionsEmbeddingsStep,
+    SentencesEmbeddingsStep,
+)
+from .formatter import DocumentFormatStep  # noqa: F401
+from .questions import GenerateQuestionsStep, MergeQuestionsStep  # noqa: F401
+from .sentences import ExtractSentencesStep  # noqa: F401

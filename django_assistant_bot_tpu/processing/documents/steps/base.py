@@ -1,0 +1,25 @@
+"""Step ABC (reference: assistant/processing/documents/steps/base.py)."""
+
+from __future__ import annotations
+
+import logging
+from abc import ABC, abstractmethod
+
+from ....storage.models import Document, WikiDocument
+
+
+class DocumentProcessingStep(ABC):
+    def __init__(self, document: Document):
+        self._document = document
+        self._logger = logging.getLogger(self.__class__.__name__)
+
+    def _wiki_path(self) -> str:
+        wiki = (
+            WikiDocument.objects.get_or_none(id=self._document.wiki_id)
+            if self._document.wiki_id
+            else None
+        )
+        return wiki.path if wiki else self._document.name
+
+    @abstractmethod
+    async def run(self) -> None: ...

@@ -1,0 +1,65 @@
+"""Embedding steps (reference: .../steps/embeddings.py:15-88).
+
+THE TPU-relevant hot loop (SURVEY.md §3.2): each step sends the document's full
+sentence/question batch in ONE embeddings call; with the ``tpu:`` embedder those
+batches coalesce across concurrent document tasks inside the serving engine and
+ride the MXU together — vs the reference's per-text torch loop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....ai.services.ai_service import get_ai_embedder
+from ....conf import settings
+from ....rag.index_registry import invalidate_index
+from ....storage.models import Question, Sentence
+from .base import DocumentProcessingStep
+
+
+class SentencesEmbeddingsStep(DocumentProcessingStep):
+    def __init__(self, document):
+        super().__init__(document)
+        self._embedder = get_ai_embedder(settings.EMBEDDING_AI_MODEL)
+
+    async def run(self) -> None:
+        sentences = Sentence.objects.filter(document=self._document).order_by("id").all()
+        if not sentences:
+            return
+        embeddings = await self._embedder.embeddings([s.text for s in sentences])
+        assert len(embeddings) == len(sentences)
+        for s, e in zip(sentences, embeddings):
+            s.embedding = np.asarray(e, np.float32)
+            s.save()
+        invalidate_index(Sentence)
+
+
+class QuestionsEmbeddingsStep(DocumentProcessingStep):
+    def __init__(self, document):
+        super().__init__(document)
+        self._embedder = get_ai_embedder(settings.EMBEDDING_AI_MODEL)
+
+    async def run(self) -> None:
+        questions = Question.objects.filter(document=self._document).order_by("id").all()
+        if not questions:
+            return
+        embeddings = await self._embedder.embeddings([q.text for q in questions])
+        assert len(embeddings) == len(questions)
+        for q, e in zip(questions, embeddings):
+            q.embedding = np.asarray(e, np.float32)
+            q.save()
+        invalidate_index(Question)
+
+
+class ContentEmbeddingsStep(DocumentProcessingStep):
+    def __init__(self, document):
+        super().__init__(document)
+        self._embedder = get_ai_embedder(settings.EMBEDDING_AI_MODEL)
+
+    async def run(self) -> None:
+        content = self._document.content or ""
+        if not content:
+            return
+        embedding = (await self._embedder.embeddings([content]))[0]
+        self._document.content_embedding = np.asarray(embedding, np.float32)
+        self._document.save()

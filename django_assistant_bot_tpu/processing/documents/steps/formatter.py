@@ -1,0 +1,44 @@
+"""LLM reformat step (reference: .../steps/formatter.py:20-44)."""
+
+from __future__ import annotations
+
+from ....ai.dialog import AIDialog
+from ....conf import settings
+from ....utils.repeat_until import repeat_until
+from ...utils import expected_language, json_prompt, language_matches
+from .base import DocumentProcessingStep
+
+
+class DocumentFormatStep(DocumentProcessingStep):
+    def __init__(self, document):
+        super().__init__(document)
+        self._ai = AIDialog(settings.FORMAT_AI_MODEL)
+
+    async def run(self) -> None:
+        self._logger.info("format document %s", self._document.id)
+        content = (self._document.content or "").replace("\t", " " * 4).strip()
+        if not content:
+            return
+        lang = expected_language(content)
+        response = await repeat_until(
+            self._ai.prompt,
+            (
+                f'This is a raw text of document called "{self._document.name}":\n'
+                f"```\n{content}\n```\n\n"
+                "Reformat this text.\n"
+                "Give a text in the best human-readable format. Markdown must be used.\n"
+                "You must not lose any information.\n"
+                "Keep the original meaning fully.\n"
+                "Keep the original language too.\n"
+                f"{json_prompt('format_document')}"
+            ),
+            json_format=True,
+            condition=lambda resp: (
+                "text" in resp.result
+                and isinstance(resp.result["text"], str)
+                and len(resp.result["text"]) >= 2
+                and language_matches(lang, resp.result["text"])
+            ),
+        )
+        self._document.content = response.result["text"]
+        self._document.save()

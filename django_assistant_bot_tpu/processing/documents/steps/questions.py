@@ -1,0 +1,160 @@
+"""Question generation + dedup steps (reference: .../steps/questions.py:19-203).
+
+GenerateQuestionsStep: LLM questions per 500-char chunk with length/language
+validation.  MergeQuestionsStep: per-question KNN against earlier documents'
+questions; near-duplicates are confirmed by an LLM same-meaning check, then an
+LLM doc-choice deletes the loser's question.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ....ai.dialog import AIDialog
+from ....conf import settings
+from ....rag.index_registry import invalidate_index
+from ....rag.services.search_service import embedding_search_questions
+from ....storage.models import Document, Question, WikiDocument
+from ....utils.repeat_until import repeat_until
+from ...utils import expected_language, json_prompt, language_matches, split_text_by_parts
+from .base import DocumentProcessingStep
+
+MERGE_DISTANCE = 0.05
+
+
+class GenerateQuestionsStep(DocumentProcessingStep):
+    def __init__(self, document):
+        super().__init__(document)
+        self._ai = AIDialog(settings.QUESTIONS_AI_MODEL)
+
+    async def run(self) -> None:
+        self._logger.info("generate questions for document %s", self._document.id)
+        doc_full_title = self._wiki_path().replace(" / ", ". ")
+        text = f"# {doc_full_title}\n\n{self._document.content}\n"
+        order = 0
+        questions = []
+        for part in split_text_by_parts(text, 500):
+            for q in await self._generate_questions(part):
+                questions.append(Question(document=self._document, text=q, order=order))
+                order += 1
+        Question.objects.bulk_create(questions)
+
+    async def _generate_questions(self, text: str) -> List[str]:
+        lang = expected_language(text)
+        prompt = (
+            "This is a text of a document:\n"
+            f"```\n{text.strip()}\n```\n"
+            "Generate all possible questions that this document will help ANSWER.\n"
+            "Do not generate questions for which the answers are not contained "
+            "in the text.\n"
+            "Include appropriate keywords in your questions so that they match "
+            "the document well when searching.\n"
+            "You must provide sentences in natural formatting removing any extra "
+            "spaces or symbols.\n"
+            "You must use the ORIGINAL DOCUMENT LANGUAGE in the answer.\n"
+            f"{json_prompt('document_questions')}"
+        )
+
+        def check_fn(resp):
+            if "questions" not in resp.result:
+                return "questions missing"
+            qs = resp.result["questions"]
+            if not all(isinstance(q, str) for q in qs):
+                return "non-string questions"
+            total = sum(len(q) for q in qs)
+            if total < int(len(text) * 0.5):
+                return f"questions too short ({total})"
+            if not all(language_matches(lang, q) for q in qs):
+                return "wrong language"
+            return True
+
+        response = await repeat_until(
+            self._ai.prompt, prompt, json_format=True, condition=check_fn
+        )
+        return response.result["questions"]
+
+
+class MergeQuestionsStep(DocumentProcessingStep):
+    def __init__(self, document):
+        super().__init__(document)
+        self._ai = AIDialog(settings.QUESTIONS_AI_MODEL)
+
+    async def run(self) -> None:
+        self._logger.info("merge questions for document %s", self._document.id)
+        questions = Question.objects.filter(document=self._document).order_by("id").all()
+        if not questions:
+            return
+        invalidate_index(Question)  # this doc's fresh embeddings must be visible
+        earlier_ids = {
+            q.id
+            for q in Question.objects.filter(document__lt=self._document.id)
+        }
+        for q in questions:
+            if q.embedding is None:
+                continue
+            similar = await embedding_search_questions(
+                q.embedding, n=1, allowed_ids=earlier_ids
+            )
+            if not similar:
+                continue
+            candidate = similar[0]
+            if candidate.distance <= MERGE_DISTANCE:
+                if await self._check_similarity(q.text, candidate.text):
+                    await self._merge_queries(q, candidate)
+
+    async def _check_similarity(self, question: str, similar_question: str) -> bool:
+        if question == similar_question:
+            return True
+        prompt = (
+            "Check if the following two questions have exactly the same meaning:\n"
+            f"```\n1. {question}\n2. {similar_question}\n```\n\n"
+            "When comparing, consider the following:\n"
+            "1. Questions may differ in context, purpose, level of detail, or "
+            "scope even a little.\n"
+            "2. Questions are considered to have the same meaning if they request "
+            "exactly the same information or have exactly the same goal.\n"
+            "3. Questions are considered to have different meanings if they "
+            "target different aspects, contexts, levels of detail, or scopes. "
+            "Even a little.\n\n"
+            "Please answer 'true' if the questions are the same, 'false' otherwise.\n"
+            f"{json_prompt('questions_similarity')}"
+        )
+        response = await repeat_until(
+            self._ai.prompt,
+            prompt,
+            json_format=True,
+            condition=lambda resp: isinstance(resp.result.get("result"), bool),
+        )
+        return response.result["result"]
+
+    def _doc_header(self, doc: Document) -> str:
+        wiki = WikiDocument.objects.get_or_none(id=doc.wiki_id) if doc.wiki_id else None
+        path = wiki.path if wiki else doc.name
+        return path.replace(" / ", ". ")
+
+    async def _merge_queries(self, question: Question, similar_question: Question) -> None:
+        doc1 = Document.objects.get(id=question.document_id)
+        doc2 = Document.objects.get(id=similar_question.document_id)
+        prompt = (
+            "Choose one of the two documents that contains the best answer to "
+            "the following question:\n"
+            f"```\n{question.text}\n```\n\n"
+            "1. The first document\n"
+            f"```\n# {self._doc_header(doc1)}\n\n{doc1.content}\n```\n\n"
+            "2. The second document\n"
+            f"```\n# {self._doc_header(doc2)}\n\n{doc2.content}\n```\n\n"
+            "Please answer `1` if the first document is better, or `2` if the "
+            "second document is better.\n"
+            f"{json_prompt('questions_merge')}"
+        )
+        response = await repeat_until(
+            self._ai.prompt,
+            prompt,
+            json_format=True,
+            condition=lambda resp: resp.result.get("result") in (1, 2),
+        )
+        if response.result["result"] == 1:
+            similar_question.delete()
+        else:
+            question.delete()
+        invalidate_index(Question)

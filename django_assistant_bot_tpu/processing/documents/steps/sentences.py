@@ -1,0 +1,81 @@
+"""Sentence extraction step (reference: .../steps/sentences.py:28-112).
+
+Chunk the document (500-char parts), LLM-split each chunk into embedding-ready
+sentences, validate with length + language heuristics, bulk-insert.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ....ai.dialog import AIDialog
+from ....conf import settings
+from ....storage.models import Sentence
+from ....utils.repeat_until import repeat_until
+from ...utils import expected_language, language_matches, split_text_by_parts
+from .base import DocumentProcessingStep
+
+
+def _estimated_total_length(text: str) -> int:
+    words = len(re.findall(r"\w+", text))
+    return min(words * 5, int(len(text.strip()) * 0.8))
+
+
+async def split_text_to_sentences(text: str, ai: AIDialog) -> List[str]:
+    lang = expected_language(text)
+    prompt = (
+        "Break down the following text into meaningful sentences to facilitate "
+        "the creation of embeddings for search optimization:\n"
+        f"```\n{text.strip()}\n```\n"
+        "The total length of the sentences must not be less than the length of "
+        "the document. Do not miss anything."
+        "You must clear any excess formatting or symbols. But keep the natural "
+        "punctuation as if the sentence is independent.\n"
+        "You must also use the original DOCUMENT LANGUAGE in the answer.\n"
+        "Answer with a JSON response that strictly matches the following example:\n"
+        "```json\n"
+        "{\n"
+        '  "sentences": [\n'
+        '    "The first sentence of the text.",\n'
+        '    "The second sentence of the text.",\n'
+        "    ...\n"
+        "  ]\n"
+        "}\n"
+        "```\n"
+    )
+
+    def check_response(resp):
+        if "sentences" not in resp.result:
+            return "sentences missing"
+        sentences = resp.result["sentences"]
+        if not all(isinstance(s, str) for s in sentences):
+            return "non-string sentences"
+        total = sum(len(s) for s in sentences)
+        if total < _estimated_total_length(text):
+            return f"sentences too short ({total})"
+        if not all(language_matches(lang, s) for s in sentences):
+            return "wrong language"
+        return True
+
+    response = await repeat_until(ai.prompt, prompt, json_format=True, condition=check_response)
+    return [s.strip() for s in response.result["sentences"] if s.strip()]
+
+
+class ExtractSentencesStep(DocumentProcessingStep):
+    def __init__(self, document):
+        super().__init__(document)
+        self._ai = AIDialog(settings.SENTENCES_AI_MODEL)
+
+    async def run(self) -> None:
+        self._logger.info("extract sentences for document %s", self._document.id)
+        text = f"# {self._wiki_path()}\n\n{self._document.content}\n"
+        order = 0
+        sentences = []
+        for part in split_text_by_parts(text, 500):
+            for sentence in await split_text_to_sentences(part, self._ai):
+                sentences.append(
+                    Sentence(document=self._document, text=sentence, order=order)
+                )
+                order += 1
+        Sentence.objects.bulk_create(sentences)

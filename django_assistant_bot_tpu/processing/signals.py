@@ -1,0 +1,16 @@
+"""WikiDocument save trigger (reference: assistant/processing/signals.py:9-11).
+
+Import this module (the CLI and example app do) to activate the post_save hook:
+every WikiDocument save enqueues reprocessing.
+"""
+
+from __future__ import annotations
+
+from ..storage.models import WikiDocument
+from ..storage.orm import post_save
+from .tasks import wiki_processing_task
+
+
+@post_save(WikiDocument)
+def trigger_wiki_processing(instance: WikiDocument, created: bool) -> None:
+    wiki_processing_task.delay(instance.id)

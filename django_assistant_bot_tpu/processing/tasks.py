@@ -1,0 +1,62 @@
+"""Ingestion task chain (reference: assistant/processing/tasks.py:15-74).
+
+wiki_processing_task: split -> group(document_processing_task x N) with a
+finalize chord.  All three tasks run at-least-once with 10 retries / 60 s delay
+(the reference's acks_late + autoretry_for policy; lease reclaim covers
+reject_on_worker_lost).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..storage.models import Document, WikiDocument, WikiDocumentProcessing
+from ..tasks.queue import CeleryQueues, group, task
+from .documents.processor import process_document
+from .wiki import split_wiki_document
+
+logger = logging.getLogger(__name__)
+
+_RETRY = dict(max_retries=10, retry_delay=60.0)
+
+
+@task(queue=CeleryQueues.PROCESSING.value, **_RETRY)
+def wiki_processing_task(wiki_document_id: int, **kwargs):
+    logger.info("wiki processing task started for %s", wiki_document_id)
+    wiki_document = WikiDocument.objects.get_or_none(id=wiki_document_id)
+    if wiki_document is None:
+        logger.error("wiki document %s not found; aborting", wiki_document_id)
+        return
+    processing = asyncio.run(split_wiki_document(wiki_document))
+    documents = Document.objects.filter(processing=processing).all()
+    group(
+        [(document_processing_task, (d.id,), {}) for d in documents],
+        chord=(finalize_document_processing_task, (processing.id,), {}),
+    )
+    logger.info("wiki processing task finished for %s", wiki_document_id)
+
+
+@task(queue=CeleryQueues.PROCESSING.value, **_RETRY)
+def document_processing_task(document_id: int, **kwargs):
+    logger.info("document processing task started for %s", document_id)
+    document = Document.objects.get(id=document_id)
+    asyncio.run(process_document(document))
+    logger.info("document processing task finished for %s", document_id)
+
+
+@task(queue=CeleryQueues.PROCESSING.value, **_RETRY)
+def finalize_document_processing_task(processing_id: int, **kwargs):
+    logger.info("finalize processing task started for %s", processing_id)
+    processing = WikiDocumentProcessing.objects.get(id=processing_id)
+    processing.status = WikiDocumentProcessing.COMPLETED
+    processing.save()
+    WikiDocumentProcessing.objects.filter(
+        wiki_document=processing.wiki_document_id
+    ).exclude(id=processing_id).delete()
+    from ..rag.index_registry import invalidate_index
+    from ..storage.models import Question, Sentence
+
+    invalidate_index(Question)
+    invalidate_index(Sentence)
+    logger.info("finalize processing task finished for %s", processing_id)

@@ -1,0 +1,45 @@
+"""json_prompt bound to the processing schemas + text chunking
+(reference: assistant/processing/utils.py)."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..conf import settings
+from ..utils.json_schema import JSONSchema
+from ..utils.language import get_language
+
+SCHEMA_DIR = os.path.join(os.path.dirname(os.path.realpath(__file__)), "schemas")
+
+_json_schema = JSONSchema(SCHEMA_DIR)
+
+
+def json_prompt(name, *args, **kwargs) -> str:
+    return _json_schema.get_prompt(name, *args, **kwargs)
+
+
+def split_text_by_parts(text: str, max_part_length: int) -> List[str]:
+    """Split by newlines so each part stays under max_part_length."""
+    parts: List[str] = []
+    part = ""
+    for line in text.splitlines():
+        if part and len(part) + len(line) > max_part_length:
+            parts.append(part)
+            part = ""
+        part += line + "\n"
+    if part:
+        parts.append(part)
+    return parts
+
+
+def expected_language(source_text: str) -> Optional[str]:
+    """Language every generated chunk must match (the reference hardcodes 'ru';
+    here it follows the source document unless DOCUMENT_LANGUAGE pins it)."""
+    if settings.DOCUMENT_LANGUAGE:
+        return settings.DOCUMENT_LANGUAGE
+    return get_language(source_text or "")
+
+
+def language_matches(expected: Optional[str], text: str) -> bool:
+    return expected is None or get_language(text) == expected

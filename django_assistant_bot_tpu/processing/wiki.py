@@ -1,0 +1,106 @@
+"""WikiDocument splitter (reference: assistant/processing/wiki.py:17-99).
+
+Short documents become a single section; long ones are split by an LLM in two
+phases: propose section names, then extract each section's text verbatim.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from ..ai.dialog import AIDialog
+from ..conf import settings
+from ..storage.models import Document, WikiDocument, WikiDocumentProcessing
+from ..utils.repeat_until import repeat_until
+from .utils import expected_language, json_prompt, language_matches
+
+logger = logging.getLogger(__name__)
+
+
+class WikiDocumentSplitter:
+    def __init__(self, wiki_document: WikiDocument):
+        self._wiki_document = wiki_document
+        self._ai = AIDialog(settings.SPLIT_AI_MODEL)
+        self._lang = expected_language(wiki_document.content)
+
+    async def run(self) -> WikiDocumentProcessing:
+        logger.info(
+            "split document %r (content length %d)",
+            self._wiki_document.title,
+            len(self._wiki_document.content or ""),
+        )
+        processing = WikiDocumentProcessing.objects.create(
+            wiki_document=self._wiki_document
+        )
+        names = await self._get_section_names()
+        logger.info("section names: %s", names)
+        for section_name in names:
+            section = await self._get_section(names, section_name)
+            Document.objects.create(
+                processing=processing,
+                name=section_name,
+                content=section,
+                wiki=self._wiki_document,
+            )
+        return processing
+
+    async def _get_section_names(self) -> List[str]:
+        content = self._wiki_document.content or ""
+        if not content:
+            return []
+        if len(content) < settings.DOCUMENT_MAX_LENGTH:
+            return [self._wiki_document.title]
+        response = await repeat_until(
+            self._ai.prompt,
+            (
+                f'This is a long document called "{self._wiki_document.title}":\n'
+                f"```\n{content.strip()}\n```\n\n"
+                "This document needs to be broken down into 2 or more parts.\n"
+                "Consider breaking this text into an optimal number of sections "
+                "based on meaning.\n"
+                "And create a list of proposed section titles for the document.\n"
+                "Keep the original language.\n"
+                f"{json_prompt('split_document_get_names')}"
+            ),
+            json_format=True,
+            condition=lambda resp: (
+                "names" in resp.result
+                and isinstance(resp.result["names"], list)
+                and len(resp.result["names"]) >= 2
+                and all(
+                    isinstance(n, str) and language_matches(self._lang, n)
+                    for n in resp.result["names"]
+                )
+            ),
+        )
+        return response.result["names"]
+
+    async def _get_section(self, names: List[str], section_name: str) -> str:
+        if len(names) == 1 and section_name == names[0]:
+            return self._wiki_document.content
+        names_list_str = "\n- ".join(names)
+        response = await repeat_until(
+            self._ai.prompt,
+            (
+                f'This is a long document called "{self._wiki_document.title}":\n'
+                f"```\n{self._wiki_document.content.strip()}\n```\n\n"
+                f"This document needs to be broken into {len(names)} parts:\n"
+                f"{names_list_str}\n"
+                f'Give the text of the section "{section_name}".\n'
+                "The text must match the original maximally in detail (word-for-word).\n"
+                "Keep the original language.\n"
+                f"{json_prompt('split_document_get_section', do_escape=True)}"
+            ),
+            json_format=True,
+            condition=lambda resp: (
+                "text" in resp.result
+                and isinstance(resp.result["text"], str)
+                and language_matches(self._lang, resp.result["text"])
+            ),
+        )
+        return response.result["text"]
+
+
+async def split_wiki_document(wiki_document: WikiDocument) -> WikiDocumentProcessing:
+    return await WikiDocumentSplitter(wiki_document).run()

@@ -175,6 +175,43 @@ class ForeignKey(IntField):
 
 MODEL_REGISTRY: Dict[str, Type["Model"]] = {}
 
+# ------------------------------------------------------------------ signals
+# Django-signal analog (reference: assistant/processing/signals.py,
+# assistant/bot/signals.py).  post_save handlers fire after Model.save();
+# disable_signals() suppresses them (reference: assistant/utils/db.py:9-43).
+_POST_SAVE: Dict[str, list] = {}
+_signals_disabled = 0
+
+
+def post_save(model_cls: "Type[Model]"):
+    """``@post_save(WikiDocument)`` -> handler(instance, created) after save."""
+
+    def decorator(fn):
+        _POST_SAVE.setdefault(model_cls.__name__, []).append(fn)
+        return fn
+
+    return decorator
+
+
+def _emit_post_save(instance: "Model", created: bool) -> None:
+    if _signals_disabled:
+        return
+    for fn in _POST_SAVE.get(type(instance).__name__, []):
+        fn(instance, created)
+
+
+class disable_signals:
+    """Context manager suppressing post_save handlers (test factories use it)."""
+
+    def __enter__(self):
+        global _signals_disabled
+        _signals_disabled += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _signals_disabled
+        _signals_disabled -= 1
+
 _OPS = {
     "lt": "<",
     "lte": "<=",
@@ -542,7 +579,8 @@ class Model(metaclass=ModelMeta):
             cols.append(col)
             vals.append(f.to_db(value))
         try:
-            if self.id is None:
+            created = self.id is None
+            if created:
                 quoted = ", ".join('"' + c + '"' for c in cols)
                 sql = (
                     f"INSERT INTO {self.table_name()} ({quoted}) "
@@ -558,6 +596,7 @@ class Model(metaclass=ModelMeta):
                 )
         except _sq.IntegrityError as e:
             raise IntegrityError(str(e)) from e
+        _emit_post_save(self, created)
         return self
 
     def delete(self) -> None:

@@ -1,0 +1,163 @@
+"""Example app: TaskManagerBot (reference: example/bot/bot.py:17-359).
+
+Demonstrates the framework's extension surface: intent classification with the
+fast model, a state-machine task-creation flow checkpointed in ``Instance.state``,
+regex command decorators, inline keyboards, and MultiPartAnswer.  Tasks live in
+the instance state (the reference does the same — no extra tables).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from django_assistant_bot_tpu.ai.providers.base import AIDebugger
+from django_assistant_bot_tpu.bot.assistant_bot import AssistantBot
+from django_assistant_bot_tpu.bot.domain import (
+    Answer,
+    Button,
+    MultiPartAnswer,
+    SingleAnswer,
+)
+from django_assistant_bot_tpu.bot.services.context_service.utils import add_system_message
+from django_assistant_bot_tpu.utils.repeat_until import repeat_until
+
+INTENTS = ("#create_task", "#list_tasks", "#other")
+
+
+class TaskManagerBot(AssistantBot):
+    DEFAULT_LANGUAGE = "en"
+
+    async def get_answer_to_messages(self, messages, debug_info, do_interrupt) -> Answer:
+        if self.instance.state.get("awaiting_input"):
+            return await self.handle_state_input(messages, debug_info)
+        category = await self._classify_intent(messages, debug_info)
+        if category == "#create_task":
+            return await self.initiate_task_creation()
+        if category == "#list_tasks":
+            return await self.show_task_list()
+        return await self.handle_general_query(messages, debug_info)
+
+    # ------------------------------------------------------- intent detection
+    async def _classify_intent(self, messages, debug_info) -> str:
+        with AIDebugger(self._fast_ai, debug_info, "intent_classification") as dbg:
+            system_msg = (
+                "Classify the user request above:\n"
+                "#create_task - creating a new task\n"
+                "#list_tasks - request task list\n"
+                "#other - other requests"
+            )
+            response = await repeat_until(
+                dbg.ai.get_response,
+                add_system_message(messages, system_msg),
+                condition=lambda r: any(i in r.result for i in INTENTS),
+                max_attempts=5,
+            )
+            intent = next((i for i in INTENTS if i in response.result), "#other")
+            dbg.node["detected_intent"] = intent
+            return intent
+
+    # ------------------------------------------------------ creation workflow
+    async def initiate_task_creation(self) -> SingleAnswer:
+        await self.update_state({"awaiting_input": "task_title", "new_task": {}})
+        return SingleAnswer(
+            "📝 Enter task name:",
+            buttons=[[Button("Cancel", callback_data="/cancel")]],
+        )
+
+    async def handle_state_input(self, messages, debug_info) -> Answer:
+        awaiting = self.instance.state.get("awaiting_input")
+        text = messages[-1]["content"] if messages else ""
+        if awaiting == "task_title":
+            new_task = dict(self.instance.state.get("new_task") or {})
+            new_task["title"] = text.strip()
+            await self.update_state({"awaiting_input": "priority", "new_task": new_task})
+            return SingleAnswer(
+                f"Priority for *{new_task['title']}*?",
+                buttons=[
+                    [Button(p.title(), callback_data=f"/priority {p}")]
+                    for p in ("high", "medium", "low")
+                ],
+            )
+        return SingleAnswer("Please use the buttons above.", no_store=True)
+
+    @AssistantBot.command(r"/priority (high|medium|low)")
+    async def set_priority(self, match: re.Match, message_id: Optional[int] = None):
+        new_task = dict(self.instance.state.get("new_task") or {})
+        new_task["priority"] = match.group(1)
+        await self.update_state({"awaiting_input": "confirm", "new_task": new_task})
+        return await self._confirm_task_creation()
+
+    async def _confirm_task_creation(self) -> SingleAnswer:
+        new_task = self.instance.state.get("new_task") or {}
+        return SingleAnswer(
+            (
+                "Confirm task creation:\n"
+                f"*Title:* {new_task.get('title')}\n"
+                f"*Priority:* {new_task.get('priority')}"
+            ),
+            buttons=[
+                [
+                    Button("✅ Confirm", callback_data="/confirm_task"),
+                    Button("❌ Cancel", callback_data="/cancel"),
+                ]
+            ],
+        )
+
+    @AssistantBot.command(r"/confirm_task")
+    async def finalize_task(self, match=None, message_id: Optional[int] = None):
+        new_task = self.instance.state.get("new_task") or {}
+        if not new_task.get("title"):
+            return SingleAnswer("Nothing to confirm.", no_store=True)
+        tasks = list(self.instance.state.get("tasks") or [])
+        tasks.append({"title": new_task["title"], "priority": new_task.get("priority", "medium")})
+        await self.update_state({"tasks": tasks, "awaiting_input": None, "new_task": {}})
+        return MultiPartAnswer(
+            parts=[
+                SingleAnswer(f"✅ Task *{new_task['title']}* created."),
+                SingleAnswer(f"You now have {len(tasks)} task(s). Use /list to view them."),
+            ],
+            no_store=True,
+        )
+
+    @AssistantBot.command(r"/cancel")
+    async def cancel_operation(self, match=None, message_id: Optional[int] = None):
+        await self.update_state({"awaiting_input": None, "new_task": {}})
+        return SingleAnswer("Operation cancelled.", no_store=True)
+
+    # ------------------------------------------------------------------ lists
+    @AssistantBot.command(r"/list")
+    async def command_list(self, match=None, message_id: Optional[int] = None):
+        return await self.show_task_list()
+
+    async def show_task_list(self) -> SingleAnswer:
+        tasks = self.instance.state.get("tasks") or []
+        if not tasks:
+            return SingleAnswer("No tasks yet. Send /new_task to create one.", no_store=True)
+        marks = {"high": "🔴", "medium": "🟡", "low": "🟢"}
+        lines = [
+            f"{marks.get(t.get('priority'), '•')} {i + 1}. {t['title']}"
+            for i, t in enumerate(tasks)
+        ]
+        return SingleAnswer("*Your tasks:*\n" + "\n".join(lines), no_store=True)
+
+    @AssistantBot.command(r"/new_task")
+    async def command_new_task(self, match=None, message_id: Optional[int] = None):
+        return await self.initiate_task_creation()
+
+    # ------------------------------------------------------------------ misc
+    async def handle_general_query(self, messages, debug_info) -> Optional[Answer]:
+        return await super().get_answer_to_messages(messages, debug_info, None)
+
+    async def command_start(self, text: str):
+        return SingleAnswer(
+            "👋 I'm the task manager bot.\n"
+            "Send /new_task to create a task, /list to see your tasks.",
+            no_store=True,
+        )
+
+    async def command_help(self):
+        return SingleAnswer(
+            "/new_task — create a task\n/list — show tasks\n/cancel — abort",
+            no_store=True,
+        )

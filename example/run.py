@@ -1,0 +1,13 @@
+"""Example app entry: framework CLI with the example BOTS registry applied."""
+
+from __future__ import annotations
+
+import sys
+
+from django_assistant_bot_tpu.cli.main import main
+
+from .settings import configure
+
+if __name__ == "__main__":
+    configure()
+    sys.exit(main())

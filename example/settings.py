@@ -1,0 +1,21 @@
+"""Example deployment config (reference: example/example/settings.py:55-70).
+
+Run with::
+
+    python -m example.run chat taskmanager
+"""
+
+from __future__ import annotations
+
+from django_assistant_bot_tpu.conf import settings
+
+BOTS = {
+    "taskmanager": {
+        "class": "example.bot.TaskManagerBot",
+        "telegram_token": None,  # set via DABT_TELEGRAM_TOKEN or Bot row
+    }
+}
+
+
+def configure() -> None:
+    settings.BOTS = BOTS

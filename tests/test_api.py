@@ -1,0 +1,162 @@
+"""HTTP API plane: webhook enqueue, REST CRUD, synchronous message serve, auth.
+
+Mirrors reference tests/bot_tests/test_api.py: the full view -> lock -> dialog
+service -> persistence path runs real; the AI is cut at get_answer_to_messages.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from django_assistant_bot_tpu.api import create_api_app
+from django_assistant_bot_tpu.bot.assistant_bot import AssistantBot
+from django_assistant_bot_tpu.bot.domain import SingleAnswer
+from django_assistant_bot_tpu.conf import settings
+from django_assistant_bot_tpu.storage import models
+
+
+def with_client(fn):
+    """Run an async test body with a live aiohttp test client."""
+
+    async def runner(*args, **kwargs):
+        app = create_api_app()
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await fn(client, *args, **kwargs)
+        finally:
+            await client.close()
+
+    return lambda *a, **k: asyncio.run(runner(*a, **k))
+
+
+@pytest.fixture()
+def seeded(tmp_db, monkeypatch):
+    bot = models.Bot.objects.create(codename="api-bot", telegram_token="123:abc")
+    user = models.BotUser.objects.create(user_id="u9", platform="console")
+    instance = models.Instance.objects.create(bot=bot, user=user)
+    dialog = models.Dialog.objects.create(instance=instance)
+
+    async def fake_answer(self, messages, debug_info, do_interrupt):
+        return SingleAnswer(text="api answer", usage=[{"model": "test"}])
+
+    monkeypatch.setattr(AssistantBot, "get_answer_to_messages", fake_answer)
+    return bot, instance, dialog
+
+
+def test_bots_endpoints(seeded):
+    @with_client
+    async def body(client):
+        resp = await client.get("/api/v1/bots/")
+        data = await resp.json()
+        assert resp.status == 200
+        assert data["results"][0]["codename"] == "api-bot"
+        resp = await client.get("/api/v1/bots/api-bot/")
+        assert resp.status == 200
+        resp = await client.get("/api/v1/bots/nope/")
+        assert resp.status == 404
+
+    body()
+
+
+def test_dialog_crud(seeded):
+    bot, instance, dialog = seeded
+
+    @with_client
+    async def body(client):
+        resp = await client.post("/api/v1/dialogs/", json={"instance_id": instance.id})
+        assert resp.status == 201
+        new_id = (await resp.json())["id"]
+        resp = await client.get(f"/api/v1/dialogs/{new_id}/")
+        assert resp.status == 200
+        resp = await client.get("/api/v1/dialogs/")
+        assert len((await resp.json())["results"]) == 2
+        resp = await client.delete(f"/api/v1/dialogs/{new_id}/")
+        assert resp.status == 204
+        assert models.Dialog.objects.get_or_none(id=new_id) is None
+
+    body()
+
+
+def test_message_create_runs_bot_synchronously(seeded):
+    bot, instance, dialog = seeded
+
+    @with_client
+    async def body(client):
+        resp = await client.post(
+            f"/api/v1/dialogs/{dialog.id}/messages/", json={"text": "hello api"}
+        )
+        assert resp.status == 201
+        data = await resp.json()
+        assert data["message"]["text"] == "hello api"
+        assert data["answers"][0]["text"] == "api answer"
+        # both user message and assistant answer persisted
+        resp = await client.get(f"/api/v1/dialogs/{dialog.id}/messages/")
+        texts = [m["text"] for m in (await resp.json())["results"]]
+        assert "hello api" in texts and "api answer" in texts
+
+    body()
+
+
+def test_wiki_endpoints(seeded):
+    @with_client
+    async def body(client):
+        resp = await client.post(
+            "/api/v1/wiki/", json={"bot": "api-bot", "title": "Root", "content": "c"}
+        )
+        assert resp.status == 201
+        root_id = (await resp.json())["id"]
+        resp = await client.post(
+            "/api/v1/wiki/bulk/",
+            json=[
+                {"bot": "api-bot", "parent_id": root_id, "title": "A"},
+                {"bot": "api-bot", "parent_id": root_id, "title": "B"},
+            ],
+        )
+        assert resp.status == 201
+        assert len((await resp.json())["created"]) == 2
+        resp = await client.get("/api/v1/wiki/?bot=api-bot")
+        data = await resp.json()
+        assert data["count"] == 3
+        child = [w for w in data["results"] if w["title"] == "A"][0]
+        assert child["path"] == "Root / A"
+
+    body()
+
+
+def test_webhook_enqueues_answer_task(seeded):
+    from django_assistant_bot_tpu.tasks import TaskRecord
+
+    @with_client
+    async def body(client):
+        payload = {
+            "message": {
+                "message_id": 3,
+                "chat": {"id": 555},
+                "text": "webhook hi",
+                "from": {"id": 555, "username": "web"},
+            }
+        }
+        resp = await client.post("/telegram/api-bot/", json=payload)
+        assert resp.status == 200
+        tasks = TaskRecord.objects.all().all()
+        assert any("answer_task" in t.name for t in tasks)
+        # user message persisted before the task runs
+        assert models.Message.objects.filter(message_id=3).count() == 1
+
+    body()
+
+
+def test_auth_token_enforced(seeded):
+    @with_client
+    async def body(client):
+        with settings.override(API_AUTH_TOKEN="sekret"):
+            resp = await client.get("/api/v1/bots/")
+            assert resp.status == 401
+            resp = await client.get(
+                "/api/v1/bots/", headers={"Authorization": "Token sekret"}
+            )
+            assert resp.status == 200
+
+    body()

@@ -1,0 +1,118 @@
+"""Broadcasting plane: status machine, targeting, batch fan-out, stats, finalize."""
+
+import asyncio
+import datetime as dt
+
+import pytest
+
+from django_assistant_bot_tpu.broadcasting import BroadcastCampaign
+from django_assistant_bot_tpu.broadcasting.services import (
+    record_batch_results,
+    resolve_target_chat_ids,
+    schedule_campaign_sending,
+)
+from django_assistant_bot_tpu.broadcasting.tasks import (
+    check_scheduled_broadcasts,
+    send_broadcast_batch,
+)
+from django_assistant_bot_tpu.bot.domain import BotPlatform, UserUnavailableError
+from django_assistant_bot_tpu.conf import settings
+from django_assistant_bot_tpu.storage import models
+from django_assistant_bot_tpu.tasks import Worker
+
+
+class FanoutPlatform(BotPlatform):
+    def __init__(self, unavailable=()):
+        self.sent = []
+        self.unavailable = set(unavailable)
+
+    @property
+    def codename(self):
+        return "telegram"
+
+    async def get_update(self, request):
+        raise NotImplementedError
+
+    async def post_answer(self, chat_id, answer):
+        if chat_id in self.unavailable:
+            raise UserUnavailableError(chat_id)
+        self.sent.append((chat_id, answer.text))
+
+    async def action_typing(self, chat_id):
+        pass
+
+
+@pytest.fixture()
+def campaign(tmp_db):
+    bot = models.Bot.objects.create(codename="bc", telegram_token="t")
+    for i in range(5):
+        user = models.BotUser.objects.create(user_id=f"u{i}", platform="telegram")
+        models.Instance.objects.create(bot=bot, user=user, is_unavailable=(i == 4))
+    return BroadcastCampaign.objects.create(bot=bot, message_text="hello all")
+
+
+def test_status_machine_schedule_sync(campaign):
+    assert campaign.status == BroadcastCampaign.DRAFT
+    campaign.scheduled_at = dt.datetime.now(dt.timezone.utc)
+    campaign.save()
+    assert campaign.status == BroadcastCampaign.SCHEDULED
+    campaign.scheduled_at = None
+    campaign.save()
+    assert campaign.status == BroadcastCampaign.DRAFT
+
+
+def test_resolve_targets_skips_unavailable(campaign):
+    ids = resolve_target_chat_ids(campaign)
+    assert sorted(ids) == ["u0", "u1", "u2", "u3"]  # u4 unavailable
+
+
+def test_full_campaign_flow_with_partial_failure(campaign, monkeypatch):
+    platform = FanoutPlatform(unavailable={"u2"})
+    import django_assistant_bot_tpu.broadcasting.tasks as btasks
+
+    monkeypatch.setattr(btasks, "get_bot_platform", lambda *a, **k: platform)
+
+    schedule_campaign_sending(campaign)
+    with settings.override(TASK_ALWAYS_EAGER=True):
+        n = check_scheduled_broadcasts.apply()
+    assert n == 1
+    campaign.refresh()
+    assert campaign.status == BroadcastCampaign.PARTIAL_FAILURE
+    assert campaign.total_recipients == 4
+    assert campaign.successful_sents == 3
+    assert campaign.failed_sents == 1
+    assert len(platform.sent) == 3
+    # the failed user got marked unavailable
+    user = models.BotUser.objects.get(user_id="u2", platform="telegram")
+    inst = models.Instance.objects.get(bot=campaign.bot_id, user=user.id)
+    assert inst.is_unavailable
+
+
+def test_campaign_flow_through_worker(campaign, monkeypatch):
+    platform = FanoutPlatform()
+    import django_assistant_bot_tpu.broadcasting.tasks as btasks
+
+    monkeypatch.setattr(btasks, "get_bot_platform", lambda *a, **k: platform)
+    schedule_campaign_sending(campaign)
+    check_scheduled_broadcasts.delay()
+    w = Worker(["broadcasting"])
+    for _ in range(6):
+        w.run_until_idle()
+    campaign.refresh()
+    assert campaign.status == BroadcastCampaign.COMPLETED
+    assert campaign.successful_sents == 4
+    assert len(platform.sent) == 4
+
+
+def test_record_batch_results_gates_on_sending(campaign):
+    campaign.status = BroadcastCampaign.SENDING
+    campaign.total_recipients = 10
+    campaign.save()
+    assert record_batch_results(campaign.id, 4, 0) is False  # not complete yet
+    assert record_batch_results(campaign.id, 4, 2) is True  # now complete
+    campaign.refresh()
+    assert campaign.successful_sents == 8 and campaign.failed_sents == 2
+    # wrong state ignored
+    campaign.status = BroadcastCampaign.COMPLETED
+    campaign.save()
+    assert record_batch_results(campaign.id, 1, 0) is False

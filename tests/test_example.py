@@ -1,0 +1,109 @@
+"""Example app: the TaskManagerBot state machine end-to-end on the real engine."""
+
+import asyncio
+
+import pytest
+
+from example.bot import TaskManagerBot
+
+from django_assistant_bot_tpu.ai.providers.echo import EchoProvider
+from django_assistant_bot_tpu.bot.domain import BotPlatform, MultiPartAnswer, Update, User
+from django_assistant_bot_tpu.bot.services.dialog_service import create_user_message
+from django_assistant_bot_tpu.storage import models
+
+
+class StubPlatform(BotPlatform):
+    @property
+    def codename(self):
+        return "console"
+
+    async def get_update(self, request):
+        raise NotImplementedError
+
+    async def post_answer(self, chat_id, answer):
+        pass
+
+    async def action_typing(self, chat_id):
+        pass
+
+
+@pytest.fixture()
+def bot(tmp_db, monkeypatch):
+    bot_model = models.Bot.objects.create(codename="taskmanager")
+    user = models.BotUser.objects.create(user_id="u1", platform="console", language="en")
+    instance = models.Instance.objects.create(bot=bot_model, user=user)
+    dialog = models.Dialog.objects.create(instance=instance)
+    return TaskManagerBot(dialog, StubPlatform())
+
+
+def _send(bot, text, message_id):
+    async def turn():
+        create_user_message(bot.dialog, message_id, text)
+        upd = Update(chat_id="u1", message_id=message_id, text=text, user=User(id="u1"))
+        answer = await bot.handle_update(upd)
+        if answer is not None:
+            await bot.on_answer_sent(answer)  # persist like the answer task does
+        return answer
+
+    return asyncio.run(turn())
+
+
+def test_task_creation_state_machine(bot, monkeypatch):
+    import example.bot as example_bot
+
+    scripted = EchoProvider(script=["#create_task"])
+    monkeypatch.setattr(
+        TaskManagerBot, "_fast_ai", property(lambda self: scripted)
+    )
+
+    # intent -> create task -> awaiting title
+    answer = _send(bot, "I want to add a task", 1)
+    assert "Enter task name" in answer.text
+    assert bot.instance.state["awaiting_input"] == "task_title"
+
+    # title input -> priority keyboard
+    answer = _send(bot, "Ship the TPU framework", 2)
+    assert "Priority" in answer.text
+    assert any("/priority high" in b.callback_data for row in answer.buttons for b in row)
+
+    # priority command -> confirm
+    answer = _send(bot, "/priority high", 3)
+    assert "Confirm task creation" in answer.text
+
+    # confirm -> MultiPartAnswer + task stored in instance state
+    answer = _send(bot, "/confirm_task", 4)
+    assert isinstance(answer, MultiPartAnswer)
+    assert "created" in answer.parts[0].text
+    state = models.Instance.objects.get(id=bot.instance.id).state
+    assert state["tasks"] == [{"title": "Ship the TPU framework", "priority": "high"}]
+
+    # /list renders the stored task
+    answer = _send(bot, "/list", 5)
+    assert "Ship the TPU framework" in answer.text
+    assert "🔴" in answer.text
+
+
+def test_cancel_resets_state(bot, monkeypatch):
+    scripted = EchoProvider(script=["#create_task"])
+    monkeypatch.setattr(TaskManagerBot, "_fast_ai", property(lambda self: scripted))
+    _send(bot, "new task please", 1)
+    assert bot.instance.state["awaiting_input"] == "task_title"
+    answer = _send(bot, "/cancel", 2)
+    assert "cancelled" in answer.text.lower()
+    assert not bot.instance.state["awaiting_input"]
+
+
+def test_custom_commands_do_not_leak_to_base(bot):
+    patterns = [p.pattern for p, _ in TaskManagerBot._command_handlers]
+    assert r"/priority (high|medium|low)" in patterns
+    from django_assistant_bot_tpu.bot.assistant_bot import AssistantBot
+
+    base_patterns = [p.pattern for p, _ in AssistantBot._command_handlers]
+    assert r"/priority (high|medium|low)" not in base_patterns
+
+
+def test_start_and_help(bot):
+    answer = _send(bot, "/start", 1)
+    assert "task manager bot" in answer.text
+    answer = _send(bot, "/help", 2)
+    assert "/new_task" in answer.text

@@ -1,0 +1,167 @@
+"""Ingestion plane: split, per-document pipeline, chord finalize, CSV loader.
+
+AI is cut at the provider (scripted EchoProvider); storage, task dispatch, KNN
+invalidation, and status machine all run real (SURVEY.md §4 strategy).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from django_assistant_bot_tpu.ai.providers.echo import EchoProvider
+from django_assistant_bot_tpu.conf import settings
+from django_assistant_bot_tpu.loading import CSVLoader
+from django_assistant_bot_tpu.processing import signals  # noqa: F401 — activates post_save
+from django_assistant_bot_tpu.processing.tasks import (
+    document_processing_task,
+    finalize_document_processing_task,
+    wiki_processing_task,
+)
+from django_assistant_bot_tpu.rag.index_registry import reset_indexes
+from django_assistant_bot_tpu.storage import models
+from django_assistant_bot_tpu.storage.orm import disable_signals
+from django_assistant_bot_tpu.tasks import TaskRecord, Worker
+
+CONTENT = "Pay invoices in the billing portal. Refunds take five business days."
+FORMATTED = "## Billing\nPay invoices in the billing portal. Refunds take five business days."
+SENTENCES = [
+    "Pay invoices in the billing portal and check status there regularly.",
+    "Refunds take five business days to process after the request is filed.",
+]
+QUESTIONS = [
+    "How do I pay my invoices in the billing portal system?",
+    "How long do refunds take to process after filing the request?",
+]
+
+
+@pytest.fixture(autouse=True)
+def _fresh(tmp_db):
+    reset_indexes()
+    yield
+    reset_indexes()
+
+
+def _scripted(monkeypatch, script):
+    from django_assistant_bot_tpu.ai import dialog as dialog_mod
+
+    provider = EchoProvider(script=list(script))
+    monkeypatch.setattr(dialog_mod, "get_ai_provider", lambda model: provider)
+    return provider
+
+
+def _pipeline_script():
+    return [
+        {"text": FORMATTED},        # DocumentFormatStep
+        {"sentences": SENTENCES},   # ExtractSentencesStep
+        {"questions": QUESTIONS},   # GenerateQuestionsStep
+    ]
+
+
+def test_wiki_processing_eager_end_to_end(monkeypatch):
+    _scripted(monkeypatch, _pipeline_script())
+    bot = models.Bot.objects.create(codename="ing")
+    with settings.override(TASK_ALWAYS_EAGER=True):
+        wiki = models.WikiDocument.objects.create(bot=bot, title="Billing", content=CONTENT)
+
+    # signal fired -> split (single section, short content) -> full pipeline -> finalize
+    processing = models.WikiDocumentProcessing.objects.get(wiki_document=wiki)
+    assert processing.status == models.WikiDocumentProcessing.COMPLETED
+    doc = models.Document.objects.get(processing=processing)
+    assert doc.name == "Billing" and doc.content == FORMATTED
+    sentences = models.Sentence.objects.filter(document=doc).all()
+    questions = models.Question.objects.filter(document=doc).all()
+    assert [s.text for s in sentences] == SENTENCES
+    assert [q.text for q in questions] == QUESTIONS
+    assert all(s.embedding is not None for s in sentences)
+    assert all(q.embedding is not None for q in questions)
+
+
+def test_wiki_processing_via_worker_chord(monkeypatch):
+    _scripted(monkeypatch, _pipeline_script())
+    bot = models.Bot.objects.create(codename="ing2")
+    wiki = models.WikiDocument.objects.create(bot=bot, title="Docs", content=CONTENT)
+    # signal enqueued the wiki task; drain: wiki -> group member -> chord finalize
+    w = Worker(["processing"])
+    for _ in range(4):
+        w.run_until_idle()
+    processing = models.WikiDocumentProcessing.objects.get(wiki_document=wiki)
+    assert processing.status == models.WikiDocumentProcessing.COMPLETED
+    assert models.Question.objects.count() == len(QUESTIONS)
+    names = [t.name for t in TaskRecord.objects.all()]
+    assert any("wiki_processing_task" in n for n in names)
+    assert any("finalize_document_processing_task" in n for n in names)
+    assert all(t.status == "done" for t in TaskRecord.objects.all())
+
+
+def test_finalize_deletes_stale_processings(monkeypatch):
+    bot = models.Bot.objects.create(codename="ing3")
+    with disable_signals():
+        wiki = models.WikiDocument.objects.create(bot=bot, title="W", content="short")
+    old = models.WikiDocumentProcessing.objects.create(wiki_document=wiki)
+    new = models.WikiDocumentProcessing.objects.create(wiki_document=wiki)
+    finalize_document_processing_task.apply(new.id)
+    assert models.WikiDocumentProcessing.objects.get(id=new.id).status == "completed"
+    assert models.WikiDocumentProcessing.objects.get_or_none(id=old.id) is None
+
+
+def test_merge_questions_dedup(monkeypatch):
+    """A near-duplicate question triggers LLM same-meaning + doc-choice; the
+    loser's question is deleted (reference: steps/questions.py:104-203)."""
+    from django_assistant_bot_tpu.processing.documents.steps.questions import (
+        MergeQuestionsStep,
+    )
+
+    bot = models.Bot.objects.create(codename="ing4")
+    with disable_signals():
+        wiki = models.WikiDocument.objects.create(bot=bot, title="W", content="x")
+    d1 = models.Document.objects.create(wiki=wiki, name="old", content="old doc")
+    d2 = models.Document.objects.create(wiki=wiki, name="new", content="new doc")
+    vec = np.random.default_rng(0).normal(size=768).astype(np.float32)
+    q_old = models.Question.objects.create(document=d1, text="How to pay?", embedding=vec)
+    q_new = models.Question.objects.create(document=d2, text="How to pay??", embedding=vec)
+
+    # similarity -> true; doc choice -> 1 (the asking doc d2 wins, old question deleted)
+    _scripted(monkeypatch, [{"result": True}, {"result": 1}])
+    asyncio.run(MergeQuestionsStep(d2).run())
+    assert models.Question.objects.get_or_none(id=q_old.id) is None
+    assert models.Question.objects.get_or_none(id=q_new.id) is not None
+
+
+def test_split_long_document(monkeypatch):
+    from django_assistant_bot_tpu.processing.wiki import split_wiki_document
+
+    long_content = "\n".join(f"Line {i} of the long document body." for i in range(60))
+    bot = models.Bot.objects.create(codename="ing5")
+    with disable_signals():
+        wiki = models.WikiDocument.objects.create(bot=bot, title="Long", content=long_content)
+    _scripted(
+        monkeypatch,
+        [
+            {"names": ["Part One", "Part Two"]},
+            {"text": "First half of the text."},
+            {"text": "Second half of the text."},
+        ],
+    )
+    processing = asyncio.run(split_wiki_document(wiki))
+    docs = models.Document.objects.filter(processing=processing).order_by("id").all()
+    assert [d.name for d in docs] == ["Part One", "Part Two"]
+    assert docs[0].content == "First half of the text."
+
+
+def test_csv_loader_builds_tree(tmp_path):
+    bot = models.Bot.objects.create(codename="csv")
+    p = tmp_path / "data.csv"
+    p.write_text(
+        "topic,title,content\n"
+        "Billing,Pay,How to pay\n"
+        "Billing,Refund,How to refund\n"
+        "Shipping,Track,How to track\n"
+    )
+    with disable_signals():
+        n = CSVLoader(bot).load(str(p))
+    assert n == 3
+    roots = models.WikiDocument.objects.filter(bot=bot, parent=None).all()
+    assert sorted(r.title for r in roots) == ["Billing", "Shipping"]
+    billing = next(r for r in roots if r.title == "Billing")
+    assert sorted(c.title for c in billing.children()) == ["Pay", "Refund"]
