@@ -1,0 +1,288 @@
+"""Admin interface — the Django-admin analog (reference: assistant/bot/admin.py,
+assistant/storage/admin.py:36-66, assistant/broadcasting/admin.py).
+
+Server-rendered HTML over the ORM: model browsers with the reference's computed
+columns (per-instance total cost, per-message I/O tokens), the storage admin's
+"Process" action (re-triggers ingestion), and the broadcasting admin's
+schedule/send-test actions.  Mounted under ``/admin/`` by
+:func:`~django_assistant_bot_tpu.api.app.create_api_app`.
+"""
+
+from __future__ import annotations
+
+import html
+import logging
+
+from aiohttp import web
+
+from ..storage import models
+
+logger = logging.getLogger(__name__)
+
+_STYLE = """
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
+ table { border-collapse: collapse; margin: 1rem 0; }
+ th, td { border: 1px solid #ccc; padding: .35rem .6rem; text-align: left; }
+ th { background: #f3f3f3; }
+ a { color: #06c; text-decoration: none; }
+ nav a { margin-right: 1rem; }
+ form { display: inline; }
+ button { cursor: pointer; }
+ .num { text-align: right; }
+</style>
+"""
+
+_NAV = (
+    "<nav><a href='/admin/'>Dashboard</a><a href='/admin/bots'>Bots</a>"
+    "<a href='/admin/instances'>Instances</a><a href='/admin/dialogs'>Dialogs</a>"
+    "<a href='/admin/wiki'>Wiki</a><a href='/admin/campaigns'>Campaigns</a>"
+    "<a href='/admin/tasks'>Tasks</a></nav>"
+)
+
+
+def _esc(value) -> str:
+    return html.escape(str(value if value is not None else ""))
+
+
+def _html(title: str, body: str) -> web.Response:
+    return web.Response(
+        text=f"<html><head><title>{title}</title>{_STYLE}</head>"
+        f"<body>{_NAV}<h1>{title}</h1>{body}</body></html>",
+        content_type="text/html",
+    )
+
+
+def _table(headers, rows) -> str:
+    head = "".join(f"<th>{h}</th>" for h in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{c}</td>" for c in row) + "</tr>" for row in rows
+    )
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def register_admin(app: web.Application) -> None:
+    async def dashboard(request: web.Request) -> web.Response:
+        from ..broadcasting.models import BroadcastCampaign
+        from ..tasks.queue import TaskRecord
+
+        counts = [
+            (name, cls.objects.count())
+            for name, cls in [
+                ("Bots", models.Bot),
+                ("Users", models.BotUser),
+                ("Instances", models.Instance),
+                ("Dialogs", models.Dialog),
+                ("Messages", models.Message),
+                ("Wiki documents", models.WikiDocument),
+                ("Documents", models.Document),
+                ("Sentences", models.Sentence),
+                ("Questions", models.Question),
+                ("Campaigns", BroadcastCampaign),
+                ("Tasks", TaskRecord),
+            ]
+        ]
+        return _html("Dashboard", _table(["Model", "Rows"], counts))
+
+    async def bots(request: web.Request) -> web.Response:
+        rows = []
+        for b in models.Bot.objects.all().order_by("id"):
+            instances = models.Instance.objects.filter(bot=b).count()
+            rows.append(
+                (
+                    b.id,
+                    _esc(b.codename),
+                    _esc(b.username),
+                    "yes" if b.is_whitelist_enabled else "no",
+                    instances,
+                )
+            )
+        return _html(
+            "Bots", _table(["id", "codename", "username", "whitelist", "instances"], rows)
+        )
+
+    async def instances(request: web.Request) -> web.Response:
+        rows = []
+        for inst in models.Instance.objects.all().order_by("id"):
+            dialog_ids = [
+                d.id for d in models.Dialog.objects.filter(instance=inst)
+            ]
+            msgs = (
+                models.Message.objects.filter(dialog__in=dialog_ids).all()
+                if dialog_ids
+                else []
+            )
+            total_cost = sum(m.cost or 0 for m in msgs)
+            rows.append(
+                (
+                    inst.id,
+                    _esc(inst.bot.codename if inst.bot_id else ""),
+                    _esc(inst.user.user_id if inst.user_id else ""),
+                    "yes" if inst.is_unavailable else "no",
+                    len(msgs),
+                    f"<span class='num'>${total_cost:.4f}</span>",
+                )
+            )
+        return _html(
+            "Instances",
+            _table(["id", "bot", "user", "unavailable", "messages", "total cost"], rows),
+        )
+
+    async def dialogs(request: web.Request) -> web.Response:
+        rows = []
+        for d in models.Dialog.objects.all().order_by("-id").limit(100):
+            n = models.Message.objects.filter(dialog=d).count()
+            rows.append(
+                (
+                    f"<a href='/admin/dialogs/{d.id}'>{d.id}</a>",
+                    d.instance_id,
+                    "yes" if d.is_completed else "no",
+                    _esc(d.created_at),
+                    n,
+                )
+            )
+        return _html(
+            "Dialogs", _table(["id", "instance", "completed", "created", "messages"], rows)
+        )
+
+    async def dialog_detail(request: web.Request) -> web.Response:
+        dialog = models.Dialog.objects.get_or_none(id=int(request.match_info["id"]))
+        if dialog is None:
+            raise web.HTTPNotFound()
+        rows = []
+        for m in models.Message.objects.filter(dialog=dialog).order_by("id"):
+            usage = m.cost_details or []
+            tokens = "/".join(
+                f"{u.get('prompt_tokens', 0)}+{u.get('completion_tokens', 0)}"
+                for u in (usage if isinstance(usage, list) else [usage])
+                if isinstance(u, dict)
+            )
+            rows.append(
+                (
+                    m.id,
+                    _esc(m.role.name if m.role_id else ""),
+                    _esc((m.text or "")[:200]),
+                    tokens or "-",  # reference admin "I/O tokens" column
+                    f"${m.cost:.5f}" if m.cost else "-",
+                )
+            )
+        return _html(
+            f"Dialog {dialog.id}", _table(["id", "role", "text", "i/o tokens", "cost"], rows)
+        )
+
+    async def wiki(request: web.Request) -> web.Response:
+        rows = []
+        for w in models.WikiDocument.objects.all().order_by("id").limit(200):
+            latest = (
+                models.WikiDocumentProcessing.objects.filter(wiki_document=w)
+                .order_by("-id")
+                .first()
+            )
+            rows.append(
+                (
+                    w.id,
+                    _esc(w.bot.codename if w.bot_id else ""),
+                    _esc(w.path),
+                    _esc(latest.status if latest else "-"),
+                    f"<form method='post' action='/admin/wiki/{w.id}/process'>"
+                    "<button>Process</button></form>",
+                )
+            )
+        return _html("Wiki", _table(["id", "bot", "path", "processing", "actions"], rows))
+
+    async def wiki_process(request: web.Request) -> web.Response:
+        """Re-trigger ingestion (reference storage admin 'Process' action)."""
+        w = models.WikiDocument.objects.get_or_none(id=int(request.match_info["id"]))
+        if w is None:
+            raise web.HTTPNotFound()
+        from ..processing.tasks import wiki_processing_task
+
+        wiki_processing_task.delay(w.id)
+        raise web.HTTPFound("/admin/wiki")
+
+    async def campaigns(request: web.Request) -> web.Response:
+        from ..broadcasting.models import BroadcastCampaign
+
+        rows = []
+        for c in BroadcastCampaign.objects.all().order_by("-id").limit(100):
+            actions = (
+                f"<form method='post' action='/admin/campaigns/{c.id}/schedule'>"
+                "<button>Schedule</button></form> "
+                f"<form method='post' action='/admin/campaigns/{c.id}/send_test'>"
+                "<button>Send test</button></form>"
+            )
+            rows.append(
+                (
+                    c.id,
+                    _esc(c.name),
+                    _esc(c.bot.codename if c.bot_id else ""),
+                    _esc(c.status),
+                    f"{c.successful_sents}/{c.failed_sents}/{c.total_recipients or '-'}",
+                    actions,
+                )
+            )
+        return _html(
+            "Campaigns",
+            _table(["id", "name", "bot", "status", "ok/fail/total", "actions"], rows),
+        )
+
+    async def campaign_schedule(request: web.Request) -> web.Response:
+        from ..broadcasting.models import BroadcastCampaign
+        from ..broadcasting.services import schedule_campaign_sending
+
+        c = BroadcastCampaign.objects.get_or_none(id=int(request.match_info["id"]))
+        if c is None:
+            raise web.HTTPNotFound()
+        schedule_campaign_sending(c)
+        raise web.HTTPFound("/admin/campaigns")
+
+    async def campaign_send_test(request: web.Request) -> web.Response:
+        """Send the campaign text to the first available instance only
+        (reference broadcasting admin send-test endpoint)."""
+        from ..bot.tasks import send_answer_task
+        from ..bot.domain import SingleAnswer
+        from ..broadcasting.models import BroadcastCampaign
+
+        c = BroadcastCampaign.objects.get_or_none(id=int(request.match_info["id"]))
+        if c is None:
+            raise web.HTTPNotFound()
+        inst = models.Instance.objects.filter(bot=c.bot_id, is_unavailable=False).first()
+        if inst is not None:
+            user = models.BotUser.objects.get(id=inst.user_id)
+            send_answer_task.delay(
+                c.bot.codename,
+                c.platform,
+                user.user_id,
+                SingleAnswer(text=c.message_text, no_store=True).to_dict(),
+            )
+        raise web.HTTPFound("/admin/campaigns")
+
+    async def tasks_view(request: web.Request) -> web.Response:
+        from ..tasks.queue import TaskRecord
+
+        rows = [
+            (
+                t.id,
+                _esc(t.queue),
+                _esc(t.name.rsplit(".", 1)[-1]),
+                _esc(t.status),
+                t.attempts,
+                _esc((t.error or "")[:120]),
+            )
+            for t in TaskRecord.objects.all().order_by("-id").limit(200)
+        ]
+        return _html(
+            "Tasks", _table(["id", "queue", "task", "status", "attempts", "error"], rows)
+        )
+
+    app.router.add_get("/admin/", dashboard)
+    app.router.add_get("/admin/bots", bots)
+    app.router.add_get("/admin/instances", instances)
+    app.router.add_get("/admin/dialogs", dialogs)
+    app.router.add_get("/admin/dialogs/{id}", dialog_detail)
+    app.router.add_get("/admin/wiki", wiki)
+    app.router.add_post("/admin/wiki/{id}/process", wiki_process)
+    app.router.add_get("/admin/campaigns", campaigns)
+    app.router.add_post("/admin/campaigns/{id}/schedule", campaign_schedule)
+    app.router.add_post("/admin/campaigns/{id}/send_test", campaign_send_test)
+    app.router.add_get("/admin/tasks", tasks_view)

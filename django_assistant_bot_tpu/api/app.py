@@ -290,4 +290,8 @@ def create_api_app() -> web.Application:
     app.router.add_post("/api/v1/wiki/", create_wiki)
     app.router.add_post("/api/v1/wiki/bulk/", bulk_wiki)
     app.router.add_get("/healthz", healthz)
+
+    from .admin import register_admin
+
+    register_admin(app)
     return app

@@ -216,6 +216,44 @@ def forward(
     return with_constraint(logits.astype(jnp.float32), ("batch", "length", "vocab_out"))
 
 
+def forward_long(
+    params: Params,
+    cfg: DecoderConfig,
+    input_ids: jnp.ndarray,  # [B, S]; S sharded over the mesh `seq` axis
+    mesh,
+) -> jnp.ndarray:
+    """Sequence-parallel forward for long contexts: activations shard over the
+    ``seq`` axis and attention runs as ring attention — K/V chunks rotate around
+    the ICI ring (O(S/n) attention memory per chip).  The reference caps context
+    at 8k instead (SURVEY.md §5.7); this is the scale-it path.
+
+    Semantics match :func:`forward` exactly (same params, causal masking).
+    """
+    from ..ops.ring_attention import ring_attention
+
+    B, S = input_ids.shape
+    cos, sin = _rope_tables(cfg, S)
+    x = params["tok_embed"][input_ids].astype(cfg.dtype)
+    x = with_constraint(x, ("batch", "length", "embed"))
+
+    def body(x, p):
+        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _attn_proj(cfg, p, h, cos, sin)
+        k, v = _repeat_kv(cfg, k), _repeat_kv(cfg, v)
+        o = ring_attention(q, k, v, mesh, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
+        x = x + jnp.einsum("bso,oe->bse", o, p["wo"])
+        h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(cfg, p, h)
+        return with_constraint(x, ("batch", "length", "embed")), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bse,ev->bsv", x, head.astype(cfg.dtype))
+    return with_constraint(logits.astype(jnp.float32), ("batch", "length", "vocab_out"))
+
+
 def _write_cache(cache_k, new_k, starts):
     """vmap'd dynamic_update_slice: cache_k [B,KH,S,D], new_k [B,KH,Sn,D], starts [B]."""
     def upd(c, n, s):

@@ -1,0 +1,10 @@
+"""Native plane — C++ components bound via ctypes.
+
+The TPU compute path is XLA/pallas; the host-side hot loops around it are C++
+(this package).  First component: the batched WordPiece tokenizer that feeds the
+embedding engine (:mod:`.tokenizer`).  Libraries build on first use with g++
+into a per-source-hash cache, so there is no install step; every consumer falls
+back to a pure-Python path when no compiler is available.
+"""
+
+from .tokenizer import NativeWordPieceTokenizer, native_available  # noqa: F401

@@ -1,0 +1,56 @@
+"""Lazy g++ build of native libraries, cached by source hash."""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import shutil
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+_cache: dict[str, Optional[str]] = {}
+
+
+def build_library(source_name: str) -> Optional[str]:
+    """Compile ``<source_name>.cpp`` into a cached .so; None when unavailable."""
+    with _lock:
+        if source_name in _cache:
+            return _cache[source_name]
+        path = _build(source_name)
+        _cache[source_name] = path
+        return path
+
+
+def _build(source_name: str) -> Optional[str]:
+    src = os.path.join(_SRC_DIR, f"{source_name}.cpp")
+    if not os.path.exists(src):
+        return None
+    gxx = shutil.which("g++") or shutil.which("c++")
+    if gxx is None:
+        logger.warning("no C++ compiler; %s falls back to Python", source_name)
+        return None
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.environ.get(
+        "DABT_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "dabt_native"),
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    out = os.path.join(cache_dir, f"lib{source_name}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    cmd = [gxx, "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", out]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        logger.info("built native %s -> %s", source_name, out)
+        return out
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+        stderr = getattr(e, "stderr", b"") or b""
+        logger.warning("native build failed for %s: %s", source_name, stderr.decode()[:500])
+        return None

@@ -1,0 +1,176 @@
+"""ctypes binding for the C++ WordPiece tokenizer + pure-Python fallback."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+from typing import List, Optional, Sequence
+
+from .build import build_library
+
+logger = logging.getLogger(__name__)
+
+
+def native_available() -> bool:
+    return build_library("wordpiece") is not None
+
+
+class NativeWordPieceTokenizer:
+    """BERT-scheme tokenizer over a ``vocab.txt`` file.
+
+    Uses the C++ implementation when a compiler is present; otherwise a
+    pure-Python equivalent (same algorithm, same outputs).
+    """
+
+    def __init__(self, vocab_file: str, *, lowercase: bool = True, max_len: int = 8192):
+        with open(vocab_file, encoding="utf-8") as f:
+            blob = f.read()
+        self.vocab = [line.rstrip("\r") for line in blob.split("\n")]
+        self.token_to_id = {tok: i for i, tok in enumerate(self.vocab) if tok}
+        self.lowercase = lowercase
+        self.max_len = max_len
+        self._lock = threading.Lock()
+        self._lib = None
+        self._handle = None
+        lib_path = build_library("wordpiece")
+        if lib_path:
+            lib = ctypes.CDLL(lib_path)
+            lib.wp_create.restype = ctypes.c_void_p
+            lib.wp_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.wp_encode.restype = ctypes.c_int32
+            lib.wp_encode.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+            ]
+            lib.wp_free.argtypes = [ctypes.c_void_p]
+            self._lib = lib
+            self._handle = lib.wp_create(blob.encode("utf-8"), int(lowercase))
+
+    def __del__(self):
+        if self._lib is not None and self._handle:
+            try:
+                self._lib.wp_free(self._handle)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------ API
+    def encode(self, text: str) -> List[int]:
+        if self._handle:
+            buf = (ctypes.c_int32 * self.max_len)()
+            with self._lock:  # the C handle is not thread-safe for concurrent use
+                n = self._lib.wp_encode(
+                    self._handle, text.encode("utf-8"), buf, self.max_len
+                )
+            return list(buf[:n])
+        return self._encode_py(text)
+
+    def encode_batch(self, texts: Sequence[str]) -> List[List[int]]:
+        return [self.encode(t) for t in texts]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        toks = [self.vocab[i] for i in ids if 0 <= i < len(self.vocab)]
+        out: List[str] = []
+        for tok in toks:
+            if tok in ("[CLS]", "[SEP]", "[PAD]"):
+                continue
+            if tok.startswith("##") and out:
+                out[-1] += tok[2:]
+            else:
+                out.append(tok)
+        return " ".join(out)
+
+    # ------------------------------------------------------- python fallback
+    def _basic_tokenize(self, text: str) -> List[str]:
+        import unicodedata
+
+        words: List[str] = []
+        cur = ""
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or (unicodedata.category(ch) == "Cc" and ch not in "\t\n\r"):
+                continue
+            if ch.isspace():
+                if cur:
+                    words.append(cur)
+                    cur = ""
+                continue
+            is_cjk = 0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF or 0xF900 <= cp <= 0xFAFF
+            is_punct = (
+                (33 <= cp <= 47)
+                or (58 <= cp <= 64)
+                or (91 <= cp <= 96)
+                or (123 <= cp <= 126)
+                or (0x2000 <= cp <= 0x206F)
+            )
+            if is_punct or is_cjk:
+                if cur:
+                    words.append(cur)
+                    cur = ""
+                words.append(ch.lower() if self.lowercase else ch)
+                continue
+            cur += ch.lower() if self.lowercase else ch
+        if cur:
+            words.append(cur)
+        return words
+
+    def _wordpiece(self, word: str) -> List[int]:
+        unk = self.token_to_id.get("[UNK]", 0)
+        if len(word) > 100:
+            return [unk]
+        pieces: List[int] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur_id: Optional[int] = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.token_to_id:
+                    cur_id = self.token_to_id[sub]
+                    break
+                end -= 1
+            if cur_id is None:
+                return [unk]
+            pieces.append(cur_id)
+            start = end
+        return pieces
+
+    def _encode_py(self, text: str) -> List[int]:
+        ids: List[int] = []
+        cls_id = self.token_to_id.get("[CLS]")
+        sep_id = self.token_to_id.get("[SEP]")
+        if cls_id is not None:
+            ids.append(cls_id)
+        for word in self._basic_tokenize(text):
+            ids.extend(self._wordpiece(word))
+            if len(ids) >= self.max_len:
+                break
+        limit = self.max_len - 1 if sep_id is not None else self.max_len
+        ids = ids[:limit]
+        if sep_id is not None:
+            ids.append(sep_id)
+        return ids
+
+
+def load_for_model_dir(model_dir: str, lowercase: Optional[bool] = None):
+    """NativeWordPieceTokenizer when the checkpoint ships a vocab.txt, else None."""
+    vocab = os.path.join(model_dir, "vocab.txt")
+    if not os.path.exists(vocab):
+        return None
+    if lowercase is None:
+        import json
+
+        lowercase = True
+        cfg_path = os.path.join(model_dir, "tokenizer_config.json")
+        if os.path.exists(cfg_path):
+            try:
+                with open(cfg_path) as f:
+                    lowercase = bool(json.load(f).get("do_lower_case", True))
+            except (OSError, ValueError):
+                pass
+    return NativeWordPieceTokenizer(vocab, lowercase=lowercase)

@@ -1,0 +1,217 @@
+// Batched WordPiece tokenizer — native host-side hot loop for the embedding path.
+//
+// The reference's tokenization happens inside HF transformers (Rust tokenizers)
+// behind `AutoTokenizer` (reference: assistant/ai/embedders/transformers.py:15-29).
+// This standalone C++ implementation reproduces the BERT scheme the shipped
+// embedder (ruBert-base) uses: BasicTokenizer (optional lowercasing, punctuation
+// splitting, CJK isolation, accent stripping off) + greedy longest-match
+// WordPiece with "##" continuations.  Exposed through a C ABI consumed via
+// ctypes (no pybind11 in this image).
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 wordpiece.cpp -o libwordpiece.so
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Tokenizer {
+    std::unordered_map<std::string, int32_t> vocab;
+    int32_t unk_id = 0;
+    int32_t cls_id = -1;
+    int32_t sep_id = -1;
+    bool lowercase = true;
+    size_t max_word_chars = 100;
+};
+
+// ---- UTF-8 helpers ---------------------------------------------------------
+size_t utf8_len(unsigned char c) {
+    if (c < 0x80) return 1;
+    if ((c >> 5) == 0x6) return 2;
+    if ((c >> 4) == 0xe) return 3;
+    if ((c >> 3) == 0x1e) return 4;
+    return 1;  // invalid byte: treat as single char
+}
+
+uint32_t utf8_decode(const char* s, size_t len) {
+    const unsigned char* u = reinterpret_cast<const unsigned char*>(s);
+    switch (len) {
+        case 1: return u[0];
+        case 2: return ((u[0] & 0x1f) << 6) | (u[1] & 0x3f);
+        case 3: return ((u[0] & 0x0f) << 12) | ((u[1] & 0x3f) << 6) | (u[2] & 0x3f);
+        case 4:
+            return ((u[0] & 0x07) << 18) | ((u[1] & 0x3f) << 12) |
+                   ((u[2] & 0x3f) << 6) | (u[3] & 0x3f);
+    }
+    return u[0];
+}
+
+bool is_whitespace(uint32_t cp) {
+    return cp == ' ' || cp == '\t' || cp == '\n' || cp == '\r' || cp == 0xa0 ||
+           cp == 0x2028 || cp == 0x2029 || (cp >= 0x2000 && cp <= 0x200a);
+}
+
+bool is_control(uint32_t cp) {
+    return (cp < 0x20 && cp != '\t' && cp != '\n' && cp != '\r') || cp == 0x7f;
+}
+
+bool is_cjk(uint32_t cp) {
+    return (cp >= 0x4e00 && cp <= 0x9fff) || (cp >= 0x3400 && cp <= 0x4dbf) ||
+           (cp >= 0x20000 && cp <= 0x2a6df) || (cp >= 0xf900 && cp <= 0xfaff);
+}
+
+bool is_punct(uint32_t cp) {
+    // ASCII punctuation ranges (BERT BasicTokenizer definition) + general
+    // punctuation block
+    if ((cp >= 33 && cp <= 47) || (cp >= 58 && cp <= 64) ||
+        (cp >= 91 && cp <= 96) || (cp >= 123 && cp <= 126))
+        return true;
+    return (cp >= 0x2000 && cp <= 0x206f);
+}
+
+void append_utf8(std::string& out, uint32_t cp) {
+    if (cp < 0x80) {
+        out += static_cast<char>(cp);
+    } else if (cp < 0x800) {
+        out += static_cast<char>(0xc0 | (cp >> 6));
+        out += static_cast<char>(0x80 | (cp & 0x3f));
+    } else if (cp < 0x10000) {
+        out += static_cast<char>(0xe0 | (cp >> 12));
+        out += static_cast<char>(0x80 | ((cp >> 6) & 0x3f));
+        out += static_cast<char>(0x80 | (cp & 0x3f));
+    } else {
+        out += static_cast<char>(0xf0 | (cp >> 18));
+        out += static_cast<char>(0x80 | ((cp >> 12) & 0x3f));
+        out += static_cast<char>(0x80 | ((cp >> 6) & 0x3f));
+        out += static_cast<char>(0x80 | (cp & 0x3f));
+    }
+}
+
+uint32_t to_lower(uint32_t cp) {
+    if (cp >= 'A' && cp <= 'Z') return cp + 32;
+    if (cp >= 0x0400 && cp <= 0x040f) return cp + 80;   // Ё-range uppercase
+    if (cp >= 0x0410 && cp <= 0x042f) return cp + 32;   // Cyrillic А-Я
+    if (cp >= 0xc0 && cp <= 0xde && cp != 0xd7) return cp + 32;  // Latin-1
+    return cp;
+}
+
+// BasicTokenizer: split into words (whitespace/punct boundaries, CJK isolated)
+std::vector<std::string> basic_tokenize(const Tokenizer& t, const char* text) {
+    std::vector<std::string> words;
+    std::string cur;
+    size_t n = std::strlen(text);
+    for (size_t i = 0; i < n;) {
+        size_t cl = utf8_len(static_cast<unsigned char>(text[i]));
+        if (i + cl > n) cl = 1;
+        uint32_t cp = utf8_decode(text + i, cl);
+        i += cl;
+        if (cp == 0 || cp == 0xfffd || is_control(cp)) continue;
+        if (is_whitespace(cp)) {
+            if (!cur.empty()) { words.push_back(cur); cur.clear(); }
+            continue;
+        }
+        if (is_punct(cp) || is_cjk(cp)) {
+            if (!cur.empty()) { words.push_back(cur); cur.clear(); }
+            std::string one;
+            append_utf8(one, t.lowercase ? to_lower(cp) : cp);
+            words.push_back(one);
+            continue;
+        }
+        append_utf8(cur, t.lowercase ? to_lower(cp) : cp);
+    }
+    if (!cur.empty()) words.push_back(cur);
+    return words;
+}
+
+// count codepoints
+size_t cp_count(const std::string& w) {
+    size_t c = 0;
+    for (size_t i = 0; i < w.size(); i += utf8_len(static_cast<unsigned char>(w[i]))) c++;
+    return c;
+}
+
+void wordpiece(const Tokenizer& t, const std::string& word, std::vector<int32_t>& out) {
+    if (cp_count(word) > t.max_word_chars) {
+        out.push_back(t.unk_id);
+        return;
+    }
+    std::vector<int32_t> pieces;
+    size_t start = 0;
+    while (start < word.size()) {
+        size_t end = word.size();
+        int32_t cur_id = -1;
+        size_t cur_end = 0;
+        while (start < end) {
+            std::string sub = word.substr(start, end - start);
+            if (start > 0) sub = "##" + sub;
+            auto it = t.vocab.find(sub);
+            if (it != t.vocab.end()) {
+                cur_id = it->second;
+                cur_end = end;
+                break;
+            }
+            // walk back one UTF-8 codepoint
+            do { end--; } while (end > start && (static_cast<unsigned char>(word[end]) & 0xc0) == 0x80);
+        }
+        if (cur_id < 0) {
+            out.push_back(t.unk_id);
+            return;
+        }
+        pieces.push_back(cur_id);
+        start = cur_end;
+    }
+    out.insert(out.end(), pieces.begin(), pieces.end());
+}
+
+}  // namespace
+
+extern "C" {
+
+void* wp_create(const char* vocab_blob, int lowercase) {
+    // vocab_blob: newline-separated tokens, index = line number
+    auto* t = new Tokenizer();
+    t->lowercase = lowercase != 0;
+    const char* p = vocab_blob;
+    int32_t idx = 0;
+    while (*p) {
+        const char* nl = std::strchr(p, '\n');
+        size_t len = nl ? static_cast<size_t>(nl - p) : std::strlen(p);
+        if (len > 0 && p[len - 1] == '\r') len--;
+        std::string tok(p, len);
+        if (!tok.empty()) {
+            t->vocab.emplace(tok, idx);
+            if (tok == "[UNK]") t->unk_id = idx;
+            if (tok == "[CLS]") t->cls_id = idx;
+            if (tok == "[SEP]") t->sep_id = idx;
+        }
+        idx++;
+        if (!nl) break;
+        p = nl + 1;
+    }
+    return t;
+}
+
+void wp_free(void* handle) { delete static_cast<Tokenizer*>(handle); }
+
+// Encode one text into out_ids (caller-allocated, max_len).  Adds [CLS]/[SEP]
+// when present in the vocab.  Returns the number of ids written.
+int32_t wp_encode(void* handle, const char* text, int32_t* out_ids, int32_t max_len) {
+    const auto& t = *static_cast<Tokenizer*>(handle);
+    std::vector<int32_t> ids;
+    if (t.cls_id >= 0) ids.push_back(t.cls_id);
+    for (const auto& word : basic_tokenize(t, text)) {
+        wordpiece(t, word, ids);
+        if (static_cast<int32_t>(ids.size()) >= max_len) break;
+    }
+    int32_t limit = t.sep_id >= 0 ? max_len - 1 : max_len;
+    if (static_cast<int32_t>(ids.size()) > limit) ids.resize(limit);
+    if (t.sep_id >= 0) ids.push_back(t.sep_id);
+    int32_t n = static_cast<int32_t>(ids.size());
+    std::memcpy(out_ids, ids.data(), n * sizeof(int32_t));
+    return n;
+}
+
+}  // extern "C"

@@ -61,9 +61,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_len: int, block_kv: int, cau
     q_ref: [q_block, D]; k_ref/v_ref: [Sk, D]; o_ref: [q_block, D].
     """
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32)
+    # keep operands in their storage dtype (bf16): the MXU's fast path; accumulate
+    # in f32 via preferred_element_type.  Scaling folds into the f32 scores.
+    q = q_ref[:]
     scale = q.shape[-1] ** -0.5
-    q = q * scale
 
     m0 = jnp.full((q_block, 1), NEG_INF, dtype=jnp.float32)
     l0 = jnp.zeros((q_block, 1), dtype=jnp.float32)
@@ -79,9 +80,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_len: int, block_kv: int, cau
 
     def body(ki, carry):
         m, l, o = carry
-        k_blk = k_ref[pl.ds(ki * block_kv, block_kv), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_kv, block_kv), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [qb, kb]
+        k_blk = k_ref[pl.ds(ki * block_kv, block_kv), :]
+        v_blk = v_ref[pl.ds(ki * block_kv, block_kv), :]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale  # [qb, kb]
         if causal:
             qpos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_kv), 0)
             kpos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_kv), 1)
@@ -90,7 +91,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_len: int, block_kv: int, cau
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        o_new = alpha * o + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        o_new = alpha * o + jnp.dot(
+            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32
+        )
         return m_new, l_new, o_new
 
     m, l, o = jax.lax.fori_loop(0, num_iter, body, (m0, l0, o0))

@@ -20,3 +20,8 @@ from .sharding import (  # noqa: F401
     shard_pytree,
     with_constraint,
 )
+from .distributed import (  # noqa: F401
+    initialize_cluster,
+    is_primary,
+    multihost_mesh,
+)

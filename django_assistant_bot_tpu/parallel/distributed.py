@@ -1,0 +1,97 @@
+"""Multi-host bootstrap — the NCCL/MPI-backend analog, the JAX way.
+
+The reference has no collectives backend at all (SURVEY.md §2.3 — its
+"distributed" substrate is Celery+Redis+HTTP).  Here the TPU compute plane scales
+across hosts with ``jax.distributed``: one process per host joins the cluster
+over DCN, ``jax.devices()`` becomes the GLOBAL device list, and the same
+mesh/sharding code paths from :mod:`.mesh`/:mod:`.sharding` then span every slice
+— XLA routes intra-slice collectives over ICI and inter-slice ones over DCN.
+
+Environment contract (all optional — TPU pods auto-discover via the metadata
+server, so ``initialize_cluster()`` with no args is the common case):
+
+- ``DABT_COORDINATOR``   — ``host:port`` of process 0
+- ``DABT_NUM_PROCESSES`` — world size
+- ``DABT_PROCESS_ID``    — this process's rank
+
+Mesh guidance for multi-host (scaling-book recipe): put ``data`` (and optionally
+``expert``) on the DCN boundary — their collectives are per-step, not per-layer —
+and keep ``model``/``seq`` inside a slice where ICI bandwidth is.
+:func:`multihost_mesh` encodes that: axis order (data, seq, model, expert) with
+``model`` innermost already groups neighbouring devices intra-host.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+from .mesh import MeshAxes, best_mesh_shape, make_mesh
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize_cluster(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join (or form) the multi-host cluster.  Idempotent; no-op single-host."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("DABT_COORDINATOR")
+    num_processes = num_processes or _int_env("DABT_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _int_env("DABT_PROCESS_ID")
+    if coordinator_address is None and num_processes is None:
+        # TPU pod slices self-discover through the runtime; bare initialize()
+        # is correct there.  On a single host it is a no-op.
+        try:
+            jax.distributed.initialize()
+        except Exception as e:  # single-process environments raise; that's fine
+            logger.debug("jax.distributed.initialize skipped: %s", e)
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    _initialized = True
+    logger.info(
+        "cluster: process %d/%d, %d global / %d local devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+        len(jax.local_devices()),
+    )
+
+
+def _int_env(name: str) -> Optional[int]:
+    value = os.environ.get(name)
+    return int(value) if value is not None else None
+
+
+def multihost_mesh(
+    *,
+    want_model: int = 1,
+    want_seq: int = 1,
+    want_expert: int = 1,
+):
+    """Global mesh over every device in the cluster (call after
+    :func:`initialize_cluster`).  ``data`` gets the remainder, so adding hosts
+    grows DP while TP/SP/EP stay intra-slice."""
+    n = len(jax.devices())
+    axes: MeshAxes = best_mesh_shape(
+        n, want_model=want_model, want_seq=want_seq, want_expert=want_expert
+    )
+    return make_mesh(axes)
+
+
+def is_primary() -> bool:
+    """True on the process that should write checkpoints / serve admin."""
+    return jax.process_index() == 0

@@ -56,22 +56,47 @@ def lm_loss(
     return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
+def lm_loss_long(
+    params: Params,
+    cfg: DecoderConfig,
+    input_ids: jnp.ndarray,
+    loss_mask: jnp.ndarray,
+    mesh,
+) -> jnp.ndarray:
+    """Ring-attention variant of :func:`lm_loss` — sequence sharded over ``seq``."""
+    logits = llama.forward_long(params, cfg, input_ids, mesh)
+    targets = input_ids[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
 def make_train_step(
     cfg: DecoderConfig,
     optimizer: optax.GradientTransformation,
     *,
     remat: bool = False,
+    long_context_mesh: Optional[Mesh] = None,
 ) -> Callable[[Params, optax.OptState, jnp.ndarray, jnp.ndarray], tuple]:
     """Build a jittable ``(params, opt_state, input_ids, loss_mask) ->
     (params, opt_state, metrics)`` step.
 
     Call under a mesh with sharded inputs; XLA derives every collective.  With
     ``remat=True`` the loss is wrapped in :func:`jax.checkpoint` so activations are
-    recomputed in the backward pass instead of held in HBM.
+    recomputed in the backward pass instead of held in HBM.  With
+    ``long_context_mesh`` the forward uses ring attention over the ``seq`` axis
+    (sequence/context parallelism for sequences too long for one chip).
     """
-    loss_fn = lm_loss
+    if long_context_mesh is not None:
+        mesh = long_context_mesh
+
+        def loss_fn(params, cfg, input_ids, loss_mask):
+            return lm_loss_long(params, cfg, input_ids, loss_mask, mesh)
+    else:
+        loss_fn = lm_loss
     if remat:
-        loss_fn = jax.checkpoint(lm_loss, static_argnums=(1,))
+        loss_fn = jax.checkpoint(loss_fn, static_argnums=(1,))
 
     def step(params, opt_state, input_ids, loss_mask):
         loss, grads = jax.value_and_grad(loss_fn)(params, cfg, input_ids, loss_mask)

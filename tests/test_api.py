@@ -160,3 +160,56 @@ def test_auth_token_enforced(seeded):
             assert resp.status == 200
 
     body()
+
+
+def test_admin_pages_render(seeded):
+    bot, instance, dialog = seeded
+    from django_assistant_bot_tpu.bot.services.dialog_service import (
+        create_bot_message,
+        create_user_message,
+    )
+    from django_assistant_bot_tpu.broadcasting.models import BroadcastCampaign
+
+    create_user_message(dialog, 1, "hi")
+    create_bot_message(
+        dialog,
+        SingleAnswer(
+            text="yo", usage=[{"model": "test", "prompt_tokens": 3, "completion_tokens": 5}]
+        ),
+    )
+    wiki = models.WikiDocument.objects.create(bot=bot, title="W")
+    campaign = BroadcastCampaign.objects.create(bot=bot, message_text="news")
+
+    @with_client
+    async def body(client):
+        for path in (
+            "/admin/",
+            "/admin/bots",
+            "/admin/instances",
+            "/admin/dialogs",
+            f"/admin/dialogs/{dialog.id}",
+            "/admin/wiki",
+            "/admin/campaigns",
+            "/admin/tasks",
+        ):
+            resp = await client.get(path)
+            assert resp.status == 200, path
+            text = await resp.text()
+            assert "<table>" in text, path
+        # process action enqueues ingestion
+        resp = await client.post(f"/admin/wiki/{wiki.id}/process", allow_redirects=False)
+        assert resp.status == 302
+        from django_assistant_bot_tpu.tasks.queue import TaskRecord
+
+        assert any(
+            "wiki_processing_task" in t.name for t in TaskRecord.objects.all()
+        )
+        # schedule action flips campaign status
+        resp = await client.post(
+            f"/admin/campaigns/{campaign.id}/schedule", allow_redirects=False
+        )
+        assert resp.status == 302
+        campaign.refresh()
+        assert campaign.status == BroadcastCampaign.SCHEDULED
+
+    body()

@@ -1,0 +1,99 @@
+"""Native C++ tokenizer: build, HF parity, fallback equivalence, speed sanity."""
+
+import pytest
+
+from django_assistant_bot_tpu.native import NativeWordPieceTokenizer, native_available
+from django_assistant_bot_tpu.native.build import build_library
+
+VOCAB = [
+    "[PAD]",
+    "[UNK]",
+    "[CLS]",
+    "[SEP]",
+    "the",
+    "quick",
+    "brown",
+    "fox",
+    "jump",
+    "##s",
+    "##ed",
+    "over",
+    "lazy",
+    "dog",
+    "##gy",
+    "hello",
+    "world",
+    "привет",
+    "мир",
+    "##у",
+    ",",
+    ".",
+    "!",
+    "中",
+    "国",
+]
+
+TEXTS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "Hello, world! Привет мир",
+    "jumped doggy UNKNOWNWORD",
+    "hello 中国 world",
+    "",
+    "  multiple   spaces\tand\nnewlines  ",
+]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    p.write_text("\n".join(VOCAB))
+    return str(p)
+
+
+def test_native_library_builds():
+    assert build_library("wordpiece") is not None, "g++ build failed"
+
+
+def test_matches_hf_bert_tokenizer(vocab_file):
+    from transformers import BertTokenizer
+
+    hf = BertTokenizer(vocab_file=vocab_file, do_lower_case=True)
+    ours = NativeWordPieceTokenizer(vocab_file, lowercase=True)
+    assert ours._handle, "native path not active"
+    for text in TEXTS:
+        expected = hf.encode(text)
+        got = ours.encode(text)
+        assert got == expected, f"{text!r}: {got} != {expected}"
+
+
+def test_python_fallback_matches_native(vocab_file):
+    native = NativeWordPieceTokenizer(vocab_file, lowercase=True)
+    assert native._handle
+    for text in TEXTS:
+        assert native._encode_py(text) == native.encode(text), text
+
+
+def test_decode_roundtrip(vocab_file):
+    tok = NativeWordPieceTokenizer(vocab_file, lowercase=True)
+    ids = tok.encode("the quick doggy")
+    assert tok.decode(ids) == "the quick doggy"
+
+
+def test_native_faster_than_python(vocab_file):
+    import time
+
+    tok = NativeWordPieceTokenizer(vocab_file, lowercase=True)
+    if not tok._handle:
+        pytest.skip("no native build")
+    text = "the quick brown fox jumps over the lazy doggy " * 50
+    n = 200
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tok.encode(text)
+    native_t = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tok._encode_py(text)
+    python_t = time.perf_counter() - t0
+    # the C++ path must beat pure Python comfortably on long inputs
+    assert native_t < python_t, (native_t, python_t)

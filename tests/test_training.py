@@ -86,3 +86,42 @@ def test_moe_train_step_runs():
         step = jax.jit(make_train_step(cfg, optimizer))
         _, _, metrics = step(state.params, state.opt_state, s_ids, s_mask)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_long_context_ring_step_matches_dense(mesh8):
+    """Ring-attention (sequence-parallel) training step == dense step."""
+    from django_assistant_bot_tpu.parallel import best_mesh_shape, make_mesh
+
+    cfg = DecoderConfig.tiny()
+    optimizer = optax.sgd(1e-2)
+    ids, mask = _batch(cfg, rng_seed=9, batch=2, seq=64)
+
+    ref_state = init_train_state(cfg, optimizer, rng=jax.random.PRNGKey(11))
+    ref_step = jax.jit(make_train_step(cfg, optimizer))
+    _, _, ref_metrics = ref_step(ref_state.params, ref_state.opt_state, ids, mask)
+
+    mesh = make_mesh(best_mesh_shape(8, want_seq=4, want_model=2))
+    with mesh:
+        state = init_train_state(cfg, optimizer, rng=jax.random.PRNGKey(11), mesh=mesh)
+        s_ids = jax.device_put(np.asarray(ids), batch_sharding(mesh))
+        s_mask = jax.device_put(np.asarray(mask), batch_sharding(mesh))
+        step = jax.jit(make_train_step(cfg, optimizer, long_context_mesh=mesh))
+        _, _, metrics = step(state.params, state.opt_state, s_ids, s_mask)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_metrics["loss"]), rtol=1e-4
+    )
+
+
+def test_forward_long_matches_forward(mesh8):
+    from django_assistant_bot_tpu.models import llama
+    from django_assistant_bot_tpu.parallel import best_mesh_shape, make_mesh, shard_pytree
+
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.PRNGKey(2))
+    ids = jnp.asarray(np.random.default_rng(3).integers(1, cfg.vocab_size, (2, 64)), jnp.int32)
+    ref = np.asarray(llama.forward(params, cfg, ids))
+    mesh = make_mesh(best_mesh_shape(8, want_seq=4, want_model=2))
+    with mesh:
+        sharded = shard_pytree(params, llama.logical_axes(cfg), mesh)
+        out = jax.jit(lambda p, i: llama.forward_long(p, cfg, i, mesh))(sharded, ids)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=2e-3)
