@@ -19,6 +19,7 @@ MoE note: when ``cfg.is_moe``, the MLP block is delegated to
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, NamedTuple, Optional
 
 import jax
@@ -31,6 +32,8 @@ from ..parallel.sharding import with_constraint
 from .config import DecoderConfig
 
 Params = Dict[str, Any]
+
+_logger = logging.getLogger(__name__)
 
 
 class KVCache(NamedTuple):
@@ -50,6 +53,45 @@ CACHE_AXES = KVCache(
     v=(None, "batch", "kv_heads", None, "head_dim"),
     lengths=("batch",),
 )
+
+
+def cache_shardings(cfg: DecoderConfig, mesh, batch: int) -> KVCache:
+    """NamedShardings for the slot cache on ``mesh``, derived from CACHE_AXES.
+
+    KV heads shard over the ``model`` (TP) axis and slots over ``data`` — each
+    dropped to replication when the dimension doesn't divide the mesh axis (e.g.
+    tiny test models on a wide mesh).  ``lengths`` is a [B] int32 — replicated.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+    from ..parallel.sharding import DEFAULT_RULES, logical_to_pspec
+
+    rules = dict(DEFAULT_RULES)
+    if batch % mesh.shape[DATA_AXIS] != 0:
+        rules["batch"] = None
+        if mesh.shape[DATA_AXIS] > 1:
+            _logger.warning(
+                "KV cache slots (%d) don't divide mesh data axis (%d): slot dim "
+                "replicated per data group — round max_slots up to a multiple to "
+                "shard it",
+                batch,
+                mesh.shape[DATA_AXIS],
+            )
+    if cfg.num_kv_heads % mesh.shape[MODEL_AXIS] != 0:
+        rules["kv_heads"] = None
+        if mesh.shape[MODEL_AXIS] > 1:
+            _logger.warning(
+                "num_kv_heads (%d) doesn't divide mesh model axis (%d): KV cache "
+                "replicated across the TP axis — every chip holds a full copy",
+                cfg.num_kv_heads,
+                mesh.shape[MODEL_AXIS],
+            )
+    return KVCache(
+        k=NamedSharding(mesh, logical_to_pspec(CACHE_AXES.k, rules)),
+        v=NamedSharding(mesh, logical_to_pspec(CACHE_AXES.v, rules)),
+        lengths=NamedSharding(mesh, P()),
+    )
 
 
 def init_cache(cfg: DecoderConfig, batch: int, max_len: int, dtype=None) -> KVCache:
@@ -310,21 +352,29 @@ def insert_sequences(
     lengths: jnp.ndarray,  # [B]
     slots: jnp.ndarray,  # [B] int32 target slot per prefilled row
 ) -> KVCache:
-    """Write prefilled K/V rows into their cache slots (positions [0, S))."""
+    """Write prefilled K/V rows into their cache slots (positions [0, S)).
 
-    def write_one(cache_kv, row, slot):
-        # cache_kv: [L, Bc, KH, Sc, D]; row: [L, KH, S, D]
-        return jax.lax.dynamic_update_slice(
-            cache_kv,
-            row[:, None].astype(cache_kv.dtype),
-            (0, slot, 0, 0, 0),
+    A ``lax.scan`` over the prefill batch — one compiled body regardless of how many
+    rows a prefill carries (a Python loop would unroll and recompile per batch size).
+    """
+
+    def body(carry, inp):
+        k, v, lens = carry
+        row_k, row_v, length, slot = inp  # row_k: [L, KH, S, D]
+        k = jax.lax.dynamic_update_slice(
+            k, row_k[:, None].astype(k.dtype), (0, slot, 0, 0, 0)
         )
+        v = jax.lax.dynamic_update_slice(
+            v, row_v[:, None].astype(v.dtype), (0, slot, 0, 0, 0)
+        )
+        lens = jax.lax.dynamic_update_index_in_dim(lens, length, slot, 0)
+        return (k, v, lens), None
 
-    k, v, cache_lengths = cache.k, cache.v, cache.lengths
-    for b in range(ks.shape[1]):
-        k = write_one(k, ks[:, b], slots[b])
-        v = write_one(v, vs[:, b], slots[b])
-        cache_lengths = cache_lengths.at[slots[b]].set(lengths[b])
+    rows_k = jnp.moveaxis(ks, 1, 0)  # [B, L, KH, S, D]
+    rows_v = jnp.moveaxis(vs, 1, 0)
+    (k, v, cache_lengths), _ = jax.lax.scan(
+        body, (cache.k, cache.v, cache.lengths), (rows_k, rows_v, lengths, slots)
+    )
     return KVCache(k=k, v=v, lengths=cache_lengths)
 
 

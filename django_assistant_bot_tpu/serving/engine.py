@@ -25,6 +25,7 @@ Design (TPU-first):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import queue
@@ -44,6 +45,12 @@ from .tokenizer import Tokenizer
 logger = logging.getLogger(__name__)
 
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+def _replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
 
 
 def _safe_resolve(fut: Future, *, result=None, exc: Optional[BaseException] = None):
@@ -106,6 +113,7 @@ class GenerationEngine:
         top_k: int = 50,
         prefill_buckets: Sequence[int] = PREFILL_BUCKETS,
         idle_poll_s: float = 0.002,
+        mesh=None,
     ):
         self.cfg = cfg
         self.params = params
@@ -117,10 +125,18 @@ class GenerationEngine:
             self.max_seq_len,
         )
         self.idle_poll_s = idle_poll_s
+        # Mesh-scoped serving (TP/DP): the KV cache shards over the mesh (kv_heads →
+        # `model`, slots → `data` — llama.CACHE_AXES) and every device step is jit'd
+        # with explicit cache out_shardings so donation updates shards in place.
+        # Without it a v5e-8 would hold 8 *replicas* of a multi-GB cache.
+        self.mesh = mesh
+        self._cache_shardings = (
+            llama.cache_shardings(cfg, mesh, max_slots) if mesh is not None else None
+        )
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._slots: List[Optional[_Slot]] = [None] * max_slots
-        self._cache = llama.init_cache(cfg, max_slots, self.max_seq_len)
+        self._cache = self._fresh_cache()
         self._tokens = np.zeros((max_slots,), np.int32)
         self._temps = np.zeros((max_slots,), np.float32)
         self._top_ps = np.ones((max_slots,), np.float32)
@@ -139,15 +155,40 @@ class GenerationEngine:
             )
             return nxt, cache
 
+        if mesh is not None:
+            tick_out = (_replicated(mesh), self._cache_shardings)
+            insert_out = self._cache_shardings
+        else:
+            tick_out = insert_out = None
         # donate the cache (argnum 2) — in-place HBM update, no copy
-        self._decode_tick = jax.jit(_decode_tick, donate_argnums=(2,))
+        self._decode_tick = jax.jit(
+            _decode_tick, donate_argnums=(2,), out_shardings=tick_out
+        )
 
         def _prefill(params, ids, lengths):
             return llama.prefill(params, cfg_c, ids, lengths)
 
         self._prefill = jax.jit(_prefill)
         # donate the cache here too: slot insertion is a scatter into HBM, not a copy
-        self._insert = jax.jit(llama.insert_sequences, donate_argnums=(0,))
+        self._insert = jax.jit(
+            llama.insert_sequences, donate_argnums=(0,), out_shardings=insert_out
+        )
+
+    def _fresh_cache(self):
+        if self._cache_shardings is not None:
+            # Allocate *sharded*: an eager init_cache would materialise the whole
+            # cache on device 0 first — at slice-sized caches that alone overflows
+            # one chip's HBM.
+            with self.mesh:
+                return jax.jit(
+                    lambda: llama.init_cache(self.cfg, self.max_slots, self.max_seq_len),
+                    out_shardings=self._cache_shardings,
+                )()
+        return llama.init_cache(self.cfg, self.max_slots, self.max_seq_len)
+
+    def _mesh_scope(self):
+        """Trace/run device steps inside the mesh so sharding constraints bind."""
+        return self.mesh if self.mesh is not None else contextlib.nullcontext()
 
     # ------------------------------------------------------------------ public
     def start(self) -> "GenerationEngine":
@@ -273,10 +314,11 @@ class GenerationEngine:
         ids = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
         ids[0, :n] = req.prompt_ids
         lengths = jnp.asarray([n], jnp.int32)
-        logits, ks, vs = self._prefill(self.params, jnp.asarray(ids), lengths)
-        self._cache = self._insert(
-            self._cache, ks, vs, lengths, jnp.asarray([slot], jnp.int32)
-        )
+        with self._mesh_scope():
+            logits, ks, vs = self._prefill(self.params, jnp.asarray(ids), lengths)
+            self._cache = self._insert(
+                self._cache, ks, vs, lengths, jnp.asarray([slot], jnp.int32)
+            )
         self._rng, sub = jax.random.split(self._rng)
         first = sample_logits(
             logits,
@@ -301,15 +343,16 @@ class GenerationEngine:
 
     def _tick(self):
         self._rng, sub = jax.random.split(self._rng)
-        nxt, self._cache = self._decode_tick(
-            self.params,
-            jnp.asarray(self._tokens),
-            self._cache,
-            jnp.asarray(self._active_mask()),
-            jnp.asarray(self._temps),
-            jnp.asarray(self._top_ps),
-            sub,
-        )
+        with self._mesh_scope():
+            nxt, self._cache = self._decode_tick(
+                self.params,
+                jnp.asarray(self._tokens),
+                self._cache,
+                jnp.asarray(self._active_mask()),
+                jnp.asarray(self._temps),
+                jnp.asarray(self._top_ps),
+                sub,
+            )
         self.steps += 1
         nxt = np.asarray(nxt)
         for i, s in enumerate(self._slots):
@@ -361,7 +404,7 @@ class GenerationEngine:
                 _safe_resolve(s.request.future, exc=err)
             self._slots[i] = None
         # the cache may have been donated into a failed call — rebuild it
-        self._cache = llama.init_cache(self.cfg, self.max_slots, self.max_seq_len)
+        self._cache = self._fresh_cache()
 
 
 class EmbeddingEngine:
@@ -381,6 +424,7 @@ class EmbeddingEngine:
         max_batch: int = 64,
         seq_buckets: Sequence[int] = (32, 64, 128, 256, 512),
         normalize: bool = False,
+        mesh=None,
     ):
         self.cfg = cfg
         self.params = params
@@ -390,6 +434,7 @@ class EmbeddingEngine:
             b for b in seq_buckets if b <= cfg.max_position_embeddings
         ) or (cfg.max_position_embeddings,)
         self.normalize = normalize
+        self.mesh = mesh
         self._queue: "queue.Queue[tuple[List[str], Future]]" = queue.Queue()
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -399,7 +444,14 @@ class EmbeddingEngine:
         def _encode(params, ids, mask):
             return encoder.encode(params, cfg_c, ids, mask, normalize=norm_c)
 
-        self._encode = jax.jit(_encode)
+        if mesh is not None:
+            # embeddings come back to host per request — replicate the output
+            self._encode = jax.jit(_encode, out_shardings=_replicated(mesh))
+        else:
+            self._encode = jax.jit(_encode)
+
+    def _mesh_scope(self):
+        return self.mesh if self.mesh is not None else contextlib.nullcontext()
 
     def start(self) -> "EmbeddingEngine":
         if self._running:
@@ -480,5 +532,6 @@ class EmbeddingEngine:
         for i, e in enumerate(encoded):
             ids[i, : len(e)] = e
             mask[i, : len(e)] = 1
-        embs = self._encode(self.params, jnp.asarray(ids), jnp.asarray(mask))
+        with self._mesh_scope():
+            embs = self._encode(self.params, jnp.asarray(ids), jnp.asarray(mask))
         return np.asarray(embs, np.float32).tolist()

@@ -83,7 +83,12 @@ class ModelRegistry:
             with self.mesh:
                 params = shard_pytree(params, encoder.logical_axes(cfg), self.mesh)
             eng = EmbeddingEngine(
-                cfg, params, tokenizer, max_batch=spec.max_batch, normalize=spec.normalize
+                cfg,
+                params,
+                tokenizer,
+                max_batch=spec.max_batch,
+                normalize=spec.normalize,
+                mesh=self.mesh,
             ).start()
             self.embedders[name] = eng
         elif spec.kind == "decoder":
@@ -102,6 +107,7 @@ class ModelRegistry:
                 tokenizer,
                 max_slots=spec.max_slots,
                 max_seq_len=spec.max_seq_len,
+                mesh=self.mesh,
             ).start()
             self.generators[name] = eng
         else:
