@@ -378,6 +378,69 @@ def insert_sequences(
     return KVCache(k=k, v=v, lengths=cache_lengths)
 
 
+def prefill_chunk(
+    params: Params,
+    cfg: DecoderConfig,
+    input_ids: jnp.ndarray,  # [1, C] one chunk of one prompt (C static; pad tail)
+    cache: KVCache,
+    slot: jnp.ndarray,  # scalar int32 — target cache slot
+    start: jnp.ndarray,  # scalar int32 — tokens already written for this slot
+    valid: jnp.ndarray,  # scalar int32 — real (non-pad) tokens in this chunk
+) -> tuple[jnp.ndarray, KVCache]:
+    """Extend one slot's cache by a chunk of prompt tokens.
+
+    The disaggregation primitive (SURVEY.md §7 hard part (c)): instead of one
+    monolithic prefill call that stalls every live decode stream for its full
+    duration, the engine splits long prompts into fixed-size chunks and interleaves
+    one chunk per decode tick — the decode head-of-line delay is bounded by a chunk,
+    not the prompt.  ``slot``/``start``/``valid`` are traced scalars, so one compiled
+    program serves every chunk position of every request.
+
+    Returns (logits [1, V] f32 at chunk index ``valid-1``, cache with
+    ``lengths[slot] = start + valid``).  Only the final chunk's logits are used.
+    """
+    B, C = input_ids.shape
+    S = cache.max_len
+    L = cfg.num_layers
+    H, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    pos = start + jnp.arange(C)
+    cos_t, sin_t = _rope_tables(cfg, S)
+    cos, sin = cos_t[pos], sin_t[pos]  # [C, hd/2]
+    x = params["tok_embed"][input_ids].astype(cfg.dtype)  # [1, C, E]
+    # queries attend to every cache position up to their own absolute position
+    kpos = jnp.arange(S)[None, None, None, :]
+    attn_mask = kpos <= pos[None, None, :, None]  # [1, 1, C, S]
+
+    k_rows = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0), (L, 1, KH, S, D))
+    v_rows = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0), (L, 1, KH, S, D))
+
+    def body(x, inputs):
+        p, k_row, v_row = inputs  # k_row: [1, KH, S, D]
+        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _attn_proj(cfg, p, h, cos, sin)
+        k_row = jax.lax.dynamic_update_slice(k_row, k.astype(k_row.dtype), (0, 0, start, 0))
+        v_row = jax.lax.dynamic_update_slice(v_row, v.astype(v_row.dtype), (0, 0, start, 0))
+        kr, vr = _repeat_kv(cfg, k_row), _repeat_kv(cfg, v_row)
+        o = dot_product_attention(q, kr, vr, mask=attn_mask)  # [1, H, C, D]
+        o = o.transpose(0, 2, 1, 3).reshape(B, C, -1)
+        x = x + jnp.einsum("bso,oe->bse", o, p["wo"])
+        h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(cfg, p, h)
+        return x, (k_row, v_row)
+
+    x, (k_rows, v_rows) = jax.lax.scan(body, x, (params["layers"], k_rows, v_rows))
+    k = jax.lax.dynamic_update_slice(cache.k, k_rows.astype(cache.k.dtype), (0, slot, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(cache.v, v_rows.astype(cache.v.dtype), (0, slot, 0, 0, 0))
+    lengths = jax.lax.dynamic_update_index_in_dim(
+        cache.lengths, (start + valid).astype(cache.lengths.dtype), slot, 0
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jax.lax.dynamic_index_in_dim(x[0], jnp.maximum(valid - 1, 0), 0, keepdims=False)
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("e,ev->v", last, head.astype(cfg.dtype))[None]
+    return logits.astype(jnp.float32), KVCache(k=k, v=v, lengths=lengths)
+
+
 def decode_step(
     params: Params,
     cfg: DecoderConfig,

@@ -25,6 +25,7 @@ Design (TPU-first):
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import logging
@@ -99,6 +100,19 @@ class _Slot:
     generated: List[int] = dataclasses.field(default_factory=list)
 
 
+@dataclasses.dataclass
+class _ChunkedPrefill:
+    """An in-flight chunked prefill: one chunk advances per engine-loop iteration,
+    interleaved with decode ticks (prefill/decode disaggregation)."""
+
+    request: _Request
+    slot: int
+    ids: np.ndarray  # [n_chunks, chunk_size] — every chunk is full of real tokens
+    starts: List[int]  # absolute start position of each chunk
+    n: int  # true prompt length
+    step: int = 0  # chunks completed
+
+
 class GenerationEngine:
     """Continuous-batching decode engine over one decoder model."""
 
@@ -113,6 +127,7 @@ class GenerationEngine:
         top_k: int = 50,
         prefill_buckets: Sequence[int] = PREFILL_BUCKETS,
         idle_poll_s: float = 0.002,
+        chunk_size: int = 512,
         mesh=None,
     ):
         self.cfg = cfg
@@ -125,6 +140,10 @@ class GenerationEngine:
             self.max_seq_len,
         )
         self.idle_poll_s = idle_poll_s
+        # Prompts longer than one chunk prefill incrementally: one chunk per engine
+        # loop iteration, a decode tick for the live slots in between.  Decode
+        # head-of-line blocking is bounded by a chunk, not by the longest prompt.
+        self.chunk_size = int(min(chunk_size, self.max_seq_len))
         # Mesh-scoped serving (TP/DP): the KV cache shards over the mesh (kv_heads →
         # `model`, slots → `data` — llama.CACHE_AXES) and every device step is jit'd
         # with explicit cache out_shardings so donation updates shards in place.
@@ -135,6 +154,8 @@ class GenerationEngine:
         )
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._pending: "collections.deque[_Request]" = collections.deque()
+        self._chunking: Optional[_ChunkedPrefill] = None
         self._slots: List[Optional[_Slot]] = [None] * max_slots
         self._cache = self._fresh_cache()
         self._tokens = np.zeros((max_slots,), np.int32)
@@ -172,6 +193,13 @@ class GenerationEngine:
         # donate the cache here too: slot insertion is a scatter into HBM, not a copy
         self._insert = jax.jit(
             llama.insert_sequences, donate_argnums=(0,), out_shardings=insert_out
+        )
+
+        def _prefill_chunk(params, ids, cache, slot, start, valid):
+            return llama.prefill_chunk(params, cfg_c, ids, cache, slot, start, valid)
+
+        self._prefill_chunk = jax.jit(
+            _prefill_chunk, donate_argnums=(2,), out_shardings=tick_out
         )
 
     def _fresh_cache(self):
@@ -212,6 +240,18 @@ class GenerationEngine:
         self._drain_queue(err)
 
     def _drain_queue(self, err: BaseException):
+        """Fail everything not yet started.  Only called with the engine thread
+        dead (stop(), after join) or from the engine thread itself (_fail_all) —
+        ``_pending``/``_chunking`` are engine-thread-private state."""
+        if self._chunking is not None:
+            _safe_resolve(self._chunking.request.future, exc=err)
+            self._chunking = None
+        while self._pending:
+            _safe_resolve(self._pending.popleft().future, exc=err)
+        self._drain_incoming(err)
+
+    def _drain_incoming(self, err: BaseException):
+        """Drain the thread-safe submission queue only (safe from any thread)."""
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -248,8 +288,9 @@ class GenerationEngine:
         # enqueued forever with no engine thread to fail it.  Re-checking after the
         # put closes the race: either the engine was still draining (it resolves the
         # future) or we drain it here — _safe_resolve makes double-resolution benign.
+        # Only the thread-safe queue is touched from this (client) thread.
         if not self._running:
-            self._drain_queue(RuntimeError("generation engine stopped"))
+            self._drain_incoming(RuntimeError("generation engine stopped"))
         return fut
 
     async def generate(
@@ -278,39 +319,53 @@ class GenerationEngine:
 
     # ---------------------------------------------------------------- internal
     def _free_slots(self) -> List[int]:
-        return [i for i, s in enumerate(self._slots) if s is None]
+        busy = {self._chunking.slot} if self._chunking is not None else set()
+        return [i for i, s in enumerate(self._slots) if s is None and i not in busy]
 
     def _loop(self):
         while self._running:
             try:
                 admitted = self._admit()
-                if self.num_active == 0:
-                    if not admitted:
-                        time.sleep(self.idle_poll_s)
-                    continue
-                self._tick()
+                if self._chunking is not None:
+                    self._chunk_step()
+                    admitted = True
+                if self.num_active > 0:
+                    self._tick()
+                elif not admitted:
+                    time.sleep(self.idle_poll_s)
             except Exception:
                 logger.exception("engine loop error; failing active requests")
                 self._fail_all()
 
     def _admit(self) -> bool:
         admitted = False
-        free = self._free_slots()
-        while free and not self._queue.empty():
+        # stage queued requests so the head can be inspected without losing order
+        while True:
             try:
-                req = self._queue.get_nowait()
+                self._pending.append(self._queue.get_nowait())
             except queue.Empty:
                 break
+        free = self._free_slots()
+        while free and self._pending:
+            req = self._pending[0]
             if req.future.cancelled():
+                self._pending.popleft()
                 continue
-            slot = free.pop(0)
-            self._start_request(slot, req)
+            if len(req.prompt_ids) > self.chunk_size:
+                if self._chunking is not None:
+                    break  # one chunked prefill at a time; FIFO order preserved
+                self._pending.popleft()
+                self._begin_chunked(free.pop(0), req)
+            else:
+                self._pending.popleft()
+                self._start_request(free.pop(0), req)
             admitted = True
         return admitted
 
     def _start_request(self, slot: int, req: _Request):
+        """Single-call prefill for prompts that fit one chunk."""
         n = len(req.prompt_ids)
-        bucket = pick_bucket(n, self.prefill_buckets, self.max_seq_len)
+        bucket = pick_bucket(n, self.prefill_buckets, self.chunk_size)
         ids = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
         ids[0, :n] = req.prompt_ids
         lengths = jnp.asarray([n], jnp.int32)
@@ -319,6 +374,45 @@ class GenerationEngine:
             self._cache = self._insert(
                 self._cache, ks, vs, lengths, jnp.asarray([slot], jnp.int32)
             )
+        self._activate(slot, req, logits)
+
+    def _begin_chunked(self, slot: int, req: _Request):
+        """Split a long prompt into full-size chunks.  The final chunk *slides left*
+        to end exactly at the prompt end (re-feeding a few already-written positions
+        — their K/V recompute to identical values) so no chunk ever carries pad
+        tokens and no cache write can cross ``max_seq_len``."""
+        n = len(req.prompt_ids)
+        c = self.chunk_size
+        flat = np.asarray(req.prompt_ids, np.int32)
+        starts = list(range(0, n - c, c)) + [n - c]
+        ids = np.stack([flat[s : s + c] for s in starts])
+        self._chunking = _ChunkedPrefill(
+            request=req, slot=slot, ids=ids, starts=starts, n=n
+        )
+
+    def _chunk_step(self):
+        st = self._chunking
+        assert st is not None
+        j = st.step
+        with self._mesh_scope():
+            logits, self._cache = self._prefill_chunk(
+                self.params,
+                jnp.asarray(st.ids[j : j + 1]),
+                self._cache,
+                jnp.asarray(st.slot, jnp.int32),
+                jnp.asarray(st.starts[j], jnp.int32),
+                jnp.asarray(self.chunk_size, jnp.int32),
+            )
+        st.step += 1
+        if st.request.future.cancelled():
+            self._chunking = None
+            return
+        if st.step >= len(st.starts):
+            self._chunking = None
+            self._activate(st.slot, st.request, logits)
+
+    def _activate(self, slot: int, req: _Request, logits):
+        """Sample the first token from prefill logits and make the slot live."""
         self._rng, sub = jax.random.split(self._rng)
         first = sample_logits(
             logits,
@@ -403,6 +497,9 @@ class GenerationEngine:
             if s is not None:
                 _safe_resolve(s.request.future, exc=err)
             self._slots[i] = None
+        if self._chunking is not None:
+            _safe_resolve(self._chunking.request.future, exc=err)
+            self._chunking = None
         # the cache may have been donated into a failed call — rebuild it
         self._cache = self._fresh_cache()
 

@@ -27,6 +27,7 @@ class ModelSpec:
     dtype: str = "bfloat16"
     max_slots: int = 8
     max_seq_len: Optional[int] = None
+    chunk_size: int = 512
     max_batch: int = 64
     normalize: bool = False
     num_experts: int = 0
@@ -107,6 +108,7 @@ class ModelRegistry:
                 tokenizer,
                 max_slots=spec.max_slots,
                 max_seq_len=spec.max_seq_len,
+                chunk_size=spec.chunk_size,
                 mesh=self.mesh,
             ).start()
             self.generators[name] = eng

@@ -116,6 +116,87 @@ def test_embedding_engine_batches_and_coalesces():
         eng.stop()
 
 
+def test_chunked_prefill_matches_forward():
+    """Long prompts prefill chunk-by-chunk; greedy output must equal the
+    full-forward reference exactly (disaggregation must not change the math)."""
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(3))
+    tok = ByteTokenizer()
+    eng = GenerationEngine(
+        cfg, params, tok, max_slots=2, max_seq_len=128, chunk_size=16
+    ).start()
+    try:
+        prompt = tok.encode("the quick brown fox jumps over the lazy dog again")
+        assert len(prompt) > 3 * 16  # several chunks + a ragged tail
+        n_new = 5
+        seq = np.asarray([prompt], np.int32)
+        expected = []
+        for _ in range(n_new):
+            logits = llama.forward(params, cfg, jnp.asarray(seq))
+            nxt = int(jnp.argmax(logits[0, -1]))
+            expected.append(nxt)
+            seq = np.concatenate([seq, [[nxt]]], axis=1)
+        r = eng.submit(prompt, max_tokens=n_new, temperature=0.0).result(timeout=300)
+        assert r.token_ids == expected
+    finally:
+        eng.stop()
+
+
+def test_chunked_prefill_ragged_tail_near_cache_end():
+    """Prompt length not a multiple of chunk_size, close to max_seq_len: the final
+    chunk slides left instead of writing past the cache end (which would silently
+    clamp and corrupt earlier positions)."""
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(5))
+    eng = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=120, chunk_size=16
+    ).start()
+    try:
+        prompt = [(i % 200) + 1 for i in range(99)]  # 6 full chunks + slid tail
+        n_new = 5
+        seq = np.asarray([prompt], np.int32)
+        expected = []
+        for _ in range(n_new):
+            logits = llama.forward(params, cfg, jnp.asarray(seq))
+            nxt = int(jnp.argmax(logits[0, -1]))
+            expected.append(nxt)
+            seq = np.concatenate([seq, [[nxt]]], axis=1)
+        r = eng.submit(prompt, max_tokens=n_new, temperature=0.0).result(timeout=300)
+        assert r.token_ids == expected
+    finally:
+        eng.stop()
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """Decode ticks keep running while a long prefill is in flight: a short
+    request admitted alongside a many-chunk prompt finishes before the long
+    request produces its first token."""
+    import time as _time
+
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(4))
+    tok = ByteTokenizer()
+    eng = GenerationEngine(
+        cfg, params, tok, max_slots=2, max_seq_len=200, chunk_size=8
+    ).start()
+    try:
+        # warm the compile caches so timing reflects steady-state interleaving
+        eng.submit(tok.encode("warm"), max_tokens=2, temperature=0.0).result(timeout=300)
+        eng.submit(list(range(1, 30)), max_tokens=2, temperature=0.0).result(timeout=300)
+
+        t0 = _time.monotonic()
+        f_short = eng.submit(tok.encode("hi"), max_tokens=4, temperature=0.0)
+        t1 = _time.monotonic()
+        f_long = eng.submit(list(range(1, 121)), max_tokens=2, temperature=0.0)  # 15 chunks
+        rs = f_short.result(timeout=300)
+        rl = f_long.result(timeout=300)
+        short_end_abs = t0 + rs.latency_s
+        long_first_tok_abs = t1 + rl.ttft_s
+        assert short_end_abs < long_first_tok_abs, (rs, rl)
+    finally:
+        eng.stop()
+
+
 def test_sharded_engine_matches_single_device(tiny_gen_engine, mesh8):
     """North-star check (VERDICT r1 #1): the generation engine running under the
     mesh — sharded params AND sharded KV cache — produces the same greedy tokens
