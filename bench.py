@@ -1,43 +1,103 @@
-"""Headline benchmark: embedding docs/sec/chip (BASELINE.md config 1).
+"""Benchmarks for BASELINE.md configs 1-3 on the local accelerator.
 
-Measures the jit-compiled TPU encoder (ruBert-base geometry, the reference
-gpu_service's shipped embedder — reference: gpu_service/models.py:1-3) against the
-reference's serving path re-created with torch/transformers on CPU, which loops one
-text at a time exactly like ``TransformersEmbedder`` does (reference:
-assistant/ai/embedders/transformers.py:15-29 — unbatched, O(n) forwards).
+Headline (the BASELINE.json north star): **end-to-end RAG req/s + p50 TTFT** —
+query embedding over HTTP -> exact-KNN top-k -> chat generation over HTTP, i.e. the
+full path the reference runs as embed (gpu_service) -> pgvector -> dialog
+(gpu_service).  Also measured:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- config 1: embedding docs/s/chip (ruBert-base geometry, batched jit encode) vs the
+  reference's unbatched per-text torch loop (assistant/ai/embedders/transformers.py:15-29)
+- config 2: continuous-batching decode tokens/s/chip + p50/p99 TTFT under
+  concurrency, vs the reference's single-stream torch generate
+  (assistant/ai/providers/transformers.py:35-94)
+
+The decoder uses a Llama-3-1B-class geometry (random weights — throughput is
+weight-value independent) so the bench fits one chip; the serving path (engine,
+chunked prefill, lookahead decode pipeline, HTTP contract) is the production path.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} for the headline,
+with the other configs under "extras".
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
+import math
 import os
+import statistics
 import sys
 import time
 
-BATCH = int(os.environ.get("BENCH_BATCH", "64"))
-SEQ = int(os.environ.get("BENCH_SEQ", "128"))
-ITERS = int(os.environ.get("BENCH_ITERS", "20"))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SMALL = bool(int(os.environ.get("BENCH_SMALL", "0")))  # CI/dev smoke mode
+
+# config 1 (embedding)
+EMB_BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+EMB_SEQ = int(os.environ.get("BENCH_SEQ", "128"))
+EMB_ITERS = int(os.environ.get("BENCH_ITERS", "20"))
 BASELINE_ITERS = int(os.environ.get("BENCH_BASELINE_ITERS", "2"))
 
+# config 2 (decode) / config 3 (RAG)
+DECODE_REQUESTS = int(os.environ.get("BENCH_DECODE_REQUESTS", "16"))
+DECODE_NEW_TOKENS = int(os.environ.get("BENCH_DECODE_NEW_TOKENS", "64"))
+DECODE_PROMPT_LEN = int(os.environ.get("BENCH_DECODE_PROMPT_LEN", "120"))
+RAG_REQUESTS = int(os.environ.get("BENCH_RAG_REQUESTS", "24"))
+RAG_CONCURRENCY = int(os.environ.get("BENCH_RAG_CONCURRENCY", "8"))
+RAG_NEW_TOKENS = int(os.environ.get("BENCH_RAG_NEW_TOKENS", "32"))
+RAG_CORPUS = int(os.environ.get("BENCH_RAG_CORPUS", "10000"))
+BASELINE_DECODE_TOKENS = int(os.environ.get("BENCH_BASELINE_DECODE_TOKENS", "6"))
 
-def bench_tpu() -> float:
+
+def _decoder_cfg():
+    """Llama-3-1B-class geometry: full 128k vocab, GQA 32/8 heads, 16 layers."""
+    import jax.numpy as jnp
+
+    from django_assistant_bot_tpu.models import DecoderConfig
+
+    if SMALL:
+        return DecoderConfig.tiny()
+    return DecoderConfig(
+        vocab_size=128_256,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        max_seq_len=1024,
+        dtype=jnp.bfloat16,
+    )
+
+
+def _encoder_cfg():
+    import jax.numpy as jnp
+
+    from django_assistant_bot_tpu.models import EncoderConfig
+
+    if SMALL:
+        return EncoderConfig.tiny()
+    return EncoderConfig(dtype=jnp.bfloat16)  # ruBert-base geometry: 12L/768E/12H
+
+
+def bench_embedding() -> float:
+    """Config 1: batched jit encode, docs/s/chip (two-run slope cancels RPC cost)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from django_assistant_bot_tpu.models import EncoderConfig, encoder
+    from django_assistant_bot_tpu.models import encoder
 
-    cfg = EncoderConfig(dtype=jnp.bfloat16)  # ruBert-base geometry: 12L/768E/12H
+    cfg = _encoder_cfg()
     params = encoder.init(cfg, jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)
-    mask = jnp.ones((BATCH, SEQ), jnp.int32)
+    seq = min(EMB_SEQ, cfg.max_position_embeddings)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (EMB_BATCH, seq)), jnp.int32)
+    mask = jnp.ones((EMB_BATCH, seq), jnp.int32)
 
     encode = jax.jit(lambda p, i, m: encoder.encode(p, cfg, i, m, normalize=True))
-    np.asarray(encode(params, ids, mask))  # compile + warm (fetch forces completion)
+    np.asarray(encode(params, ids, mask))  # compile + warm
     np.asarray(encode(params, ids, mask))
 
     def run(iters: int) -> float:
@@ -48,55 +108,276 @@ def bench_tpu() -> float:
         np.asarray(out)  # one fetch; device executed all iters serially before it
         return time.perf_counter() - t0
 
-    # Two-run slope: under a remote-RPC device tunnel, a fixed round-trip latency
-    # rides on every timed region; (t(2N) - t(N)) / N cancels it.
-    t1 = run(ITERS)
-    t2 = run(2 * ITERS)
-    per_iter = max((t2 - t1) / ITERS, 1e-9)
-    # encode is an unsharded single-device jit: exactly one chip does the work,
-    # regardless of how many are visible.
-    return BATCH / per_iter
+    t1 = run(EMB_ITERS)
+    t2 = run(2 * EMB_ITERS)
+    per_iter = max((t2 - t1) / EMB_ITERS, 1e-9)
+    return EMB_BATCH / per_iter
 
 
-def bench_torch_cpu() -> float:
+def _build_gen_engine():
+    import jax
+
+    from django_assistant_bot_tpu.models import llama
+    from django_assistant_bot_tpu.parallel import get_mesh, shard_pytree
+    from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
+
+    cfg = _decoder_cfg()
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    mesh = get_mesh()
+    with mesh:
+        params = shard_pytree(params, llama.logical_axes(cfg), mesh)
+    eng = GenerationEngine(
+        cfg,
+        params,
+        ByteTokenizer(),
+        max_slots=8,
+        max_seq_len=min(1024, cfg.max_seq_len),
+        prefill_buckets=(128, 512),
+        chunk_size=512,
+        mesh=mesh,
+    ).start()
+    return eng, cfg
+
+
+def bench_decode(eng) -> dict:
+    """Config 2: continuous-batching decode throughput + TTFT under concurrency."""
+    import numpy as np
+
+    rng = np.random.default_rng(1)
+
+    def fire(n_req, n_new):
+        prompts = [
+            rng.integers(1, 255, DECODE_PROMPT_LEN).tolist() for _ in range(n_req)
+        ]
+        t0 = time.perf_counter()
+        futs = [
+            eng.submit(p, max_tokens=n_new, temperature=0.8) for p in prompts
+        ]
+        results = [f.result(timeout=1200) for f in futs]
+        wall = time.perf_counter() - t0
+        return results, wall
+
+    fire(2, 4)  # compile prefill + decode tick; warm sampling shapes
+    results, wall = fire(DECODE_REQUESTS, DECODE_NEW_TOKENS)
+    total_new = sum(r.completion_tokens for r in results)
+    ttfts = sorted(r.ttft_s for r in results)
+    p99_idx = min(len(ttfts) - 1, max(0, math.ceil(0.99 * len(ttfts)) - 1))
+    return {
+        "decode_tokens_per_s_per_chip": round(total_new / wall, 2),
+        "decode_p50_ttft_s": round(statistics.median(ttfts), 4),
+        "decode_p99_ttft_s": round(ttfts[p99_idx], 4),
+        "decode_concurrency": DECODE_REQUESTS,
+        "decode_new_tokens": DECODE_NEW_TOKENS,
+    }
+
+
+def bench_rag(gen_engine) -> dict:
+    """Config 3 (headline): embed -> KNN -> generate over the real HTTP path."""
+    import numpy as np
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from django_assistant_bot_tpu.models import encoder
+    from django_assistant_bot_tpu.serving import EmbeddingEngine, ByteTokenizer
+    from django_assistant_bot_tpu.serving.registry import ModelRegistry, ModelSpec
+    from django_assistant_bot_tpu.serving.server import create_app
+    from django_assistant_bot_tpu.storage.knn import VectorIndex
+
+    import jax
+
+    from django_assistant_bot_tpu.parallel import get_mesh, shard_pytree
+
+    ecfg = _encoder_cfg()
+    eparams = encoder.init(ecfg, jax.random.PRNGKey(1))
+    mesh = get_mesh()
+    with mesh:
+        eparams = shard_pytree(eparams, encoder.logical_axes(ecfg), mesh)
+    emb_eng = EmbeddingEngine(
+        ecfg, eparams, ByteTokenizer(), max_batch=32, normalize=True, mesh=mesh
+    ).start()
+
+    registry = ModelRegistry.__new__(ModelRegistry)
+    registry.mesh = mesh
+    registry.specs = {
+        "bench-emb": ModelSpec(name="bench-emb", kind="encoder"),
+        "bench-chat": ModelSpec(name="bench-chat", kind="decoder"),
+    }
+    registry.embedders = {"bench-emb": emb_eng}
+    registry.generators = {"bench-chat": gen_engine}
+
+    # corpus: random docs, embeddings pre-computed (ingestion is config 4)
+    rng = np.random.default_rng(2)
+    index = VectorIndex(ecfg.hidden_size)
+    vecs = rng.normal(size=(RAG_CORPUS, ecfg.hidden_size)).astype(np.float32)
+    index.add(list(range(RAG_CORPUS)), vecs)
+    docs = {
+        i: f"Document {i}: " + " ".join(f"fact{i}-{j}" for j in range(30))
+        for i in range(RAG_CORPUS)
+    }
+    index.search(rng.normal(size=ecfg.hidden_size))  # compile KNN kernel
+
+    async def one_request(client, qid: int) -> dict:
+        q = f"benchmark question number {qid} about topic {qid % 7}?"
+        r = await client.post(
+            "/embeddings/", json={"model": "bench-emb", "texts": [q]}
+        )
+        emb = (await r.json())["embeddings"][0]
+        top = index.search(np.asarray(emb, np.float32), k=3)
+        context = "\n".join(docs[i][:200] for i, _ in top)
+        r = await client.post(
+            "/dialog/",
+            json={
+                "model": "bench-chat",
+                "messages": [
+                    {"role": "system", "content": "Answer from context:\n" + context},
+                    {"role": "user", "content": q},
+                ],
+                "max_tokens": RAG_NEW_TOKENS,
+                "json_format": False,
+            },
+        )
+        data = await r.json()
+        return data["response"]["usage"]
+
+    async def drive():
+        loop = asyncio.get_event_loop()
+        client = TestClient(TestServer(create_app(registry)), loop=loop)
+        await client.start_server()
+        try:
+            await one_request(client, 999)  # warm all shapes end-to-end
+            sem = asyncio.Semaphore(RAG_CONCURRENCY)
+
+            async def guarded(i):
+                async with sem:
+                    return await one_request(client, i)
+
+            t0 = time.perf_counter()
+            usages = await asyncio.gather(*(guarded(i) for i in range(RAG_REQUESTS)))
+            wall = time.perf_counter() - t0
+        finally:
+            await client.close()
+        return usages, wall
+
+    usages, wall = asyncio.new_event_loop().run_until_complete(drive())
+    emb_eng.stop()
+    ttfts = sorted(u["ttft_s"] for u in usages)
+    return {
+        "rag_req_per_s": round(RAG_REQUESTS / wall, 3),
+        "rag_p50_ttft_s": round(statistics.median(ttfts), 4),
+        "rag_concurrency": RAG_CONCURRENCY,
+        "rag_corpus_vectors": RAG_CORPUS,
+        "rag_new_tokens": RAG_NEW_TOKENS,
+    }
+
+
+# --------------------------------------------------------------------- baselines
+def baseline_embedding_torch_cpu() -> float:
     """Reference serving path: per-text torch forward loop (unbatched), CPU."""
     import torch
     from transformers import BertConfig, BertModel
 
+    jcfg = _encoder_cfg()  # SMALL mode shrinks baseline and bench alike
     cfg = BertConfig(
-        vocab_size=119_547,
-        hidden_size=768,
-        num_hidden_layers=12,
-        num_attention_heads=12,
-        intermediate_size=3072,
+        vocab_size=jcfg.vocab_size,
+        hidden_size=jcfg.hidden_size,
+        num_hidden_layers=jcfg.num_layers,
+        num_attention_heads=jcfg.num_heads,
+        intermediate_size=jcfg.intermediate_size,
     )
     model = BertModel(cfg)
     model.eval()
-    ids = torch.randint(1, cfg.vocab_size, (BATCH, SEQ))
+    ids = torch.randint(1, cfg.vocab_size, (EMB_BATCH, EMB_SEQ))
     with torch.no_grad():
         model(input_ids=ids[:1])  # warm
         t0 = time.perf_counter()
         for _ in range(BASELINE_ITERS):
-            for i in range(BATCH):
+            for i in range(EMB_BATCH):
                 out = model(input_ids=ids[i : i + 1])
                 out.last_hidden_state.mean(dim=1)
         dt = time.perf_counter() - t0
-    return (BATCH * BASELINE_ITERS) / dt
+    return (EMB_BATCH * BASELINE_ITERS) / dt
+
+
+def baseline_decode_torch_cpu() -> float:
+    """Reference generate path: single-stream torch decode, tokens/s (same 1B-class
+    geometry).  The reference has no batching across requests
+    (assistant/ai/providers/transformers.py:35-94)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    jcfg = _decoder_cfg()  # SMALL mode shrinks baseline and bench alike
+    cfg = LlamaConfig(
+        vocab_size=jcfg.vocab_size,
+        hidden_size=jcfg.hidden_size,
+        intermediate_size=jcfg.intermediate_size,
+        num_hidden_layers=jcfg.num_layers,
+        num_attention_heads=jcfg.num_heads,
+        num_key_value_heads=jcfg.num_kv_heads,
+        max_position_embeddings=jcfg.max_seq_len,
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = torch.randint(1, 250, (1, DECODE_PROMPT_LEN))
+    with torch.no_grad():
+        t0 = time.perf_counter()
+        model.generate(
+            ids,
+            attention_mask=torch.ones_like(ids),
+            max_new_tokens=BASELINE_DECODE_TOKENS,
+            do_sample=True,
+            top_p=0.95,
+            top_k=50,
+            pad_token_id=cfg.eos_token_id,
+        )
+        dt = time.perf_counter() - t0
+    return BASELINE_DECODE_TOKENS / dt
 
 
 def main() -> None:
-    value = bench_tpu()
+    extras: dict = {}
+
+    emb = bench_embedding()
+    extras["embedding_docs_per_sec_per_chip"] = round(emb, 2)
+
+    gen_eng, _ = _build_gen_engine()
     try:
-        baseline = bench_torch_cpu()
+        extras.update(bench_decode(gen_eng))
+        rag = bench_rag(gen_eng)
+    finally:
+        gen_eng.stop()
+    extras.update({k: v for k, v in rag.items() if k != "rag_req_per_s"})
+
+    try:
+        emb_base = baseline_embedding_torch_cpu()
+        extras["embedding_vs_torch_cpu"] = round(emb / emb_base, 2)
     except Exception:
-        baseline = None
+        emb_base = None
+    try:
+        dec_base = baseline_decode_torch_cpu()
+        extras["decode_baseline_tokens_per_s_torch_cpu"] = round(dec_base, 3)
+        extras["decode_vs_torch_cpu"] = round(
+            extras["decode_tokens_per_s_per_chip"] / dec_base, 2
+        )
+    except Exception:
+        dec_base = None
+
+    # headline vs_baseline: generation dominates a RAG request end-to-end; the
+    # reference would serve it single-stream at dec_base tokens/s plus its
+    # unbatched embed, so its req/s ceiling is dec_base/(new_tokens + embed time).
+    vs = None
+    if dec_base and emb_base:
+        ref_req_s = 1.0 / (RAG_NEW_TOKENS / dec_base + 1.0 / emb_base)
+        extras["rag_baseline_req_per_s_torch_cpu"] = round(ref_req_s, 4)
+        vs = round(rag["rag_req_per_s"] / ref_req_s, 2)
+
     print(
         json.dumps(
             {
-                "metric": "embedding_docs_per_sec_per_chip",
-                "value": round(value, 2),
-                "unit": "docs/s/chip",
-                "vs_baseline": round(value / baseline, 2) if baseline else None,
+                "metric": "rag_req_per_s_plus_p50_ttft",
+                "value": rag["rag_req_per_s"],
+                "unit": "req/s (p50 TTFT %ss)" % rag["rag_p50_ttft_s"],
+                "vs_baseline": vs,
+                "extras": extras,
             }
         )
     )

@@ -28,6 +28,7 @@ class ModelSpec:
     max_slots: int = 8
     max_seq_len: Optional[int] = None
     chunk_size: int = 512
+    lookahead: int = 8
     max_batch: int = 64
     normalize: bool = False
     num_experts: int = 0
@@ -109,6 +110,7 @@ class ModelRegistry:
                 max_slots=spec.max_slots,
                 max_seq_len=spec.max_seq_len,
                 chunk_size=spec.chunk_size,
+                lookahead=spec.lookahead,
                 mesh=self.mesh,
             ).start()
             self.generators[name] = eng
