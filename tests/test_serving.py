@@ -229,6 +229,42 @@ def test_sharded_engine_matches_single_device(tiny_gen_engine, mesh8):
     assert got == ref
 
 
+def test_moe_engine_sharded_generate_matches_single_device():
+    """Config-5 path (Mixtral-style MoE continuous batching): the engine serving a
+    MoE decoder under a (data, model, expert) mesh matches single-device greedy."""
+    from django_assistant_bot_tpu.models.llama import logical_axes
+    from django_assistant_bot_tpu.parallel import best_mesh_shape, make_mesh, shard_pytree
+    from django_assistant_bot_tpu.parallel.mesh import EXPERT_AXIS
+
+    cfg = DecoderConfig.tiny(num_experts=4)
+    params = llama.init(cfg, jax.random.key(6))
+    tok = ByteTokenizer()
+    prompts = [tok.encode(t) for t in ["mixture of experts", "routing"]]
+
+    eng0 = GenerationEngine(cfg, params, tok, max_slots=2, max_seq_len=96).start()
+    try:
+        ref = [
+            eng0.submit(p, max_tokens=5, temperature=0.0).result(timeout=300).token_ids
+            for p in prompts
+        ]
+    finally:
+        eng0.stop()
+
+    mesh = make_mesh(best_mesh_shape(8, want_model=2, want_expert=2))
+    assert mesh.shape[EXPERT_AXIS] == 2
+    with mesh:
+        sharded = shard_pytree(params, logical_axes(cfg), mesh)
+    eng = GenerationEngine(
+        cfg, sharded, tok, max_slots=2, max_seq_len=96, mesh=mesh
+    ).start()
+    try:
+        futs = [eng.submit(p, max_tokens=5, temperature=0.0) for p in prompts]
+        got = [f.result(timeout=300).token_ids for f in futs]
+    finally:
+        eng.stop()
+    assert got == ref
+
+
 def test_sharded_embedding_engine_matches_single_device(mesh8):
     from django_assistant_bot_tpu.models import EncoderConfig, encoder
     from django_assistant_bot_tpu.parallel import shard_pytree
