@@ -1,0 +1,375 @@
+"""Sharded checkpoint save/restore — the orbax-analog the reference never needed.
+
+The reference is inference-only and has no model state at all (SURVEY.md §5.4:
+conversational state lives in Postgres; weights come from the HF hub).  The TPU
+build trains and serves sharded arrays, so it needs snapshot/resume of params +
+optimizer state across process death.  Design:
+
+- **Per-shard files.**  Every process writes only its addressable shards (one
+  ``.npy`` per unique shard index, replica 0 only), so saving a TP/DP-sharded
+  8B-param tree never materialises a full array on one host.  Restore reassembles
+  on host and ``device_put``s with the caller's target shardings — arbitrary
+  re-sharding between save and restore (different mesh shape, different axis
+  rules) is therefore free.
+- **Atomic.**  Writes go to ``<dir>.tmp`` and are ``os.rename``d into place, so a
+  kill mid-save can never leave a half-checkpoint that restore would read.
+- **Self-describing.**  ``manifest.json`` records the leaf key-paths (via
+  ``jax.tree_util.keystr``), shapes, dtypes, shard index ranges, a step counter
+  and arbitrary user metadata (model config, tokenizer path, ...).
+
+Trees restore either into a ``like`` template (any pytree — required for optax
+state, whose NamedTuple structure is not recoverable from key paths) or, for
+plain nested dict/list trees (model params), with no template at all.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Mapping, Optional
+
+import jax
+import ml_dtypes  # noqa: F401 — registers bfloat16 & friends with np.dtype()
+import numpy as np
+
+FORMAT_VERSION = 1
+_STEP_DIR = re.compile(r"^step_(\d+)$")
+
+
+def _leaf_entries(tree: Any):
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in leaves]
+
+
+def _shard_filename(leaf_idx: int, start: tuple) -> str:
+    tag = "_".join(str(s) for s in start) if start else "0"
+    return f"a{leaf_idx:05d}.{tag}.npy"
+
+
+def _index_start(index, shape) -> tuple:
+    """Normalize a shard's index (tuple of slices) to its start offsets."""
+    return tuple(
+        (0 if sl.start is None else int(sl.start)) for sl in index
+    ) if index else ()
+
+
+def save_checkpoint(
+    path: str,
+    tree: Any,
+    *,
+    step: int = 0,
+    meta: Optional[Mapping[str, Any]] = None,
+) -> str:
+    """Write ``tree`` (jax arrays / numpy / scalars) to ``path`` atomically.
+
+    Sharded ``jax.Array`` leaves are written one file per unique shard index by
+    the process that owns them; replicated leaves are written once (replica 0).
+    Multi-host deployments write to a shared filesystem, exactly like orbax/
+    tensorstore-based checkpointing.
+    """
+    final_tmp = path + ".tmp"
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        if jax.process_index() == 0:
+            if os.path.exists(final_tmp):
+                shutil.rmtree(final_tmp)
+            os.makedirs(final_tmp, exist_ok=True)
+        multihost_utils.sync_global_devices("checkpoint_init_" + path)
+    else:
+        if os.path.exists(final_tmp):
+            shutil.rmtree(final_tmp)
+        os.makedirs(final_tmp, exist_ok=True)
+
+    def write_block(fname: str, block: np.ndarray):
+        # raw bytes, not .npy: numpy's header cannot round-trip ml_dtypes
+        # (bfloat16 reloads as void); the manifest carries dtype + shape instead
+        with open(os.path.join(final_tmp, fname), "wb") as f:
+            f.write(block.tobytes())
+
+    manifest_leaves = []
+    for leaf_idx, (key, leaf) in enumerate(_leaf_entries(tree)):
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+            shards = []
+            seen = set()
+            for shard in leaf.addressable_shards:
+                start = _index_start(shard.index, leaf.shape)
+                if start in seen or shard.replica_id != 0:
+                    continue
+                seen.add(start)
+                block = np.asarray(shard.data)
+                fname = _shard_filename(leaf_idx, start)
+                write_block(fname, block)
+                shards.append(
+                    {"start": list(start), "shape": list(block.shape), "file": fname}
+                )
+            entry = {
+                "key": key,
+                "shape": list(leaf.shape),
+                "dtype": str(leaf.dtype),
+                "shards": shards,
+            }
+        else:
+            arr = np.asarray(leaf)
+            fname = _shard_filename(leaf_idx, ())
+            write_block(fname, arr)
+            entry = {
+                "key": key,
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "shards": [
+                    {"start": [0] * arr.ndim, "shape": list(arr.shape), "file": fname}
+                ],
+                "scalar": arr.ndim == 0 and not isinstance(leaf, (np.ndarray, jax.Array)),
+            }
+        manifest_leaves.append(entry)
+
+    manifest = {
+        "format": FORMAT_VERSION,
+        "step": int(step),
+        "meta": dict(meta or {}),
+        "leaves": manifest_leaves,
+    }
+    if jax.process_count() > 1:
+        # Multi-host: every process wrote its own shards into the shared tmp dir;
+        # each dumps a per-process manifest, then process 0 merges shard lists and
+        # renames after a barrier so the final dir appears only when complete.
+        with open(
+            os.path.join(final_tmp, f"manifest.p{jax.process_index()}.json"), "w"
+        ) as f:
+            json.dump(manifest, f)
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("checkpoint_save_" + path)
+        if jax.process_index() == 0:
+            for name in sorted(os.listdir(final_tmp)):
+                if name.startswith("manifest.p") and name != "manifest.p0.json":
+                    with open(os.path.join(final_tmp, name)) as f:
+                        other = json.load(f)
+                    for mine, theirs in zip(manifest["leaves"], other["leaves"]):
+                        assert mine["key"] == theirs["key"]
+                        seen = {tuple(s["start"]) for s in mine["shards"]}
+                        mine["shards"] += [
+                            s for s in theirs["shards"] if tuple(s["start"]) not in seen
+                        ]
+            with open(os.path.join(final_tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f, indent=1)
+            if os.path.exists(path):
+                shutil.rmtree(path)
+            os.rename(final_tmp, path)
+        multihost_utils.sync_global_devices("checkpoint_done_" + path)
+        return path
+
+    with open(os.path.join(final_tmp, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.rename(final_tmp, path)
+    return path
+
+
+def _read_block(ckpt_dir: str, shard: Mapping[str, Any], dtype: np.dtype) -> np.ndarray:
+    with open(os.path.join(ckpt_dir, shard["file"]), "rb") as f:
+        raw = f.read()
+    return np.frombuffer(raw, dtype).reshape(tuple(shard["shape"]))
+
+
+def _assemble_leaf(ckpt_dir: str, entry: Mapping[str, Any]) -> np.ndarray:
+    shape = tuple(entry["shape"])
+    dtype = np.dtype(entry["dtype"])
+    shards = entry["shards"]
+    if len(shards) == 1 and tuple(shards[0]["shape"]) == shape:
+        return _read_block(ckpt_dir, shards[0], dtype)
+    # GSPMD shard indices partition the array disjointly, so full coverage ⇔
+    # volumes sum to the array volume; np.empty must never leak through
+    covered = sum(int(np.prod(s["shape"])) for s in shards)
+    if covered != int(np.prod(shape)):
+        raise ValueError(
+            f"{entry['key']}: shards cover {covered} of {int(np.prod(shape))} "
+            "elements — incomplete checkpoint (partial multi-host write?)"
+        )
+    out = np.empty(shape, dtype)
+    for shard in shards:
+        block = _read_block(ckpt_dir, shard, dtype)
+        idx = tuple(slice(s, s + b) for s, b in zip(shard["start"], block.shape))
+        out[idx] = block
+    return out
+
+
+def read_manifest(path: str) -> Mapping[str, Any]:
+    with open(os.path.join(path, "manifest.json")) as f:
+        return json.load(f)
+
+
+def _rebuild_tree(entries, values):
+    """Rebuild a nested dict/list tree from jax keystr paths (model-params case)."""
+    root: Any = None
+
+    def ensure(container, token, nxt):
+        if isinstance(token, int):
+            while len(container) <= token:
+                container.append(None)
+            if container[token] is None:
+                container[token] = nxt
+            return container[token]
+        if token not in container or container[token] is None:
+            container[token] = nxt
+        return container[token]
+
+    token_re = re.compile(r"\['([^']*)'\]|\[(\d+)\]")
+    for entry, value in zip(entries, values):
+        raw = token_re.findall(entry["key"])
+        tokens = [t[0] if t[0] != "" else int(t[1]) for t in raw]
+        if not tokens:
+            return value  # single-leaf tree
+        if root is None:
+            root = [] if isinstance(tokens[0], int) else {}
+        node = root
+        for tok, nxt_tok in zip(tokens[:-1], tokens[1:]):
+            node = ensure(node, tok, [] if isinstance(nxt_tok, int) else {})
+        last = tokens[-1]
+        if isinstance(last, int):
+            while len(node) <= last:
+                node.append(None)
+            node[last] = value
+        else:
+            node[last] = value
+    return root
+
+
+def restore_checkpoint(
+    path: str,
+    *,
+    like: Any = None,
+    shardings: Any = None,
+) -> tuple[Any, int, Mapping[str, Any]]:
+    """Read a checkpoint -> (tree, step, meta).
+
+    ``like``: template pytree (values ignored) giving the tree structure — pass
+    e.g. ``jax.eval_shape``-built state for optax NamedTuple trees.  Without it,
+    the tree is rebuilt from key paths (nested dicts/lists only).
+
+    ``shardings``: optional pytree of :class:`jax.sharding.NamedSharding` (same
+    structure as the tree) or a callable ``(key, value) -> sharding``; leaves are
+    ``device_put`` accordingly.  Host numpy is returned where it is None.
+    """
+    manifest = read_manifest(path)
+    entries = manifest["leaves"]
+    values = [_assemble_leaf(path, e) for e in entries]
+    values = [
+        v.item() if e.get("scalar") else v for e, v in zip(entries, values)
+    ]
+
+    if like is not None:
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(like)
+        if len(leaves) != len(entries):
+            raise ValueError(
+                f"checkpoint has {len(entries)} leaves, template has {len(leaves)}"
+            )
+        for (tpath, _), entry in zip(leaves, entries):
+            if jax.tree_util.keystr(tpath) != entry["key"]:
+                raise ValueError(
+                    f"leaf mismatch: template {jax.tree_util.keystr(tpath)!r} vs "
+                    f"checkpoint {entry['key']!r}"
+                )
+        tree = jax.tree_util.tree_unflatten(treedef, values)
+    else:
+        tree = _rebuild_tree(entries, values)
+
+    if shardings is not None:
+        if callable(shardings):
+            flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+            out = []
+            for p, v in flat:
+                s = shardings(jax.tree_util.keystr(p), np.asarray(v))
+                out.append(jax.device_put(v, s) if s is not None else v)
+            tree = jax.tree_util.tree_unflatten(treedef, out)
+        else:
+            tree = jax.tree.map(
+                lambda v, s: jax.device_put(v, s) if s is not None else v,
+                tree,
+                shardings,
+                is_leaf=lambda x: x is None,
+            )
+    return tree, int(manifest["step"]), manifest["meta"]
+
+
+# ------------------------------------------------------------- step directories
+def step_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step_{step:09d}")
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    """Highest complete ``step_*`` checkpoint under ``directory`` (tmp ignored)."""
+    if not os.path.isdir(directory):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(directory):
+        m = _STEP_DIR.match(name)
+        if m and os.path.exists(os.path.join(directory, name, "manifest.json")):
+            s = int(m.group(1))
+            if s > best_step:
+                best, best_step = os.path.join(directory, name), s
+    return best
+
+
+def prune_checkpoints(directory: str, keep: int) -> None:
+    """Delete all but the newest ``keep`` step checkpoints."""
+    if keep <= 0 or not os.path.isdir(directory):
+        return
+    steps = sorted(
+        (int(m.group(1)), name)
+        for name in os.listdir(directory)
+        if (m := _STEP_DIR.match(name))
+    )
+    for _, name in steps[:-keep]:
+        shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
+
+
+# ------------------------------------------------------------ model checkpoints
+def _config_to_dict(cfg) -> dict:
+    import dataclasses
+
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = str(np.dtype(d["dtype"]))
+    return d
+
+
+def _config_from_dict(kind: str, d: Mapping[str, Any]):
+    import jax.numpy as jnp
+
+    from .models.config import DecoderConfig, EncoderConfig
+
+    cls = EncoderConfig if kind == "encoder" else DecoderConfig
+    kw = dict(d)
+    kw["dtype"] = getattr(jnp, str(np.dtype(kw["dtype"])))
+    return cls(**kw)
+
+
+def save_model(path: str, kind: str, cfg, params, *, meta: Optional[dict] = None) -> str:
+    """Save a served model (encoder/decoder params + config) as a native
+    checkpoint the registry can load instead of an HF directory."""
+    m = {"kind": kind, "config": _config_to_dict(cfg), **(meta or {})}
+    return save_checkpoint(path, params, meta=m)
+
+
+def load_model(path: str, *, dtype=None):
+    """-> (kind, cfg, host params, meta).  The caller shards onto its mesh (exactly
+    the HF-loader contract — see serving/registry.py)."""
+    manifest = read_manifest(path)
+    kind = manifest["meta"]["kind"]
+    cfg_d = dict(manifest["meta"]["config"])
+    if dtype is not None:
+        cfg_d["dtype"] = str(np.dtype(dtype))
+    cfg = _config_from_dict(kind, cfg_d)
+    params, _, _ = restore_checkpoint(path)
+    if dtype is not None:
+        params = jax.tree.map(
+            lambda a: a.astype(np.dtype(dtype)) if np.issubdtype(a.dtype, np.floating)
+            or a.dtype == np.dtype("bfloat16")
+            else a,
+            params,
+        )
+    return kind, cfg, params, manifest["meta"]

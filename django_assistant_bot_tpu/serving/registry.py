@@ -23,6 +23,7 @@ class ModelSpec:
     name: str
     kind: str  # "encoder" | "decoder"
     path: Optional[str] = None  # HF checkpoint dir; None + tiny=True -> random tiny
+    checkpoint: Optional[str] = None  # native sharded checkpoint dir (checkpoint.py)
     tiny: bool = False
     dtype: str = "bfloat16"
     max_slots: int = 8
@@ -71,17 +72,30 @@ class ModelRegistry:
 
         name = spec.name.lower()
         dtype = getattr(jnp, spec.dtype)
-        tokenizer = load_tokenizer(spec.path)
+        tokenizer_path = spec.path
         logger.info("loading model %r (%s, tiny=%s)", name, spec.kind, spec.tiny)
 
+        if spec.checkpoint:
+            from ..checkpoint import load_model
+
+            kind, _cfg, _params, _meta = load_model(spec.checkpoint, dtype=dtype)
+            if kind != spec.kind:
+                raise ValueError(
+                    f"model {name}: checkpoint is a {kind}, spec says {spec.kind}"
+                )
+            tokenizer_path = tokenizer_path or _meta.get("tokenizer")
+        tokenizer = load_tokenizer(tokenizer_path)
+
         if spec.kind == "encoder":
-            if spec.path:
+            if spec.checkpoint:
+                cfg, params = _cfg, _params
+            elif spec.path:
                 cfg, params = load_encoder(spec.path, dtype=dtype)
             elif spec.tiny:
                 cfg = EncoderConfig.tiny()
                 params = encoder.init(cfg, jax.random.key(0))
             else:
-                raise ValueError(f"model {name}: need path or tiny=true")
+                raise ValueError(f"model {name}: need path, checkpoint, or tiny=true")
             with self.mesh:
                 params = shard_pytree(params, encoder.logical_axes(cfg), self.mesh)
             eng = EmbeddingEngine(
@@ -94,13 +108,15 @@ class ModelRegistry:
             ).start()
             self.embedders[name] = eng
         elif spec.kind == "decoder":
-            if spec.path:
+            if spec.checkpoint:
+                cfg, params = _cfg, _params
+            elif spec.path:
                 cfg, params = load_decoder(spec.path, dtype=dtype)
             elif spec.tiny:
                 cfg = DecoderConfig.tiny(num_experts=spec.num_experts)
                 params = llama.init(cfg, jax.random.key(0))
             else:
-                raise ValueError(f"model {name}: need path or tiny=true")
+                raise ValueError(f"model {name}: need path, checkpoint, or tiny=true")
             with self.mesh:
                 params = shard_pytree(params, llama.logical_axes(cfg), self.mesh)
             eng = GenerationEngine(

@@ -13,4 +13,6 @@ from .train import (  # noqa: F401
     init_train_state,
     lm_loss,
     make_train_step,
+    restore_train_state,
+    save_train_state,
 )

@@ -1,0 +1,171 @@
+"""Sharded checkpoint save/restore (SURVEY.md §5.4 — the orbax-analog).
+
+The reference has no model state to checkpoint (inference-only; conversational
+state lives in Postgres).  These tests cover the TPU build's obligation: params +
+optimizer state survive process death, restore onto a mesh with identical
+shardings, and the serving registry can boot from a native checkpoint.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from django_assistant_bot_tpu import checkpoint as ckpt
+from django_assistant_bot_tpu.models import DecoderConfig, llama
+
+
+def tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_roundtrip_sharded_params(tmp_path, mesh8):
+    """Sharded save -> per-shard files -> restore with shardings == original."""
+    from django_assistant_bot_tpu.parallel import shard_pytree
+
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(0))
+    with mesh8:
+        sharded = shard_pytree(params, llama.logical_axes(cfg), mesh8)
+
+    path = str(tmp_path / "ck")
+    ckpt.save_checkpoint(path, sharded, step=7, meta={"note": "test"})
+
+    # sharded leaves must have produced >1 shard file for TP-sharded weights
+    manifest = ckpt.read_manifest(path)
+    by_key = {e["key"]: e for e in manifest["leaves"]}
+    wq = by_key["['layers']['wq']"]
+    assert len(wq["shards"]) > 1  # heads axis sharded over model=2
+
+    shardings = jax.tree.map(lambda x: x.sharding, sharded)
+    restored, step, meta = ckpt.restore_checkpoint(path, shardings=shardings)
+    assert step == 7 and meta["note"] == "test"
+    tree_equal(restored, sharded)
+    # restored leaves carry the requested shardings
+    assert restored["layers"]["wq"].sharding == sharded["layers"]["wq"].sharding
+
+
+def test_restore_without_template_rebuilds_dict_tree(tmp_path):
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(1))
+    path = str(tmp_path / "ck")
+    ckpt.save_checkpoint(path, params)
+    restored, _, _ = ckpt.restore_checkpoint(path)
+    tree_equal(restored, params)
+
+
+def test_bfloat16_leaves_roundtrip(tmp_path):
+    tree = {"w": jnp.arange(8, dtype=jnp.bfloat16) / 3, "b": jnp.ones((3,), jnp.float32)}
+    path = str(tmp_path / "ck")
+    ckpt.save_checkpoint(path, tree)
+    restored, _, _ = ckpt.restore_checkpoint(path)
+    assert np.asarray(restored["w"]).dtype == np.dtype("bfloat16")
+    tree_equal(restored, tree)
+
+
+def test_latest_and_prune(tmp_path):
+    d = str(tmp_path)
+    for s in (3, 10, 7):
+        ckpt.save_checkpoint(ckpt.step_path(d, s), {"x": np.ones(2)}, step=s)
+    assert ckpt.latest_checkpoint(d).endswith("step_000000010")
+    ckpt.prune_checkpoints(d, keep=2)
+    names = sorted(os.listdir(d))
+    assert names == ["step_000000007", "step_000000010"]
+
+
+def test_save_is_atomic_against_partial_state(tmp_path):
+    """A leftover .tmp dir from a killed save is ignored and overwritten."""
+    d = str(tmp_path)
+    path = ckpt.step_path(d, 1)
+    os.makedirs(path + ".tmp")  # simulate a crash mid-save
+    with open(os.path.join(path + ".tmp", "garbage"), "w") as f:
+        f.write("partial")
+    assert ckpt.latest_checkpoint(d) is None  # incomplete tmp is not a checkpoint
+    ckpt.save_checkpoint(path, {"x": np.arange(4)}, step=1)
+    assert ckpt.latest_checkpoint(d) == path
+    restored, _, _ = ckpt.restore_checkpoint(path)
+    np.testing.assert_array_equal(restored["x"], np.arange(4))
+
+
+def test_kill_and_resume_training_matches_straight_run(tmp_path, mesh8):
+    """Train 2 steps -> checkpoint -> 'die' -> restore into a FRESH state -> 1 more
+    step == 3 straight steps, bit-for-bit on params."""
+    import optax
+
+    from django_assistant_bot_tpu.training import (
+        init_train_state,
+        make_train_step,
+        restore_train_state,
+        save_train_state,
+    )
+    from django_assistant_bot_tpu.training.train import TrainState, batch_sharding
+
+    cfg = DecoderConfig.tiny()
+    optimizer = optax.adamw(1e-3)
+    step_fn = jax.jit(make_train_step(cfg, optimizer))
+    rng = np.random.default_rng(0)
+    batches = [
+        rng.integers(1, cfg.vocab_size, (4, 32)).astype(np.int32) for _ in range(3)
+    ]
+    mask = np.ones((4, 32), np.float32)
+
+    def run(state, data):
+        with mesh8:
+            for ids in data:
+                ids_d = jax.device_put(ids, batch_sharding(mesh8))
+                mask_d = jax.device_put(mask, batch_sharding(mesh8))
+                p, o, _ = step_fn(state.params, state.opt_state, ids_d, mask_d)
+                state = TrainState(params=p, opt_state=o, step=state.step + 1)
+        return state
+
+    def fresh_state():
+        with mesh8:
+            return init_train_state(cfg, optimizer, mesh=mesh8)
+
+    # straight 3-step run
+    straight = run(fresh_state(), batches)
+
+    # interrupted run: 2 steps, save, restore fresh, 1 step
+    d = str(tmp_path / "ckpts")
+    s = run(fresh_state(), batches[:2])
+    save_train_state(d, s, cfg)
+    del s  # the process "dies"
+    resumed = restore_train_state(d, cfg, optimizer, mesh=mesh8)
+    assert resumed is not None and resumed.step == 2
+    resumed = run(resumed, batches[2:])
+
+    assert resumed.step == straight.step == 3
+    tree_equal(resumed.params, straight.params)
+
+
+def test_registry_loads_native_checkpoint(tmp_path):
+    """cli serve can boot a model from a native checkpoint dir instead of HF."""
+    from django_assistant_bot_tpu.serving import ModelRegistry
+
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(2))
+    path = str(tmp_path / "model-ck")
+    ckpt.save_model(path, "decoder", cfg, params)
+
+    registry = ModelRegistry.from_config(
+        {"native-chat": {"kind": "decoder", "checkpoint": path, "dtype": "float32",
+                         "max_slots": 2, "max_seq_len": 64}}
+    )
+    try:
+        eng = registry.get_generator("native-chat")
+        assert eng is not None
+        r = eng.submit([1, 2, 3], max_tokens=3, temperature=0.0).result(timeout=300)
+        assert len(r.token_ids) == 3
+        # weights really came from the checkpoint: greedy output matches forward
+        seq = np.asarray([[1, 2, 3]], np.int32)
+        logits = llama.forward(params, cfg, jnp.asarray(seq))
+        assert r.token_ids[0] == int(jnp.argmax(logits[0, -1]))
+    finally:
+        registry.stop()
