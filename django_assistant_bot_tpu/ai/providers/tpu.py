@@ -101,7 +101,8 @@ class TPUProvider(AIProvider):
             result = await self._engine.generate(
                 list(messages),
                 max_tokens=max_tokens,
-                temperature=0.2 if json_format else 0.8,
+                temperature=0.8,
+                json_format=json_format,
             )
             usage = {
                 "model": self._model,

@@ -91,6 +91,7 @@ class _Request:
     top_p: float
     future: Future
     submitted_at: float
+    json: bool = False  # grammar-constrained JSON decoding (ops/json_fsm.py)
     first_token_at: Optional[float] = None
 
 
@@ -192,6 +193,14 @@ class GenerationEngine:
         self._temps_dev = None
         self._top_ps_dev = None
         self._active_dev = None
+        # grammar-constrained JSON decoding: tables built lazily on first use
+        self._json = np.zeros((max_slots,), bool)
+        self._json_dev = None
+        self._fsm = None  # ops.json_fsm.TokenFSM
+        self._fsm_next_dev = None
+        self._fsm_allowed_dev = None
+        self._fsm_states_dev = jnp.zeros((max_slots,), jnp.int32)
+        self._decode_tick_json = None
         self._rng = jax.random.key(0)
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -232,6 +241,55 @@ class GenerationEngine:
         self._prefill_chunk = jax.jit(
             _prefill_chunk, donate_argnums=(2,), out_shardings=tick_out
         )
+
+    def _ensure_fsm(self):
+        """Build the JSON token-FSM tables on first constrained request (one-time:
+        char DFA + vectorised closure over the tokenizer) and the json tick jit."""
+        if self._fsm is not None:
+            return
+        from ..ops.attention import NEG_INF
+        from ..ops.json_fsm import fsm_for_tokenizer
+
+        fsm = fsm_for_tokenizer(self.tokenizer)
+        V_model = self.cfg.vocab_size
+        S, V_tok = fsm.allowed.shape
+        # pad to the model vocab: ids beyond the tokenizer are never valid JSON
+        allowed = np.zeros((S, V_model), bool)
+        allowed[:, : min(V_tok, V_model)] = fsm.allowed[:, :V_model]
+        nxt = np.full((S, V_model), fsm.dead, np.int32)
+        nxt[:, : min(V_tok, V_model)] = fsm.next_state[:, :V_model]
+        self._fsm = fsm
+        self._fsm_next_np = nxt
+        rep = _replicated(self.mesh) if self.mesh is not None else None
+        self._fsm_allowed_dev = jax.device_put(allowed, rep)
+        self._fsm_next_dev = jax.device_put(nxt, rep)
+        self._fsm_init_row_dev = jax.device_put(allowed[fsm.initial], rep)
+
+        cfg_c, top_k_c = self.cfg, self.top_k
+
+        def _tick_json(params, tokens, cache, active, temps, top_ps, rng, fsm_s, jmask, next_tab, allowed_tab):
+            logits, cache = llama.decode_step(params, cfg_c, tokens, cache, active=active)
+            ok = allowed_tab[fsm_s]  # [B, V]
+            logits = jnp.where(jmask[:, None] & ~ok, NEG_INF, logits)
+            nxt_tok = sample_logits(
+                logits, rng, temperature=temps, top_k=top_k_c, top_p=top_ps
+            )
+            safe = jnp.minimum(nxt_tok, next_tab.shape[1] - 1)
+            fsm_s = jnp.where(jmask, next_tab[fsm_s, safe], fsm_s)
+            return nxt_tok, cache, fsm_s
+
+        if self.mesh is not None:
+            out = (_replicated(self.mesh), self._cache_shardings, _replicated(self.mesh))
+        else:
+            out = None
+        self._decode_tick_json = jax.jit(_tick_json, donate_argnums=(2,), out_shardings=out)
+
+    def _mask_prefill_logits(self, logits):
+        """Constrain the first sampled token to valid JSON openings (on device —
+        no host round trip of the [1, V] logits)."""
+        from ..ops.attention import NEG_INF
+
+        return jnp.where(self._fsm_init_row_dev[None, :], logits, NEG_INF)
 
     def _fresh_cache(self):
         if self._cache_shardings is not None:
@@ -305,6 +363,7 @@ class GenerationEngine:
         max_tokens: int = 1024,
         temperature: float = 0.8,
         top_p: float = 0.95,
+        json_format: bool = False,
     ) -> Future:
         """Thread-safe submission; returns a concurrent Future[GenerationResult]."""
         prompt_ids = list(prompt_ids)
@@ -321,6 +380,7 @@ class GenerationEngine:
                 top_p=top_p,
                 future=fut,
                 submitted_at=time.monotonic(),
+                json=json_format,
             )
         )
         # A stop() racing (or preceding) the put above would leave the request
@@ -339,6 +399,7 @@ class GenerationEngine:
         max_tokens: int = 1024,
         temperature: float = 0.8,
         top_p: float = 0.95,
+        json_format: bool = False,
     ) -> GenerationResult:
         """Async convenience: tokenize (chat-templating message lists), run, decode."""
         import asyncio
@@ -348,7 +409,11 @@ class GenerationEngine:
         else:
             ids = self.tokenizer.encode_chat(prompt)
         fut = self.submit(
-            ids, max_tokens=max_tokens, temperature=temperature, top_p=top_p
+            ids,
+            max_tokens=max_tokens,
+            temperature=temperature,
+            top_p=top_p,
+            json_format=json_format,
         )
         return await asyncio.wrap_future(fut)
 
@@ -462,6 +527,9 @@ class GenerationEngine:
 
     def _activate(self, slot: int, req: _Request, logits):
         """Sample the first token from prefill logits and make the slot live."""
+        if req.json:
+            self._ensure_fsm()
+            logits = self._mask_prefill_logits(logits)
         self._rng, sub = jax.random.split(self._rng)
         first = sample_logits(
             logits,
@@ -478,6 +546,14 @@ class GenerationEngine:
         self._tokens_dev = self._tokens_dev.at[slot].set(tok)
         self._temps[slot] = req.temperature
         self._top_ps[slot] = req.top_p
+        self._json[slot] = req.json
+        if req.json:
+            state = int(
+                self._fsm_next_np[
+                    self._fsm.initial, min(tok, self._fsm_next_np.shape[1] - 1)
+                ]
+            )
+            self._fsm_states_dev = self._fsm_states_dev.at[slot].set(state)
         self._sampling_dirty = True
         if self._should_finish(slot, tok):
             self._finish(slot)
@@ -487,6 +563,7 @@ class GenerationEngine:
             self._active_dev = jnp.asarray([s is not None for s in self._slots])
             self._temps_dev = jnp.asarray(self._temps)
             self._top_ps_dev = jnp.asarray(self._top_ps)
+            self._json_dev = jnp.asarray(self._json)
             self._sampling_dirty = False
 
     def _issue_tick(self):
@@ -496,15 +573,30 @@ class GenerationEngine:
         self._rng, sub = jax.random.split(self._rng)
         self._refresh_sampling()
         with self._mesh_scope():
-            nxt, self._cache = self._decode_tick(
-                self.params,
-                self._tokens_dev,
-                self._cache,
-                self._active_dev,
-                self._temps_dev,
-                self._top_ps_dev,
-                sub,
-            )
+            if self._json.any():
+                nxt, self._cache, self._fsm_states_dev = self._decode_tick_json(
+                    self.params,
+                    self._tokens_dev,
+                    self._cache,
+                    self._active_dev,
+                    self._temps_dev,
+                    self._top_ps_dev,
+                    sub,
+                    self._fsm_states_dev,
+                    self._json_dev,
+                    self._fsm_next_dev,
+                    self._fsm_allowed_dev,
+                )
+            else:
+                nxt, self._cache = self._decode_tick(
+                    self.params,
+                    self._tokens_dev,
+                    self._cache,
+                    self._active_dev,
+                    self._temps_dev,
+                    self._top_ps_dev,
+                    sub,
+                )
         try:
             nxt.copy_to_host_async()
         except AttributeError:  # backend without async host copies
@@ -546,6 +638,7 @@ class GenerationEngine:
         assert s is not None
         self._slots[slot] = None
         self._slot_epoch[slot] += 1  # invalidate this slot's in-flight ticks
+        self._json[slot] = False
         self._sampling_dirty = True
         req = s.request
         ids = s.generated
@@ -578,10 +671,12 @@ class GenerationEngine:
         if self._chunking is not None:
             _safe_resolve(self._chunking.request.future, exc=err)
             self._chunking = None
+        self._json[:] = False
         self._sampling_dirty = True
         # the cache may have been donated into a failed call — rebuild it
         self._cache = self._fresh_cache()
         self._tokens_dev = jnp.zeros((self.max_slots,), jnp.int32)
+        self._fsm_states_dev = jnp.zeros((self.max_slots,), jnp.int32)
 
 
 class EmbeddingEngine:

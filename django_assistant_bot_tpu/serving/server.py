@@ -66,14 +66,18 @@ def create_app(registry: ModelRegistry) -> web.Application:
         eng = registry.get_generator(model)
         if eng is None:
             return web.json_response({"detail": "Model is not supported"}, status=400)
-        if json_format:
-            # decoder-side JSON steering: the reference relies on provider-side
-            # retries (assistant/ai/providers/ollama.py:49-86); we also bias the
-            # prompt.  Greedy-ish sampling makes JSON far more reliable.
-            temperature = min(temperature, 0.2)
         try:
+            # json_format enables grammar-constrained decoding: a JSON token-FSM
+            # masks sampling inside the decode tick (ops/json_fsm.py), so the
+            # output is valid JSON in one shot even at high temperature — the
+            # reference instead retries with an LLM repair loop
+            # (assistant/ai/providers/ollama.py:49-107)
             result = await eng.generate(
-                messages, max_tokens=max_tokens, temperature=temperature, top_p=top_p
+                messages,
+                max_tokens=max_tokens,
+                temperature=temperature,
+                top_p=top_p,
+                json_format=json_format,
             )
             usage = {
                 "model": model,

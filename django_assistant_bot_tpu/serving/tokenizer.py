@@ -36,6 +36,10 @@ class ByteTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
 
+    def token_bytes(self) -> List[bytes]:
+        """Exact bytes per id (constrained decoding); specials render nothing."""
+        return [bytes([i]) for i in range(256)] + [b"", b"", b""]
+
     def apply_chat(self, messages: Sequence[dict]) -> str:
         return render_plain_chat(messages)
 
@@ -51,6 +55,7 @@ class HFTokenizer:
         self.eos_id = tok.eos_token_id if tok.eos_token_id is not None else -1
         pad = tok.pad_token_id
         self.pad_id = pad if pad is not None else (self.eos_id if self.eos_id >= 0 else 0)
+        self.vocab_size = len(tok)  # incl. added tokens — ids the model can emit
 
     def encode(self, text: str) -> List[int]:
         return self._tok.encode(text)
