@@ -1,14 +1,21 @@
 """Markdown -> Telegram MarkdownV2 renderer (reference: platforms/telegram/format.py:12-426).
 
-The reference pipes markdown2 -> BeautifulSoup -> a recursive formatter-node tree.
-Neither markdown2 nor the heavyweight tree is needed for the MarkdownV2 subset
-Telegram accepts; this renderer works directly on the markdown source:
+The reference pipes markdown2 -> BeautifulSoup -> a recursive formatter-node tree
+(Paragraph/Code/Quote/Bold/Italic/lists).  Neither markdown2 nor the DOM round
+trip is needed for the MarkdownV2 subset Telegram accepts; this renderer works
+directly on the markdown source in three passes:
 
-- code fences / inline code are extracted first and re-inserted verbatim (their
-  contents only escape `` ` `` and ``\\``);
-- bold/italic/strikethrough/links are converted token-wise;
-- every other MarkdownV2-special character is escaped;
-- any failure falls back to fully-escaped plain text (the reference's fallback).
+1. code fences / inline code are extracted first and re-inserted verbatim
+   (their contents only escape `` ` `` and ``\\``);
+2. a line-oriented block pass handles headers, blockquotes, and (nested)
+   bullet / numbered lists — bullets render as ``\\-`` items and numbers as
+   ``N\\.`` with indentation preserved, matching the reference's
+   ListItem/NumberedListItem output (reference format.py:245-282);
+3. a recursive inline pass renders nested bold/italic/strikethrough/links
+   (``**bold with _italic_**`` keeps both styles, like the reference's
+   formatter-node recursion); every other special character is escaped.
+
+Any failure falls back to fully-escaped plain text (the reference's fallback).
 """
 
 from __future__ import annotations
@@ -30,13 +37,26 @@ def _escape_code(text: str) -> str:
     return text.replace("\\", "\\\\").replace("`", "\\`")
 
 
+def _escape_link(url: str) -> str:
+    return url.replace("\\", "\\\\").replace(")", "\\)")
+
+
 _FENCE_RE = re.compile(r"```(\w*)\n(.*?)```", re.DOTALL)
 _INLINE_CODE_RE = re.compile(r"`([^`\n]+)`")
-_BOLD_RE = re.compile(r"\*\*(.+?)\*\*|__(.+?)__")
-_ITALIC_RE = re.compile(r"(?<!\*)\*([^*\n]+)\*(?!\*)|(?<!_)_([^_\n]+)_(?!_)")
-_STRIKE_RE = re.compile(r"~~(.+?)~~")
-_LINK_RE = re.compile(r"\[([^\]]+)\]\(([^)]+)\)")
-_HEADER_RE = re.compile(r"^#{1,6}\s+(.*)$", re.MULTILINE)
+_HEADER_RE = re.compile(r"^(#{1,6})\s+(.*)$")
+_BULLET_RE = re.compile(r"^(\s*)([-*+])\s+(.*)$")
+_NUMBER_RE = re.compile(r"^(\s*)(\d+)[.)]\s+(.*)$")
+_QUOTE_RE = re.compile(r"^\s*>\s?(.*)$")
+
+# inline patterns, in match-priority order (bold before italic so ** wins at
+# the same position); inner content is rendered recursively
+_INLINE_PATTERNS = (
+    ("link", re.compile(r"\[([^\]]+)\]\(([^)\s]+)\)")),
+    ("bolditalic", re.compile(r"\*\*\*(.+?)\*\*\*|___(.+?)___", re.DOTALL)),
+    ("bold", re.compile(r"\*\*(.+?)\*\*|__(.+?)__", re.DOTALL)),
+    ("strike", re.compile(r"~~(.+?)~~", re.DOTALL)),
+    ("italic", re.compile(r"(?<!\*)\*([^*\n]+)\*(?!\*)|(?<!_)_([^_\n]+)_(?!_)")),
+)
 
 
 def format_markdown_v2(text: str) -> str:
@@ -60,31 +80,58 @@ def _format(text: str) -> str:
         lambda m: stash(f"```{m.group(1)}\n{_escape_code(m.group(2))}```"), text
     )
     text = _INLINE_CODE_RE.sub(lambda m: stash(f"`{_escape_code(m.group(1))}`"), text)
-    # 2) structural markdown -> placeholders with escaped inner text
-    text = _LINK_RE.sub(
-        lambda m: stash(
-            f"[{escape_markdown_v2(m.group(1))}]({_escape_link(m.group(2))})"
-        ),
-        text,
-    )
-    text = _BOLD_RE.sub(
-        lambda m: stash(f"*{escape_markdown_v2(m.group(1) or m.group(2))}*"), text
-    )
-    text = _STRIKE_RE.sub(lambda m: stash(f"~{escape_markdown_v2(m.group(1))}~"), text)
-    text = _ITALIC_RE.sub(
-        lambda m: stash(f"_{escape_markdown_v2(m.group(1) or m.group(2))}_"), text
-    )
-    text = _HEADER_RE.sub(lambda m: stash(f"*{escape_markdown_v2(m.group(1))}*"), text)
-    # 3) escape everything else
-    text = escape_markdown_v2(text)
-    # 4) restore
+
+    # 2) block pass (line-oriented), inline pass per line
+    out_lines = [_render_line(line) for line in text.split("\n")]
+    text = "\n".join(out_lines)
+
+    # 3) restore protected code
     for i, rendered in enumerate(placeholders):
         text = text.replace(f"\x00{i}\x00", rendered)
     return text
 
 
-def _escape_link(url: str) -> str:
-    return url.replace("\\", "\\\\").replace(")", "\\)")
+def _render_line(line: str) -> str:
+    m = _HEADER_RE.match(line)
+    if m:
+        return f"*{_render_inline(m.group(2))}*"
+    m = _QUOTE_RE.match(line)
+    if m:
+        # native MarkdownV2 blockquote (the reference predates it and used a
+        # code fence; '>' is the current Bot API rendering)
+        return f">{_render_inline(m.group(1))}"
+    m = _BULLET_RE.match(line)
+    if m:
+        indent, _, body = m.groups()
+        return f"{indent}\\- {_render_inline(body)}"
+    m = _NUMBER_RE.match(line)
+    if m:
+        indent, num, body = m.groups()
+        return f"{indent}{num}\\. {_render_inline(body)}"
+    return _render_inline(line)
+
+
+def _render_inline(text: str) -> str:
+    """Recursive inline renderer: earliest match wins, inner content recurses —
+    nested styles survive (bold containing italic containing a link, ...)."""
+    best = None
+    for kind, rex in _INLINE_PATTERNS:
+        m = rex.search(text)
+        if m and (best is None or m.start() < best[1].start()):
+            best = (kind, m)
+    if best is None:
+        return escape_markdown_v2(text)
+    kind, m = best
+    before = escape_markdown_v2(text[: m.start()])
+    after = _render_inline(text[m.end() :])
+    if kind == "link":
+        inner = _render_inline(m.group(1))
+        return f"{before}[{inner}]({_escape_link(m.group(2))}){after}"
+    inner = _render_inline(m.group(1) or m.group(2))
+    if kind == "bolditalic":
+        return f"{before}*_{inner}_*{after}"
+    marker = {"bold": "*", "strike": "~", "italic": "_"}[kind]
+    return f"{before}{marker}{inner}{marker}{after}"
 
 
 class TelegramMarkdownV2FormattedText(str):

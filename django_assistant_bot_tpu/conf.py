@@ -47,7 +47,13 @@ class Settings:
         # resources + registries
         self.RESOURCES_DIR: Optional[str] = _env("RESOURCES_DIR")
         self.API_AUTH_TOKEN: Optional[str] = _env("API_AUTH_TOKEN")
+        # "user:password" protecting /admin with HTTP Basic; falls back to
+        # "admin:<API_AUTH_TOKEN>" when only the token is configured
+        self.ADMIN_BASIC_AUTH: Optional[str] = _env("ADMIN_BASIC_AUTH")
         self.WEBHOOK_BASE_URL: Optional[str] = _env("WEBHOOK_BASE_URL")
+        # sent to Telegram at setWebhook and required back on every webhook
+        # delivery via X-Telegram-Bot-Api-Secret-Token
+        self.TELEGRAM_WEBHOOK_SECRET: Optional[str] = _env("TELEGRAM_WEBHOOK_SECRET")
         self.BOTS: Dict[str, Dict[str, Any]] = {}
         # TPU serving config (model registry TOML/JSON path for the `tpu:` provider)
         self.TPU_SERVING_CONFIG: Optional[str] = _env("TPU_SERVING_CONFIG")

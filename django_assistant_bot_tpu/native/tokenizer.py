@@ -48,7 +48,10 @@ class NativeWordPieceTokenizer:
             ]
             lib.wp_free.argtypes = [ctypes.c_void_p]
             self._lib = lib
-            self._handle = lib.wp_create(blob.encode("utf-8"), int(lowercase))
+            # casing always happens in Python (str.lower below) so native and
+            # fallback paths share one Unicode casing implementation — the C++
+            # to_lower tables only cover ASCII/Latin-1/Cyrillic
+            self._handle = lib.wp_create(blob.encode("utf-8"), 0)
 
     def __del__(self):
         if self._lib is not None and self._handle:
@@ -60,6 +63,8 @@ class NativeWordPieceTokenizer:
     # ------------------------------------------------------------------ API
     def encode(self, text: str) -> List[int]:
         if self._handle:
+            if self.lowercase:
+                text = text.lower()
             buf = (ctypes.c_int32 * self.max_len)()
             with self._lock:  # the C handle is not thread-safe for concurrent use
                 n = self._lib.wp_encode(
@@ -98,7 +103,12 @@ class NativeWordPieceTokenizer:
                     words.append(cur)
                     cur = ""
                 continue
-            is_cjk = 0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF or 0xF900 <= cp <= 0xFAFF
+            is_cjk = (
+                0x4E00 <= cp <= 0x9FFF
+                or 0x3400 <= cp <= 0x4DBF
+                or 0x20000 <= cp <= 0x2A6DF  # ext-B, matching wordpiece.cpp is_cjk
+                or 0xF900 <= cp <= 0xFAFF
+            )
             is_punct = (
                 (33 <= cp <= 47)
                 or (58 <= cp <= 64)

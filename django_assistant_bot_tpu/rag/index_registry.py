@@ -1,9 +1,14 @@
-"""Process-wide vector-index cache per (model, field).
+"""Process-wide vector-index cache per (model, field), invalidated cross-process.
 
-pgvector maintains its HNSW incrementally inside Postgres; here each index is an
-MXU-resident matrix rebuilt lazily from sqlite after writers call
-:func:`invalidate_index` (ingestion does this once per batch — the rebuild is one
-table scan + one host->HBM transfer, amortised across every subsequent query).
+pgvector maintains its HNSW incrementally inside Postgres, so every process of
+the reference sees new vectors immediately.  Here each index is an MXU-resident
+matrix rebuilt lazily from sqlite — and because deployments are split across
+processes (``cli api`` server, ``--queues``-partitioned workers), the
+invalidation generation is *persisted in sqlite* rather than held in-process:
+an ingestion worker's :func:`invalidate_index` bumps a row every process
+observes on its next :func:`get_index`, so no process serves stale KNN results.
+The rebuild is one table scan + one host->HBM transfer, amortised across every
+subsequent query; the generation check is a single PK lookup.
 """
 
 from __future__ import annotations
@@ -11,27 +16,41 @@ from __future__ import annotations
 import threading
 from typing import Dict, Tuple, Type
 
+from ..storage.db import get_database
 from ..storage.knn import VectorIndex
 from ..storage.orm import Model
 
+_SCHEMA = (
+    "CREATE TABLE IF NOT EXISTS vector_index_generation ("
+    "key TEXT PRIMARY KEY, generation INTEGER NOT NULL)"
+)
+
 _indexes: Dict[Tuple[str, str], VectorIndex] = {}
-_generation: Dict[Tuple[str, str], int] = {}  # bumped by invalidate_index
 _built_generation: Dict[Tuple[str, str], int] = {}  # generation each index was built at
 _lock = threading.Lock()
 
 
+def _db_generation(key: str) -> int:
+    db = get_database()
+    db.connection().execute(_SCHEMA)
+    rows = db.query(
+        "SELECT generation FROM vector_index_generation WHERE key = ?", (key,)
+    )
+    return int(rows[0]["generation"]) if rows else 0
+
+
 def get_index(model_cls: Type[Model], field: str = "embedding") -> VectorIndex:
     key = (model_cls.__name__, field)
+    gen = _db_generation(f"{key[0]}.{key[1]}")
     with _lock:
         index = _indexes.get(key)
-        gen = _generation.get(key, 0)
         needs_build = index is None or _built_generation.get(key, -1) != gen
     if needs_build:
         fresh = VectorIndex.from_model(model_cls, field=field)
         with _lock:
             # only adopt if no invalidation landed during the rebuild; otherwise
             # keep the stale marker so the next caller rebuilds again
-            if _generation.get(key, 0) == gen:
+            if _db_generation(f"{key[0]}.{key[1]}") == gen:
                 _indexes[key] = fresh
                 _built_generation[key] = gen
                 index = fresh
@@ -41,13 +60,19 @@ def get_index(model_cls: Type[Model], field: str = "embedding") -> VectorIndex:
 
 
 def invalidate_index(model_cls: Type[Model], field: str = "embedding") -> None:
-    with _lock:
-        key = (model_cls.__name__, field)
-        _generation[key] = _generation.get(key, 0) + 1
+    """Bump the persistent generation — every process (API server, query
+    workers, other ingestion workers) rebuilds on its next lookup."""
+    key = f"{model_cls.__name__}.{field}"
+    db = get_database()
+    db.connection().execute(_SCHEMA)
+    db.execute(
+        "INSERT INTO vector_index_generation (key, generation) VALUES (?, 1) "
+        "ON CONFLICT(key) DO UPDATE SET generation = generation + 1",
+        (key,),
+    )
 
 
 def reset_indexes() -> None:
     with _lock:
         _indexes.clear()
-        _generation.clear()
         _built_generation.clear()

@@ -119,6 +119,45 @@ def test_markdown_v2_escaping_and_structure():
     assert "```python\nx = a.b\n```" in fenced
 
 
+import pytest  # noqa: E402
+
+
+@pytest.mark.parametrize(
+    "src,expected",
+    [
+        # bullet lists -> \- items (reference ListItem, format.py:245-270)
+        ("- one\n- two.", "\\- one\n\\- two\\."),
+        ("* star\n+ plus", "\\- star\n\\- plus"),
+        # nested bullets keep their indentation
+        ("- a\n  - b\n    - c", "\\- a\n  \\- b\n    \\- c"),
+        # numbered lists -> N\. items (reference NumberedListItem)
+        ("1. first\n2. second", "1\\. first\n2\\. second"),
+        ("1) alt style", "1\\. alt style"),
+        # blockquotes -> native '>' quote lines
+        ("> quoted text.", ">quoted text\\."),
+        ("> line one\n> line two", ">line one\n>line two"),
+        # nested inline styles survive (reference recursive formatter nodes)
+        ("**bold with _italic_ inside**", "*bold with _italic_ inside*"),
+        ("**bold ~~strike~~** tail.", "*bold ~strike~* tail\\."),
+        ("- item with **bold** and [link](https://x.y/z)",
+         "\\- item with *bold* and [link](https://x.y/z)"),
+        ("# Header with **bold**", "*Header with *bold**"),
+        ("***both***", "*_both_*"),
+    ],
+)
+def test_markdown_v2_structures_render_without_fallback(src, expected):
+    """The reference's test-worthy structures (format.py:108-426) render as
+    MarkdownV2 rather than degrading to fully-escaped literals."""
+    assert format_markdown_v2(src) == expected
+
+
+def test_markdown_v2_list_items_not_escaped_to_literals():
+    out = format_markdown_v2("Intro:\n- **a**\n- b\n\n1. c\n2. d")
+    assert "\\- *a*" in out and "1\\. c" in out
+    # the old regex subset escaped bullets into literal '\-'-less text
+    assert "\\*\\*" not in out
+
+
 # ------------------------------------------------------------------ resources
 def test_resource_manager_language_fallback(tmp_path):
     bot_dir = tmp_path / "mybot"
