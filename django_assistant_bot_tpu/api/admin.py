@@ -10,8 +10,10 @@ schedule/send-test actions.  Mounted under ``/admin/`` by
 
 from __future__ import annotations
 
+import hmac
 import html
 import logging
+import secrets
 
 from aiohttp import web
 
@@ -62,6 +64,21 @@ def _table(headers, rows) -> str:
 
 
 def register_admin(app: web.Application) -> None:
+    # Per-process CSRF token embedded in every mutating form and required back
+    # on POST (the Django-admin csrfmiddlewaretoken analog).  Per-process is
+    # enough because the admin is a single-server surface; multi-replica
+    # deployments need sticky sessions for /admin.
+    csrf_token = secrets.token_hex(16)
+
+    def _csrf_input() -> str:
+        return f"<input type='hidden' name='csrf' value='{csrf_token}'>"
+
+    async def _require_csrf(request: web.Request) -> None:
+        form = await request.post()
+        got = str(form.get("csrf", ""))
+        if not hmac.compare_digest(got.encode(), csrf_token.encode()):
+            raise web.HTTPForbidden(text="CSRF token missing or invalid")
+
     async def dashboard(request: web.Request) -> web.Response:
         from ..broadcasting.models import BroadcastCampaign
         from ..tasks.queue import TaskRecord
@@ -185,13 +202,14 @@ def register_admin(app: web.Application) -> None:
                     _esc(w.path),
                     _esc(latest.status if latest else "-"),
                     f"<form method='post' action='/admin/wiki/{w.id}/process'>"
-                    "<button>Process</button></form>",
+                    f"{_csrf_input()}<button>Process</button></form>",
                 )
             )
         return _html("Wiki", _table(["id", "bot", "path", "processing", "actions"], rows))
 
     async def wiki_process(request: web.Request) -> web.Response:
         """Re-trigger ingestion (reference storage admin 'Process' action)."""
+        await _require_csrf(request)
         w = models.WikiDocument.objects.get_or_none(id=int(request.match_info["id"]))
         if w is None:
             raise web.HTTPNotFound()
@@ -207,9 +225,9 @@ def register_admin(app: web.Application) -> None:
         for c in BroadcastCampaign.objects.all().order_by("-id").limit(100):
             actions = (
                 f"<form method='post' action='/admin/campaigns/{c.id}/schedule'>"
-                "<button>Schedule</button></form> "
+                f"{_csrf_input()}<button>Schedule</button></form> "
                 f"<form method='post' action='/admin/campaigns/{c.id}/send_test'>"
-                "<button>Send test</button></form>"
+                f"{_csrf_input()}<button>Send test</button></form>"
             )
             rows.append(
                 (
@@ -230,6 +248,7 @@ def register_admin(app: web.Application) -> None:
         from ..broadcasting.models import BroadcastCampaign
         from ..broadcasting.services import schedule_campaign_sending
 
+        await _require_csrf(request)
         c = BroadcastCampaign.objects.get_or_none(id=int(request.match_info["id"]))
         if c is None:
             raise web.HTTPNotFound()
@@ -243,6 +262,7 @@ def register_admin(app: web.Application) -> None:
         from ..bot.domain import SingleAnswer
         from ..broadcasting.models import BroadcastCampaign
 
+        await _require_csrf(request)
         c = BroadcastCampaign.objects.get_or_none(id=int(request.match_info["id"]))
         if c is None:
             raise web.HTTPNotFound()

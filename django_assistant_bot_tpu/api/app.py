@@ -22,6 +22,8 @@ Auth: optional static token (``DABT_API_AUTH_TOKEN``) via
 
 from __future__ import annotations
 
+import base64
+import hmac
 import logging
 from typing import Optional
 
@@ -97,11 +99,30 @@ def _page_qs(request: web.Request, qs, serialize) -> dict:
 
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
+    if request.path.startswith("/admin"):
+        # /admin mutates state from browser forms, so it gets interactive HTTP
+        # Basic auth (the Django-admin-login analog) rather than the API token
+        # the forms cannot send.  Credentials: DABT_ADMIN_BASIC_AUTH
+        # ("user:password"), falling back to admin:<API token>.
+        cred = getattr(settings, "ADMIN_BASIC_AUTH", None)
+        token = getattr(settings, "API_AUTH_TOKEN", None)
+        if not cred and token:
+            cred = f"admin:{token}"
+        if cred:
+            expected = "Basic " + base64.b64encode(cred.encode()).decode()
+            got = request.headers.get("Authorization", "")
+            if not hmac.compare_digest(got.encode(), expected.encode()):
+                return web.Response(
+                    status=401,
+                    headers={"WWW-Authenticate": 'Basic realm="admin"'},
+                    text="Unauthorized",
+                )
+        return await handler(request)
     token = getattr(settings, "API_AUTH_TOKEN", None)
     exempt = request.path.startswith("/telegram/") or request.path == "/healthz"
     if token and not exempt:
         got = request.headers.get("Authorization", "")
-        if got != f"Token {token}":
+        if not hmac.compare_digest(got.encode(), f"Token {token}".encode()):
             return web.json_response({"detail": "Unauthorized"}, status=401)
     return await handler(request)
 
@@ -111,6 +132,11 @@ def create_api_app() -> web.Application:
 
     # ---------------------------------------------------------------- webhook
     async def telegram_webhook(request: web.Request) -> web.Response:
+        secret = getattr(settings, "TELEGRAM_WEBHOOK_SECRET", None)
+        if secret:
+            got = request.headers.get("X-Telegram-Bot-Api-Secret-Token", "")
+            if not hmac.compare_digest(got.encode(), secret.encode()):
+                return web.json_response({"detail": "bad secret token"}, status=403)
         codename = request.match_info["codename"]
         bot = models.Bot.objects.get_or_none(codename=codename)
         if bot is None:

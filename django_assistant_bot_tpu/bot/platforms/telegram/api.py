@@ -114,8 +114,11 @@ class TelegramAPI:
     ) -> List[Dict]:
         return await self.call("getUpdates", offset=offset, timeout=timeout)
 
-    async def set_webhook(self, url: str) -> Any:
-        return await self.call("setWebhook", url=url)
+    async def set_webhook(self, url: str, secret_token: Optional[str] = None) -> Any:
+        kwargs = {"url": url}
+        if secret_token:
+            kwargs["secret_token"] = secret_token
+        return await self.call("setWebhook", **kwargs)
 
     async def answer_callback_query(self, callback_query_id: str) -> Any:
         return await self.call("answerCallbackQuery", callback_query_id=callback_query_id)

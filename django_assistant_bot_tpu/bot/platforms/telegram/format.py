@@ -94,7 +94,7 @@ def _format(text: str) -> str:
 def _render_line(line: str) -> str:
     m = _HEADER_RE.match(line)
     if m:
-        return f"*{_render_inline(m.group(2))}*"
+        return f"*{_render_inline(m.group(2), frozenset({'bold'}))}*"
     m = _QUOTE_RE.match(line)
     if m:
         # native MarkdownV2 blockquote (the reference predates it and used a
@@ -111,9 +111,12 @@ def _render_line(line: str) -> str:
     return _render_inline(line)
 
 
-def _render_inline(text: str) -> str:
+def _render_inline(text: str, active: frozenset = frozenset()) -> str:
     """Recursive inline renderer: earliest match wins, inner content recurses —
-    nested styles survive (bold containing italic containing a link, ...)."""
+    nested styles survive (bold containing italic containing a link, ...).
+    ``active`` carries the styles already open in this context (a header is a
+    bold context; bold-inside-bold would double the ``*`` markers, which
+    Telegram rejects, so markers for an already-active style are elided)."""
     best = None
     for kind, rex in _INLINE_PATTERNS:
         m = rex.search(text)
@@ -123,15 +126,16 @@ def _render_inline(text: str) -> str:
         return escape_markdown_v2(text)
     kind, m = best
     before = escape_markdown_v2(text[: m.start()])
-    after = _render_inline(text[m.end() :])
+    after = _render_inline(text[m.end() :], active)
     if kind == "link":
-        inner = _render_inline(m.group(1))
+        inner = _render_inline(m.group(1), active)
         return f"{before}[{inner}]({_escape_link(m.group(2))}){after}"
-    inner = _render_inline(m.group(1) or m.group(2))
-    if kind == "bolditalic":
-        return f"{before}*_{inner}_*{after}"
-    marker = {"bold": "*", "strike": "~", "italic": "_"}[kind]
-    return f"{before}{marker}{inner}{marker}{after}"
+    styles = {"bolditalic": ("bold", "italic"), "bold": ("bold",), "strike": ("strike",), "italic": ("italic",)}[kind]
+    new_styles = tuple(s for s in styles if s not in active)
+    inner = _render_inline(m.group(1) or m.group(2), active | set(styles))
+    open_marks = "".join({"bold": "*", "italic": "_", "strike": "~"}[s] for s in new_styles)
+    close_marks = open_marks[::-1]
+    return f"{before}{open_marks}{inner}{close_marks}{after}"
 
 
 class TelegramMarkdownV2FormattedText(str):

@@ -3,6 +3,12 @@
 
 Import this module to activate: saving a Bot with a telegram token calls
 ``setWebhook`` pointing at ``settings.WEBHOOK_BASE_URL/telegram/<codename>/``.
+
+This sync hook is the *automatic* registration path (post_save may fire from
+sync or async contexts, so it uses blocking ``requests`` rather than the async
+``TelegramAPI`` client); ``TelegramAPI.set_webhook(url, secret_token=...)`` is
+the programmatic path for library users.  Both send the same
+``TELEGRAM_WEBHOOK_SECRET`` that the webhook view enforces.
 """
 
 from __future__ import annotations
@@ -24,10 +30,15 @@ def register_telegram_webhook(instance: Bot, created: bool) -> None:
     if not base or not instance.telegram_token:
         return
     url = f"{base.rstrip('/')}/telegram/{instance.codename}/"
+    payload = {"url": url}
+    if getattr(settings, "TELEGRAM_WEBHOOK_SECRET", None):
+        # Telegram echoes this back on every delivery via
+        # X-Telegram-Bot-Api-Secret-Token; the webhook view rejects mismatches
+        payload["secret_token"] = settings.TELEGRAM_WEBHOOK_SECRET
     try:
         resp = requests.post(
             f"https://api.telegram.org/bot{instance.telegram_token}/setWebhook",
-            json={"url": url},
+            json=payload,
             timeout=10,
         )
         logger.info("setWebhook %s -> %s", url, resp.status_code)

@@ -24,6 +24,18 @@ def run(args) -> int:
 
     from ..api import create_api_app
 
+    # Re-sync webhook registrations at boot so a newly-configured
+    # TELEGRAM_WEBHOOK_SECRET reaches Telegram for bots registered before the
+    # secret existed — otherwise the view would 403 their deliveries forever.
+    from ..bot.signals import register_telegram_webhook
+    from ..conf import settings
+    from ..storage.models import Bot
+
+    if getattr(settings, "WEBHOOK_BASE_URL", None):
+        for bot in Bot.objects.all():
+            if bot.telegram_token:
+                register_telegram_webhook(bot, created=False)
+
     app = create_api_app()
     web.run_app(app, host=args.host, port=args.port)
     return 0

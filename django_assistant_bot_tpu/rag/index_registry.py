@@ -32,7 +32,9 @@ _lock = threading.Lock()
 
 def _db_generation(key: str) -> int:
     db = get_database()
-    db.connection().execute(_SCHEMA)
+    # memoized per Database, so the KNN hot path pays a PK SELECT only —
+    # not a DDL statement (and its schema lock) per query
+    db.ensure_schema("vector_index_generation", _SCHEMA)
     rows = db.query(
         "SELECT generation FROM vector_index_generation WHERE key = ?", (key,)
     )
@@ -64,7 +66,7 @@ def invalidate_index(model_cls: Type[Model], field: str = "embedding") -> None:
     workers, other ingestion workers) rebuilds on its next lookup."""
     key = f"{model_cls.__name__}.{field}"
     db = get_database()
-    db.connection().execute(_SCHEMA)
+    db.ensure_schema("vector_index_generation", _SCHEMA)
     db.execute(
         "INSERT INTO vector_index_generation (key, generation) VALUES (?, 1) "
         "ON CONFLICT(key) DO UPDATE SET generation = generation + 1",

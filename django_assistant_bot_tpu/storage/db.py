@@ -63,6 +63,17 @@ class Database:
                 self.connection().commit()
                 self._created_tables.add(name)
 
+    def ensure_schema(self, name: str, sql: str) -> None:
+        """Run a raw DDL statement once per Database (same memo as ensure_table,
+        for tables that live outside the Model layer)."""
+        if name in self._created_tables:
+            return
+        with self._lock:
+            if name not in self._created_tables:
+                self.connection().execute(sql)
+                self.connection().commit()
+                self._created_tables.add(name)
+
     def close(self) -> None:
         conn = getattr(self._local, "conn", None)
         if conn is not None:

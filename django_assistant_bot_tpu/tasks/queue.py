@@ -87,7 +87,27 @@ class Task:
         """Run inline (possibly async)."""
         result = self.fn(*args, **kwargs)
         if inspect.iscoroutine(result):
-            return asyncio.run(result)
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                return asyncio.run(result)
+            # Eager .delay() from inside a running loop (e.g. the aiohttp
+            # webhook with TASK_ALWAYS_EAGER): asyncio.run() would raise, so
+            # drive the coroutine on a private loop in a fresh thread.
+            box: Dict[str, Any] = {}
+
+            def runner() -> None:
+                try:
+                    box["result"] = asyncio.run(result)
+                except BaseException as e:  # re-raised in the caller
+                    box["error"] = e
+
+            t = threading.Thread(target=runner, daemon=True)
+            t.start()
+            t.join()
+            if "error" in box:
+                raise box["error"]
+            return box.get("result")
         return result
 
     def delay(self, *args, **kwargs) -> Optional[TaskRecord]:

@@ -196,8 +196,18 @@ def test_admin_pages_render(seeded):
             assert resp.status == 200, path
             text = await resp.text()
             assert "<table>" in text, path
-        # process action enqueues ingestion
+        # POST without the CSRF token is rejected
         resp = await client.post(f"/admin/wiki/{wiki.id}/process", allow_redirects=False)
+        assert resp.status == 403
+        # extract the per-process CSRF token from a rendered form
+        import re
+
+        page = await (await client.get("/admin/wiki")).text()
+        csrf = re.search(r"name='csrf' value='([0-9a-f]+)'", page).group(1)
+        # process action enqueues ingestion
+        resp = await client.post(
+            f"/admin/wiki/{wiki.id}/process", data={"csrf": csrf}, allow_redirects=False
+        )
         assert resp.status == 302
         from django_assistant_bot_tpu.tasks.queue import TaskRecord
 
@@ -206,10 +216,86 @@ def test_admin_pages_render(seeded):
         )
         # schedule action flips campaign status
         resp = await client.post(
-            f"/admin/campaigns/{campaign.id}/schedule", allow_redirects=False
+            f"/admin/campaigns/{campaign.id}/schedule",
+            data={"csrf": csrf},
+            allow_redirects=False,
         )
         assert resp.status == 302
         campaign.refresh()
         assert campaign.status == BroadcastCampaign.SCHEDULED
+
+    body()
+
+
+def test_admin_basic_auth_enforced(seeded):
+    import base64
+
+    @with_client
+    async def body(client):
+        with settings.override(ADMIN_BASIC_AUTH="boss:hunter2"):
+            resp = await client.get("/admin/")
+            assert resp.status == 401
+            assert resp.headers.get("WWW-Authenticate", "").startswith("Basic")
+            cred = base64.b64encode(b"boss:hunter2").decode()
+            resp = await client.get(
+                "/admin/", headers={"Authorization": f"Basic {cred}"}
+            )
+            assert resp.status == 200
+        # API token alone also locks the admin (admin:<token> fallback)
+        with settings.override(API_AUTH_TOKEN="sekret", ADMIN_BASIC_AUTH=None):
+            resp = await client.get("/admin/")
+            assert resp.status == 401
+            cred = base64.b64encode(b"admin:sekret").decode()
+            resp = await client.get(
+                "/admin/", headers={"Authorization": f"Basic {cred}"}
+            )
+            assert resp.status == 200
+
+    body()
+
+
+def test_webhook_secret_token_enforced(seeded):
+    @with_client
+    async def body(client):
+        payload = {
+            "message": {
+                "message_id": 7,
+                "chat": {"id": 556},
+                "text": "secret hi",
+                "from": {"id": 556, "username": "web"},
+            }
+        }
+        with settings.override(TELEGRAM_WEBHOOK_SECRET="wh-secret"):
+            resp = await client.post("/telegram/api-bot/", json=payload)
+            assert resp.status == 403
+            resp = await client.post(
+                "/telegram/api-bot/",
+                json=payload,
+                headers={"X-Telegram-Bot-Api-Secret-Token": "wh-secret"},
+            )
+            assert resp.status == 200
+        assert models.Message.objects.filter(message_id=7).count() == 1
+
+    body()
+
+
+def test_eager_task_delay_from_running_loop(seeded):
+    """TASK_ALWAYS_EAGER .delay() of an async task from inside a running loop
+    (the webhook path) must not raise 'asyncio.run() cannot be called...'."""
+    from django_assistant_bot_tpu.tasks.queue import task
+
+    calls = []
+
+    @task(name="tests.eager_async_probe")
+    async def probe(x):
+        calls.append(x)
+        return x * 2
+
+    @with_client
+    async def body(client):
+        with settings.override(TASK_ALWAYS_EAGER=True):
+            # directly from this running loop
+            probe.delay(21)
+        assert calls == [21]
 
     body()

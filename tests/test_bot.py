@@ -141,8 +141,13 @@ import pytest  # noqa: E402
         ("**bold ~~strike~~** tail.", "*bold ~strike~* tail\\."),
         ("- item with **bold** and [link](https://x.y/z)",
          "\\- item with *bold* and [link](https://x.y/z)"),
-        ("# Header with **bold**", "*Header with *bold**"),
+        # bold markers inside an already-bold context (a header) are elided —
+        # doubled '*' would be rejected by Telegram's parser
+        ("# Header with **bold**", "*Header with bold*"),
+        ("**outer **inner** tail**", "*outer *inner* tail*"),
         ("***both***", "*_both_*"),
+        # bold+italic inside a header: only the italic marker is new
+        ("# H ***bi***", "*H _bi_*"),
     ],
 )
 def test_markdown_v2_structures_render_without_fallback(src, expected):
