@@ -1,28 +1,66 @@
 """AI-vs-AI dialog simulator + LLM QA analyzer
 (reference: assistant/bot/management/commands/tester.py:43-453).
 
-``run`` mode: N simulated dialogs — a persona-driven "user" LLM talks to the real
-bot stack in-process; transcripts are saved as JSONL.
-``analyze`` mode: an analyzer LLM scores each saved dialog (JSON verdict) and an
-aggregate report with RICE-style improvement suggestions is printed.
+``run`` mode: N simulated dialogs.  Each dialog gets a *randomized persona*
+sampled from a trait table (one value per dimension), and a persona-driven
+"user" LLM talks to the real bot stack in-process — seeing the transcript with
+roles swapped, opening with ``/start``, while a second "control" LLM decides
+after each exchange whether a real user would keep talking (capped at
+``--turns``).  Engine exceptions are captured as crash entries instead of
+aborting the dialog.  Each dialog is written to ``<out>/dialog_<i>.json``.
+
+``analyze`` mode: an analyzer LLM reviews each saved dialog and must return a
+strict ``{"warnings": [...], "errors": [...]}`` JSON verdict (retried via
+``repeat_until`` until it validates); crashes are counted from the transcript.
+Per-dialog results land in ``<out>/analysis_results.jsonl``; the aggregate
+report prints totals and asks an improvement LLM for the single
+highest-priority fix, weighed RICE-style (reach/impact/confidence/effort).
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import logging
+import os
 import random
-import time
 import uuid
-from typing import List
+from typing import List, Optional
 
-PERSONAS = [
-    "an impatient customer who writes short, terse messages",
-    "a polite elderly user unfamiliar with technology",
-    "a power user asking detailed technical questions",
-    "a confused user who mixes several questions in one message",
-    "a skeptical user who doubts the bot's answers",
-]
+from ..ai.dialog import AIDialog
+from ..ai.domain import Message as AIMessage
+from ..utils.repeat_until import RepeatUntilError, repeat_until
+
+logger = logging.getLogger(__name__)
+
+CRASH_MARKER = "[crash]"
+
+# Trait dimensions sampled independently per dialog — the cartesian space is
+# large enough that every simulated user is distinct (reference samples an
+# analogous personality table, tester.py:260-305).
+TRAITS = {
+    "age bracket": ["a teenager", "in their twenties", "middle-aged", "retired"],
+    "tech fluency": ["barely computer-literate", "average", "a developer", "a tinkerer"],
+    "message style": ["one-liners", "long rambling paragraphs", "bullet-point lists", "precise sentences"],
+    "mood": ["cheerful", "irritated", "anxious", "indifferent", "playful"],
+    "patience": ["gives up quickly", "persistent", "methodical", "demanding"],
+    "formality": ["very formal", "casual", "slangy", "businesslike"],
+    "trust in bots": ["trusting", "skeptical", "hostile to chatbots", "curious about AI"],
+    "topic discipline": ["stays on topic", "drifts between topics", "asks several things at once"],
+    "typos": ["types carefully", "makes frequent typos", "ignores punctuation"],
+    "humor": ["jokes often", "deadpan", "never jokes"],
+    "detail appetite": ["wants step-by-step detail", "wants the short version", "asks for sources"],
+    "politeness": ["says please and thanks", "neutral", "brusque"],
+    "follow-up habit": ["asks follow-up questions", "accepts the first answer", "rephrases when unsatisfied"],
+    "emotional expression": ["uses emoji", "expresses frustration verbally", "flat affect"],
+    "goal clarity": ["knows exactly what they want", "vague about their goal", "exploring capabilities"],
+}
+
+
+def generate_persona(rng: Optional[random.Random] = None) -> str:
+    """One random value per trait dimension, rendered as a bullet profile."""
+    rng = rng or random
+    return "\n".join(f"- {dim}: {rng.choice(vals)}" for dim, vals in TRAITS.items())
 
 
 def add_parser(sub):
@@ -30,98 +68,296 @@ def add_parser(sub):
     p.add_argument("bot_codename")
     p.add_argument("--mode", choices=("run", "analyze"), default="run")
     p.add_argument("--dialogs", type=int, default=3)
-    p.add_argument("--turns", type=int, default=4)
+    p.add_argument("--turns", type=int, default=10, help="max turns per dialog")
     p.add_argument("--model", default=None, help="simulator/analyzer model")
-    p.add_argument("--out", default="tester_dialogs.jsonl")
+    p.add_argument("--out", default="test_dialogs", help="artifact directory")
+    p.add_argument("--seed", type=int, default=None, help="persona sampling seed")
     return p
 
 
+def _swapped_history(dialog_log: List[dict]) -> List[AIMessage]:
+    """The simulator plays the human, so bot turns become its 'user' input."""
+    return [
+        AIMessage(
+            role="user" if entry["role"] == "assistant" else "assistant",
+            content=entry["text"],
+        )
+        for entry in dialog_log
+        if entry.get("role") in ("user", "assistant")
+    ]
+
+
+def _log_answer(dialog_log: List[dict], answer) -> None:
+    from ..bot.domain import MultiPartAnswer
+
+    parts = answer.parts if isinstance(answer, MultiPartAnswer) else [answer]
+    for part in parts:
+        entry: dict = {"role": "assistant", "text": part.text}
+        if part.buttons:
+            entry["buttons"] = [
+                [
+                    {"text": b.text, "callback_data": b.callback_data, "url": b.url}
+                    for b in row
+                ]
+                for row in part.buttons
+            ]
+        if getattr(part, "reply_keyboard", None):
+            entry["reply_keyboard"] = [list(row) for row in part.reply_keyboard]
+        dialog_log.append(entry)
+
+
 async def _simulate_dialog(args, model: str, persona: str) -> List[dict]:
-    from ..ai.dialog import AIDialog
-    from .chat import process_message
-    from .utils import ConsolePlatform
+    from ..bot.domain import Update, User
+    from ..bot.services.dialog_service import create_user_message
+    from ..bot.utils import get_bot_class
+    from ..storage.locks import InstanceLockAsync
+    from .utils import ConsolePlatform, get_instance, open_dialog
 
     simulator = AIDialog(model)
+    control = AIDialog(model)
     chat_id = f"tester-{uuid.uuid4()}"
     platform = ConsolePlatform(echo=False)
-    transcript: List[dict] = [{"persona": persona}]
-    last_bot = None
-    for turn in range(args.turns):
-        if last_bot is None:
-            sim_prompt = (
-                f"You are {persona}. Start a conversation with a support bot with "
-                "one realistic question or request. Answer with the message only."
+    dialog_log: List[dict] = [{"persona": persona}]
+
+    _, instance = get_instance(args.bot_codename, chat_id)
+    dialog = open_dialog(instance)
+    bot_cls = get_bot_class(args.bot_codename)
+    bot = bot_cls(dialog=dialog, platform=platform)
+    try:
+        persona_system = AIMessage(
+            role="system",
+            content=(
+                "You are a human user texting a support bot.  Your traits:\n"
+                f"{persona}\n"
+                "Write the next message you would send, and nothing else.\n"
+                'Your very first message must be "/start" (do not repeat it later).\n'
+                "You may close the conversation with a short goodbye when it "
+                "feels natural."
+            ),
+        )
+        message_id = 0
+        for turn in range(args.turns):
+            if turn == 0:
+                user_message = "/start"
+            else:
+                resp = await simulator.get_response(
+                    messages=[persona_system] + _swapped_history(dialog_log),
+                    max_tokens=150,
+                )
+                user_message = str(resp.result).strip()
+            dialog_log.append({"role": "user", "text": user_message})
+
+            message_id += 1
+            create_user_message(dialog, message_id, user_message)
+            update = Update(
+                chat_id=chat_id,
+                message_id=message_id,
+                text=user_message,
+                user=User(id=chat_id, username="ai_tester"),
             )
-        else:
-            sim_prompt = (
-                f"You are {persona}. The support bot replied:\n```\n{last_bot}\n```\n"
-                "Continue the conversation with one short realistic message. "
-                "Answer with the message only."
-            )
-        user_msg = (await simulator.prompt(sim_prompt)).result
-        transcript.append({"role": "user", "text": user_msg})
-        answer = await process_message(args.bot_codename, user_msg, chat_id, platform)
-        last_bot = answer.text if answer else "(no answer)"
-        transcript.append({"role": "assistant", "text": last_bot})
-    return transcript
+            try:
+                async with InstanceLockAsync(instance):
+                    answer = await bot.handle_update(update)
+            except Exception as e:
+                logger.exception("bot crashed on tester update")
+                dialog_log.append(
+                    {"role": "assistant", "text": f"{CRASH_MARKER} {type(e).__name__}: {e}"}
+                )
+                answer = None
+            if answer is not None:
+                _log_answer(dialog_log, answer)
+                await bot.on_answer_sent(answer)
+
+            if turn >= 2:
+                # a separate control model guesses whether a real user would
+                # keep going; unclear verdicts end the dialog
+                try:
+                    verdict = await repeat_until(
+                        control.get_response,
+                        messages=_swapped_history(dialog_log)
+                        + [
+                            AIMessage(
+                                role="system",
+                                content=(
+                                    "Given this conversation, would the user keep "
+                                    'talking?  Answer exactly "continue" or "end".'
+                                ),
+                            )
+                        ],
+                        max_tokens=10,
+                        condition=lambda r: str(r.result).strip().lower()
+                        in ("continue", "end"),
+                        max_attempts=3,
+                    )
+                except RepeatUntilError:
+                    break
+                if "end" in str(verdict.result).strip().lower():
+                    break
+    finally:
+        # simulated conversations must not pollute the production tables —
+        # remove the dialog (messages cascade) and the synthetic user/instance
+        dialog.delete()
+        user_row = instance.user
+        instance.delete()
+        if user_row is not None:
+            user_row.delete()
+    return dialog_log
 
 
 async def _run(args) -> int:
     from ..conf import settings
 
     model = args.model or settings.DIALOG_FAST_AI_MODEL
-    with open(args.out, "a", encoding="utf-8") as f:
-        for i in range(args.dialogs):
-            persona = random.choice(PERSONAS)
-            print(f"dialog {i + 1}/{args.dialogs} (persona: {persona})")
-            transcript = await _simulate_dialog(args, model, persona)
-            f.write(json.dumps({"ts": time.time(), "transcript": transcript}, ensure_ascii=False) + "\n")
-    print(f"saved {args.dialogs} dialogs to {args.out}")
+    rng = random.Random(args.seed) if args.seed is not None else random
+    os.makedirs(args.out, exist_ok=True)
+    for i in range(args.dialogs):
+        persona = generate_persona(rng)
+        print(f"dialog {i + 1}/{args.dialogs}")
+        transcript = await _simulate_dialog(args, model, persona)
+        path = os.path.join(args.out, f"dialog_{i + 1}.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(transcript, f, ensure_ascii=False, indent=2)
+        print(f"  saved to {path}")
     return 0
 
 
+def _analysis_prompt(dialog_text: str) -> List[AIMessage]:
+    return [
+        AIMessage(
+            role="system",
+            content=(
+                "You are a chatbot QA expert reviewing one conversation.\n"
+                "Identify deficiencies on the bot's side only:\n"
+                "- language problems (grammar, formatting, awkward phrasing);\n"
+                "- context problems (misunderstood question, irrelevant or wrong answer);\n"
+                "- tone problems (unnatural, rude, or mismatched formality);\n"
+                "- missed chances to offer a useful next step.\n"
+                "Classify each as a warning (cosmetic) or an error (harmed the "
+                "user's goal), quoting the offending line where possible.  Empty "
+                "lists are a valid verdict for a clean dialog.\n"
+                'Technical notes: "/start" just opens the conversation; lines '
+                f'starting with "{CRASH_MARKER}" are engine crashes counted '
+                "separately — do not list them.\n"
+                "Conversation:\n"
+                f"{dialog_text}\n"
+                "Answer with JSON exactly matching:\n"
+                '```json\n{"warnings": ["..."], "errors": ["..."]}\n```\n'
+            ),
+        )
+    ]
+
+
+def _valid_verdict(resp) -> bool:
+    r = resp.result
+    return (
+        isinstance(r, dict)
+        and isinstance(r.get("warnings", []), list)
+        and isinstance(r.get("errors", []), list)
+    )
+
+
 async def _analyze(args) -> int:
-    from ..ai.dialog import AIDialog
     from ..conf import settings
 
     model = args.model or settings.DIALOG_FAST_AI_MODEL
     analyzer = AIDialog(model)
-    dialogs = []
-    with open(args.out, encoding="utf-8") as f:
-        for line in f:
-            if line.strip():
-                dialogs.append(json.loads(line))
-    if not dialogs:
-        print("no dialogs to analyze")
+
+    try:
+        names = sorted(
+            (
+                f
+                for f in os.listdir(args.out)
+                if f.startswith("dialog_") and f.endswith(".json")
+            ),
+            key=lambda f: int(f.split("_")[1].split(".")[0]),
+        )
+    except FileNotFoundError:
+        names = []
+    if not names:
+        print(f"no dialogs to analyze in {args.out!r}")
         return 1
 
-    verdicts = []
-    for i, d in enumerate(dialogs):
-        rendered = "\n".join(
-            f"{m.get('role', 'meta')}: {m.get('text', m.get('persona', ''))}"
-            for m in d["transcript"]
-        )
-        resp = await analyzer.prompt(
-            "You are a QA analyst reviewing a support-bot dialog:\n"
-            f"```\n{rendered}\n```\n"
-            "Rate the bot's performance and answer with JSON matching:\n"
-            "```json\n"
-            '{"score": 7, "issues": ["..."], "suggestion": "..."}\n'
-            "```\n",
-            json_format=True,
-        )
-        verdict = resp.result if isinstance(resp.result, dict) else {}
-        verdicts.append(verdict)
-        print(f"dialog {i + 1}: score={verdict.get('score')} issues={verdict.get('issues')}")
+    results = []
+    for name in names:
+        with open(os.path.join(args.out, name), encoding="utf-8") as f:
+            dialog_log = json.load(f)
+        lines = []
+        for entry in dialog_log:
+            if entry.get("role") == "user":
+                lines.append(f"User: {entry['text']}")
+            elif entry.get("role") == "assistant":
+                lines.append(f"Bot: {entry['text']}")
+        dialog_text = "\n".join(lines)
+        record = {
+            "dialog_file": name,
+            "warnings": [],
+            "errors": [],
+            "crashes": dialog_text.count(CRASH_MARKER),
+        }
+        try:
+            verdict = await repeat_until(
+                analyzer.get_response,
+                messages=_analysis_prompt(dialog_text),
+                max_tokens=1024,
+                json_format=True,
+                condition=_valid_verdict,
+            )
+        except RepeatUntilError:
+            # one stubborn dialog must not abort the run and lose the rest
+            logger.warning("analyzer verdict never validated for %s", name)
+            record["analysis_failed"] = True
+        else:
+            record["warnings"] = verdict.result.get("warnings") or []
+            record["errors"] = verdict.result.get("errors") or []
+        results.append(record)
 
-    scores = [v.get("score") for v in verdicts if isinstance(v.get("score"), (int, float))]
-    if scores:
-        print(f"\naverage score: {sum(scores) / len(scores):.2f} over {len(scores)} dialogs")
-    suggestions = [v.get("suggestion") for v in verdicts if v.get("suggestion")]
-    if suggestions:
-        print("improvement suggestions (by frequency):")
-        for s in suggestions:
-            print(f"- {s}")
+    out_path = os.path.join(args.out, "analysis_results.jsonl")
+    with open(out_path, "w", encoding="utf-8") as f:
+        for r in results:
+            f.write(json.dumps(r, ensure_ascii=False) + "\n")
+
+    print("Analysis results:")
+    for r in results:
+        print(f"\nDialog {r['dialog_file']}:")
+        if not (r["warnings"] or r["errors"] or r["crashes"]):
+            print("  OK")
+        for w in r["warnings"]:
+            print(f"  warning: {w}")
+        for e in r["errors"]:
+            print(f"  error: {e}")
+        if r["crashes"]:
+            print(f"  {r['crashes']} crashes")
+
+    all_warnings = [w for r in results for w in r["warnings"]]
+    all_errors = [e for r in results for e in r["errors"]]
+    total_crashes = sum(r["crashes"] for r in results)
+    print(
+        f"\nTotals: {len(all_warnings)} warnings, {len(all_errors)} errors, "
+        f"{total_crashes} crashes over {len(results)} dialogs"
+    )
+
+    if all_warnings or all_errors or total_crashes:
+        prompt = (
+            f"Across {len(results)} reviewed bot conversations, QA flagged:\n"
+            "Warnings:\n" + "\n".join(f"- {w}" for w in all_warnings) + "\n"
+            "Errors:\n" + "\n".join(f"- {e}" for e in all_errors) + "\n"
+        )
+        if total_crashes:
+            prompt += (
+                f"Plus {total_crashes} engine crashes — crashes outrank "
+                "everything else.\n"
+            )
+        prompt += (
+            "Pick the ONE improvement to make first, weighing how many users it "
+            "reaches, how much it improves their outcome, how confident you are, "
+            "and how hard it is to build (RICE-style, but answer informally — "
+            "don't mention the framework).  Describe the improvement concretely."
+        )
+        improvement = await AIDialog(model).prompt(prompt, role="system", max_tokens=500)
+        print("\nProposed improvement:")
+        print(str(improvement.result).strip())
+    else:
+        print("\nNo deficiencies found. The bot is performing correctly.")
     return 0
 
 

@@ -1,0 +1,146 @@
+"""Tester harness: randomized personas, simulated dialogs, QA analysis + RICE
+report (reference: assistant/bot/management/commands/tester.py:43-453)."""
+
+import argparse
+import json
+import random
+
+import pytest
+
+from django_assistant_bot_tpu.ai.domain import AIResponse
+from django_assistant_bot_tpu.bot.assistant_bot import AssistantBot
+from django_assistant_bot_tpu.bot.domain import SingleAnswer
+from django_assistant_bot_tpu.cli import tester
+
+
+def test_generate_persona_randomized_and_reproducible():
+    a = tester.generate_persona(random.Random(1))
+    b = tester.generate_persona(random.Random(2))
+    a2 = tester.generate_persona(random.Random(1))
+    assert a == a2  # seeded -> reproducible
+    assert a != b  # different seeds -> different profiles
+    for dim in tester.TRAITS:
+        assert f"- {dim}: " in a
+
+
+class FakeAIDialog:
+    """Stands in for simulator/control/analyzer/improvement models."""
+
+    def __init__(self, model):
+        self.model = model
+
+    async def get_response(self, messages, max_tokens=1024, json_format=False):
+        if json_format:  # analyzer verdict
+            return AIResponse(
+                result={"warnings": ["greeting is stiff"], "errors": []},
+                usage={"model": self.model},
+            )
+        system = next((m["content"] for m in messages if m["role"] == "system"), "")
+        if '"continue" or "end"' in system:  # control decision
+            return AIResponse(result="end", usage={"model": self.model})
+        return AIResponse(result="what can you do?", usage={"model": self.model})
+
+    async def prompt(self, context, role="user", **kwargs):  # improvement model
+        return AIResponse(result="Soften the greeting text.", usage={"model": self.model})
+
+
+def _args(out, mode="run", dialogs=2, turns=6):
+    return argparse.Namespace(
+        bot_codename="tester-bot",
+        mode=mode,
+        dialogs=dialogs,
+        turns=turns,
+        model="test",
+        out=str(out),
+        seed=7,
+    )
+
+
+@pytest.fixture()
+def patched(tmp_db, monkeypatch):
+    async def fake_answer(self, messages, debug_info, do_interrupt):
+        return SingleAnswer(text="bot reply", usage=[{"model": "test"}])
+
+    monkeypatch.setattr(AssistantBot, "get_answer_to_messages", fake_answer)
+    monkeypatch.setattr(tester, "AIDialog", FakeAIDialog)
+
+
+def test_run_and_analyze_end_to_end(patched, tmp_path, capsys):
+    out = tmp_path / "td"
+    assert tester.run(_args(out)) == 0
+    files = sorted(p.name for p in out.glob("dialog_*.json"))
+    assert files == ["dialog_1.json", "dialog_2.json"]
+    log = json.loads((out / "dialog_1.json").read_text())
+    assert "persona" in log[0]
+    user_turns = [e for e in log if e.get("role") == "user"]
+    assert user_turns[0]["text"] == "/start"
+    assert len(user_turns) >= 3  # control fires from turn 3, then says "end"
+    assert any(e.get("role") == "assistant" for e in log)
+    # personas differ between the two dialogs
+    other = json.loads((out / "dialog_2.json").read_text())
+    assert log[0]["persona"] != other[0]["persona"]
+    # simulated dialogs are cleaned up (reference deletes them too), including
+    # the synthetic user/instance rows
+    from django_assistant_bot_tpu.storage import models
+
+    assert models.Dialog.objects.count() == 0
+    assert models.Instance.objects.count() == 0
+    assert models.BotUser.objects.count() == 0
+
+    assert tester.run(_args(out, mode="analyze")) == 0
+    captured = capsys.readouterr().out
+    assert "greeting is stiff" in captured
+    assert "Proposed improvement:" in captured
+    assert "Soften the greeting text." in captured
+    lines = (out / "analysis_results.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 2
+    rec = json.loads(lines[0])
+    assert rec["warnings"] == ["greeting is stiff"]
+    assert rec["crashes"] == 0
+
+
+def test_crashes_are_captured_and_counted(patched, tmp_path, monkeypatch, capsys):
+    async def boom(self, update):
+        raise RuntimeError("engine exploded")
+
+    monkeypatch.setattr(AssistantBot, "handle_update", boom)
+    out = tmp_path / "td"
+    assert tester.run(_args(out, dialogs=1, turns=4)) == 0
+    log = json.loads((out / "dialog_1.json").read_text())
+    crash_entries = [
+        e for e in log if e.get("role") == "assistant" and tester.CRASH_MARKER in e["text"]
+    ]
+    assert crash_entries  # crash captured, dialog not aborted
+
+    class CleanAnalyzer(FakeAIDialog):
+        async def get_response(self, messages, max_tokens=1024, json_format=False):
+            if json_format:
+                return AIResponse(result={"warnings": [], "errors": []}, usage={})
+            return await super().get_response(messages, max_tokens, json_format)
+
+    monkeypatch.setattr(tester, "AIDialog", CleanAnalyzer)
+    assert tester.run(_args(out, mode="analyze")) == 0
+    rec = json.loads(
+        (out / "analysis_results.jsonl").read_text().strip().splitlines()[0]
+    )
+    assert rec["crashes"] >= 1
+    assert "crashes" in capsys.readouterr().out
+
+
+def test_analyze_survives_stubborn_analyzer(patched, tmp_path, monkeypatch, capsys):
+    """A dialog whose verdict never validates is recorded as failed; the run
+    still completes and writes the other results."""
+    out = tmp_path / "td"
+    assert tester.run(_args(out, dialogs=2, turns=4)) == 0
+
+    class BadAnalyzer(FakeAIDialog):
+        async def get_response(self, messages, max_tokens=1024, json_format=False):
+            if json_format:
+                return AIResponse(result="not json at all", usage={})
+            return await super().get_response(messages, max_tokens, json_format)
+
+    monkeypatch.setattr(tester, "AIDialog", BadAnalyzer)
+    assert tester.run(_args(out, mode="analyze")) == 0
+    lines = (out / "analysis_results.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 2
+    assert all(json.loads(l)["analysis_failed"] for l in lines)
