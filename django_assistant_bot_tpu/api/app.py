@@ -119,7 +119,12 @@ async def auth_middleware(request: web.Request, handler):
                 )
         return await handler(request)
     token = getattr(settings, "API_AUTH_TOKEN", None)
-    exempt = request.path.startswith("/telegram/") or request.path == "/healthz"
+    # docs are public like the reference's AllowAny schema view (urls.py:33-64)
+    exempt = (
+        request.path.startswith("/telegram/")
+        or request.path == "/healthz"
+        or request.path in ("/api/docs", "/api/openapi.json")
+    )
     if token and not exempt:
         got = request.headers.get("Authorization", "")
         if not hmac.compare_digest(got.encode(), f"Token {token}".encode()):
@@ -318,6 +323,8 @@ def create_api_app() -> web.Application:
     app.router.add_get("/healthz", healthz)
 
     from .admin import register_admin
+    from .docs import register_docs
 
     register_admin(app)
+    register_docs(app)
     return app

@@ -1,0 +1,273 @@
+"""OpenAPI schema + docs UI — the drf-yasg swagger/redoc analog
+(reference: assistant/assistant/urls.py:33-64, public swagger + redoc views).
+
+``build_openapi(app)`` walks the live aiohttp route table, so the spec can
+never drift from the registered handlers; per-route summaries/schemas come
+from the ``ROUTE_META`` table below.  ``GET /api/openapi.json`` serves the
+spec and ``GET /api/docs`` renders it with a small self-contained HTML page
+(no CDN assets — deployments may be egress-less), both auth-exempt like the
+reference's ``permission_classes=[AllowAny]`` schema view.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+from typing import Optional
+
+from aiohttp import web
+
+_PAGINATED = {
+    "type": "object",
+    "properties": {
+        "count": {"type": "integer"},
+        "page": {"type": "integer"},
+        "results": {"type": "array", "items": {"type": "object"}},
+    },
+}
+
+# (method, path) -> metadata; paths use aiohttp's {param} syntax, which is
+# already OpenAPI-compatible.
+ROUTE_META = {
+    ("POST", "/telegram/{codename}/"): {
+        "tags": ["webhook"],
+        "summary": "Telegram webhook: persist the user message and enqueue the answer task",
+        "requestBody": {"type": "object", "description": "Telegram Update payload"},
+        "responses": {"200": "acknowledged", "403": "bad secret token", "404": "bot not found"},
+        "security": [],
+    },
+    ("GET", "/api/v1/bots/"): {
+        "tags": ["bots"],
+        "summary": "List bots (paginated)",
+        "responses": {"200": _PAGINATED},
+    },
+    ("GET", "/api/v1/bots/{codename}/"): {
+        "tags": ["bots"],
+        "summary": "Get one bot by codename",
+        "responses": {"200": "bot", "404": "not found"},
+    },
+    ("GET", "/api/v1/dialogs/"): {
+        "tags": ["dialogs"],
+        "summary": "List dialogs, optionally filtered by ?instance=",
+        "responses": {"200": _PAGINATED},
+    },
+    ("POST", "/api/v1/dialogs/"): {
+        "tags": ["dialogs"],
+        "summary": "Create a dialog for an instance",
+        "requestBody": {
+            "type": "object",
+            "properties": {"instance_id": {"type": "integer"}, "state": {"type": "object"}},
+            "required": ["instance_id"],
+        },
+        "responses": {"201": "created", "400": "instance not found"},
+    },
+    ("GET", "/api/v1/dialogs/{id}/"): {
+        "tags": ["dialogs"],
+        "summary": "Get one dialog",
+        "responses": {"200": "dialog", "404": "not found"},
+    },
+    ("DELETE", "/api/v1/dialogs/{id}/"): {
+        "tags": ["dialogs"],
+        "summary": "Delete a dialog",
+        "responses": {"204": "deleted", "404": "not found"},
+    },
+    ("GET", "/api/v1/dialogs/{id}/messages/"): {
+        "tags": ["messages"],
+        "summary": "List a dialog's messages",
+        "responses": {"200": _PAGINATED, "404": "not found"},
+    },
+    ("POST", "/api/v1/dialogs/{id}/messages/"): {
+        "tags": ["messages"],
+        "summary": "Send a message and run the bot synchronously; returns the answers",
+        "requestBody": {
+            "type": "object",
+            "properties": {"text": {"type": "string"}, "message_id": {"type": "integer"}},
+            "required": ["text"],
+        },
+        "responses": {"201": "user message + assistant answers", "404": "not found"},
+    },
+    ("GET", "/api/v1/wiki/"): {
+        "tags": ["wiki"],
+        "summary": "List wiki documents, optionally filtered by ?bot=",
+        "responses": {"200": _PAGINATED},
+    },
+    ("POST", "/api/v1/wiki/"): {
+        "tags": ["wiki"],
+        "summary": "Create a wiki document (triggers ingestion via post_save)",
+        "requestBody": {
+            "type": "object",
+            "properties": {
+                "bot": {"type": "string"},
+                "parent_id": {"type": "integer"},
+                "title": {"type": "string"},
+                "description": {"type": "string"},
+                "content": {"type": "string"},
+                "url": {"type": "string"},
+            },
+        },
+        "responses": {"201": "created", "400": "bot not found"},
+    },
+    ("POST", "/api/v1/wiki/bulk/"): {
+        "tags": ["wiki"],
+        "summary": "Bulk-create wiki documents",
+        "requestBody": {"type": "array", "items": {"type": "object"}},
+        "responses": {"201": "created list"},
+    },
+    ("GET", "/healthz"): {
+        "tags": ["meta"],
+        "summary": "Liveness probe",
+        "responses": {"200": "ok"},
+        "security": [],
+    },
+}
+
+
+def _response_obj(spec) -> dict:
+    if isinstance(spec, dict):
+        return {
+            "description": spec.get("description", "response"),
+            "content": {"application/json": {"schema": spec}},
+        }
+    return {"description": str(spec)}
+
+
+def build_openapi(app: web.Application) -> dict:
+    paths: dict = {}
+    for route in app.router.routes():
+        method = route.method.upper()
+        if method in ("HEAD", "OPTIONS"):
+            continue
+        resource = route.resource
+        if resource is None:
+            continue
+        path = resource.canonical
+        if path.startswith("/admin") or path.startswith("/api/docs") or path.startswith(
+            "/api/openapi"
+        ):
+            continue  # the HTML admin and the docs themselves stay out of the spec
+        meta = ROUTE_META.get((method, path), {})
+        op: dict = {
+            "summary": meta.get("summary", (route.handler.__doc__ or "").strip().split("\n")[0]),
+            "tags": meta.get("tags", ["api"]),
+            "responses": {
+                str(code): _response_obj(spec)
+                for code, spec in meta.get("responses", {"200": "response"}).items()
+            },
+        }
+        params = [
+            {
+                "name": name,
+                "in": "path",
+                "required": True,
+                "schema": {"type": "string"},
+            }
+            for name in _path_params(path)
+        ]
+        if params:
+            op["parameters"] = params
+        body = meta.get("requestBody")
+        if body:
+            op["requestBody"] = {
+                "required": True,
+                "content": {"application/json": {"schema": body}},
+            }
+        if "security" in meta:
+            op["security"] = meta["security"]
+        paths.setdefault(path, {})[method.lower()] = op
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "Assistant API",
+            "version": "v1",
+            "description": "API documentation for the TPU assistant framework",
+        },
+        "components": {
+            "securitySchemes": {
+                "tokenAuth": {
+                    "type": "apiKey",
+                    "in": "header",
+                    "name": "Authorization",
+                    "description": 'Format: "Token <value>"',
+                }
+            }
+        },
+        "security": [{"tokenAuth": []}],
+        "paths": paths,
+    }
+
+
+def _path_params(path: str) -> list:
+    out, i = [], 0
+    while True:
+        i = path.find("{", i)
+        if i < 0:
+            return out
+        j = path.find("}", i)
+        out.append(path[i + 1 : j])
+        i = j
+
+
+_DOCS_CSS = """
+ body { font-family: system-ui, sans-serif; margin: 2rem auto; max-width: 60rem; color: #222; }
+ h1 { border-bottom: 2px solid #eee; padding-bottom: .4rem; }
+ .op { border: 1px solid #ddd; border-radius: 6px; margin: .6rem 0; padding: .5rem .8rem; }
+ .m { display: inline-block; min-width: 4.5rem; font-weight: 700; }
+ .GET { color: #0a7; } .POST { color: #06c; } .DELETE { color: #c33; } .PUT, .PATCH { color: #a60; }
+ .path { font-family: ui-monospace, monospace; }
+ .tag { margin-top: 1.4rem; text-transform: capitalize; }
+ pre { background: #f6f6f6; padding: .6rem; border-radius: 4px; overflow-x: auto; }
+ .resp { color: #555; font-size: .9rem; }
+"""
+
+
+def render_docs_html(spec: dict) -> str:
+    """Self-contained endpoint browser over the OpenAPI spec (no CDN assets)."""
+    by_tag: dict = {}
+    for path, ops in sorted(spec["paths"].items()):
+        for method, op in ops.items():
+            by_tag.setdefault(op.get("tags", ["api"])[0], []).append((method, path, op))
+    sections = []
+    for tag, ops in sorted(by_tag.items()):
+        rows = []
+        for method, path, op in ops:
+            m = method.upper()
+            resp = ", ".join(
+                f"{code}: {r.get('description', '')}" for code, r in op.get("responses", {}).items()
+            )
+            body = op.get("requestBody", {}).get("content", {}).get("application/json", {}).get("schema")
+            body_html = (
+                f"<pre>{html.escape(json.dumps(body, indent=2))}</pre>" if body else ""
+            )
+            rows.append(
+                f"<div class='op'><span class='m {m}'>{m}</span>"
+                f"<span class='path'>{html.escape(path)}</span>"
+                f"<div>{html.escape(op.get('summary') or '')}</div>"
+                f"{body_html}<div class='resp'>{html.escape(resp)}</div></div>"
+            )
+        sections.append(f"<h2 class='tag'>{html.escape(tag)}</h2>" + "".join(rows))
+    info = spec["info"]
+    return (
+        f"<html><head><title>{html.escape(info['title'])}</title>"
+        f"<style>{_DOCS_CSS}</style></head><body>"
+        f"<h1>{html.escape(info['title'])} <small>{html.escape(info['version'])}</small></h1>"
+        f"<p>{html.escape(info.get('description', ''))} &mdash; "
+        "<a href='/api/openapi.json'>openapi.json</a></p>" + "".join(sections) + "</body></html>"
+    )
+
+
+def register_docs(app: web.Application) -> None:
+    cache: dict = {}
+
+    def _spec() -> dict:
+        if "spec" not in cache:  # routes are frozen once the app is running
+            cache["spec"] = build_openapi(app)
+        return cache["spec"]
+
+    async def openapi_json(request: web.Request) -> web.Response:
+        return web.json_response(_spec())
+
+    async def docs(request: web.Request) -> web.Response:
+        return web.Response(text=render_docs_html(_spec()), content_type="text/html")
+
+    app.router.add_get("/api/openapi.json", openapi_json)
+    app.router.add_get("/api/docs", docs)

@@ -227,6 +227,34 @@ def test_admin_pages_render(seeded):
     body()
 
 
+def test_openapi_docs(seeded):
+    @with_client
+    async def body(client):
+        resp = await client.get("/api/openapi.json")
+        assert resp.status == 200
+        spec = await resp.json()
+        assert spec["openapi"].startswith("3.")
+        # every REST route registered on the app appears in the spec
+        for method, path in [
+            ("post", "/telegram/{codename}/"),
+            ("get", "/api/v1/bots/"),
+            ("post", "/api/v1/dialogs/{id}/messages/"),
+            ("post", "/api/v1/wiki/bulk/"),
+        ]:
+            assert method in spec["paths"][path], (method, path)
+        assert "/admin/" not in spec["paths"]
+        # docs page renders and is public even with an API token configured
+        with settings.override(API_AUTH_TOKEN="sekret"):
+            resp = await client.get("/api/docs")
+            assert resp.status == 200
+            text = await resp.text()
+            assert "/api/v1/dialogs/" in text and "openapi.json" in text
+            resp = await client.get("/api/openapi.json")
+            assert resp.status == 200
+
+    body()
+
+
 def test_admin_basic_auth_enforced(seeded):
     import base64
 
