@@ -159,7 +159,7 @@ def _build_gen_engine(cfg=None):
         cfg,
         params,
         ByteTokenizer(),
-        max_slots=8,
+        max_slots=16,  # match the bench concurrency: every request decodes in one wave
         max_seq_len=min(1024, cfg.max_seq_len),
         prefill_buckets=(128, 512),
         chunk_size=512,

@@ -103,15 +103,21 @@ class _Slot:
 
 @dataclasses.dataclass
 class _TickRef:
-    """One issued-but-not-yet-processed decode tick.
+    """One issued-but-not-yet-processed device result.
 
     ``slots`` records (slot, epoch) for every slot that was live at issue time;
     processing skips entries whose slot epoch has moved on (request finished by an
     earlier tick — its later speculative tokens are garbage and are dropped).
+
+    ``first=True`` marks an activation: ``nxt`` is the [1] first sampled token of
+    a freshly-prefilled slot (kept on device so admission never blocks on a host
+    round trip); FIFO order in the inflight deque guarantees it is appended
+    before any burst tokens of the same slot.
     """
 
-    nxt: Any  # device array [max_slots] of sampled token ids
+    nxt: Any  # device array: [burst, max_slots] sampled ids, or [1] when first
     slots: List[tuple]
+    first: bool = False
 
 
 @dataclasses.dataclass
@@ -142,7 +148,8 @@ class GenerationEngine:
         prefill_buckets: Sequence[int] = PREFILL_BUCKETS,
         idle_poll_s: float = 0.002,
         chunk_size: int = 512,
-        lookahead: int = 8,
+        lookahead: int = 3,
+        burst: int = 8,
         mesh=None,
     ):
         self.cfg = cfg
@@ -167,6 +174,15 @@ class GenerationEngine:
         # removes a blocking sync per token.  Cost: up to `lookahead` speculative
         # ticks per finished request (their tokens are dropped via slot epochs).
         self.lookahead = max(0, int(lookahead))
+        # Burst decode: one jit call advances every live slot `burst` tokens via
+        # a lax.scan over decode steps, so the per-dispatch overhead (the decode
+        # bottleneck once ticks are pipelined — each dispatch is an RPC under a
+        # remote-device tunnel and a host round trip locally) is amortised over
+        # `burst` tokens.  Cost: finished slots decode garbage for the rest of
+        # their burst (dropped via slot epochs), and admission waits for the
+        # burst in flight — bounded by burst * per-step time, same order as a
+        # prefill chunk.
+        self.burst = max(1, int(burst))
         # Mesh-scoped serving (TP/DP): the KV cache shards over the mesh (kv_heads →
         # `model`, slots → `data` — llama.CACHE_AXES) and every device step is jit'd
         # with explicit cache out_shardings so donation updates shards in place.
@@ -208,19 +224,37 @@ class GenerationEngine:
 
         cfg_c = cfg
         top_k_c = top_k
+        burst_c = self.burst
 
         def _decode_tick(params, tokens, cache, active, temps, top_ps, rng):
-            logits, cache = llama.decode_step(params, cfg_c, tokens, cache, active=active)
-            nxt = sample_logits(
-                logits, rng, temperature=temps, top_k=top_k_c, top_p=top_ps
+            """`burst` chained decode steps in one dispatch -> (toks [K,B], cache)."""
+
+            def body(carry, _):
+                tokens, cache, rng = carry
+                rng, sub = jax.random.split(rng)
+                logits, cache = llama.decode_step(
+                    params, cfg_c, tokens, cache, active=active
+                )
+                nxt = sample_logits(
+                    logits, sub, temperature=temps, top_k=top_k_c, top_p=top_ps
+                )
+                return (nxt, cache, rng), nxt
+
+            (tokens, cache, _), toks = jax.lax.scan(
+                body, (tokens, cache, rng), None, length=burst_c
             )
-            return nxt, cache
+            return toks, tokens, cache
 
         if mesh is not None:
-            tick_out = (_replicated(mesh), self._cache_shardings)
+            tick_out = (
+                _replicated(mesh),
+                _replicated(mesh),
+                self._cache_shardings,
+            )
             insert_out = self._cache_shardings
+            chunk_out = (_replicated(mesh), self._cache_shardings)
         else:
-            tick_out = insert_out = None
+            tick_out = insert_out = chunk_out = None
         # donate the cache (argnum 2) — in-place HBM update, no copy
         self._decode_tick = jax.jit(
             _decode_tick, donate_argnums=(2,), out_shardings=tick_out
@@ -239,7 +273,7 @@ class GenerationEngine:
             return llama.prefill_chunk(params, cfg_c, ids, cache, slot, start, valid)
 
         self._prefill_chunk = jax.jit(
-            _prefill_chunk, donate_argnums=(2,), out_shardings=tick_out
+            _prefill_chunk, donate_argnums=(2,), out_shardings=chunk_out
         )
 
     def _ensure_fsm(self):
@@ -265,21 +299,36 @@ class GenerationEngine:
         self._fsm_next_dev = jax.device_put(nxt, rep)
         self._fsm_init_row_dev = jax.device_put(allowed[fsm.initial], rep)
 
-        cfg_c, top_k_c = self.cfg, self.top_k
+        cfg_c, top_k_c, burst_c = self.cfg, self.top_k, self.burst
 
         def _tick_json(params, tokens, cache, active, temps, top_ps, rng, fsm_s, jmask, next_tab, allowed_tab):
-            logits, cache = llama.decode_step(params, cfg_c, tokens, cache, active=active)
-            ok = allowed_tab[fsm_s]  # [B, V]
-            logits = jnp.where(jmask[:, None] & ~ok, NEG_INF, logits)
-            nxt_tok = sample_logits(
-                logits, rng, temperature=temps, top_k=top_k_c, top_p=top_ps
+            def body(carry, _):
+                tokens, cache, rng, fsm_s = carry
+                rng, sub = jax.random.split(rng)
+                logits, cache = llama.decode_step(
+                    params, cfg_c, tokens, cache, active=active
+                )
+                ok = allowed_tab[fsm_s]  # [B, V]
+                logits = jnp.where(jmask[:, None] & ~ok, NEG_INF, logits)
+                nxt_tok = sample_logits(
+                    logits, sub, temperature=temps, top_k=top_k_c, top_p=top_ps
+                )
+                safe = jnp.minimum(nxt_tok, next_tab.shape[1] - 1)
+                fsm_s = jnp.where(jmask, next_tab[fsm_s, safe], fsm_s)
+                return (nxt_tok, cache, rng, fsm_s), nxt_tok
+
+            (tokens, cache, _, fsm_s), toks = jax.lax.scan(
+                body, (tokens, cache, rng, fsm_s), None, length=burst_c
             )
-            safe = jnp.minimum(nxt_tok, next_tab.shape[1] - 1)
-            fsm_s = jnp.where(jmask, next_tab[fsm_s, safe], fsm_s)
-            return nxt_tok, cache, fsm_s
+            return toks, tokens, cache, fsm_s
 
         if self.mesh is not None:
-            out = (_replicated(self.mesh), self._cache_shardings, _replicated(self.mesh))
+            out = (
+                _replicated(self.mesh),
+                _replicated(self.mesh),
+                self._cache_shardings,
+                _replicated(self.mesh),
+            )
         else:
             out = None
         self._decode_tick_json = jax.jit(_tick_json, donate_argnums=(2,), out_shardings=out)
@@ -526,7 +575,11 @@ class GenerationEngine:
             self._starting = None
 
     def _activate(self, slot: int, req: _Request, logits):
-        """Sample the first token from prefill logits and make the slot live."""
+        """Sample the first token from prefill logits and make the slot live.
+
+        Fully asynchronous: the token stays on device (chained into the decode
+        token array and, for JSON, the FSM state) and its host value arrives
+        through the inflight pipeline — admission never pays a device sync."""
         if req.json:
             self._ensure_fsm()
             logits = self._mask_prefill_logits(logits)
@@ -538,25 +591,25 @@ class GenerationEngine:
             top_k=self.top_k,
             top_p=jnp.asarray([req.top_p], jnp.float32),
         )
-        tok = int(first[0])
-        req.first_token_at = time.monotonic()
         s = _Slot(request=req)
-        s.generated.append(tok)
         self._slots[slot] = s
-        self._tokens_dev = self._tokens_dev.at[slot].set(tok)
+        self._tokens_dev = self._tokens_dev.at[slot].set(first[0])
         self._temps[slot] = req.temperature
         self._top_ps[slot] = req.top_p
         self._json[slot] = req.json
         if req.json:
-            state = int(
-                self._fsm_next_np[
-                    self._fsm.initial, min(tok, self._fsm_next_np.shape[1] - 1)
-                ]
+            safe = jnp.minimum(first[0], self._fsm_next_dev.shape[1] - 1)
+            self._fsm_states_dev = self._fsm_states_dev.at[slot].set(
+                self._fsm_next_dev[self._fsm.initial, safe]
             )
-            self._fsm_states_dev = self._fsm_states_dev.at[slot].set(state)
         self._sampling_dirty = True
-        if self._should_finish(slot, tok):
-            self._finish(slot)
+        try:
+            first.copy_to_host_async()
+        except AttributeError:
+            pass
+        self._inflight.append(
+            _TickRef(nxt=first, slots=[(slot, self._slot_epoch[slot])], first=True)
+        )
 
     def _refresh_sampling(self):
         if self._sampling_dirty:
@@ -574,7 +627,7 @@ class GenerationEngine:
         self._refresh_sampling()
         with self._mesh_scope():
             if self._json.any():
-                nxt, self._cache, self._fsm_states_dev = self._decode_tick_json(
+                toks, last, self._cache, self._fsm_states_dev = self._decode_tick_json(
                     self.params,
                     self._tokens_dev,
                     self._cache,
@@ -588,7 +641,7 @@ class GenerationEngine:
                     self._fsm_allowed_dev,
                 )
             else:
-                nxt, self._cache = self._decode_tick(
+                toks, last, self._cache = self._decode_tick(
                     self.params,
                     self._tokens_dev,
                     self._cache,
@@ -598,28 +651,42 @@ class GenerationEngine:
                     sub,
                 )
         try:
-            nxt.copy_to_host_async()
+            toks.copy_to_host_async()
         except AttributeError:  # backend without async host copies
             pass
-        self._tokens_dev = nxt
-        self.steps += 1
+        self._tokens_dev = last
+        self.steps += self.burst
         live = [
             (i, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None
         ]
-        self._inflight.append(_TickRef(nxt=nxt, slots=live))
+        self._inflight.append(_TickRef(nxt=toks, slots=live))
 
     def _process_tick(self):
-        """Consume the oldest in-flight tick (blocks until its result arrives)."""
+        """Consume the oldest in-flight result (blocks until it arrives)."""
         ref = self._inflight.popleft()
         vals = np.asarray(ref.nxt)
-        for slot, epoch in ref.slots:
+        if ref.first:
+            (slot, epoch) = ref.slots[0]
             s = self._slots[slot]
             if s is None or self._slot_epoch[slot] != epoch:
-                continue  # finished by an earlier tick; speculative token dropped
-            tok = int(vals[slot])
+                return
+            tok = int(vals[0])
+            s.request.first_token_at = time.monotonic()
             s.generated.append(tok)
             if self._should_finish(slot, tok):
                 self._finish(slot)
+            return
+        for k in range(vals.shape[0]):  # burst steps, oldest first
+            for slot, epoch in ref.slots:
+                s = self._slots[slot]
+                if s is None or self._slot_epoch[slot] != epoch:
+                    continue  # finished by an earlier token; speculation dropped
+                tok = int(vals[k, slot])
+                s.generated.append(tok)
+                if s.request.first_token_at is None:
+                    s.request.first_token_at = time.monotonic()
+                if self._should_finish(slot, tok):
+                    self._finish(slot)
 
     def _should_finish(self, slot: int, tok: int) -> bool:
         s = self._slots[slot]

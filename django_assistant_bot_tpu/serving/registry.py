@@ -29,7 +29,10 @@ class ModelSpec:
     max_slots: int = 8
     max_seq_len: Optional[int] = None
     chunk_size: int = 512
-    lookahead: int = 8
+    # pipeline depth is lookahead * burst speculative tokens per finished slot —
+    # keep these in step with the GenerationEngine defaults
+    lookahead: int = 3
+    burst: int = 8
     max_batch: int = 64
     normalize: bool = False
     num_experts: int = 0
@@ -127,6 +130,7 @@ class ModelRegistry:
                 max_seq_len=spec.max_seq_len,
                 chunk_size=spec.chunk_size,
                 lookahead=spec.lookahead,
+                burst=spec.burst,
                 mesh=self.mesh,
             ).start()
             self.generators[name] = eng
