@@ -77,6 +77,8 @@ class DecoderConfig:
     rope_theta: float = 500_000.0
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    # Qwen2 family: biases on the q/k/v projections (o stays bias-free)
+    attn_bias: bool = False
     # MoE (Mixtral): 0 experts = dense SwiGLU MLP
     num_experts: int = 0
     experts_per_token: int = 2
@@ -109,6 +111,11 @@ class DecoderConfig:
             rope_theta=hf.get("rope_theta", 500_000.0),
             rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
             tie_embeddings=hf.get("tie_word_embeddings", False),
+            # Qwen2 checkpoints predate the attention_bias flag; the family
+            # always uses qkv biases (HF modeling hardcodes them)
+            attn_bias=bool(
+                hf.get("attention_bias", hf.get("model_type") == "qwen2")
+            ),
             num_experts=num_experts,
             experts_per_token=hf.get("num_experts_per_tok", 2),
             dtype=dtype,

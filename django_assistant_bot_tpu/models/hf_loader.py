@@ -113,6 +113,14 @@ def load_decoder(model_dir: str, dtype=None) -> tuple[DecoderConfig, Dict[str, A
         "wo": _stack(t, pre + "self_attn.o_proj.weight", L, T=True),
         "mlp_norm": _stack(t, pre + "post_attention_layernorm.weight", L),
     }
+    if cfg.attn_bias:  # Qwen2 family: qkv biases (o_proj stays bias-free)
+        layers.update(
+            {
+                "bq": _stack(t, pre + "self_attn.q_proj.bias", L),
+                "bk": _stack(t, pre + "self_attn.k_proj.bias", L),
+                "bv": _stack(t, pre + "self_attn.v_proj.bias", L),
+            }
+        )
     if cfg.is_moe:
         X = cfg.num_experts
 

@@ -114,6 +114,10 @@ def logical_axes(cfg: DecoderConfig) -> Params:
         "wo": (None, "heads", E),
         "mlp_norm": (None, E),
     }
+    if cfg.attn_bias:
+        layers.update(
+            {"bq": (None, "heads"), "bk": (None, "kv_heads"), "bv": (None, "kv_heads")}
+        )
     if cfg.is_moe:
         layers.update(
             {
@@ -154,6 +158,14 @@ def init(cfg: DecoderConfig, rng: jax.Array) -> Params:
         "wo": dense(keys[3], (L, H * D, E)),
         "mlp_norm": jnp.ones((L, E), cfg.dtype),
     }
+    if cfg.attn_bias:
+        layers.update(
+            {
+                "bq": jnp.zeros((L, H * D), cfg.dtype),
+                "bk": jnp.zeros((L, KH * D), cfg.dtype),
+                "bv": jnp.zeros((L, KH * D), cfg.dtype),
+            }
+        )
     if cfg.is_moe:
         X = cfg.num_experts
         layers.update(
@@ -198,9 +210,16 @@ def _attn_proj(cfg: DecoderConfig, p: Params, x: jnp.ndarray, cos, sin):
     """QKV projections + RoPE.  Returns q:[B,H,S,D], k/v:[B,KH,S,D]."""
     B, S, E = x.shape
     H, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    q = jnp.einsum("bse,eo->bso", x, p["wq"]).reshape(B, S, H, D)
-    k = jnp.einsum("bse,eo->bso", x, p["wk"]).reshape(B, S, KH, D)
-    v = jnp.einsum("bse,eo->bso", x, p["wv"]).reshape(B, S, KH, D)
+    q = jnp.einsum("bse,eo->bso", x, p["wq"])
+    k = jnp.einsum("bse,eo->bso", x, p["wk"])
+    v = jnp.einsum("bse,eo->bso", x, p["wv"])
+    if cfg.attn_bias:
+        q = q + p["bq"]
+        k = k + p["bk"]
+        v = v + p["bv"]
+    q = q.reshape(B, S, H, D)
+    k = k.reshape(B, S, KH, D)
+    v = v.reshape(B, S, KH, D)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     q = with_constraint(q.transpose(0, 2, 1, 3), ("batch", "heads", "length", "head_dim"))
@@ -472,9 +491,16 @@ def decode_step(
     def body(x, inputs):
         p, k_cache, v_cache = inputs
         h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("bse,eo->bso", h, p["wq"]).reshape(B, 1, H, D)
-        k = jnp.einsum("bse,eo->bso", h, p["wk"]).reshape(B, 1, KH, D)
-        v = jnp.einsum("bse,eo->bso", h, p["wv"]).reshape(B, 1, KH, D)
+        q = jnp.einsum("bse,eo->bso", h, p["wq"])
+        k = jnp.einsum("bse,eo->bso", h, p["wk"])
+        v = jnp.einsum("bse,eo->bso", h, p["wv"])
+        if cfg.attn_bias:
+            q = q + p["bq"]
+            k = k + p["bk"]
+            v = v + p["bv"]
+        q = q.reshape(B, 1, H, D)
+        k = k.reshape(B, 1, KH, D)
+        v = v.reshape(B, 1, KH, D)
         q = apply_rope(q, cos, sin).transpose(0, 2, 1, 3)
         k = apply_rope(k, cos, sin).transpose(0, 2, 1, 3)
         v = v.transpose(0, 2, 1, 3)
