@@ -223,42 +223,13 @@ class GenerationEngine:
         self.steps = 0
 
         cfg_c = cfg
-        top_k_c = top_k
-        burst_c = self.burst
-
-        def _decode_tick(params, tokens, cache, active, temps, top_ps, rng):
-            """`burst` chained decode steps in one dispatch -> (toks [K,B], cache)."""
-
-            def body(carry, _):
-                tokens, cache, rng = carry
-                rng, sub = jax.random.split(rng)
-                logits, cache = llama.decode_step(
-                    params, cfg_c, tokens, cache, active=active
-                )
-                nxt = sample_logits(
-                    logits, sub, temperature=temps, top_k=top_k_c, top_p=top_ps
-                )
-                return (nxt, cache, rng), nxt
-
-            (tokens, cache, _), toks = jax.lax.scan(
-                body, (tokens, cache, rng), None, length=burst_c
-            )
-            return toks, tokens, cache
+        self._decode_tick = self._make_decode_tick(json_mode=False)
 
         if mesh is not None:
-            tick_out = (
-                _replicated(mesh),
-                _replicated(mesh),
-                self._cache_shardings,
-            )
             insert_out = self._cache_shardings
             chunk_out = (_replicated(mesh), self._cache_shardings)
         else:
-            tick_out = insert_out = chunk_out = None
-        # donate the cache (argnum 2) — in-place HBM update, no copy
-        self._decode_tick = jax.jit(
-            _decode_tick, donate_argnums=(2,), out_shardings=tick_out
-        )
+            insert_out = chunk_out = None
 
         def _prefill(params, ids, lengths):
             return llama.prefill(params, cfg_c, ids, lengths)
@@ -276,12 +247,57 @@ class GenerationEngine:
             _prefill_chunk, donate_argnums=(2,), out_shardings=chunk_out
         )
 
+    def _make_decode_tick(self, json_mode: bool):
+        """Build the jitted burst tick: `burst` chained decode steps in one
+        dispatch -> (toks [K,B], last tokens [B], cache[, fsm states]).
+
+        One body serves both variants; ``json_mode`` adds the grammar mask
+        before sampling and the FSM advance after it (trace-time branches, so
+        the plain path pays nothing for them).  The cache (argnum 2) is donated
+        — in-place HBM update, no copy."""
+        from ..ops.attention import NEG_INF
+
+        cfg_c, top_k_c, burst_c = self.cfg, self.top_k, self.burst
+
+        def tick(params, tokens, cache, active, temps, top_ps, rng,
+                 fsm_s=None, jmask=None, next_tab=None, allowed_tab=None):
+            def body(carry, _):
+                tokens, cache, rng, fsm_s = carry
+                rng, sub = jax.random.split(rng)
+                logits, cache = llama.decode_step(
+                    params, cfg_c, tokens, cache, active=active
+                )
+                if json_mode:
+                    ok = allowed_tab[fsm_s]  # [B, V]
+                    logits = jnp.where(jmask[:, None] & ~ok, NEG_INF, logits)
+                nxt = sample_logits(
+                    logits, sub, temperature=temps, top_k=top_k_c, top_p=top_ps
+                )
+                if json_mode:
+                    safe = jnp.minimum(nxt, next_tab.shape[1] - 1)
+                    fsm_s = jnp.where(jmask, next_tab[fsm_s, safe], fsm_s)
+                return (nxt, cache, rng, fsm_s), nxt
+
+            carry = (tokens, cache, rng, fsm_s if json_mode else jnp.zeros_like(tokens))
+            (tokens, cache, _, fsm_s), toks = jax.lax.scan(
+                body, carry, None, length=burst_c
+            )
+            if json_mode:
+                return toks, tokens, cache, fsm_s
+            return toks, tokens, cache
+
+        if self.mesh is not None:
+            rep = _replicated(self.mesh)
+            out = (rep, rep, self._cache_shardings) + ((rep,) if json_mode else ())
+        else:
+            out = None
+        return jax.jit(tick, donate_argnums=(2,), out_shardings=out)
+
     def _ensure_fsm(self):
         """Build the JSON token-FSM tables on first constrained request (one-time:
         char DFA + vectorised closure over the tokenizer) and the json tick jit."""
         if self._fsm is not None:
             return
-        from ..ops.attention import NEG_INF
         from ..ops.json_fsm import fsm_for_tokenizer
 
         fsm = fsm_for_tokenizer(self.tokenizer)
@@ -293,45 +309,11 @@ class GenerationEngine:
         nxt = np.full((S, V_model), fsm.dead, np.int32)
         nxt[:, : min(V_tok, V_model)] = fsm.next_state[:, :V_model]
         self._fsm = fsm
-        self._fsm_next_np = nxt
         rep = _replicated(self.mesh) if self.mesh is not None else None
         self._fsm_allowed_dev = jax.device_put(allowed, rep)
         self._fsm_next_dev = jax.device_put(nxt, rep)
         self._fsm_init_row_dev = jax.device_put(allowed[fsm.initial], rep)
-
-        cfg_c, top_k_c, burst_c = self.cfg, self.top_k, self.burst
-
-        def _tick_json(params, tokens, cache, active, temps, top_ps, rng, fsm_s, jmask, next_tab, allowed_tab):
-            def body(carry, _):
-                tokens, cache, rng, fsm_s = carry
-                rng, sub = jax.random.split(rng)
-                logits, cache = llama.decode_step(
-                    params, cfg_c, tokens, cache, active=active
-                )
-                ok = allowed_tab[fsm_s]  # [B, V]
-                logits = jnp.where(jmask[:, None] & ~ok, NEG_INF, logits)
-                nxt_tok = sample_logits(
-                    logits, sub, temperature=temps, top_k=top_k_c, top_p=top_ps
-                )
-                safe = jnp.minimum(nxt_tok, next_tab.shape[1] - 1)
-                fsm_s = jnp.where(jmask, next_tab[fsm_s, safe], fsm_s)
-                return (nxt_tok, cache, rng, fsm_s), nxt_tok
-
-            (tokens, cache, _, fsm_s), toks = jax.lax.scan(
-                body, (tokens, cache, rng, fsm_s), None, length=burst_c
-            )
-            return toks, tokens, cache, fsm_s
-
-        if self.mesh is not None:
-            out = (
-                _replicated(self.mesh),
-                _replicated(self.mesh),
-                self._cache_shardings,
-                _replicated(self.mesh),
-            )
-        else:
-            out = None
-        self._decode_tick_json = jax.jit(_tick_json, donate_argnums=(2,), out_shardings=out)
+        self._decode_tick_json = self._make_decode_tick(json_mode=True)
 
     def _mask_prefill_logits(self, logits):
         """Constrain the first sampled token to valid JSON openings (on device —
