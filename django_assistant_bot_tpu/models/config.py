@@ -79,6 +79,11 @@ class DecoderConfig:
     tie_embeddings: bool = False
     # Qwen2 family: biases on the q/k/v projections (o stays bias-free)
     attn_bias: bool = False
+    # Gemma family: GeGLU MLP ("gelu_tanh") and sqrt(E)-scaled embeddings.
+    # Gemma's (1+w) RMSNorm needs no flag — the +1 folds into the stored norm
+    # weights at load time (hf_loader), keeping one norm implementation.
+    hidden_act: str = "silu"
+    embed_multiplier: float = 1.0
     # MoE (Mixtral): 0 experts = dense SwiGLU MLP
     num_experts: int = 0
     experts_per_token: int = 2
@@ -100,6 +105,8 @@ class DecoderConfig:
     @classmethod
     def from_hf(cls, hf: Mapping[str, Any], dtype=jnp.bfloat16) -> "DecoderConfig":
         num_experts = hf.get("num_local_experts", 0)
+        is_gemma = hf.get("model_type") == "gemma"
+        act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
         return cls(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -107,6 +114,9 @@ class DecoderConfig:
             num_layers=hf["num_hidden_layers"],
             num_heads=hf["num_attention_heads"],
             num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim"),
+            hidden_act="gelu_tanh" if "gelu" in act else "silu",
+            embed_multiplier=float(hf["hidden_size"]) ** 0.5 if is_gemma else 1.0,
             max_seq_len=hf.get("max_position_embeddings", 8192),
             rope_theta=hf.get("rope_theta", 500_000.0),
             rms_norm_eps=hf.get("rms_norm_eps", 1e-5),

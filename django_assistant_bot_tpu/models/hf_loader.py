@@ -7,7 +7,10 @@ our [in, out] einsum convention, stacked along the leading layer axis (scan layo
 cast to the target dtype on host, then sharded onto the mesh in one ``device_put``
 (:func:`..parallel.sharding.shard_pytree`).
 
-Supported families: BERT (ruBert-base / MiniLM), Llama-3, Mixtral.
+Supported decoder families: Llama-3 / Mistral, Qwen2 (qkv biases), Gemma-1
+(GeGLU, (1+w) norm fold, scaled embeddings), Mixtral MoE.  Encoders: BERT
+(ruBert-base / MiniLM).  Unknown decoder model_types are rejected rather than
+silently mis-loaded (gemma-2/3 add norms this mapping does not carry).
 """
 
 from __future__ import annotations
@@ -94,12 +97,24 @@ def load_encoder(model_dir: str, dtype=None) -> tuple[EncoderConfig, Dict[str, A
     return cfg, params
 
 
+# families whose tensors AND math this loader maps faithfully; anything else
+# (e.g. gemma2's extra pre/post_feedforward norms) would load without error but
+# produce silently wrong logits, so it is rejected up front
+_SUPPORTED_DECODERS = {"llama", "mistral", "mixtral", "qwen2", "gemma"}
+
+
 def load_decoder(model_dir: str, dtype=None) -> tuple[DecoderConfig, Dict[str, Any]]:
-    """Load a Llama-3 or Mixtral checkpoint directory -> (DecoderConfig, params)."""
+    """Load a Llama/Qwen2/Gemma/Mixtral checkpoint dir -> (DecoderConfig, params)."""
     import jax.numpy as jnp
 
     dtype = dtype or jnp.bfloat16
     hf = read_hf_config(model_dir)
+    model_type = hf.get("model_type")
+    if model_type is not None and model_type not in _SUPPORTED_DECODERS:
+        raise ValueError(
+            f"unsupported decoder model_type {model_type!r}; "
+            f"supported: {sorted(_SUPPORTED_DECODERS)}"
+        )
     cfg = DecoderConfig.from_hf(hf, dtype=dtype)
     t = _read_safetensors(model_dir)
     L = cfg.num_layers
@@ -159,6 +174,12 @@ def load_decoder(model_dir: str, dtype=None) -> tuple[DecoderConfig, Dict[str, A
         "final_norm": t["model.norm.weight"],
         "layers": layers,
     }
+    if hf.get("model_type") == "gemma":
+        # Gemma's RMSNorm multiplies by (1 + w); folding the +1 into the stored
+        # weights keeps a single norm implementation for every family
+        layers["attn_norm"] = layers["attn_norm"] + 1.0
+        layers["mlp_norm"] = layers["mlp_norm"] + 1.0
+        params["final_norm"] = params["final_norm"] + 1.0
     if not cfg.tie_embeddings:
         head = t.get("lm_head.weight")
         if head is None:  # some checkpoints tie implicitly

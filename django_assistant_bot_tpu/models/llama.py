@@ -19,6 +19,7 @@ MoE note: when ``cfg.is_moe``, the MLP block is delegated to
 
 from __future__ import annotations
 
+import functools
 import logging
 from typing import Any, Dict, NamedTuple, Optional
 
@@ -194,12 +195,25 @@ def init(cfg: DecoderConfig, rng: jax.Array) -> Params:
     return params
 
 
+def _embed(params: Params, cfg: DecoderConfig, ids: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding lookup; Gemma scales by sqrt(E) (in model dtype, like HF)."""
+    x = params["tok_embed"][ids].astype(cfg.dtype)
+    if cfg.embed_multiplier != 1.0:
+        x = x * jnp.asarray(cfg.embed_multiplier, cfg.dtype)
+    return x
+
+
 def _mlp(cfg: DecoderConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
     if cfg.is_moe:
         from .mixtral import moe_mlp
 
         return moe_mlp(cfg, p, x)
-    h = jax.nn.silu(jnp.einsum("bse,ef->bsf", x, p["w_gate"])) * jnp.einsum(
+    act = (
+        functools.partial(jax.nn.gelu, approximate=True)
+        if cfg.hidden_act == "gelu_tanh"
+        else jax.nn.silu
+    )
+    h = act(jnp.einsum("bse,ef->bsf", x, p["w_gate"])) * jnp.einsum(
         "bse,ef->bsf", x, p["w_up"]
     )
     h = with_constraint(h, ("batch", "length", "mlp"))
@@ -253,7 +267,7 @@ def forward(
     """
     B, S = input_ids.shape
     cos, sin = _rope_tables(cfg, S)
-    x = params["tok_embed"][input_ids].astype(cfg.dtype)
+    x = _embed(params, cfg, input_ids)
     x = with_constraint(x, ("batch", "length", "embed"))
 
     def body(x, p):
@@ -294,7 +308,7 @@ def forward_long(
 
     B, S = input_ids.shape
     cos, sin = _rope_tables(cfg, S)
-    x = params["tok_embed"][input_ids].astype(cfg.dtype)
+    x = _embed(params, cfg, input_ids)
     x = with_constraint(x, ("batch", "length", "embed"))
 
     def body(x, p):
@@ -337,7 +351,7 @@ def prefill(
     """
     B, S = input_ids.shape
     cos, sin = _rope_tables(cfg, S)
-    x = params["tok_embed"][input_ids].astype(cfg.dtype)
+    x = _embed(params, cfg, input_ids)
 
     def body(x, p):
         h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
@@ -425,7 +439,7 @@ def prefill_chunk(
     pos = start + jnp.arange(C)
     cos_t, sin_t = _rope_tables(cfg, S)
     cos, sin = cos_t[pos], sin_t[pos]  # [C, hd/2]
-    x = params["tok_embed"][input_ids].astype(cfg.dtype)  # [1, C, E]
+    x = _embed(params, cfg, input_ids)  # [1, C, E]
     # queries attend to every cache position up to their own absolute position
     kpos = jnp.arange(S)[None, None, None, :]
     attn_mask = kpos <= pos[None, None, :, None]  # [1, 1, C, S]
@@ -481,7 +495,7 @@ def decode_step(
     cos = cos_t[positions][:, None, :]  # [B,1,hd/2] — per-slot position
     sin = sin_t[positions][:, None, :]
 
-    x = params["tok_embed"][tokens][:, None, :].astype(cfg.dtype)  # [B,1,E]
+    x = _embed(params, cfg, tokens)[:, None, :]  # [B,1,E]
     S = cache.max_len
     kpos = jnp.arange(S)[None, :]
     attn_mask = (kpos <= positions[:, None])[:, None, None, :]  # [B,1,1,S]

@@ -79,6 +79,28 @@ def tiny_qwen2_dir(tmp_path_factory):
     return str(d), model
 
 
+@pytest.fixture(scope="module")
+def tiny_gemma_dir(tmp_path_factory):
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    cfg = GemmaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,  # gemma decouples head_dim from hidden/heads
+        intermediate_size=64,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+    )
+    model = GemmaForCausalLM(cfg)
+    model.eval()
+    d = tmp_path_factory.mktemp("tiny_gemma")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
 def test_encoder_matches_hf(tiny_bert_dir):
     import torch
 
@@ -136,6 +158,60 @@ def test_qwen2_matches_hf(tiny_qwen2_dir):
         hf_logits = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
     ours = np.asarray(llama.forward(params, cfg, jnp.asarray(ids)))
     np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=1e-3)
+
+
+def test_gemma_matches_hf(tiny_gemma_dir):
+    """Gemma family: GeGLU MLP, (1+w) RMSNorm (folded at load), sqrt(E)-scaled
+    embeddings, tied head, decoupled head_dim."""
+    import torch
+
+    d, hf_model = tiny_gemma_dir
+    cfg, params = load_decoder(d, dtype=jnp.float32)
+    assert cfg.hidden_act == "gelu_tanh"
+    assert cfg.embed_multiplier == pytest.approx(32 ** 0.5)
+    assert cfg.tie_embeddings and cfg.head_dim == 16
+    ids = np.array([[1, 5, 9, 17, 3, 25, 7, 2]], np.int32)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(llama.forward(params, cfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=1e-3)
+
+
+def test_unsupported_decoder_family_rejected(tiny_gemma_dir, tmp_path):
+    """gemma-2 etc. would load without error but mis-compute; reject up front."""
+    import json, shutil
+
+    d, _ = tiny_gemma_dir
+    bad = tmp_path / "fake_gemma2"
+    shutil.copytree(d, bad)
+    cfg = json.loads((bad / "config.json").read_text())
+    cfg["model_type"] = "gemma2"
+    (bad / "config.json").write_text(json.dumps(cfg))
+    with pytest.raises(ValueError, match="unsupported decoder model_type"):
+        load_decoder(str(bad))
+
+
+def test_gemma_prefill_decode_matches_forward(tiny_gemma_dir):
+    d, _ = tiny_gemma_dir
+    cfg, params = load_decoder(d, dtype=jnp.float32)
+    prompt = np.array([[1, 5, 9, 17, 3]], np.int32)
+    seq = prompt.copy()
+    for _ in range(4):
+        logits = llama.forward(params, cfg, jnp.asarray(seq))
+        seq = np.concatenate([seq, [[int(jnp.argmax(logits[0, -1]))]]], axis=1)
+    expected = seq[0, prompt.shape[1]:].tolist()
+
+    cache = llama.init_cache(cfg, batch=1, max_len=32, dtype=jnp.float32)
+    lengths = jnp.asarray([prompt.shape[1]], jnp.int32)
+    logits, ks, vs = llama.prefill(params, cfg, jnp.asarray(prompt), lengths)
+    cache = llama.insert_sequences(cache, ks, vs, lengths, jnp.asarray([0], jnp.int32))
+    got = [int(jnp.argmax(logits[0]))]
+    for _ in range(3):
+        logits, cache = llama.decode_step(
+            params, cfg, jnp.asarray([got[-1]], jnp.int32), cache
+        )
+        got.append(int(jnp.argmax(logits[0])))
+    assert got == expected
 
 
 def test_qwen2_prefill_decode_matches_forward(tiny_qwen2_dir):
