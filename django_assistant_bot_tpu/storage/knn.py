@@ -48,13 +48,23 @@ def _normalize(x: np.ndarray) -> np.ndarray:
     return x / np.maximum(norms, 1e-12)
 
 
+@jax.jit
+def _normalize_rows_dev(x: jnp.ndarray) -> jnp.ndarray:
+    """Row-normalize on device (f32 stats, bf16 out) — bulk ingestion skips the
+    two O(N*D) host passes; row-wise, so it shards over 'data' untouched."""
+    xf = x.astype(jnp.float32)
+    norms = jnp.maximum(jnp.linalg.norm(xf, axis=-1, keepdims=True), 1e-12)
+    return (xf / norms).astype(jnp.bfloat16)
+
+
 class VectorIndex:
     """Append/compact exact-KNN index over (id, vector) pairs.
 
     Thread-safe; the device copy is maintained incrementally: pure appends that
     fit the current capacity bucket are written in place on device, while
     overwrites/removes/growth trigger a full re-stage.  Scores are cosine
-    similarities in [-1, 1] (queries and rows are normalized on ingest).
+    similarities in [-1, 1] — rows are normalized on device at staging time
+    (host rows stay raw), queries on host at search time.
 
     Pass ``mesh`` to shard rows over the mesh's ``data`` axis (see
     :class:`ShardedVectorIndex` semantics below): search then runs as a
@@ -92,7 +102,8 @@ class VectorIndex:
             self._mat = new
 
     def add(self, ids: Sequence[int], vectors: np.ndarray) -> None:
-        vectors = _normalize(np.asarray(vectors, np.float32).reshape(-1, self.dim))
+        # rows are stored raw; normalization happens on device at staging time
+        vectors = np.asarray(vectors, np.float32).reshape(-1, self.dim)
         ids = [int(i) for i in ids]
         with self._lock:
             if len(set(ids)) == len(ids) and not any(i in self._id_pos for i in ids):
@@ -159,7 +170,9 @@ class VectorIndex:
             mat[:n] = self._mat[:n]
         valid = np.zeros((n_pad,), bool)
         valid[:n] = True
-        self._device_index = self._put(jnp.asarray(mat, jnp.bfloat16), sharded=True)
+        self._device_index = _normalize_rows_dev(
+            self._put(jnp.asarray(mat, jnp.bfloat16), sharded=True)
+        )
         self._device_valid = self._put(jnp.asarray(valid), sharded=True)
         self._device_count = n
         self._snapshot_ids = list(self._ids)
@@ -185,12 +198,12 @@ class VectorIndex:
                 self._stage_full(n)
                 self._dirty_full = False
             elif n > self._device_count:
-                # incremental append: transfer only the new rows
+                # incremental append: normalize the small fresh batch on host
+                # (O(batch); a jitted kernel here would recompile per batch size)
                 start = self._device_count
-                fresh = self._mat[start:n]
+                fresh = jnp.asarray(_normalize(self._mat[start:n]), jnp.bfloat16)
                 self._device_index = self._put(
-                    self._device_index.at[start:n].set(jnp.asarray(fresh, jnp.bfloat16)),
-                    sharded=True,
+                    self._device_index.at[start:n].set(fresh), sharded=True
                 )
                 self._device_valid = self._put(
                     self._device_valid.at[start:n].set(True), sharded=True

@@ -1,0 +1,100 @@
+"""Multi-host bootstrap: two REAL OS processes form a jax.distributed cluster
+over the CPU backend and run a cross-process collective — the closest a single
+machine gets to proving the DCN path (SURVEY.md §2.3 collectives backend)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, os.environ["DABT_TEST_REPO"])
+    import jax
+    # the launch environment may force-register an accelerator plugin; pin CPU
+    # before any backend touch (env vars alone are overridden by jax.config)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    from django_assistant_bot_tpu.parallel.distributed import (
+        initialize_cluster, is_primary, multihost_mesh,
+    )
+
+    initialize_cluster()  # reads DABT_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, len(jax.devices())
+    assert len(jax.local_devices()) == 2
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = multihost_mesh()
+    assert mesh.shape["data"] == 4, dict(mesh.shape)
+    # cross-process collective: every process contributes its local shards of a
+    # data-sharded array; the jit'd global sum must see all four devices' rows
+    sharding = NamedSharding(mesh, P("data"))
+    global_shape = (4,)
+    local = [
+        jax.device_put(jnp.asarray([float(d.id) + 1.0]), d)
+        for d in mesh.local_devices
+    ]
+    arr = jax.make_array_from_single_device_arrays(global_shape, sharding, local)
+    total = jax.jit(
+        lambda x: jnp.sum(x), out_shardings=NamedSharding(mesh, P())
+    )(arr)
+    # every device (local on SOME process) contributed id+1; the global sum
+    # proves rows from both processes met in one reduction
+    expected = sum(d.id + 1.0 for d in jax.devices())
+    assert float(total) == expected, (float(total), expected)
+    print(f"rank={jax.process_index()} primary={is_primary()} ok")
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cluster_runs_cross_process_collective(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(
+            os.environ,
+            DABT_TEST_REPO=REPO,
+            DABT_COORDINATOR=f"127.0.0.1:{port}",
+            DABT_NUM_PROCESSES="2",
+            DABT_PROCESS_ID=str(rank),
+            JAX_PLATFORMS="cpu",
+        )
+        env.pop("XLA_FLAGS", None)  # worker pins its own device count
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"rank={rank}" in out and "ok" in out, out
+    assert any("primary=True" in o for o in outs)
