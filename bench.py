@@ -143,7 +143,7 @@ def bench_embedding() -> float:
     return EMB_BATCH / per_iter
 
 
-def _build_gen_engine(cfg=None):
+def _build_gen_engine(cfg=None, quantize=None):
     import jax
 
     from django_assistant_bot_tpu.models import llama
@@ -152,6 +152,10 @@ def _build_gen_engine(cfg=None):
 
     cfg = cfg or _decoder_cfg()
     params = llama.init(cfg, jax.random.PRNGKey(0))
+    if quantize == "int8":
+        from django_assistant_bot_tpu.ops.quant import quantize_decoder_params
+
+        params = quantize_decoder_params(params)
     mesh = get_mesh()
     with mesh:
         params = shard_pytree(params, llama.logical_axes(cfg), mesh)
@@ -498,6 +502,15 @@ def main() -> None:
     finally:
         gen_eng.stop()
     extras.update({k: v for k, v in rag.items() if k != "rag_req_per_s"})
+
+    # config 2b: int8 weight-only decode (halves HBM reads on the decode path)
+    q8_eng, _ = _build_gen_engine(quantize="int8")
+    try:
+        q8 = bench_decode(q8_eng)
+        extras["decode_int8_tokens_per_s_per_chip"] = q8["decode_tokens_per_s_per_chip"]
+        extras["decode_int8_p50_ttft_s"] = q8["decode_p50_ttft_s"]
+    finally:
+        q8_eng.stop()
 
     # config 5: MoE continuous batching (Mixtral-style top-2 routing)
     moe_eng, _ = _build_gen_engine(_moe_cfg())

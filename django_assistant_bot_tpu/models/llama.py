@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import attention, dot_product_attention
 from ..ops.norms import rms_norm
+from ..ops.quant import deq
 from ..ops.rope import apply_rope, rope_frequencies
 from ..parallel.sharding import with_constraint
 from .config import DecoderConfig
@@ -213,20 +214,20 @@ def _mlp(cfg: DecoderConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
         if cfg.hidden_act == "gelu_tanh"
         else jax.nn.silu
     )
-    h = act(jnp.einsum("bse,ef->bsf", x, p["w_gate"])) * jnp.einsum(
-        "bse,ef->bsf", x, p["w_up"]
+    h = act(jnp.einsum("bse,ef->bsf", x, deq(p["w_gate"], cfg.dtype))) * jnp.einsum(
+        "bse,ef->bsf", x, deq(p["w_up"], cfg.dtype)
     )
     h = with_constraint(h, ("batch", "length", "mlp"))
-    return jnp.einsum("bsf,fe->bse", h, p["w_down"])
+    return jnp.einsum("bsf,fe->bse", h, deq(p["w_down"], cfg.dtype))
 
 
 def _attn_proj(cfg: DecoderConfig, p: Params, x: jnp.ndarray, cos, sin):
     """QKV projections + RoPE.  Returns q:[B,H,S,D], k/v:[B,KH,S,D]."""
     B, S, E = x.shape
     H, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    q = jnp.einsum("bse,eo->bso", x, p["wq"])
-    k = jnp.einsum("bse,eo->bso", x, p["wk"])
-    v = jnp.einsum("bse,eo->bso", x, p["wv"])
+    q = jnp.einsum("bse,eo->bso", x, deq(p["wq"], cfg.dtype))
+    k = jnp.einsum("bse,eo->bso", x, deq(p["wk"], cfg.dtype))
+    v = jnp.einsum("bse,eo->bso", x, deq(p["wv"], cfg.dtype))
     if cfg.attn_bias:
         q = q + p["bq"]
         k = k + p["bk"]
@@ -279,7 +280,7 @@ def forward(
         else:
             o = dot_product_attention(q, k, v, causal=True, mask=mask)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
-        x = x + jnp.einsum("bso,oe->bse", o, p["wo"])
+        x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
         h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, p, h)
         return with_constraint(x, ("batch", "length", "embed")), None
@@ -317,7 +318,7 @@ def forward_long(
         k, v = _repeat_kv(cfg, k), _repeat_kv(cfg, v)
         o = ring_attention(q, k, v, mesh, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
-        x = x + jnp.einsum("bso,oe->bse", o, p["wo"])
+        x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
         h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, p, h)
         return with_constraint(x, ("batch", "length", "embed")), None
@@ -363,7 +364,7 @@ def prefill(
         # decode.  Keeping the call mask-free lets the flash kernel take long buckets.
         o = attention(q, kr, vr, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
-        x = x + jnp.einsum("bso,oe->bse", o, p["wo"])
+        x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
         h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, p, h)
         return with_constraint(x, ("batch", "length", "embed")), (k, v)
@@ -456,7 +457,7 @@ def prefill_chunk(
         kr, vr = _repeat_kv(cfg, k_row), _repeat_kv(cfg, v_row)
         o = dot_product_attention(q, kr, vr, mask=attn_mask)  # [1, H, C, D]
         o = o.transpose(0, 2, 1, 3).reshape(B, C, -1)
-        x = x + jnp.einsum("bso,oe->bse", o, p["wo"])
+        x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
         h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, p, h)
         return x, (k_row, v_row)
@@ -505,9 +506,9 @@ def decode_step(
     def body(x, inputs):
         p, k_cache, v_cache = inputs
         h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("bse,eo->bso", h, p["wq"])
-        k = jnp.einsum("bse,eo->bso", h, p["wk"])
-        v = jnp.einsum("bse,eo->bso", h, p["wv"])
+        q = jnp.einsum("bse,eo->bso", h, deq(p["wq"], cfg.dtype))
+        k = jnp.einsum("bse,eo->bso", h, deq(p["wk"], cfg.dtype))
+        v = jnp.einsum("bse,eo->bso", h, deq(p["wv"], cfg.dtype))
         if cfg.attn_bias:
             q = q + p["bq"]
             k = k + p["bk"]
@@ -523,7 +524,7 @@ def decode_step(
         kr, vr = _repeat_kv(cfg, k_cache), _repeat_kv(cfg, v_cache)
         o = dot_product_attention(q, kr, vr, mask=attn_mask)  # [B,H,1,D]
         o = o.transpose(0, 2, 1, 3).reshape(B, 1, -1)
-        x = x + jnp.einsum("bso,oe->bse", o, p["wo"])
+        x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
         h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, p, h)
         return x, (k_cache, v_cache)

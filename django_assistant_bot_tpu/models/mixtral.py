@@ -21,6 +21,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ..ops.quant import deq
+
 from ..parallel.sharding import with_constraint
 from .config import DecoderConfig
 
@@ -62,10 +64,10 @@ def moe_mlp(cfg: DecoderConfig, p, x: jnp.ndarray) -> jnp.ndarray:
 
     xe = jnp.einsum("txc,te->xce", dispatch, xt)  # [X, C, E]
     xe = with_constraint(xe, ("expert", None, "embed"))
-    h = jax.nn.silu(jnp.einsum("xce,xef->xcf", xe, p["w_gate"])) * jnp.einsum(
-        "xce,xef->xcf", xe, p["w_up"]
+    h = jax.nn.silu(jnp.einsum("xce,xef->xcf", xe, deq(p["w_gate"], cfg.dtype))) * jnp.einsum(
+        "xce,xef->xcf", xe, deq(p["w_up"], cfg.dtype)
     )
     h = with_constraint(h, ("expert", None, "mlp"))
-    ye = jnp.einsum("xcf,xfe->xce", h, p["w_down"])  # [X, C, E]
+    ye = jnp.einsum("xcf,xfe->xce", h, deq(p["w_down"], cfg.dtype))  # [X, C, E]
     out = jnp.einsum("txc,xce->te", combine.astype(cfg.dtype), ye)
     return out.reshape(B, S, E)

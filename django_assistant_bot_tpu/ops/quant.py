@@ -1,0 +1,63 @@
+"""Weight-only int8 quantization for the decode path.
+
+Decode is HBM-bandwidth-bound: every generated token re-reads all layer
+weights, so halving the bytes (bf16 -> int8 + per-channel f32 scales) nearly
+doubles the decode roofline on real hardware and halves host->HBM transfer at
+load.  The reference has no quantization (torch fp16 generate,
+assistant/ai/providers/transformers.py:22-29); this is a TPU-first extra.
+
+Scheme: symmetric per-output-channel.  Every projection weight in this
+codebase is laid out ``[..., in, out]`` with the contraction on axis -2
+(layer-stacked: wq/wk/wv [L,E,O], wo [L,O,E], MLP [L,(X,)E,F] / [L,(X,)F,E]),
+so one rule quantizes them all: ``scale = max|w| over axis -2 / 127``.
+
+``QTensor`` is a NamedTuple (automatically a pytree): the scale keeps the
+weight's rank with the contracted dim = 1, so it scans along the layer axis
+with the weights AND accepts the same PartitionSpec — ``shard_pytree``'s
+sharding tree applies to a QTensor node as a pytree prefix, no rule changes.
+
+Dequantization sits inside the einsum callsites (:func:`deq`); XLA fuses the
+convert-multiply into the dot, so the bf16 weights are never materialized in
+HBM — int8 is what gets read.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax.numpy as jnp
+
+QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+class QTensor(NamedTuple):
+    q: jnp.ndarray      # int8, original shape
+    scale: jnp.ndarray  # f32, same rank, contracted (-2) dim = 1
+
+
+def quantize_tensor(w: jnp.ndarray) -> QTensor:
+    """Symmetric per-output-channel int8 over contraction axis -2."""
+    wf = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(jnp.rint(wf / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def deq(w: Any, dtype) -> jnp.ndarray:
+    """Dequantize at the einsum callsite (fused by XLA); pass-through otherwise."""
+    if isinstance(w, QTensor):
+        return (w.q.astype(jnp.float32) * w.scale).astype(dtype)
+    return w
+
+
+def quantize_decoder_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize every layer projection; norms/biases/embeddings/head stay bf16
+    (tiny, and embedding/head quality is disproportionately sensitive)."""
+    layers = dict(params["layers"])
+    for key in QUANTIZABLE:
+        if key in layers:
+            layers[key] = quantize_tensor(layers[key])
+    out = dict(params)
+    out["layers"] = layers
+    return out

@@ -78,8 +78,36 @@ def shard_pytree(
     Host→HBM transfer happens once here; afterwards jit-compiled steps consume the
     already-resident sharded arrays (minimising host↔device traffic, the usual HBM
     bottleneck — see SURVEY.md §7 hard parts).
+
+    An annotation position may cover a *subtree* of arrays (e.g. a quantized
+    weight is a QTensor of int8 values + per-channel scales); the spec applies
+    per leaf, with size-1 dims never sharded — so a scale whose contracted dim
+    collapsed to 1 rides the same annotation as its weight.
     """
-    shardings = tree_shardings(mesh, logical_tree, rules)
+
+    def leaf_sharding(axes: tuple, arr) -> NamedSharding:
+        spec = [rules.get(a) if a is not None else None for a in axes]
+        shape = getattr(arr, "shape", ())
+        if len(shape) != len(spec):
+            # a silent fallback here would replicate a mis-annotated weight on
+            # every device (N-fold HBM) with no diagnostic — fail loudly instead
+            raise ValueError(
+                f"logical axes {axes} (rank {len(spec)}) do not match array "
+                f"shape {tuple(shape)}"
+            )
+        spec = [None if shape[i] == 1 else s for i, s in enumerate(spec)]
+        return NamedSharding(mesh, P(*spec))
+
+    shardings = jax.tree.map(
+        lambda axes, subtree: jax.tree.map(
+            lambda arr: leaf_sharding(axes, arr), subtree
+        ),
+        logical_tree,
+        params,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        ),
+    )
     return jax.device_put(params, shardings)
 
 

@@ -33,6 +33,9 @@ class ModelSpec:
     # keep these in step with the GenerationEngine defaults
     lookahead: int = 3
     burst: int = 8
+    # weight-only quantization for decoders: None | "int8" (ops/quant.py) —
+    # halves HBM reads on the bandwidth-bound decode path
+    quantize: Optional[str] = None
     max_batch: int = 64
     normalize: bool = False
     num_experts: int = 0
@@ -99,6 +102,11 @@ class ModelRegistry:
                 params = encoder.init(cfg, jax.random.key(0))
             else:
                 raise ValueError(f"model {name}: need path, checkpoint, or tiny=true")
+            if spec.quantize:
+                raise ValueError(
+                    f"model {name}: quantize={spec.quantize!r} is decoder-only "
+                    "(encoders are compute-bound, not weight-read-bound)"
+                )
             with self.mesh:
                 params = shard_pytree(params, encoder.logical_axes(cfg), self.mesh)
             eng = EmbeddingEngine(
@@ -120,6 +128,14 @@ class ModelRegistry:
                 params = llama.init(cfg, jax.random.key(0))
             else:
                 raise ValueError(f"model {name}: need path, checkpoint, or tiny=true")
+            if spec.quantize == "int8":
+                # quantize BEFORE device placement: int8 is what transfers and
+                # shards (QTensor rides the same sharding tree as a prefix)
+                from ..ops.quant import quantize_decoder_params
+
+                params = quantize_decoder_params(params)
+            elif spec.quantize:
+                raise ValueError(f"model {name}: unknown quantize={spec.quantize!r}")
             with self.mesh:
                 params = shard_pytree(params, llama.logical_axes(cfg), self.mesh)
             eng = GenerationEngine(

@@ -1,0 +1,96 @@
+"""Weight-only int8 quantization (ops/quant.py): accuracy, decode parity,
+sharded serving integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from django_assistant_bot_tpu.models import DecoderConfig, llama
+from django_assistant_bot_tpu.ops.quant import (
+    QTensor,
+    QUANTIZABLE,
+    deq,
+    quantize_decoder_params,
+    quantize_tensor,
+)
+
+
+def test_quantize_tensor_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(3, 64, 32)).astype(np.float32))
+    qt = quantize_tensor(w)
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (3, 1, 32)
+    back = deq(qt, jnp.float32)
+    # symmetric int8: error bounded by scale/2 per element
+    max_err = float(jnp.max(jnp.abs(back - w)))
+    assert max_err <= float(jnp.max(qt.scale)) * 0.51
+
+
+def test_quantized_forward_close_and_decode_consistent():
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    qparams = quantize_decoder_params(params)
+    for key in QUANTIZABLE:
+        if key in qparams["layers"]:
+            assert isinstance(qparams["layers"][key], QTensor)
+    ids = jnp.asarray(np.random.default_rng(1).integers(1, 100, (2, 12)), jnp.int32)
+    full = np.asarray(llama.forward(params, cfg, ids))
+    quant = np.asarray(llama.forward(qparams, cfg, ids))
+    # int8 per-channel error stays a small fraction of the logit scale
+    rel = np.abs(quant - full).max() / max(np.abs(full).max(), 1e-6)
+    assert rel < 0.05, rel
+
+    # prefill+decode on the QUANTIZED params agrees with the quantized forward
+    prompt = np.asarray(ids[:1, :5])
+    seq = prompt.copy()
+    for _ in range(4):
+        logits = llama.forward(qparams, cfg, jnp.asarray(seq))
+        seq = np.concatenate([seq, [[int(jnp.argmax(logits[0, -1]))]]], axis=1)
+    expected = seq[0, prompt.shape[1]:].tolist()
+
+    cache = llama.init_cache(cfg, batch=1, max_len=32)
+    lengths = jnp.asarray([prompt.shape[1]], jnp.int32)
+    logits, ks, vs = llama.prefill(qparams, cfg, jnp.asarray(prompt), lengths)
+    cache = llama.insert_sequences(cache, ks, vs, lengths, jnp.asarray([0], jnp.int32))
+    got = [int(jnp.argmax(logits[0]))]
+    for _ in range(3):
+        logits, cache = llama.decode_step(
+            qparams, cfg, jnp.asarray([got[-1]], jnp.int32), cache
+        )
+        got.append(int(jnp.argmax(logits[0])))
+    assert got == expected
+
+
+def test_quantized_sharded_engine_generates(mesh8, tmp_db):
+    """QTensor leaves ride shard_pytree's sharding tree as a prefix; the full
+    registry->engine path serves a quantized model on the 8-device mesh."""
+    from django_assistant_bot_tpu.serving.registry import ModelRegistry, ModelSpec
+
+    registry = ModelRegistry(mesh=mesh8)
+    spec = ModelSpec(
+        name="tiny-q8", kind="decoder", tiny=True, quantize="int8",
+        max_slots=2, max_seq_len=64,
+    )
+    registry.specs = {"tiny-q8": spec}
+    registry.load(spec)
+    eng = registry.get_generator("tiny-q8")
+    try:
+        fut = eng.submit([3, 7, 11], max_tokens=6, temperature=0.0)
+        res = fut.result(timeout=600)
+        assert len(res.token_ids) == 6
+        # greedy determinism across a second request
+        fut2 = eng.submit([3, 7, 11], max_tokens=6, temperature=0.0)
+        assert fut2.result(timeout=600).token_ids == res.token_ids
+    finally:
+        registry.stop()
+
+
+def test_unknown_quantize_rejected(mesh8):
+    from django_assistant_bot_tpu.serving.registry import ModelRegistry, ModelSpec
+
+    registry = ModelRegistry(mesh=mesh8)
+    with pytest.raises(ValueError, match="unknown quantize"):
+        registry.load(
+            ModelSpec(name="bad", kind="decoder", tiny=True, quantize="int4")
+        )
