@@ -26,6 +26,7 @@ from __future__ import annotations
 from typing import Any, Dict, NamedTuple
 
 import jax.numpy as jnp
+import numpy as np
 
 QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
@@ -35,12 +36,17 @@ class QTensor(NamedTuple):
     scale: jnp.ndarray  # f32, same rank, contracted (-2) dim = 1
 
 
-def quantize_tensor(w: jnp.ndarray) -> QTensor:
-    """Symmetric per-output-channel int8 over contraction axis -2."""
-    wf = jnp.asarray(w, jnp.float32)
-    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
-    scale = jnp.maximum(amax / 127.0, 1e-12)
-    q = jnp.clip(jnp.rint(wf / scale), -127, 127).astype(jnp.int8)
+def quantize_tensor(w) -> QTensor:
+    """Symmetric per-output-channel int8 over contraction axis -2.
+
+    Runs on HOST numpy: an on-device f32 upcast of a layer-stacked weight
+    would double the bf16 footprint on one chip at exactly the moment
+    quantization is supposed to shrink it.  shard_pytree transfers the int8
+    result afterwards."""
+    wf = np.asarray(w, np.float32)
+    amax = np.max(np.abs(wf), axis=-2, keepdims=True)
+    scale = np.maximum(amax / 127.0, 1e-12).astype(np.float32)
+    q = np.clip(np.rint(wf / scale), -127, 127).astype(np.int8)
     return QTensor(q=q, scale=scale)
 
 

@@ -86,7 +86,7 @@ def shard_pytree(
     """
 
     def leaf_sharding(axes: tuple, arr) -> NamedSharding:
-        spec = [rules.get(a) if a is not None else None for a in axes]
+        spec = list(logical_to_pspec(axes, rules))
         shape = getattr(arr, "shape", ())
         if len(shape) != len(spec):
             # a silent fallback here would replicate a mis-annotated weight on

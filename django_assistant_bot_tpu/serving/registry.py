@@ -78,6 +78,14 @@ class ModelRegistry:
 
         name = spec.name.lower()
         dtype = getattr(jnp, spec.dtype)
+        # validate config knobs BEFORE the (potentially multi-GB) weight load
+        if spec.quantize and spec.kind == "encoder":
+            raise ValueError(
+                f"model {name}: quantize={spec.quantize!r} is decoder-only "
+                "(encoders are compute-bound, not weight-read-bound)"
+            )
+        if spec.quantize and spec.quantize != "int8":
+            raise ValueError(f"model {name}: unknown quantize={spec.quantize!r}")
         tokenizer_path = spec.path
         logger.info("loading model %r (%s, tiny=%s)", name, spec.kind, spec.tiny)
 
@@ -102,11 +110,6 @@ class ModelRegistry:
                 params = encoder.init(cfg, jax.random.key(0))
             else:
                 raise ValueError(f"model {name}: need path, checkpoint, or tiny=true")
-            if spec.quantize:
-                raise ValueError(
-                    f"model {name}: quantize={spec.quantize!r} is decoder-only "
-                    "(encoders are compute-bound, not weight-read-bound)"
-                )
             with self.mesh:
                 params = shard_pytree(params, encoder.logical_axes(cfg), self.mesh)
             eng = EmbeddingEngine(
@@ -134,8 +137,6 @@ class ModelRegistry:
                 from ..ops.quant import quantize_decoder_params
 
                 params = quantize_decoder_params(params)
-            elif spec.quantize:
-                raise ValueError(f"model {name}: unknown quantize={spec.quantize!r}")
             with self.mesh:
                 params = shard_pytree(params, llama.logical_axes(cfg), self.mesh)
             eng = GenerationEngine(

@@ -90,6 +90,35 @@ def test_engine_long_prompt_truncated(tiny_gen_engine):
     assert r.prompt_tokens <= 95
 
 
+def test_engine_fails_active_requests_and_recovers():
+    """A device-step exception must fail in-flight futures (not hang them) and
+    leave the engine serviceable: the cache is rebuilt and the next request
+    completes normally (the failure-detection obligation, SURVEY.md §5.3)."""
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(1))
+    eng = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=64
+    ).start()
+    try:
+        orig = eng._decode_tick
+        state = {"armed": True}
+
+        def boom(*args, **kwargs):
+            if state.pop("armed", False):
+                raise RuntimeError("injected device failure")
+            return orig(*args, **kwargs)
+
+        eng._decode_tick = boom
+        fut = eng.submit([1, 2, 3], max_tokens=5, temperature=0.0)
+        with pytest.raises(RuntimeError):
+            fut.result(timeout=120)
+        # engine healed itself (fresh cache, cleared slots): next request works
+        res = eng.submit([1, 2, 3], max_tokens=5, temperature=0.0).result(timeout=120)
+        assert len(res.token_ids) == 5
+    finally:
+        eng.stop()
+
+
 def test_embedding_engine_batches_and_coalesces():
     from django_assistant_bot_tpu.models import EncoderConfig, encoder
 
