@@ -253,7 +253,9 @@ def bench_rag(gen_engine) -> dict:
             "/embeddings/", json={"model": "bench-emb", "texts": [q]}
         )
         emb = (await r.json())["embeddings"][0]
-        top = index.search(np.asarray(emb, np.float32), k=3)
+        # the real search service runs KNN in a thread (asyncio.to_thread) so
+        # concurrent requests overlap their device round trips
+        top = await asyncio.to_thread(index.search, np.asarray(emb, np.float32), 3)
         context = "\n".join(docs[i][:200] for i, _ in top)
         r = await client.post(
             "/dialog/",
