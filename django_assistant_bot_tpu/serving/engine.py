@@ -109,15 +109,18 @@ class _TickRef:
     processing skips entries whose slot epoch has moved on (request finished by an
     earlier tick — its later speculative tokens are garbage and are dropped).
 
-    ``first=True`` marks an activation: ``nxt`` is the [1] first sampled token of
-    a freshly-prefilled slot (kept on device so admission never blocks on a host
-    round trip); FIFO order in the inflight deque guarantees it is appended
-    before any burst tokens of the same slot.
+    ``first=True`` marks an activation: ``nxt`` is the [Bp] first sampled tokens
+    of a freshly-prefilled admission wave (kept on device so admission never
+    blocks on a host round trip); entry ``offset + j`` belongs to ``slots[j]``
+    (rows below ``offset`` are batch-bucket padding).  FIFO order in the
+    inflight deque guarantees they are appended before any burst tokens of the
+    same slots.
     """
 
-    nxt: Any  # device array: [burst, max_slots] sampled ids, or [1] when first
+    nxt: Any  # device array: [burst, max_slots] sampled ids, or [Bp] when first
     slots: List[tuple]
     first: bool = False
+    offset: int = 0
 
 
 @dataclasses.dataclass
@@ -195,14 +198,14 @@ class GenerationEngine:
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._pending: "collections.deque[_Request]" = collections.deque()
         self._chunking: Optional[_ChunkedPrefill] = None
-        # request currently mid-start (popped from _pending, not yet slotted):
-        # must be failed explicitly if its prefill/activation raises
-        self._starting: Optional[_Request] = None
+        # requests currently mid-start (popped from _pending, not yet slotted):
+        # must be failed explicitly if their prefill/activation raises
+        self._starting_batch: Optional[List[tuple]] = None
         self._slots: List[Optional[_Slot]] = [None] * max_slots
         self._slot_epoch = [0] * max_slots
         self._inflight: "collections.deque[_TickRef]" = collections.deque()
         self._cache = self._fresh_cache()
-        self._tokens_dev = jnp.zeros((max_slots,), jnp.int32)
+        self._tokens_dev = self._fresh_tokens()
         self._temps = np.zeros((max_slots,), np.float32)
         self._top_ps = np.ones((max_slots,), np.float32)
         self._sampling_dirty = True
@@ -215,7 +218,7 @@ class GenerationEngine:
         self._fsm = None  # ops.json_fsm.TokenFSM
         self._fsm_next_dev = None
         self._fsm_allowed_dev = None
-        self._fsm_states_dev = jnp.zeros((max_slots,), jnp.int32)
+        self._fsm_states_dev = self._fresh_tokens()
         self._decode_tick_json = None
         self._rng = jax.random.key(0)
         self._running = False
@@ -224,6 +227,8 @@ class GenerationEngine:
 
         cfg_c = cfg
         self._decode_tick = self._make_decode_tick(json_mode=False)
+        self._activate_fn = self._make_activate(json_mode=False)
+        self._activate_fn_json = None  # built in _ensure_fsm
 
         if mesh is not None:
             insert_out = self._cache_shardings
@@ -246,6 +251,43 @@ class GenerationEngine:
         self._prefill_chunk = jax.jit(
             _prefill_chunk, donate_argnums=(2,), out_shardings=chunk_out
         )
+
+    def _make_activate(self, json_mode: bool):
+        """Build the jitted activation: mask (JSON), sample the first token per
+        row, scatter into the decode token array (pad/non-JSON rows drop via
+        out-of-bounds indices), and advance FSM states.  One fused program per
+        batch bucket — eagerly composing these ops would pay a compile round
+        trip PER OP under a remote device."""
+        from ..ops.attention import NEG_INF
+
+        top_k_c = self.top_k
+        oob = self.max_slots  # out-of-bounds scatter index -> mode="drop"
+
+        def act(logits, tokens_dev, rng, temps, top_ps, scatter_idx,
+                fsm_states=None, jmask=None, init_row=None, next_tab=None,
+                initial=None):
+            if json_mode:
+                logits = jnp.where(
+                    jmask[:, None] & ~init_row[None, :], NEG_INF, logits
+                )
+            first = sample_logits(
+                logits, rng, temperature=temps, top_k=top_k_c, top_p=top_ps
+            )
+            tokens_dev = tokens_dev.at[scatter_idx].set(first, mode="drop")
+            if json_mode:
+                safe = jnp.minimum(first, next_tab.shape[1] - 1)
+                new_states = next_tab[initial, safe]
+                fsm_idx = jnp.where(jmask, scatter_idx, oob)
+                fsm_states = fsm_states.at[fsm_idx].set(new_states, mode="drop")
+                return first, tokens_dev, fsm_states
+            return first, tokens_dev
+
+        if self.mesh is not None:
+            rep = _replicated(self.mesh)
+            out = (rep, rep) + ((rep,) if json_mode else ())
+        else:
+            out = None
+        return jax.jit(act, out_shardings=out, static_argnames=("initial",))
 
     def _make_decode_tick(self, json_mode: bool):
         """Build the jitted burst tick: `burst` chained decode steps in one
@@ -314,13 +356,16 @@ class GenerationEngine:
         self._fsm_next_dev = jax.device_put(nxt, rep)
         self._fsm_init_row_dev = jax.device_put(allowed[fsm.initial], rep)
         self._decode_tick_json = self._make_decode_tick(json_mode=True)
+        self._activate_fn_json = self._make_activate(json_mode=True)
 
-    def _mask_prefill_logits(self, logits):
-        """Constrain the first sampled token to valid JSON openings (on device —
-        no host round trip of the [1, V] logits)."""
-        from ..ops.attention import NEG_INF
-
-        return jnp.where(self._fsm_init_row_dev[None, :], logits, NEG_INF)
+    def _fresh_tokens(self) -> jnp.ndarray:
+        """Zeroed [max_slots] int32 with the SAME committed sharding the jitted
+        steps emit — warmup and serving must present identical input shardings
+        or the fused programs silently recompile at serve time."""
+        z = jnp.zeros((self.max_slots,), jnp.int32)
+        if self.mesh is not None:
+            return jax.device_put(z, _replicated(self.mesh))
+        return jax.device_put(z)
 
     def _fresh_cache(self):
         if self._cache_shardings is not None:
@@ -487,37 +532,161 @@ class GenerationEngine:
             except queue.Empty:
                 break
         free = self._free_slots()
+        batch: List[tuple[int, _Request]] = []
         while free and self._pending:
             req = self._pending[0]
             if req.future.cancelled():
                 self._pending.popleft()
                 continue
             if len(req.prompt_ids) > self.chunk_size:
-                if self._chunking is not None:
+                if self._chunking is not None or batch:
                     break  # one chunked prefill at a time; FIFO order preserved
                 self._pending.popleft()
                 self._begin_chunked(free.pop(0), req)
+                admitted = True
             else:
                 self._pending.popleft()
-                self._starting = req
-                self._start_request(free.pop(0), req)
-                self._starting = None
+                batch.append((free.pop(0), req))
+        if batch:
+            # group the wave by seq bucket: short prompts must not pay the
+            # longest prompt's O(S^2) attention; one dispatch per bucket group
+            groups: Dict[int, List[tuple[int, _Request]]] = {}
+            for slot, req in batch:
+                b = pick_bucket(
+                    len(req.prompt_ids), self.prefill_buckets, self.chunk_size
+                )
+                groups.setdefault(b, []).append((slot, req))
+            for group in groups.values():
+                self._starting_batch = group
+                self._start_batch(group)
+                self._starting_batch = None
             admitted = True
         return admitted
 
-    def _start_request(self, slot: int, req: _Request):
-        """Single-call prefill for prompts that fit one chunk."""
-        n = len(req.prompt_ids)
-        bucket = pick_bucket(n, self.prefill_buckets, self.chunk_size)
-        ids = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-        ids[0, :n] = req.prompt_ids
-        lengths = jnp.asarray([n], jnp.int32)
+    def warmup(
+        self, seq_buckets: Optional[Sequence[int]] = None, json: bool = False
+    ) -> None:
+        """Deterministically compile every (batch-bucket, seq-bucket) prefill +
+        insert + activation shape and the decode tick.  Admission-wave sizes are
+        timing-dependent, so relying on warm *traffic* to hit every shape is
+        racy — a multi-second XLA compile can land mid-measurement (or mid-SLA).
+        ``json=True`` additionally builds the token FSM and compiles the
+        JSON-constrained activation/tick variants.  Call before :meth:`start`:
+        the zero-length insert writes touch only slot 0's cache row and set its
+        length to 0."""
+        if self._running:
+            raise RuntimeError("warmup() must run before start() — the engine "
+                               "thread owns the cache once running")
+        buckets = set(
+            b
+            for b in (seq_buckets if seq_buckets is not None else self.prefill_buckets)
+            if b <= self.chunk_size
+        )
+        # pick_bucket falls back to the cap when no bucket fits — that shape
+        # must be warm too or an odd-length prompt compiles at serve time
+        buckets.add(self.chunk_size)
+        buckets = tuple(sorted(buckets))
+        if json:
+            self._ensure_fsm()
         with self._mesh_scope():
-            logits, ks, vs = self._prefill(self.params, jnp.asarray(ids), lengths)
-            self._cache = self._insert(
-                self._cache, ks, vs, lengths, jnp.asarray([slot], jnp.int32)
+            for bucket in buckets:
+                for bp in self._batch_buckets():
+                    ids = jnp.zeros((bp, bucket), jnp.int32)
+                    lengths = jnp.zeros((bp,), jnp.int32)
+                    logits, ks, vs = self._prefill(self.params, ids, lengths)
+                    self._cache = self._insert(
+                        self._cache, ks, vs, lengths, jnp.zeros((bp,), jnp.int32)
+                    )
+                    # the fused activation program keys on the batch bucket too
+                    # — compile it here, discarding results (all rows OOB-drop)
+                    self._activate_fn(
+                        logits,
+                        self._tokens_dev,
+                        self._rng,
+                        np.ones((bp,), np.float32),
+                        np.ones((bp,), np.float32),
+                        np.full((bp,), self.max_slots, np.int32),
+                    )
+                    if json:
+                        self._activate_fn_json(
+                            logits,
+                            self._tokens_dev,
+                            self._rng,
+                            np.ones((bp,), np.float32),
+                            np.ones((bp,), np.float32),
+                            np.full((bp,), self.max_slots, np.int32),
+                            fsm_states=self._fsm_states_dev,
+                            jmask=np.zeros((bp,), bool),
+                            init_row=self._fsm_init_row_dev,
+                            next_tab=self._fsm_next_dev,
+                            initial=self._fsm.initial,
+                        )
+            jax.random.split(self._rng)  # the per-call rng split op
+            toks, last, self._cache = self._decode_tick(
+                self.params,
+                self._tokens_dev,
+                self._cache,
+                jnp.zeros((self.max_slots,), bool),
+                jnp.asarray(self._temps),
+                jnp.asarray(self._top_ps),
+                self._rng,
             )
-        self._activate(slot, req, logits)
+            if json:
+                toks, last, self._cache, _ = self._decode_tick_json(
+                    self.params,
+                    last,
+                    self._cache,
+                    jnp.zeros((self.max_slots,), bool),
+                    jnp.asarray(self._temps),
+                    jnp.asarray(self._top_ps),
+                    self._rng,
+                    self._fsm_states_dev,
+                    jnp.zeros((self.max_slots,), bool),
+                    self._fsm_next_dev,
+                    self._fsm_allowed_dev,
+                )
+            jax.block_until_ready(last)
+
+    def _batch_buckets(self) -> tuple:
+        """Prefill batch-dim buckets: {1, 4, max_slots} — a whole admission wave
+        prefills in ONE dispatch while the compiled-shape space stays 3 x
+        seq-buckets (pow-of-two padding would explode it) and single-request
+        admission pays no padding."""
+        return tuple(sorted({1, min(4, self.max_slots), self.max_slots}))
+
+    def _start_batch(self, batch: List[tuple[int, _Request]]):
+        """One prefill dispatch for every request admitted this wave.
+
+        The batch dim pads to a bucket; pad rows carry zero lengths, PRECEDE the
+        real rows, and alias the first real slot — ``insert_sequences`` scans in
+        row order, so the real row overwrites the pad's zero-length write."""
+        reqs = [r for _, r in batch]
+        slots = [s for s, _ in batch]
+        B = len(batch)
+        bucket = pick_bucket(
+            max(len(r.prompt_ids) for r in reqs), self.prefill_buckets, self.chunk_size
+        )
+        Bp = pick_bucket(B, self._batch_buckets(), self.max_slots)
+        pad = Bp - B
+        ids = np.full((Bp, bucket), self.tokenizer.pad_id, np.int32)
+        lengths = np.zeros((Bp,), np.int32)
+        slot_arr = np.full((Bp,), slots[0], np.int32)
+        for j, req in enumerate(reqs):
+            n = len(req.prompt_ids)
+            ids[pad + j, :n] = req.prompt_ids
+            lengths[pad + j] = n
+            slot_arr[pad + j] = slots[j]
+        with self._mesh_scope():
+            logits, ks, vs = self._prefill(
+                self.params, jnp.asarray(ids), jnp.asarray(lengths)
+            )
+            self._cache = self._insert(
+                self._cache, ks, vs, jnp.asarray(lengths), jnp.asarray(slot_arr)
+            )
+        # activation consumes the FULL [Bp, V] logits so its (eager) sampling
+        # and scatter shapes key on the batch bucket, not the wave size —
+        # otherwise every distinct wave size would trigger fresh compiles
+        self._activate_batch(slots, reqs, logits, pad=pad)
 
     def _begin_chunked(self, slot: int, req: _Request):
         """Split a long prompt into full-size chunks.  The final chunk *slides left*
@@ -552,45 +721,63 @@ class GenerationEngine:
             return
         if st.step >= len(st.starts):
             self._chunking = None
-            self._starting = st.request
+            self._starting_batch = [(st.slot, st.request)]
             self._activate(st.slot, st.request, logits)
-            self._starting = None
+            self._starting_batch = None
 
     def _activate(self, slot: int, req: _Request, logits):
-        """Sample the first token from prefill logits and make the slot live.
+        self._activate_batch([slot], [req], logits, pad=0)
 
-        Fully asynchronous: the token stays on device (chained into the decode
-        token array and, for JSON, the FSM state) and its host value arrives
-        through the inflight pipeline — admission never pays a device sync."""
-        if req.json:
-            self._ensure_fsm()
-            logits = self._mask_prefill_logits(logits)
+    def _activate_batch(
+        self, slots: List[int], reqs: List[_Request], logits, *, pad: int
+    ):
+        """Sample first tokens from prefill logits ([Bp, V], first ``pad`` rows
+        are batch-bucket padding) and make the wave's slots live.
+
+        Fully asynchronous: tokens stay on device (chained into the decode token
+        array and, for JSON, the FSM states) via ONE fused jit call per batch
+        bucket (:meth:`_make_activate`); host values arrive through the inflight
+        pipeline — admission never pays a device sync.  Pad rows sample garbage
+        dropped on device (out-of-bounds scatter index + ``mode="drop"``)."""
         self._rng, sub = jax.random.split(self._rng)
-        first = sample_logits(
-            logits,
-            sub,
-            temperature=jnp.asarray([req.temperature], jnp.float32),
-            top_k=self.top_k,
-            top_p=jnp.asarray([req.top_p], jnp.float32),
-        )
-        s = _Slot(request=req)
-        self._slots[slot] = s
-        self._tokens_dev = self._tokens_dev.at[slot].set(first[0])
-        self._temps[slot] = req.temperature
-        self._top_ps[slot] = req.top_p
-        self._json[slot] = req.json
-        if req.json:
-            safe = jnp.minimum(first[0], self._fsm_next_dev.shape[1] - 1)
-            self._fsm_states_dev = self._fsm_states_dev.at[slot].set(
-                self._fsm_next_dev[self._fsm.initial, safe]
-            )
+        temps = np.asarray([1.0] * pad + [r.temperature for r in reqs], np.float32)
+        top_ps = np.asarray([1.0] * pad + [r.top_p for r in reqs], np.float32)
+        scatter_idx = np.asarray([self.max_slots] * pad + slots, np.int32)
+        with self._mesh_scope():
+            if any(r.json for r in reqs):
+                self._ensure_fsm()
+                jmask = np.asarray([False] * pad + [r.json for r in reqs])
+                first, self._tokens_dev, self._fsm_states_dev = self._activate_fn_json(
+                    logits,
+                    self._tokens_dev,
+                    sub,
+                    temps,
+                    top_ps,
+                    scatter_idx,
+                    fsm_states=self._fsm_states_dev,
+                    jmask=jmask,
+                    init_row=self._fsm_init_row_dev,
+                    next_tab=self._fsm_next_dev,
+                    initial=self._fsm.initial,
+                )
+            else:
+                first, self._tokens_dev = self._activate_fn(
+                    logits, self._tokens_dev, sub, temps, top_ps, scatter_idx
+                )
+        ref_slots = []
+        for slot, req in zip(slots, reqs):
+            self._slots[slot] = _Slot(request=req)
+            self._temps[slot] = req.temperature
+            self._top_ps[slot] = req.top_p
+            self._json[slot] = req.json
+            ref_slots.append((slot, self._slot_epoch[slot]))
         self._sampling_dirty = True
         try:
             first.copy_to_host_async()
         except AttributeError:
             pass
         self._inflight.append(
-            _TickRef(nxt=first, slots=[(slot, self._slot_epoch[slot])], first=True)
+            _TickRef(nxt=first, slots=ref_slots, first=True, offset=pad)
         )
 
     def _refresh_sampling(self):
@@ -648,15 +835,15 @@ class GenerationEngine:
         ref = self._inflight.popleft()
         vals = np.asarray(ref.nxt)
         if ref.first:
-            (slot, epoch) = ref.slots[0]
-            s = self._slots[slot]
-            if s is None or self._slot_epoch[slot] != epoch:
-                return
-            tok = int(vals[0])
-            s.request.first_token_at = time.monotonic()
-            s.generated.append(tok)
-            if self._should_finish(slot, tok):
-                self._finish(slot)
+            for j, (slot, epoch) in enumerate(ref.slots):
+                s = self._slots[slot]
+                if s is None or self._slot_epoch[slot] != epoch:
+                    continue
+                tok = int(vals[ref.offset + j])
+                s.request.first_token_at = time.monotonic()
+                s.generated.append(tok)
+                if self._should_finish(slot, tok):
+                    self._finish(slot)
             return
         for k in range(vals.shape[0]):  # burst steps, oldest first
             for slot, epoch in ref.slots:
@@ -708,9 +895,10 @@ class GenerationEngine:
 
     def _fail_all(self):
         err = RuntimeError("generation engine failure")
-        if self._starting is not None:
-            _safe_resolve(self._starting.future, exc=err)
-            self._starting = None
+        if self._starting_batch is not None:
+            for _, req in self._starting_batch:
+                _safe_resolve(req.future, exc=err)
+            self._starting_batch = None
         self._inflight.clear()
         for i, s in enumerate(self._slots):
             if s is not None:
@@ -724,8 +912,8 @@ class GenerationEngine:
         self._sampling_dirty = True
         # the cache may have been donated into a failed call — rebuild it
         self._cache = self._fresh_cache()
-        self._tokens_dev = jnp.zeros((self.max_slots,), jnp.int32)
-        self._fsm_states_dev = jnp.zeros((self.max_slots,), jnp.int32)
+        self._tokens_dev = self._fresh_tokens()
+        self._fsm_states_dev = self._fresh_tokens()
 
 
 class EmbeddingEngine:
