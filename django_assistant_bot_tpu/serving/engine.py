@@ -556,10 +556,16 @@ class GenerationEngine:
                     len(req.prompt_ids), self.prefill_buckets, self.chunk_size
                 )
                 groups.setdefault(b, []).append((slot, req))
+            # every not-yet-slotted request of the wave stays in
+            # _starting_batch until its group succeeds — if an earlier group's
+            # prefill raises, _fail_all resolves the rest instead of orphaning
+            remaining = [pair for group in groups.values() for pair in group]
+            self._starting_batch = remaining
             for group in groups.values():
-                self._starting_batch = group
                 self._start_batch(group)
-                self._starting_batch = None
+                for pair in group:
+                    remaining.remove(pair)
+            self._starting_batch = None
             admitted = True
         return admitted
 
@@ -622,6 +628,15 @@ class GenerationEngine:
                             initial=self._fsm.initial,
                         )
             jax.random.split(self._rng)  # the per-call rng split op
+            # chunked prefill (prompts > chunk_size) has one fixed shape
+            _, self._cache = self._prefill_chunk(
+                self.params,
+                jnp.zeros((1, self.chunk_size), jnp.int32),
+                self._cache,
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(0, jnp.int32),
+            )
             toks, last, self._cache = self._decode_tick(
                 self.params,
                 self._tokens_dev,

@@ -36,6 +36,13 @@ class ModelSpec:
     # weight-only quantization for decoders: None | "int8" (ops/quant.py) —
     # halves HBM reads on the bandwidth-bound decode path
     quantize: Optional[str] = None
+    # compile every (batch, seq) prefill/activation shape + decode ticks at
+    # load time instead of on first traffic (GenerationEngine.warmup) — slower
+    # boot, no multi-second serve-time compile stalls.  warmup_json also
+    # builds the token FSM + JSON-constrained programs (costs boot time and
+    # device memory for the [S, V] tables — enable when json_format is used)
+    warmup: bool = False
+    warmup_json: bool = False
     max_batch: int = 64
     normalize: bool = False
     num_experts: int = 0
@@ -149,7 +156,10 @@ class ModelRegistry:
                 lookahead=spec.lookahead,
                 burst=spec.burst,
                 mesh=self.mesh,
-            ).start()
+            )
+            if spec.warmup or spec.warmup_json:
+                eng.warmup(json=spec.warmup_json)
+            eng.start()
             self.generators[name] = eng
         else:
             raise ValueError(f"model {name}: unknown kind {spec.kind!r}")

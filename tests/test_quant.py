@@ -86,6 +86,27 @@ def test_quantized_sharded_engine_generates(mesh8, tmp_db):
         registry.stop()
 
 
+def test_registry_warmup_knob(mesh8, tmp_db):
+    """warmup=true compiles shapes at load; the engine then serves normally."""
+    from django_assistant_bot_tpu.serving.registry import ModelRegistry, ModelSpec
+
+    registry = ModelRegistry(mesh=mesh8)
+    spec = ModelSpec(
+        name="warm", kind="decoder", tiny=True, warmup=True, warmup_json=True,
+        max_slots=2, max_seq_len=64,
+    )
+    registry.specs = {"warm": spec}
+    registry.load(spec)
+    eng = registry.get_generator("warm")
+    try:
+        res = eng.submit([5, 9], max_tokens=4, temperature=0.0).result(timeout=600)
+        assert len(res.token_ids) == 4
+        # json variants were compiled too (FSM exists before first json request)
+        assert eng._fsm is not None and eng._decode_tick_json is not None
+    finally:
+        registry.stop()
+
+
 def test_unknown_quantize_rejected(mesh8):
     from django_assistant_bot_tpu.serving.registry import ModelRegistry, ModelSpec
 

@@ -119,6 +119,57 @@ def test_engine_fails_active_requests_and_recovers():
         eng.stop()
 
 
+def test_wave_prefill_failure_fails_every_unstarted_group():
+    """A wave split into seq-bucket groups: if an early group's prefill raises,
+    the later groups' futures must fail too (not hang unresolved)."""
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(2))
+    eng = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=4, max_seq_len=96,
+        prefill_buckets=(32, 64),
+    )
+    # enqueue directly (submit() pre-start intentionally fails fast) so both
+    # requests land in ONE admission wave, split into two seq-bucket groups
+    import time as _time
+    from concurrent.futures import Future
+
+    from django_assistant_bot_tpu.serving.engine import _Request
+
+    fut_short: Future = Future()
+    fut_long: Future = Future()
+    for ids, fut in (([1, 2, 3], fut_short), (list(range(1, 41)), fut_long)):
+        eng._queue.put(
+            _Request(
+                prompt_ids=ids,
+                max_tokens=4,
+                temperature=0.0,
+                top_p=0.95,
+                future=fut,
+                submitted_at=_time.monotonic(),
+            )
+        )
+    state = {"armed": True}
+    orig = eng._prefill
+
+    def boom(*args, **kwargs):
+        if state.pop("armed", False):
+            raise RuntimeError("injected prefill failure")
+        return orig(*args, **kwargs)
+
+    eng._prefill = boom
+    eng.start()
+    try:
+        with pytest.raises(RuntimeError):
+            fut_short.result(timeout=120)
+        with pytest.raises(RuntimeError):
+            fut_long.result(timeout=120)
+        # engine recovered; new requests serve normally
+        res = eng.submit([1, 2, 3], max_tokens=4, temperature=0.0).result(timeout=120)
+        assert len(res.token_ids) == 4
+    finally:
+        eng.stop()
+
+
 def test_embedding_engine_batches_and_coalesces():
     from django_assistant_bot_tpu.models import EncoderConfig, encoder
 
