@@ -628,15 +628,18 @@ class GenerationEngine:
                             initial=self._fsm.initial,
                         )
             jax.random.split(self._rng)  # the per-call rng split op
-            # chunked prefill (prompts > chunk_size) has one fixed shape
-            _, self._cache = self._prefill_chunk(
-                self.params,
-                jnp.zeros((1, self.chunk_size), jnp.int32),
-                self._cache,
-                jnp.asarray(0, jnp.int32),
-                jnp.asarray(0, jnp.int32),
-                jnp.asarray(0, jnp.int32),
-            )
+            if self.chunk_size < self.max_seq_len - 1:
+                # chunked prefill (prompts > chunk_size) has one fixed shape;
+                # unreachable (and not worth compiling) when prompts are
+                # truncated to max_seq_len - 1 <= chunk_size
+                _, self._cache = self._prefill_chunk(
+                    self.params,
+                    jnp.zeros((1, self.chunk_size), jnp.int32),
+                    self._cache,
+                    jnp.asarray(0, jnp.int32),
+                    jnp.asarray(0, jnp.int32),
+                    jnp.asarray(0, jnp.int32),
+                )
             toks, last, self._cache = self._decode_tick(
                 self.params,
                 self._tokens_dev,
@@ -1045,17 +1048,39 @@ class EmbeddingEngine:
                 _safe_resolve(f, result=embs[pos : pos + len(ts)])
                 pos += len(ts)
 
+    def _batch_buckets(self) -> List[int]:
+        sizes, b = [], 1
+        while b < self.max_batch:
+            sizes.append(b)
+            b *= 2
+        sizes.append(self.max_batch)
+        return sizes
+
+    def warmup(self, seq_buckets: Optional[Sequence[int]] = None) -> None:
+        """Deterministically compile every (batch-bucket, seq-bucket) encode
+        shape so no XLA compile lands on the first oddly-sized live batch."""
+        for bucket in seq_buckets if seq_buckets is not None else self.seq_buckets:
+            for b in self._batch_buckets():
+                ids = np.zeros((b, bucket), np.int32)
+                mask = np.ones((b, bucket), np.int32)
+                with self._mesh_scope():
+                    self._encode(self.params, jnp.asarray(ids), jnp.asarray(mask))
+
     def _embed_batch(self, texts: List[str]) -> List[List[float]]:
         cap = self.seq_buckets[-1]
         encoded = [self.tokenizer.encode(t)[:cap] for t in texts]
         longest = max((len(e) for e in encoded), default=1)
         bucket = pick_bucket(longest, self.seq_buckets, cap)
         B = len(encoded)
-        ids = np.full((B, bucket), self.tokenizer.pad_id, np.int32)
-        mask = np.zeros((B, bucket), np.int32)
+        # pad the batch dim to a power-of-two bucket: every distinct live batch
+        # size would otherwise compile its own encode program
+        Bp = pick_bucket(B, self._batch_buckets(), self.max_batch)
+        ids = np.full((Bp, bucket), self.tokenizer.pad_id, np.int32)
+        mask = np.zeros((Bp, bucket), np.int32)
+        mask[B:, 0] = 1  # pad rows see one pad token; all-zero masks divide by 0
         for i, e in enumerate(encoded):
             ids[i, : len(e)] = e
             mask[i, : len(e)] = 1
         with self._mesh_scope():
             embs = self._encode(self.params, jnp.asarray(ids), jnp.asarray(mask))
-        return np.asarray(embs, np.float32).tolist()
+        return np.asarray(embs, np.float32)[:B].tolist()

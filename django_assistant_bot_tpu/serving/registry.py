@@ -93,6 +93,8 @@ class ModelRegistry:
             )
         if spec.quantize and spec.quantize != "int8":
             raise ValueError(f"model {name}: unknown quantize={spec.quantize!r}")
+        if spec.warmup_json and spec.kind == "encoder":
+            raise ValueError(f"model {name}: warmup_json is decoder-only")
         tokenizer_path = spec.path
         logger.info("loading model %r (%s, tiny=%s)", name, spec.kind, spec.tiny)
 
@@ -126,7 +128,10 @@ class ModelRegistry:
                 max_batch=spec.max_batch,
                 normalize=spec.normalize,
                 mesh=self.mesh,
-            ).start()
+            )
+            if spec.warmup:
+                eng.warmup()
+            eng.start()
             self.embedders[name] = eng
         elif spec.kind == "decoder":
             if spec.checkpoint:
