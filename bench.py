@@ -144,8 +144,11 @@ def bench_embedding() -> float:
 
 
 def _decode_bucket() -> int:
-    """The prefill bucket the decode benches actually exercise."""
-    return 128 if DECODE_PROMPT_LEN <= 128 else 512
+    """The prefill bucket the decode benches actually exercise — computed with
+    the engine's own bucket picker so it can't diverge from config 2."""
+    from django_assistant_bot_tpu.serving.engine import pick_bucket
+
+    return pick_bucket(DECODE_PROMPT_LEN, (128, 512), 512)
 
 
 def _build_gen_engine(cfg=None, quantize=None, buckets=(128, 512)):

@@ -220,7 +220,11 @@ class GenerationEngine:
         self._fsm_allowed_dev = None
         self._fsm_states_dev = self._fresh_tokens()
         self._decode_tick_json = None
-        self._rng = jax.random.key(0)
+        # committed sharding for the same reason as _fresh_tokens: the rng
+        # state threads through jit outputs and must round-trip identically
+        self._rng = jax.device_put(
+            jax.random.key(0), _replicated(mesh) if mesh is not None else None
+        )
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self.steps = 0
@@ -266,12 +270,13 @@ class GenerationEngine:
         def act(logits, tokens_dev, rng, temps, top_ps, scatter_idx,
                 fsm_states=None, jmask=None, init_row=None, next_tab=None,
                 initial=None):
+            rng, sub = jax.random.split(rng)
             if json_mode:
                 logits = jnp.where(
                     jmask[:, None] & ~init_row[None, :], NEG_INF, logits
                 )
             first = sample_logits(
-                logits, rng, temperature=temps, top_k=top_k_c, top_p=top_ps
+                logits, sub, temperature=temps, top_k=top_k_c, top_p=top_ps
             )
             tokens_dev = tokens_dev.at[scatter_idx].set(first, mode="drop")
             if json_mode:
@@ -279,12 +284,12 @@ class GenerationEngine:
                 new_states = next_tab[initial, safe]
                 fsm_idx = jnp.where(jmask, scatter_idx, oob)
                 fsm_states = fsm_states.at[fsm_idx].set(new_states, mode="drop")
-                return first, tokens_dev, fsm_states
-            return first, tokens_dev
+                return first, tokens_dev, rng, fsm_states
+            return first, tokens_dev, rng
 
         if self.mesh is not None:
             rep = _replicated(self.mesh)
-            out = (rep, rep) + ((rep,) if json_mode else ())
+            out = (rep, rep, rep) + ((rep,) if json_mode else ())
         else:
             out = None
         return jax.jit(act, out_shardings=out, static_argnames=("initial",))
@@ -321,16 +326,19 @@ class GenerationEngine:
                 return (nxt, cache, rng, fsm_s), nxt
 
             carry = (tokens, cache, rng, fsm_s if json_mode else jnp.zeros_like(tokens))
-            (tokens, cache, _, fsm_s), toks = jax.lax.scan(
+            (tokens, cache, rng, fsm_s), toks = jax.lax.scan(
                 body, carry, None, length=burst_c
             )
+            # the advanced rng is an output: the host threads it call-to-call as
+            # opaque device state — an eager jax.random.split per burst would be
+            # one more dispatch round trip on the critical host path
             if json_mode:
-                return toks, tokens, cache, fsm_s
-            return toks, tokens, cache
+                return toks, tokens, cache, rng, fsm_s
+            return toks, tokens, cache, rng
 
         if self.mesh is not None:
             rep = _replicated(self.mesh)
-            out = (rep, rep, self._cache_shardings) + ((rep,) if json_mode else ())
+            out = (rep, rep, self._cache_shardings, rep) + ((rep,) if json_mode else ())
         else:
             out = None
         return jax.jit(tick, donate_argnums=(2,), out_shardings=out)
@@ -627,7 +635,6 @@ class GenerationEngine:
                             next_tab=self._fsm_next_dev,
                             initial=self._fsm.initial,
                         )
-            jax.random.split(self._rng)  # the per-call rng split op
             if self.chunk_size < self.max_seq_len - 1:
                 # chunked prefill (prompts > chunk_size) has one fixed shape;
                 # unreachable (and not worth compiling) when prompts are
@@ -640,7 +647,7 @@ class GenerationEngine:
                     jnp.asarray(0, jnp.int32),
                     jnp.asarray(0, jnp.int32),
                 )
-            toks, last, self._cache = self._decode_tick(
+            toks, last, self._cache, self._rng = self._decode_tick(
                 self.params,
                 self._tokens_dev,
                 self._cache,
@@ -650,7 +657,7 @@ class GenerationEngine:
                 self._rng,
             )
             if json:
-                toks, last, self._cache, _ = self._decode_tick_json(
+                toks, last, self._cache, self._rng, _ = self._decode_tick_json(
                     self.params,
                     last,
                     self._cache,
@@ -757,7 +764,6 @@ class GenerationEngine:
         bucket (:meth:`_make_activate`); host values arrive through the inflight
         pipeline — admission never pays a device sync.  Pad rows sample garbage
         dropped on device (out-of-bounds scatter index + ``mode="drop"``)."""
-        self._rng, sub = jax.random.split(self._rng)
         temps = np.asarray([1.0] * pad + [r.temperature for r in reqs], np.float32)
         top_ps = np.asarray([1.0] * pad + [r.top_p for r in reqs], np.float32)
         scatter_idx = np.asarray([self.max_slots] * pad + slots, np.int32)
@@ -765,22 +771,24 @@ class GenerationEngine:
             if any(r.json for r in reqs):
                 self._ensure_fsm()
                 jmask = np.asarray([False] * pad + [r.json for r in reqs])
-                first, self._tokens_dev, self._fsm_states_dev = self._activate_fn_json(
-                    logits,
-                    self._tokens_dev,
-                    sub,
-                    temps,
-                    top_ps,
-                    scatter_idx,
-                    fsm_states=self._fsm_states_dev,
-                    jmask=jmask,
-                    init_row=self._fsm_init_row_dev,
-                    next_tab=self._fsm_next_dev,
-                    initial=self._fsm.initial,
+                first, self._tokens_dev, self._rng, self._fsm_states_dev = (
+                    self._activate_fn_json(
+                        logits,
+                        self._tokens_dev,
+                        self._rng,
+                        temps,
+                        top_ps,
+                        scatter_idx,
+                        fsm_states=self._fsm_states_dev,
+                        jmask=jmask,
+                        init_row=self._fsm_init_row_dev,
+                        next_tab=self._fsm_next_dev,
+                        initial=self._fsm.initial,
+                    )
                 )
             else:
-                first, self._tokens_dev = self._activate_fn(
-                    logits, self._tokens_dev, sub, temps, top_ps, scatter_idx
+                first, self._tokens_dev, self._rng = self._activate_fn(
+                    logits, self._tokens_dev, self._rng, temps, top_ps, scatter_idx
                 )
         ref_slots = []
         for slot, req in zip(slots, reqs):
@@ -808,34 +816,36 @@ class GenerationEngine:
 
     def _issue_tick(self):
         """Dispatch one decode tick without waiting for its result.  The token
-        input chains device-to-device from the previous tick; the sampled ids
-        stream back asynchronously and are consumed by :meth:`_process_tick`."""
-        self._rng, sub = jax.random.split(self._rng)
+        input chains device-to-device from the previous tick (the rng state
+        too); the sampled ids stream back asynchronously and are consumed by
+        :meth:`_process_tick`."""
         self._refresh_sampling()
         with self._mesh_scope():
             if self._json.any():
-                toks, last, self._cache, self._fsm_states_dev = self._decode_tick_json(
-                    self.params,
-                    self._tokens_dev,
-                    self._cache,
-                    self._active_dev,
-                    self._temps_dev,
-                    self._top_ps_dev,
-                    sub,
-                    self._fsm_states_dev,
-                    self._json_dev,
-                    self._fsm_next_dev,
-                    self._fsm_allowed_dev,
+                toks, last, self._cache, self._rng, self._fsm_states_dev = (
+                    self._decode_tick_json(
+                        self.params,
+                        self._tokens_dev,
+                        self._cache,
+                        self._active_dev,
+                        self._temps_dev,
+                        self._top_ps_dev,
+                        self._rng,
+                        self._fsm_states_dev,
+                        self._json_dev,
+                        self._fsm_next_dev,
+                        self._fsm_allowed_dev,
+                    )
                 )
             else:
-                toks, last, self._cache = self._decode_tick(
+                toks, last, self._cache, self._rng = self._decode_tick(
                     self.params,
                     self._tokens_dev,
                     self._cache,
                     self._active_dev,
                     self._temps_dev,
                     self._top_ps_dev,
-                    sub,
+                    self._rng,
                 )
         try:
             toks.copy_to_host_async()
@@ -932,6 +942,13 @@ class GenerationEngine:
         self._cache = self._fresh_cache()
         self._tokens_dev = self._fresh_tokens()
         self._fsm_states_dev = self._fresh_tokens()
+        # the rng threads through jit outputs, so a failed device call may have
+        # poisoned it — rebuild it like the rest of the device state (seeded
+        # off the step counter so recovery doesn't replay the same stream)
+        self._rng = jax.device_put(
+            jax.random.key(self.steps + 1),
+            _replicated(self.mesh) if self.mesh is not None else None,
+        )
 
 
 class EmbeddingEngine:

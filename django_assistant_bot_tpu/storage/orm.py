@@ -6,6 +6,17 @@ Covers the query surface the reference framework actually uses from the Django O
 limit / count / delete / update``, unique-together constraints, JSON fields,
 datetime fields, float32-vector BLOB fields, and FK cascades.  Lookups support
 Django-style suffixes: ``field__lt/lte/gt/gte/ne/in/isnull/contains``.
+
+Concurrency model (vs the reference's Postgres): sqlite WAL allows many readers
+concurrent with ONE writer per database file; writers serialize on the file
+lock with a 30 s busy timeout (db.py).  Every write here is a short autocommit
+statement — the task queue's atomic claim UPDATE, lease renewals, and row
+CRUD — so multi-process deployments (api + N workers) contend only for
+microseconds per statement; tests/test_tasks.py demonstrates exactly-once task
+execution under concurrent multi-worker write contention.  The ceiling is
+single-host write throughput (~10k small writes/s in WAL); beyond that, point
+``DABT_DB_PATH`` at separate files per concern or swap the Database class for a
+server-backed one — the ORM surface doesn't change.
 """
 
 from __future__ import annotations
