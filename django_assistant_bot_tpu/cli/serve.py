@@ -14,6 +14,13 @@ def add_parser(sub):
         action="store_true",
         help="serve tiny random models (dev/testing without checkpoints)",
     )
+    p.add_argument(
+        "--warmup",
+        action="store_true",
+        help="compile the prefill/decode/embed shapes before accepting traffic "
+        "(JSON-constrained programs compile on first json request unless "
+        "warmup_json is set per model in the config file)",
+    )
     return p
 
 
@@ -22,16 +29,19 @@ def run(args) -> int:
     from ..serving.server import load_config_file, run_server
 
     if args.tiny:
-        registry = ModelRegistry.from_config(
-            {
-                "tiny-emb": {"kind": "encoder", "tiny": True, "normalize": False},
-                "tiny-chat": {"kind": "decoder", "tiny": True, "max_slots": 4, "max_seq_len": 256},
-            }
-        )
+        config = {
+            "tiny-emb": {"kind": "encoder", "tiny": True, "normalize": False},
+            "tiny-chat": {"kind": "decoder", "tiny": True, "max_slots": 4, "max_seq_len": 256},
+        }
     elif args.config:
-        registry = ModelRegistry.from_config(load_config_file(args.config))
+        config = dict(load_config_file(args.config))
     else:
         print("need --config or --tiny")
         return 2
+    if args.warmup:
+        config = {
+            name: {**spec, "warmup": True} for name, spec in config.items()
+        }
+    registry = ModelRegistry.from_config(config)
     run_server(host=args.host, port=args.port, registry=registry)
     return 0

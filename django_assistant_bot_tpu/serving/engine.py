@@ -220,11 +220,8 @@ class GenerationEngine:
         self._fsm_allowed_dev = None
         self._fsm_states_dev = self._fresh_tokens()
         self._decode_tick_json = None
-        # committed sharding for the same reason as _fresh_tokens: the rng
-        # state threads through jit outputs and must round-trip identically
-        self._rng = jax.device_put(
-            jax.random.key(0), _replicated(mesh) if mesh is not None else None
-        )
+        self._reseeds = 0  # distinct recovery seeds even for back-to-back failures
+        self._rng = self._fresh_rng(0)
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self.steps = 0
@@ -365,6 +362,15 @@ class GenerationEngine:
         self._fsm_init_row_dev = jax.device_put(allowed[fsm.initial], rep)
         self._decode_tick_json = self._make_decode_tick(json_mode=True)
         self._activate_fn_json = self._make_activate(json_mode=True)
+
+    def _fresh_rng(self, seed: int) -> jnp.ndarray:
+        """Committed-sharding rng key — the rng threads through jit outputs and
+        must round-trip with the exact sharding the programs emit (see
+        :meth:`_fresh_tokens`)."""
+        return jax.device_put(
+            jax.random.key(seed),
+            _replicated(self.mesh) if self.mesh is not None else None,
+        )
 
     def _fresh_tokens(self) -> jnp.ndarray:
         """Zeroed [max_slots] int32 with the SAME committed sharding the jitted
@@ -943,12 +949,10 @@ class GenerationEngine:
         self._tokens_dev = self._fresh_tokens()
         self._fsm_states_dev = self._fresh_tokens()
         # the rng threads through jit outputs, so a failed device call may have
-        # poisoned it — rebuild it like the rest of the device state (seeded
-        # off the step counter so recovery doesn't replay the same stream)
-        self._rng = jax.device_put(
-            jax.random.key(self.steps + 1),
-            _replicated(self.mesh) if self.mesh is not None else None,
-        )
+        # poisoned it — rebuild it like the rest of the device state, with a
+        # reseed counter so even back-to-back failures get distinct streams
+        self._reseeds += 1
+        self._rng = self._fresh_rng(self.steps + self._reseeds)
 
 
 class EmbeddingEngine:

@@ -170,6 +170,34 @@ def test_wave_prefill_failure_fails_every_unstarted_group():
         eng.stop()
 
 
+def test_serve_cli_warmup_flag(monkeypatch):
+    """--warmup forces warmup=true onto every model spec before load."""
+    import argparse
+
+    from django_assistant_bot_tpu.cli import serve as serve_cli
+
+    captured = {}
+
+    class FakeRegistry:
+        @classmethod
+        def from_config(cls, config, mesh=None):
+            captured.update(config)
+            return cls()
+
+    monkeypatch.setattr(
+        "django_assistant_bot_tpu.serving.registry.ModelRegistry", FakeRegistry
+    )
+    monkeypatch.setattr(
+        "django_assistant_bot_tpu.serving.server.run_server",
+        lambda host, port, registry: None,
+    )
+    args = argparse.Namespace(
+        config=None, host="0.0.0.0", port=0, tiny=True, warmup=True
+    )
+    assert serve_cli.run(args) == 0
+    assert captured and all(spec["warmup"] for spec in captured.values())
+
+
 def test_embedding_engine_batches_and_coalesces():
     from django_assistant_bot_tpu.models import EncoderConfig, encoder
 
