@@ -345,6 +345,10 @@ def _config_from_dict(kind: str, d: Mapping[str, Any]):
     cls = EncoderConfig if kind == "encoder" else DecoderConfig
     kw = dict(d)
     kw["dtype"] = getattr(jnp, str(np.dtype(kw["dtype"])))
+    if kw.get("rope_scaling"):
+        # JSON round-trips the tuple as a list; the frozen config must stay
+        # hashable (it rides as a static jit argument in the training step)
+        kw["rope_scaling"] = tuple(kw["rope_scaling"])
     return cls(**kw)
 
 

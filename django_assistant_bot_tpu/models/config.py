@@ -75,6 +75,9 @@ class DecoderConfig:
     head_dim: Optional[int] = None
     max_seq_len: int = 8192
     rope_theta: float = 500_000.0
+    # Llama-3.1-style rope frequency remap as a hashable 4-tuple
+    # (factor, low_freq_factor, high_freq_factor, original_max_len); None = plain rope
+    rope_scaling: Optional[tuple] = None
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
     # Qwen2 family: biases on the q/k/v projections (o stays bias-free)
@@ -107,6 +110,28 @@ class DecoderConfig:
         num_experts = hf.get("num_local_experts", 0)
         is_gemma = hf.get("model_type") == "gemma"
         act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
+        rs = hf.get("rope_scaling")
+        rope_scaling = None
+        if rs:
+            kind = rs.get("rope_type") or rs.get("type")
+            if kind == "llama3":
+                rope_scaling = (
+                    float(rs["factor"]),
+                    float(rs["low_freq_factor"]),
+                    float(rs["high_freq_factor"]),
+                    float(rs["original_max_position_embeddings"]),
+                )
+            elif kind != "default":  # HF "default" = plain rope, i.e. None
+                # silently dropping the scaling would mis-place every position
+                # beyond the original context — reject instead
+                raise ValueError(f"unsupported rope_scaling type {kind!r}")
+        # Sliding-window attention (Mistral, Phi-3) is exactly equal to full
+        # attention while sequences stay within the window, so clamping the
+        # usable context to the window keeps parity without a windowed kernel
+        max_seq = hf.get("max_position_embeddings", 8192)
+        window = hf.get("sliding_window")
+        if window:
+            max_seq = min(max_seq, int(window))
         return cls(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -117,8 +142,9 @@ class DecoderConfig:
             head_dim=hf.get("head_dim"),
             hidden_act="gelu_tanh" if "gelu" in act else "silu",
             embed_multiplier=float(hf["hidden_size"]) ** 0.5 if is_gemma else 1.0,
-            max_seq_len=hf.get("max_position_embeddings", 8192),
+            max_seq_len=max_seq,
             rope_theta=hf.get("rope_theta", 500_000.0),
+            rope_scaling=rope_scaling,
             rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
             tie_embeddings=hf.get("tie_word_embeddings", False),
             # Qwen2 checkpoints predate the attention_bias flag; the family

@@ -7,10 +7,13 @@ our [in, out] einsum convention, stacked along the leading layer axis (scan layo
 cast to the target dtype on host, then sharded onto the mesh in one ``device_put``
 (:func:`..parallel.sharding.shard_pytree`).
 
-Supported decoder families: Llama-3 / Mistral, Qwen2 (qkv biases), Gemma-1
-(GeGLU, (1+w) norm fold, scaled embeddings), Mixtral MoE.  Encoders: BERT
-(ruBert-base / MiniLM).  Unknown decoder model_types are rejected rather than
-silently mis-loaded (gemma-2/3 add norms this mapping does not carry).
+Supported decoder families: Llama-3/-3.1 (incl. llama3 rope scaling) /
+Mistral, Qwen2 (qkv biases), Gemma-1 (GeGLU, (1+w) norm fold, scaled
+embeddings), Phi-3 (fused qkv / gate_up split at load), Mixtral MoE.
+Encoders: BERT (ruBert-base / MiniLM).  Unknown decoder model_types and
+unsupported rope_scaling types are rejected rather than silently mis-loaded
+(gemma-2/3 add norms this mapping does not carry; longrope/yarn remaps are
+not implemented).
 """
 
 from __future__ import annotations
@@ -100,7 +103,7 @@ def load_encoder(model_dir: str, dtype=None) -> tuple[EncoderConfig, Dict[str, A
 # families whose tensors AND math this loader maps faithfully; anything else
 # (e.g. gemma2's extra pre/post_feedforward norms) would load without error but
 # produce silently wrong logits, so it is rejected up front
-_SUPPORTED_DECODERS = {"llama", "mistral", "mixtral", "qwen2", "gemma"}
+_SUPPORTED_DECODERS = {"llama", "mistral", "mixtral", "qwen2", "gemma", "phi3"}
 
 
 def load_decoder(model_dir: str, dtype=None) -> tuple[DecoderConfig, Dict[str, Any]]:
@@ -122,12 +125,21 @@ def load_decoder(model_dir: str, dtype=None) -> tuple[DecoderConfig, Dict[str, A
 
     layers: Dict[str, np.ndarray] = {
         "attn_norm": _stack(t, pre + "input_layernorm.weight", L),
-        "wq": _stack(t, pre + "self_attn.q_proj.weight", L, T=True),
-        "wk": _stack(t, pre + "self_attn.k_proj.weight", L, T=True),
-        "wv": _stack(t, pre + "self_attn.v_proj.weight", L, T=True),
         "wo": _stack(t, pre + "self_attn.o_proj.weight", L, T=True),
         "mlp_norm": _stack(t, pre + "post_attention_layernorm.weight", L),
     }
+    if model_type == "phi3":
+        # phi3 fuses qkv and gate/up; split along the output dim
+        qkv = _stack(t, pre + "self_attn.qkv_proj.weight", L, T=True)  # [L,E,(H+2KH)*D]
+        qd = cfg.num_heads * cfg.head_dim
+        kd = cfg.num_kv_heads * cfg.head_dim
+        layers["wq"] = qkv[:, :, :qd]
+        layers["wk"] = qkv[:, :, qd : qd + kd]
+        layers["wv"] = qkv[:, :, qd + kd :]
+    else:
+        layers["wq"] = _stack(t, pre + "self_attn.q_proj.weight", L, T=True)
+        layers["wk"] = _stack(t, pre + "self_attn.k_proj.weight", L, T=True)
+        layers["wv"] = _stack(t, pre + "self_attn.v_proj.weight", L, T=True)
     if cfg.attn_bias:  # Qwen2 family: qkv biases (o_proj stays bias-free)
         layers.update(
             {
@@ -158,6 +170,16 @@ def load_decoder(model_dir: str, dtype=None) -> tuple[DecoderConfig, Dict[str, A
                 "w_gate": stack_experts("w1"),
                 "w_up": stack_experts("w3"),
                 "w_down": stack_experts("w2"),
+            }
+        )
+    elif model_type == "phi3":
+        gate_up = _stack(t, pre + "mlp.gate_up_proj.weight", L, T=True)  # [L,E,2F]
+        F = cfg.intermediate_size
+        layers.update(
+            {
+                "w_gate": gate_up[:, :, :F],
+                "w_up": gate_up[:, :, F:],
+                "w_down": _stack(t, pre + "mlp.down_proj.weight", L, T=True),
             }
         )
     else:

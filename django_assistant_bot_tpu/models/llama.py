@@ -251,7 +251,9 @@ def _repeat_kv(cfg: DecoderConfig, k: jnp.ndarray) -> jnp.ndarray:
 
 
 def _rope_tables(cfg: DecoderConfig, max_len: int):
-    cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
+    cos, sin = rope_frequencies(
+        cfg.head_dim, max_len, cfg.rope_theta, scaling=cfg.rope_scaling
+    )
     return jnp.asarray(cos), jnp.asarray(sin)
 
 

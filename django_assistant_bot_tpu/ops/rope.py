@@ -11,10 +11,33 @@ import numpy as np
 
 
 def rope_frequencies(
-    head_dim: int, max_len: int, theta: float = 500_000.0
+    head_dim: int,
+    max_len: int,
+    theta: float = 500_000.0,
+    scaling: "tuple | None" = None,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Return (cos, sin) tables of shape [max_len, head_dim//2] in float32."""
+    """Return (cos, sin) tables of shape [max_len, head_dim//2] in float32.
+
+    ``scaling`` applies the Llama-3.1 frequency remap as a 4-tuple
+    ``(factor, low_freq_factor, high_freq_factor, original_max_len)``:
+    long-wavelength (low-frequency) components stretch by ``factor``,
+    short-wavelength ones stay, and the band between interpolates smoothly —
+    a one-time host-side table edit, free at run time."""
     inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if scaling is not None:
+        factor, low_f, high_f, orig = scaling
+        wavelen = 2.0 * np.pi / inv_freq
+        low_wavelen = orig / low_f
+        high_wavelen = orig / high_f
+        smooth = np.clip(
+            (orig / wavelen - low_f) / (high_f - low_f), 0.0, 1.0
+        )
+        interp = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = np.where(
+            wavelen > low_wavelen,
+            inv_freq / factor,
+            np.where(wavelen < high_wavelen, inv_freq, interp),
+        )
     t = np.arange(max_len, dtype=np.float64)
     freqs = np.outer(t, inv_freq)
     return np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)

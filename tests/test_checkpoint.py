@@ -145,6 +145,25 @@ def test_kill_and_resume_training_matches_straight_run(tmp_path, mesh8):
     tree_equal(resumed.params, straight.params)
 
 
+def test_rope_scaling_config_roundtrips_as_tuple(tmp_path):
+    """JSON turns the rope_scaling tuple into a list; the restore path must
+    coerce it back or the frozen config becomes unhashable (it rides as a
+    static jit argument in the training step)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        DecoderConfig.tiny(), rope_scaling=(8.0, 1.0, 4.0, 64.0)
+    )
+    params = llama.init(cfg, jax.random.key(5))
+    path = str(tmp_path / "rs-ck")
+    ckpt.save_model(path, "decoder", cfg, params)
+    kind, cfg2, _, _ = ckpt.load_model(path)
+    assert cfg2.rope_scaling == (8.0, 1.0, 4.0, 64.0)
+    assert isinstance(cfg2.rope_scaling, tuple)
+    hash(cfg2)  # frozen dataclass must stay hashable
+    assert cfg2 == cfg
+
+
 def test_registry_loads_native_checkpoint(tmp_path):
     """cli serve can boot a model from a native checkpoint dir instead of HF."""
     from django_assistant_bot_tpu.serving import ModelRegistry

@@ -177,6 +177,89 @@ def test_gemma_matches_hf(tiny_gemma_dir):
     np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=1e-3)
 
 
+def test_llama31_rope_scaling_matches_hf(tmp_path):
+    """Llama-3.1-style checkpoints carry rope_scaling type 'llama3'; the
+    frequency remap must match HF's (silently ignoring it would misplace
+    every position past the original context)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+        tie_word_embeddings=False,
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    d = tmp_path / "llama31"
+    model.save_pretrained(d, safe_serialization=True)
+    jcfg, params = load_decoder(str(d), dtype=jnp.float32)
+    assert jcfg.rope_scaling == (8.0, 1.0, 4.0, 64.0)
+    # long enough that scaled and unscaled frequencies clearly diverge
+    ids = np.asarray(
+        np.random.default_rng(3).integers(1, 128, (1, 96)), np.int32
+    )
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jcfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, atol=5e-4, rtol=1e-3)
+
+
+def test_unsupported_rope_scaling_rejected(tiny_llama_dir, tmp_path):
+    import json, shutil
+
+    d, _ = tiny_llama_dir
+    bad = tmp_path / "longrope"
+    shutil.copytree(d, bad)
+    cfg = json.loads((bad / "config.json").read_text())
+    cfg["rope_scaling"] = {"rope_type": "longrope", "factor": 4.0}
+    (bad / "config.json").write_text(json.dumps(cfg))
+    with pytest.raises(ValueError, match="unsupported rope_scaling"):
+        load_decoder(str(bad))
+
+
+def test_phi3_matches_hf(tmp_path):
+    """Phi-3: fused qkv_proj / gate_up_proj split at load time."""
+    import torch
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    cfg = Phi3Config(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        pad_token_id=0,  # Phi3Config defaults to 32000, past this tiny vocab
+    )
+    model = Phi3ForCausalLM(cfg)
+    model.eval()
+    d = tmp_path / "phi3"
+    model.save_pretrained(d, safe_serialization=True)
+    jcfg, params = load_decoder(str(d), dtype=jnp.float32)
+    ids = np.array([[1, 5, 9, 17, 3, 25, 7, 2]], np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jcfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=1e-3)
+
+
 def test_unsupported_decoder_family_rejected(tiny_gemma_dir, tmp_path):
     """gemma-2 etc. would load without error but mis-compute; reject up front."""
     import json, shutil
