@@ -127,10 +127,15 @@ class DecoderConfig:
                 raise ValueError(f"unsupported rope_scaling type {kind!r}")
         # Sliding-window attention (Mistral, Phi-3) is exactly equal to full
         # attention while sequences stay within the window, so clamping the
-        # usable context to the window keeps parity without a windowed kernel
+        # usable context to the window keeps parity without a windowed kernel.
+        # Qwen2 ships sliding_window but gates it behind use_sliding_window —
+        # and HF defaults that flag OFF for the qwen2 family, on elsewhere.
         max_seq = hf.get("max_position_embeddings", 8192)
         window = hf.get("sliding_window")
-        if window:
+        window_on = hf.get(
+            "use_sliding_window", hf.get("model_type") != "qwen2"
+        )
+        if window and window_on:
             max_seq = min(max_seq, int(window))
         return cls(
             vocab_size=hf["vocab_size"],

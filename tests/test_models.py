@@ -218,6 +218,29 @@ def test_llama31_rope_scaling_matches_hf(tmp_path):
     np.testing.assert_allclose(ours, hf_logits, atol=5e-4, rtol=1e-3)
 
 
+def test_sliding_window_clamps_context_unless_disabled():
+    from django_assistant_bot_tpu.models.config import DecoderConfig
+
+    base = dict(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=4096,
+    )
+    # Mistral/Phi-3 style: window active -> context clamps to it
+    cfg = DecoderConfig.from_hf({**base, "sliding_window": 1024})
+    assert cfg.max_seq_len == 1024
+    # Qwen2 style: window present but disabled -> full context
+    cfg = DecoderConfig.from_hf(
+        {**base, "sliding_window": 1024, "use_sliding_window": False}
+    )
+    assert cfg.max_seq_len == 4096
+    # qwen2 family omitting the flag: HF defaults it OFF for qwen2 only
+    cfg = DecoderConfig.from_hf(
+        {**base, "model_type": "qwen2", "sliding_window": 1024}
+    )
+    assert cfg.max_seq_len == 4096
+
+
 def test_unsupported_rope_scaling_rejected(tiny_llama_dir, tmp_path):
     import json, shutil
 
