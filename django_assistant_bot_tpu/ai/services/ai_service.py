@@ -79,7 +79,8 @@ def get_ai_embedder(model: Optional[str] = None) -> AIEmbedder:
     if model == "test" or model.startswith("test:"):
         from ..providers.echo import HashEmbedder
 
-        return HashEmbedder()
+        # match the storage schema's vector width so test vectors round-trip
+        return HashEmbedder(dim=settings.EMBEDDING_DIM)
     from ..providers.ollama import OllamaEmbedder
 
     return OllamaEmbedder(model=model, host=settings.OLLAMA_ENDPOINT)

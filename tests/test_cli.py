@@ -1,0 +1,99 @@
+"""CLI smoke tests: load_csv, search, emb_test, queue — each command's run()
+drives the real stack (reference: the management commands in SURVEY §2.1 #21)."""
+
+import argparse
+
+import numpy as np
+import pytest
+
+from django_assistant_bot_tpu.cli import emb_test, load_csv, queue_cmd, search
+from django_assistant_bot_tpu.conf import settings
+from django_assistant_bot_tpu.rag.index_registry import reset_indexes
+from django_assistant_bot_tpu.storage import models
+
+
+@pytest.fixture(autouse=True)
+def fresh_indexes():
+    reset_indexes()
+    yield
+    reset_indexes()
+
+
+@pytest.fixture()
+def csv_loaded(tmp_db, tmp_path, capsys):
+    path = tmp_path / "docs.csv"
+    path.write_text(
+        "topic,title,content\n"
+        "Billing,Refunds,Refunds take three days.\n"
+        "Billing,Invoices,Invoices are emailed monthly.\n"
+        "Access,Login,Reset your password from the login page.\n"
+    )
+    args = argparse.Namespace(bot_codename="clibot", path=str(path), no_process=True)
+    assert load_csv.run(args) == 0
+    assert "Loaded 3 documents" in capsys.readouterr().out
+    return models.Bot.objects.get(codename="clibot")
+
+
+def test_load_csv_builds_wiki_tree(csv_loaded):
+    bot = csv_loaded
+    docs = models.WikiDocument.objects.filter(bot=bot).all()
+    titles = {d.title for d in docs}
+    assert {"Billing", "Access", "Refunds", "Invoices", "Login"} <= titles
+    refunds = next(d for d in docs if d.title == "Refunds")
+    assert refunds.parent_id is not None  # 2-level topic tree
+
+
+def test_search_cli_finds_ingested_question(csv_loaded, capsys):
+    bot = csv_loaded
+    wiki = models.WikiDocument.objects.filter(bot=bot, title="Refunds").first()
+    doc = models.Document.objects.create(wiki=wiki, name="Refunds")
+    # embed via the SAME factory the search CLI uses, so dims always agree
+    from django_assistant_bot_tpu.ai.services.ai_service import get_ai_embedder
+
+    import asyncio
+
+    emb = get_ai_embedder("test")
+    vec = asyncio.run(emb.embeddings(["how long do refunds take?"]))[0]
+    models.Question.objects.create(
+        document=doc, text="how long do refunds take?", embedding=np.asarray(vec, np.float32)
+    )
+    with settings.override(EMBEDDING_AI_MODEL="test"):
+        # a document only scores once it has >= max_scores_n hits (reference
+        # aggregation semantics); one question in the corpus -> max_scores_n=1
+        args = argparse.Namespace(
+            query="how long do refunds take?", field="questions", max_scores_n=1, n=5
+        )
+        assert search.run(args) == 0
+    out = capsys.readouterr().out
+    assert "Refunds" in out  # the matching document is printed with its score
+
+
+def test_emb_test_cli_prints_similarity(tmp_db, capsys):
+    with settings.override(EMBEDDING_AI_MODEL="test"):
+        args = argparse.Namespace(query1="hello", query2="hello", model=None)
+        assert emb_test.run(args) == 0
+    out = capsys.readouterr().out
+    assert "Score: " in out
+    score = float(out.split("Score:")[1].strip())
+    assert score == pytest.approx(1.0, abs=1e-5)  # identical texts
+
+
+def test_queue_cli_list_clear_remove(tmp_db, capsys):
+    from django_assistant_bot_tpu.tasks.queue import TaskRecord
+
+    for i in range(3):
+        TaskRecord.objects.create(queue="query", name=f"tests.task{i}", args=[], kwargs={})
+    assert queue_cmd.run(argparse.Namespace(action="list", queue=None, id=None, status=None)) == 0
+    out = capsys.readouterr().out
+    assert "tests.task0" in out and "tests.task2" in out
+
+    first = TaskRecord.objects.all().order_by("id").first()
+    assert (
+        queue_cmd.run(argparse.Namespace(action="remove", queue=None, id=first.id, status=None))
+        == 0
+    )
+    assert TaskRecord.objects.count() == 2
+    assert queue_cmd.run(argparse.Namespace(action="clear", queue="query", id=None, status=None)) == 0
+    assert TaskRecord.objects.count() == 0
+    # remove without --id is a usage error
+    assert queue_cmd.run(argparse.Namespace(action="remove", queue=None, id=None, status=None)) == 1

@@ -2,6 +2,7 @@
 
 import numpy as np
 import optax
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +64,16 @@ def test_train_step_covers_family_variants(mesh8):
         # the bias leaves actually trained (nonzero gradient flowed)
         after = np.asarray(params["layers"]["bq"])
         assert not np.allclose(before, after)
+        # the variant features really change the math: the same weights under a
+        # plain config produce a different loss (guards against silent no-ops)
+        plain = dataclasses.replace(
+            cfg,
+            hidden_act="silu",
+            embed_multiplier=1.0,
+            rope_scaling=None,
+        )
+        plain_loss = float(lm_loss(state.params, plain, ids, mask))
+        assert plain_loss != pytest.approx(float(metrics["loss"]), rel=1e-6)
 
 
 def test_sharded_step_matches_single_device(mesh8):
