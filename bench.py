@@ -347,20 +347,17 @@ def bench_ingestion() -> dict:
     mask = jnp.ones((EMB_BATCH, seq), jnp.int32)
     np.asarray(encode(params, ids, mask))  # compile
 
+    # device-path ingestion: encoder outputs append on device (add_device), no
+    # host round trip per batch — the d2h link (the slowest hop through a
+    # remote tunnel) is off the hot path entirely
     index = VectorIndex(cfg.hidden_size)
+    index.reserve(n_docs)
     t0 = time.perf_counter()
     done = 0
-    FETCH_EVERY = 8  # keep several batches in flight; one sync per group
-    pending = []
     while done < n_docs:
-        pending.append((done, encode(params, ids, mask)))
+        index.add_device(range(done, done + EMB_BATCH), encode(params, ids, mask))
         done += EMB_BATCH
-        if len(pending) >= FETCH_EVERY or done >= n_docs:
-            fetched = jax.device_get([p[1] for p in pending])
-            for (start, _), embs in zip(pending, fetched):
-                index.add(range(start, start + EMB_BATCH), np.asarray(embs, np.float32))
-            pending = []
-    index.search(np.zeros(cfg.hidden_size, np.float32), k=10)  # flush staging
+    index.warmup(ks=(16,), q_rows=(8,))  # blocks until every append landed
     wall = time.perf_counter() - t0
     out["ingest_docs_per_s_per_chip"] = round(done / wall, 2)
     out["ingest_docs"] = done
@@ -372,14 +369,19 @@ def bench_ingestion() -> dict:
     scale_index = VectorIndex(dim)
     t0 = time.perf_counter()
     scale_index.add(range(n_vec), big)
-    scale_index._ensure_device()  # normalize + stage + host->HBM transfer
+    out["knn_build_host_s"] = round(time.perf_counter() - t0, 3)
+    # warmup = the real cost of making the corpus serveable: bf16 host->HBM
+    # transfer + normalize + query-bucket compiles, BLOCKED until resident
+    # (dispatch is async; round 2 under-reported build and the first live
+    # query silently paid the whole transfer)
+    t0 = time.perf_counter()
+    scale_index.warmup(ks=(16,), q_rows=(8, KNN_QUERIES))
     out["knn_build_s"] = round(time.perf_counter() - t0, 3)
     out["knn_vectors"] = n_vec
-    # first query at a new shape bucket pays the one-time XLA compile; report
-    # it separately so build/query costs aren't conflated with it
+    # post-warmup first query — the serving-path reality (no compile stall)
     t0 = time.perf_counter()
     scale_index.search(big[0], k=10)
-    out["knn_first_query_compile_s"] = round(time.perf_counter() - t0, 3)
+    out["knn_first_query_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
 
     lat = []
     q = rng.normal(size=(KNN_QUERIES, dim)).astype(np.float32)

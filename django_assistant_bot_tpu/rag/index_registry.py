@@ -28,6 +28,9 @@ _SCHEMA = (
 _indexes: Dict[Tuple[str, str], VectorIndex] = {}
 _built_generation: Dict[Tuple[str, str], int] = {}  # generation each index was built at
 _lock = threading.Lock()
+# single-flight per key: a rebuild stages + warms a full corpus copy into HBM,
+# so concurrent losers must wait for the winner, not race duplicate transfers
+_build_locks: Dict[Tuple[str, str], threading.Lock] = {}
 
 
 def _db_generation(key: str) -> int:
@@ -47,17 +50,31 @@ def get_index(model_cls: Type[Model], field: str = "embedding") -> VectorIndex:
     with _lock:
         index = _indexes.get(key)
         needs_build = index is None or _built_generation.get(key, -1) != gen
+        build_lock = _build_locks.setdefault(key, threading.Lock())
     if needs_build:
-        fresh = VectorIndex.from_model(model_cls, field=field)
-        with _lock:
-            # only adopt if no invalidation landed during the rebuild; otherwise
-            # keep the stale marker so the next caller rebuilds again
-            if _db_generation(f"{key[0]}.{key[1]}") == gen:
-                _indexes[key] = fresh
-                _built_generation[key] = gen
-                index = fresh
-            else:
-                index = _indexes.get(key) or fresh
+        with build_lock:  # single-flight: losers wait, then re-check
+            # re-read the generation: an invalidation may have landed while we
+            # blocked, and the winner may have built it already — a stale gen
+            # here would trigger a doomed duplicate rebuild+transfer
+            gen = _db_generation(f"{key[0]}.{key[1]}")
+            with _lock:
+                index = _indexes.get(key)
+                if index is not None and _built_generation.get(key, -1) == gen:
+                    return index
+            # warmup now: stages the corpus into HBM, pre-compiles the
+            # query-shape buckets, and BLOCKS until resident — so rebuilds pay
+            # the transfer in the (worker) thread that caused them, never a
+            # live query
+            fresh = VectorIndex.from_model(model_cls, field=field).warmup()
+            with _lock:
+                # only adopt if no invalidation landed during the rebuild;
+                # otherwise keep the stale marker so the next caller rebuilds
+                if _db_generation(f"{key[0]}.{key[1]}") == gen:
+                    _indexes[key] = fresh
+                    _built_generation[key] = gen
+                    index = fresh
+                else:
+                    index = _indexes.get(key) or fresh
     return index
 
 

@@ -50,13 +50,11 @@ async def _objects_embedding_search(
 
     def run() -> List[Model]:
         index = get_index(model_cls, field)
-        # with an allowlist, rank the WHOLE index (exact KNN is one matmul; any
-        # smaller k silently drops allowed rows ranked below the global top-k)
-        k = n if allowed_ids is None else max(len(index), 1)
-        hits = index.search(np.asarray(query_embedding, np.float32), k=k)
-        if allowed_ids is not None:
-            hits = [h for h in hits if h[0] in allowed_ids]
-        hits = hits[:n]
+        # the allowlist becomes a position mask on the scoring kernel — the
+        # same compiled program as the unfiltered path, no full-corpus ranking
+        hits = index.search(
+            np.asarray(query_embedding, np.float32), k=n, allowed_ids=allowed_ids
+        )
         by_id = {
             obj.id: obj
             for obj in model_cls.objects.filter(id__in=[h[0] for h in hits])

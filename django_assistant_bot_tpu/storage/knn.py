@@ -7,11 +7,28 @@ resident in bf16 and score every candidate with one [Q,D]x[D,N] matmul + top-k.
 At the framework's scale (<= millions of 768-d vectors) this is *exact*, runs in
 sub-millisecond MXU time, and has no index build cost — mutation is append/compact.
 
-Shapes are padded to MXU tiles (rows to 8, N to 128) and bucketed by power-of-two
-so recompilation is rare and every compiled kernel is reused.  Appends within the
-current capacity bucket update the device matrix in place (one small
-``dynamic_update_slice``-style transfer) instead of re-staging the whole corpus,
-so steady-state ingestion costs O(batch) host->HBM traffic, not O(N).
+Serving discipline (everything the pgvector HNSW gives Postgres for free):
+
+- **Bucketed shapes everywhere.** Query rows pad to a small bucket set, ``k``
+  pads to a bucket and is sliced on host, appends pad to row buckets written
+  with ``dynamic_update_slice`` (start is a traced operand), and capacity grows
+  by powers of two — so every compiled kernel is reused and steady state never
+  recompiles.
+- **``warmup()``** pre-executes the query kernels for the common (rows, k)
+  buckets and blocks until the corpus is actually resident in HBM.  JAX
+  dispatch is async — without an explicit barrier the first live query would
+  silently pay the whole corpus host->HBM transfer + compile.  Mirrors the
+  generation/embedding engines' warmup (serving/engine.py).
+- **Device-side appends.** Vectors that were just computed on device (the
+  ingestion path) append without a host round trip: ``add_device`` normalizes
+  and writes rows on device and materializes the host copy lazily, so bulk
+  ingestion is compute-bound, not d2h-bound.
+- **One fetch per search.** Scores and indices come back in a single
+  ``device_get`` — per-call latency is one host<->device round trip.
+
+Allow-listed searches (the reference's ``filter(id__in=...)`` + KNN) pass a
+positions mask as the kernel's validity input — same compiled kernel, no
+full-corpus ranking.
 
 Corpora beyond one chip's HBM shard over the mesh ``data`` axis: rows are
 scattered across devices, each device scores its local shard and takes a local
@@ -22,13 +39,31 @@ the classic distributed exact-KNN reduction, riding ICI instead of host RAM.
 from __future__ import annotations
 
 import threading
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..parallel.mesh import pad_to_multiple
+# Compiled-shape buckets.  Queries and k snap to these so the jit cache stays
+# tiny; results are sliced to the caller's true sizes on host.
+_QUERY_BUCKETS = (8, 32, 128)
+_K_BUCKETS = (16, 64, 256, 1024)
+_APPEND_BUCKETS = (64, 256, 1024, 4096)
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return _next_cap(buckets[-1], n)
+
+
+def _next_cap(base: int, target: int) -> int:
+    """Smallest power-of-two multiple of ``base`` that is >= ``target``."""
+    while base < target:
+        base *= 2
+    return base
 
 
 def _topk_scores_impl(index: jnp.ndarray, queries: jnp.ndarray, valid: jnp.ndarray, k: int):
@@ -57,18 +92,49 @@ def _normalize_rows_dev(x: jnp.ndarray) -> jnp.ndarray:
     return (xf / norms).astype(jnp.bfloat16)
 
 
+def _append_rows_impl(index, valid, fresh, fresh_valid, start):
+    """Write a padded row bucket at a *traced* start offset.
+
+    ``start`` being an operand (not a Python int) means one compile per
+    (capacity, bucket) pair covers every append position — the round-2 path
+    compiled a new program per distinct ``.at[start:n]`` slice.  Zero pad rows
+    normalize to zero-norm clamps and land under ``fresh_valid=False``.
+
+    Rows are rounded to bf16 BEFORE normalization so every ingestion route
+    (full stage, host append, device append) produces bit-identical index rows.
+    """
+    fresh = _normalize_rows_dev(fresh.astype(jnp.bfloat16))
+    index = jax.lax.dynamic_update_slice(index, fresh, (start, 0))
+    valid = jax.lax.dynamic_update_slice(valid, fresh_valid, (start,))
+    return index, valid
+
+
+_append_rows = jax.jit(_append_rows_impl)
+
+
+def _grow_dev_impl(index, valid, new_cap: int):
+    big = jnp.zeros((new_cap, index.shape[1]), index.dtype)
+    big = jax.lax.dynamic_update_slice(big, index, (0, 0))
+    big_valid = jnp.zeros((new_cap,), bool)
+    big_valid = jax.lax.dynamic_update_slice(big_valid, valid, (0,))
+    return big, big_valid
+
+
+_grow_dev = jax.jit(_grow_dev_impl, static_argnums=(2,))
+
+
 class VectorIndex:
     """Append/compact exact-KNN index over (id, vector) pairs.
 
-    Thread-safe; the device copy is maintained incrementally: pure appends that
-    fit the current capacity bucket are written in place on device, while
-    overwrites/removes/growth trigger a full re-stage.  Scores are cosine
+    Thread-safe; the device copy is maintained incrementally: pure appends
+    write padded row buckets in place on device (from host vectors or directly
+    from device-resident embeddings via :meth:`add_device`), while
+    overwrites/removes trigger a full re-stage.  Scores are cosine
     similarities in [-1, 1] — rows are normalized on device at staging time
     (host rows stay raw), queries on host at search time.
 
-    Pass ``mesh`` to shard rows over the mesh's ``data`` axis (see
-    :class:`ShardedVectorIndex` semantics below): search then runs as a
-    shard_map with a local top-k per device and an all-gather merge.
+    Pass ``mesh`` to shard rows over the mesh's ``data`` axis: search then runs
+    as a shard_map with a local top-k per device and an all-gather merge.
     """
 
     def __init__(self, dim: int, mesh=None):
@@ -87,19 +153,35 @@ class VectorIndex:
         self._device_count = 0  # rows materialized on device
         self._snapshot_ids: list[int] = []
         self._dirty_full = True
+        # device-born rows whose host copy hasn't been fetched yet:
+        # [(start, device_rows)] — drained lazily (d2h through a remote tunnel
+        # is the slowest link; the serve path never needs it) but bounded, so
+        # a long ingestion run can't hold a second full corpus copy in HBM
+        self._pending_host: list[tuple[int, jnp.ndarray]] = []
+        self._pending_bytes = 0
+        self.pending_host_limit = 256 << 20
 
     def __len__(self) -> int:
         return self._n
 
     # ------------------------------------------------------------------ mutation
     def _grow_host(self, need: int) -> None:
-        cap = max(1024, self._mat.shape[0])
-        while cap < need:
-            cap *= 2
+        cap = _next_cap(max(1024, self._mat.shape[0]), need)
         if cap != self._mat.shape[0]:
             new = np.empty((cap, self.dim), np.float32)
             new[: self._n] = self._mat[: self._n]
             self._mat = new
+
+    def _join_pending_host(self) -> None:
+        """Materialize host copies of device-born rows (one batched fetch)."""
+        if not self._pending_host:
+            return
+        fetched = jax.device_get([rows for _, rows in self._pending_host])
+        for (start, _), host_rows in zip(self._pending_host, fetched):
+            m = host_rows.shape[0]
+            self._mat[start : start + m] = np.asarray(host_rows, np.float32)
+        self._pending_host = []
+        self._pending_bytes = 0
 
     def add(self, ids: Sequence[int], vectors: np.ndarray) -> None:
         # rows are stored raw; normalization happens on device at staging time
@@ -116,6 +198,7 @@ class VectorIndex:
                 self._ids.extend(ids)
                 self._n += m
                 return
+            self._join_pending_host()
             for i, vec in zip(ids, vectors):
                 pos = self._id_pos.get(i)
                 if pos is None:
@@ -128,11 +211,101 @@ class VectorIndex:
                     self._mat[pos] = vec
                     self._dirty_full = True  # in-place overwrite: re-stage
 
+    def add_device(self, ids: Sequence[int], rows) -> None:
+        """Append rows that already live on device (e.g. fresh encoder output).
+
+        The device index is updated with a bucketed on-device write — no
+        host->device or device->host traffic on the hot path; the host copy is
+        fetched lazily only if a full re-stage later needs it.  Falls back to
+        the host path when ids collide/overwrite or the index is sharded.
+        """
+        ids = [int(i) for i in ids]
+        rows = jnp.asarray(rows)
+        if rows.ndim != 2 or rows.shape[1] != self.dim:
+            rows = rows.reshape(-1, self.dim)
+        if rows.shape[0] != len(ids):
+            raise ValueError(
+                f"add_device: {len(ids)} ids for {rows.shape[0]} rows"
+            )
+        with self._lock:
+            fresh_ok = len(set(ids)) == len(ids) and not any(i in self._id_pos for i in ids)
+            if self.mesh is None and fresh_ok and self._n == 0 and self._device_index is None:
+                self._stage_full(0)  # cold start: an empty staged buffer, no transfer
+                self._dirty_full = False
+            device_in_sync = (
+                self.mesh is None
+                and fresh_ok
+                and not self._dirty_full
+                and self._device_index is not None
+                and self._device_count == self._n
+            )
+            if device_in_sync:
+                m = len(ids)
+                start = self._n
+                self._write_bucketed(start, rows, m)
+                self._grow_host(start + m)  # reserve host rows; filled lazily
+                self._pending_host.append((start, rows[:m]))
+                self._pending_bytes += int(rows[:m].size) * rows.dtype.itemsize
+                if self._pending_bytes > self.pending_host_limit:
+                    self._join_pending_host()  # bound the HBM held by raw rows
+                for j, i in enumerate(ids):
+                    self._id_pos[i] = start + j
+                self._ids.extend(ids)
+                self._n = start + m
+                self._device_count = start + m
+                self._snapshot_ids = list(self._ids)
+                return
+        # host fallback (sharded index, id collisions, or device not staged yet)
+        self.add(ids, np.asarray(jax.device_get(rows), np.float32))
+
+    def _write_bucketed(self, start: int, rows: jnp.ndarray, m: int) -> None:
+        """Write ``m`` device rows at ``start``, padded to an append bucket.
+
+        The single home of the clamp-safety invariant: the WHOLE padded bucket
+        must fit capacity, because ``dynamic_update_slice`` clamps an
+        out-of-range start and would silently overwrite row 0 onward.  Grows
+        capacity by powers of two until it does.  Caller holds ``_lock``.
+        """
+        bkt = _bucket(m, _APPEND_BUCKETS)
+        if start + bkt > self._capacity():
+            self._device_index, self._device_valid = _grow_dev(
+                self._device_index,
+                self._device_valid,
+                _next_cap(max(self._capacity(), 1), start + bkt),
+            )
+        if bkt != m:
+            rows = jnp.concatenate([rows, jnp.zeros((bkt - m, self.dim), rows.dtype)])
+        fresh_valid = np.zeros((bkt,), bool)
+        fresh_valid[:m] = True
+        self._device_index, self._device_valid = _append_rows(
+            self._device_index, self._device_valid, rows, jnp.asarray(fresh_valid), start
+        )
+
+    def reserve(self, n: int) -> None:
+        """Pre-grow device capacity for a known ingestion size, so a bulk
+        device-append run compiles its write kernel once instead of once per
+        power-of-two growth step."""
+        if self.mesh is not None:
+            return
+        with self._lock:
+            if self._dirty_full or self._device_index is None:
+                self._stage_full(self._n)
+                self._dirty_full = False
+            cap = self._capacity()
+            if n <= cap:
+                return
+            new_cap = _next_cap(cap, n)
+            self._device_index, self._device_valid = _grow_dev(
+                self._device_index, self._device_valid, new_cap
+            )
+            self._grow_host(new_cap)
+
     def remove(self, ids: Sequence[int]) -> None:
         with self._lock:
             drop = {int(i) for i in ids} & set(self._id_pos)
             if not drop:
                 return
+            self._join_pending_host()
             keep_mask = np.fromiter((i not in drop for i in self._ids), bool, self._n)
             kept = self._mat[: self._n][keep_mask]
             self._mat[: kept.shape[0]] = kept
@@ -148,6 +321,8 @@ class VectorIndex:
             self._n = 0
             self._device_index = self._device_valid = None
             self._device_count = 0
+            self._pending_host = []
+            self._pending_bytes = 0
             self._dirty_full = True
 
     # ------------------------------------------------------------------- search
@@ -161,18 +336,23 @@ class VectorIndex:
 
     def _stage_full(self, n: int) -> None:
         """Re-stage the whole corpus: pad N to the next power-of-two multiple of
-        the row tile so the kernel shape (and its compilation) is reused."""
+        the row tile so the kernel shape (and its compilation) is reused.  The
+        host->HBM transfer goes out as bf16 — half the bytes of the raw f32
+        rows, which matters when the device link is a remote tunnel."""
+        self._join_pending_host()
         n_pad = self._row_multiple()
         while n_pad < n:
             n_pad *= 2
-        mat = np.zeros((n_pad, self.dim), np.float32)
+        mat = np.zeros((n_pad, self.dim), np.dtype(jnp.bfloat16))
         if n:
-            mat[:n] = self._mat[:n]
+            # chunked cast keeps the f32->bf16 conversion cache-resident
+            step = 1 << 16
+            for s in range(0, n, step):
+                e = min(n, s + step)
+                mat[s:e] = self._mat[s:e].astype(np.dtype(jnp.bfloat16))
         valid = np.zeros((n_pad,), bool)
         valid[:n] = True
-        self._device_index = _normalize_rows_dev(
-            self._put(jnp.asarray(mat, jnp.bfloat16), sharded=True)
-        )
+        self._device_index = _normalize_rows_dev(self._put(jnp.asarray(mat), sharded=True))
         self._device_valid = self._put(jnp.asarray(valid), sharded=True)
         self._device_count = n
         self._snapshot_ids = list(self._ids)
@@ -185,64 +365,140 @@ class VectorIndex:
         spec = P("data") if sharded else P()
         return jax.device_put(arr, NamedSharding(self.mesh, spec))
 
-    def _ensure_device(self) -> Tuple[jnp.ndarray, jnp.ndarray, list[int]]:
-        """Returns (device matrix, valid mask, ids snapshot).
+    def _ensure_device(self, allowed_ids: Optional[set] = None):
+        """Returns (device matrix, valid mask, ids snapshot, allowed-positions
+        mask or None).
 
-        The ids snapshot is taken under the same lock that built the device copy,
-        so concurrent remove()/add() compactions can't shift position→id mapping
-        for an in-flight search.
+        The ids snapshot AND the allowlist position mask are taken under the
+        same lock that built the device copy, so concurrent remove()/add()
+        compactions can't shift position→id mapping for an in-flight search.
         """
         with self._lock:
             n = self._n
-            if self._dirty_full or self._device_index is None or n > self._capacity():
+            needs_full = (
+                self._dirty_full
+                or self._device_index is None
+                # the sharded update path can't grow in place; plain indexes
+                # grow on device inside _write_bucketed (no corpus re-transfer)
+                or (self.mesh is not None and n > self._capacity())
+            )
+            if needs_full:
                 self._stage_full(n)
                 self._dirty_full = False
             elif n > self._device_count:
-                # incremental append: normalize the small fresh batch on host
-                # (O(batch); a jitted kernel here would recompile per batch size)
                 start = self._device_count
-                fresh = jnp.asarray(_normalize(self._mat[start:n]), jnp.bfloat16)
-                self._device_index = self._put(
-                    self._device_index.at[start:n].set(fresh), sharded=True
-                )
-                self._device_valid = self._put(
-                    self._device_valid.at[start:n].set(True), sharded=True
-                )
+                if self.mesh is not None:
+                    # sharded copy: keep the replicated-update path (appends are
+                    # rare relative to searches on a sharded corpus); same
+                    # bf16-then-normalize rounding as every other route
+                    fresh = _normalize_rows_dev(
+                        jnp.asarray(self._mat[start:n].astype(np.dtype(jnp.bfloat16)))
+                    )
+                    self._device_index = self._put(
+                        self._device_index.at[start:n].set(fresh), sharded=True
+                    )
+                    self._device_valid = self._put(
+                        self._device_valid.at[start:n].set(True), sharded=True
+                    )
+                else:
+                    # incremental append of host-added rows: bucketed device
+                    # write, reusing one compile per (capacity, bucket); the
+                    # h2d transfer carries only the real rows (bf16)
+                    m = n - start
+                    fresh = jnp.asarray(
+                        self._mat[start:n].astype(np.dtype(jnp.bfloat16))
+                    )
+                    self._write_bucketed(start, fresh, m)
                 self._device_count = n
                 self._snapshot_ids = list(self._ids)
-            return self._device_index, self._device_valid, self._snapshot_ids
+            allowed_mask = None
+            if allowed_ids is not None:
+                # inside the staging lock: _id_pos is consistent with the
+                # just-(re)staged device matrix here and nowhere else
+                allowed_mask = np.zeros((self._capacity(),), bool)
+                for i in allowed_ids:
+                    pos = self._id_pos.get(int(i))
+                    if pos is not None and pos < allowed_mask.shape[0]:
+                        allowed_mask[pos] = True
+            return self._device_index, self._device_valid, self._snapshot_ids, allowed_mask
 
-    def search(self, query: np.ndarray, k: int = 10) -> list[tuple[int, float]]:
+    def warmup(self, ks: Sequence[int] = _K_BUCKETS, q_rows: Sequence[int] = (8,)):
+        """Stage the corpus and pre-execute the search kernels for the common
+        (query-rows, k) buckets, BLOCKING until results are fetchable.
+
+        Dispatch is async: without this, the first live query pays the whole
+        corpus transfer + XLA compile (minutes at 1M x 768 through a remote
+        tunnel).  Call after build (rag/index_registry.py does) — the analog of
+        the serving engines' warmup (serving/engine.py).
+        """
+        if not self._n:
+            return self
+        index, valid, ids, _ = self._ensure_device()
+        q = np.zeros((1, self.dim), np.float32)
+        q[0, 0] = 1.0
+        seen: set = set()
+        for qr in q_rows:
+            qb = _bucket(qr, _QUERY_BUCKETS)
+            for k in ks:
+                kb = min(_bucket(min(k, len(ids)), _K_BUCKETS), index.shape[0])
+                if (qb, kb) in seen:
+                    continue  # small corpora clamp several ks to one bucket
+                seen.add((qb, kb))
+                qp = np.repeat(q, qb, axis=0)
+                if self.mesh is not None:
+                    out = _sharded_topk(self.mesh, index, jnp.asarray(qp), valid, kb)
+                else:
+                    out = _topk_scores(index, jnp.asarray(qp), valid, kb)
+                jax.device_get(out)  # the only reliable barrier through a tunnel
+        return self
+
+    def search(
+        self, query: np.ndarray, k: int = 10, allowed_ids: Optional[set] = None
+    ) -> list[tuple[int, float]]:
         """Top-k (id, cosine_similarity) for one query vector."""
-        pairs = self.search_batch(np.asarray(query, np.float32)[None, :], k)
+        pairs = self.search_batch(
+            np.asarray(query, np.float32)[None, :], k, allowed_ids=allowed_ids
+        )
         return pairs[0]
 
     def search_batch(
-        self, queries: np.ndarray, k: int = 10
+        self, queries: np.ndarray, k: int = 10, allowed_ids: Optional[set] = None
     ) -> list[list[tuple[int, float]]]:
-        index, valid, ids = self._ensure_device()
+        """Batched top-k.  ``allowed_ids`` restricts candidates to that subset
+        by masking their row positions — the same compiled kernel as the
+        unfiltered path (the mask rides the validity input), so no full-corpus
+        ranking and no extra compile, unlike the reference's ``id__in`` +
+        HNSW re-walk."""
+        index, valid, ids, allowed_mask = self._ensure_device(allowed_ids)
         if not ids:
             return [[] for _ in range(len(queries))]
-        k_eff = min(k, len(ids))
+        n_live = len(ids)
+        if allowed_mask is not None:
+            hits = int(allowed_mask.sum())
+            if not hits:
+                return [[] for _ in range(len(queries))]
+            valid = self._put(jnp.asarray(allowed_mask), sharded=True)
+            n_live = hits
+        k_eff = min(k, n_live)
+        kb = min(_bucket(k_eff, _K_BUCKETS), index.shape[0])
         q = _normalize(np.asarray(queries, np.float32).reshape(-1, self.dim))
-        q_pad = pad_to_multiple(q.shape[0], 8)
+        q_pad = _bucket(q.shape[0], _QUERY_BUCKETS)
         if q_pad != q.shape[0]:
             q = np.concatenate([q, np.zeros((q_pad - q.shape[0], self.dim), np.float32)])
         if self.mesh is not None:
-            scores, idx = _sharded_topk(self.mesh, index, jnp.asarray(q), valid, k_eff)
+            out = _sharded_topk(self.mesh, index, jnp.asarray(q), valid, kb)
         else:
-            scores, idx = _topk_scores(index, jnp.asarray(q), valid, k_eff)
-        scores = np.asarray(scores)
-        idx = np.asarray(idx)
-        out = []
+            out = _topk_scores(index, jnp.asarray(q), valid, kb)
+        scores, idx = jax.device_get(out)  # one round trip for both outputs
+        out_rows = []
         for qi in range(len(queries)):
             row = []
             for j in range(k_eff):
                 p = int(idx[qi, j])
                 if p < len(ids) and np.isfinite(scores[qi, j]):
                     row.append((ids[p], float(scores[qi, j])))
-            out.append(row)
-        return out
+            out_rows.append(row)
+        return out_rows
 
     # ----------------------------------------------------------------- loading
     @classmethod

@@ -163,15 +163,111 @@ def test_knn_incremental_append_avoids_full_restage():
     assert stages == []  # appended in place
     # old rows still searchable after the in-place append
     assert index.search(a[10], k=1)[0][0] == 10
-    # growth past capacity re-stages once
+    # growth past capacity grows ON DEVICE — still no O(N) corpus re-transfer
     c = rng.normal(size=(60, 16)).astype(np.float32)
     index.add(list(range(200, 260)), c)
     assert index.search(c[5], k=1)[0][0] == 205
-    assert stages == [150]
-    # overwriting an existing row also re-stages (positions may be reused)
+    assert index.search(a[10], k=1)[0][0] == 10  # pre-growth rows intact
+    assert stages == []
+    # overwriting an existing row re-stages (positions may be reused)
     index.add([10], rng.normal(size=(1, 16)).astype(np.float32))
     index.search(a[0], k=1)
-    assert len(stages) == 2
+    assert len(stages) == 1
+
+
+def test_knn_allowed_ids_mask():
+    """Allow-listed search masks row positions on the scoring kernel — exact
+    filtered top-k without ranking the whole corpus (reference semantics:
+    ``filter(id__in=...)`` + pgvector KNN)."""
+    rng = np.random.default_rng(8)
+    vecs = rng.normal(size=(300, 32)).astype(np.float32)
+    index = VectorIndex(32)
+    index.add(list(range(300)), vecs)
+    q = vecs[42]
+    allowed = {7, 99, 123, 250, 9999}  # 9999 not in the index: ignored
+    hits = index.search(q, k=10, allowed_ids=allowed)
+    assert [i for i, _ in hits[:1]] != [42]  # 42 itself is masked out
+    assert {i for i, _ in hits} <= {7, 99, 123, 250}
+    assert len(hits) == 4
+    # agreement with brute force restricted to the allowlist
+    normed = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+    sims = normed @ normed[42]
+    want = sorted([7, 99, 123, 250], key=lambda i: -sims[i])
+    assert [i for i, _ in hits] == want
+    # nothing allowed -> empty rows, no kernel call explosion
+    assert index.search(q, k=5, allowed_ids={55555}) == []
+    # unfiltered search unaffected
+    assert index.search(q, k=1)[0][0] == 42
+
+
+def test_knn_add_device_no_host_roundtrip():
+    """Device-born rows append without a host round trip and stay searchable;
+    the host copy materializes lazily when a re-stage needs it."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(9)
+    a = rng.normal(size=(100, 16)).astype(np.float32)
+    index = VectorIndex(16)
+    index.add(list(range(100)), a)
+    index.search(a[0], k=1)  # stage
+
+    stages = []
+    orig = index._stage_full
+    index._stage_full = lambda n: (stages.append(n), orig(n))[1]
+
+    b = rng.normal(size=(20, 16)).astype(np.float32)
+    index.add_device(list(range(500, 520)), jnp.asarray(b))
+    assert len(index) == 120
+    assert index._pending_host  # host copy deferred
+    assert index.search(b[3], k=1)[0][0] == 503
+    assert stages == []  # no full re-stage, no host round trip
+    # old rows still searchable
+    assert index.search(a[10], k=1)[0][0] == 10
+    # a remove forces host materialization + re-stage; device rows survive it
+    index.remove([0])
+    assert index.search(b[3], k=1)[0][0] == 503
+    assert not index._pending_host
+    assert len(stages) == 1
+    # device append onto an unstaged/sharded/dirty index falls back to host add
+    cold = VectorIndex(16)
+    cold.add_device([1, 2], jnp.asarray(a[:2]))
+    assert cold.search(a[1], k=1)[0][0] == 2
+
+
+def test_knn_append_bucket_spanning_two_growths():
+    """A padded append bucket must fit capacity entirely: dynamic_update_slice
+    CLAMPS an out-of-range start, which would silently overwrite row 0 onward.
+    Regression: start=50, m=70 -> bucket 256 needs capacity 512, not 256."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    a = rng.normal(size=(50, 16)).astype(np.float32)
+    index = VectorIndex(16)
+    index.add(list(range(50)), a)
+    index.search(a[0], k=1)  # stage at capacity 128
+    b = rng.normal(size=(70, 16)).astype(np.float32)
+    index.add(list(range(100, 170)), b)  # host incremental path
+    assert index.search(a[0], k=1)[0][0] == 0  # old rows intact
+    assert index.search(b[3], k=1)[0][0] == 103
+    # same shape stress through the device-append path
+    index2 = VectorIndex(16)
+    index2.add(list(range(50)), a)
+    index2.search(a[0], k=1)
+    index2.add_device(list(range(100, 170)), jnp.asarray(b))
+    assert index2.search(a[0], k=1)[0][0] == 0
+    assert index2.search(b[3], k=1)[0][0] == 103
+
+
+def test_knn_warmup_precompiles_and_blocks():
+    rng = np.random.default_rng(10)
+    vecs = rng.normal(size=(200, 16)).astype(np.float32)
+    index = VectorIndex(16)
+    index.add(list(range(200)), vecs)
+    assert index.warmup() is index  # stages + pre-executes query buckets
+    assert index._device_count == 200
+    assert index.search(vecs[5], k=3)[0][0] == 5
+    # empty index: warmup is a no-op, not an error
+    assert VectorIndex(8).warmup()._device_index is None
 
 
 def test_knn_remove_then_add_same_count_keeps_ids_fresh():
