@@ -82,6 +82,14 @@ class DecoderConfig:
     tie_embeddings: bool = False
     # Qwen2 family: biases on the q/k/v projections (o stays bias-free)
     attn_bias: bool = False
+    # Sliding-window attention (Mistral, Phi-3, optionally Qwen2): a query
+    # attends to the `sliding_window` most recent positions including itself
+    # (HF masking_utils.sliding_window_overlay semantics).  None = full causal.
+    sliding_window: Optional[int] = None
+    # First windowed layer: layers [0, window_layer_start) use full attention,
+    # [window_layer_start, L) the window — Qwen2's max_window_layers split;
+    # 0 = every layer windowed (Mistral/Phi-3).
+    window_layer_start: int = 0
     # Gemma family: GeGLU MLP ("gelu_tanh") and sqrt(E)-scaled embeddings.
     # Gemma's (1+w) RMSNorm needs no flag — the +1 folds into the stored norm
     # weights at load time (hf_loader), keeping one norm implementation.
@@ -125,18 +133,23 @@ class DecoderConfig:
                 # silently dropping the scaling would mis-place every position
                 # beyond the original context — reject instead
                 raise ValueError(f"unsupported rope_scaling type {kind!r}")
-        # Sliding-window attention (Mistral, Phi-3) is exactly equal to full
-        # attention while sequences stay within the window, so clamping the
-        # usable context to the window keeps parity without a windowed kernel.
-        # Qwen2 ships sliding_window but gates it behind use_sliding_window —
-        # and HF defaults that flag OFF for the qwen2 family, on elsewhere.
+        # Sliding-window attention runs natively (banded masks + block-skipping
+        # flash kernel), so the full advertised context is usable — no clamp.
+        # Qwen2 ships sliding_window but gates it behind use_sliding_window
+        # (HF defaults that flag OFF for the qwen2 family, on elsewhere) and
+        # windows only layers >= max_window_layers.
         max_seq = hf.get("max_position_embeddings", 8192)
         window = hf.get("sliding_window")
         window_on = hf.get(
             "use_sliding_window", hf.get("model_type") != "qwen2"
         )
-        if window and window_on:
-            max_seq = min(max_seq, int(window))
+        sliding_window = int(window) if (window and window_on) else None
+        window_layer_start = 0
+        if sliding_window and hf.get("model_type") == "qwen2":
+            mwl = hf.get("max_window_layers")
+            # HF Qwen2Config defaults max_window_layers=28 when absent — a
+            # fallback of 0 would window every layer HF keeps full
+            window_layer_start = int(mwl) if mwl is not None else 28
         return cls(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -157,6 +170,8 @@ class DecoderConfig:
             attn_bias=bool(
                 hf.get("attention_bias", hf.get("model_type") == "qwen2")
             ),
+            sliding_window=sliding_window,
+            window_layer_start=window_layer_start,
             num_experts=num_experts,
             experts_per_token=hf.get("num_experts_per_tok", 2),
             dtype=dtype,

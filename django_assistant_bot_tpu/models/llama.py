@@ -257,6 +257,35 @@ def _rope_tables(cfg: DecoderConfig, max_len: int):
     return jnp.asarray(cos), jnp.asarray(sin)
 
 
+def _window_split(cfg: DecoderConfig) -> int:
+    """Index of the first sliding-window layer (== num_layers -> none windowed)."""
+    if cfg.sliding_window is None:
+        return cfg.num_layers
+    return min(max(cfg.window_layer_start, 0), cfg.num_layers)
+
+
+def _scan_window_split(cfg: DecoderConfig, make_body, carry, xs):
+    """``lax.scan`` over stacked layers with an optional full/windowed split.
+
+    ``make_body(window)`` returns a scan body; layers [0, split) run full
+    attention, [split, L) the sliding window — Qwen2's ``max_window_layers``
+    semantics (Mistral/Phi-3 have split=0: every layer windowed).  Still at
+    most two compiled bodies regardless of depth; per-layer outputs
+    concatenate back on the stacked-layer axis.
+    """
+    split = _window_split(cfg)
+    if split == cfg.num_layers:
+        return jax.lax.scan(make_body(None), carry, xs)
+    if split == 0:
+        return jax.lax.scan(make_body(cfg.sliding_window), carry, xs)
+    head = jax.tree.map(lambda a: a[:split], xs)
+    tail = jax.tree.map(lambda a: a[split:], xs)
+    carry, y_head = jax.lax.scan(make_body(None), carry, head)
+    carry, y_tail = jax.lax.scan(make_body(cfg.sliding_window), carry, tail)
+    y = jax.tree.map(lambda a, b: jnp.concatenate([a, b], axis=0), y_head, y_tail)
+    return carry, y
+
+
 def forward(
     params: Params,
     cfg: DecoderConfig,
@@ -273,21 +302,24 @@ def forward(
     x = _embed(params, cfg, input_ids)
     x = with_constraint(x, ("batch", "length", "embed"))
 
-    def body(x, p):
-        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _attn_proj(cfg, p, h, cos, sin)
-        k, v = _repeat_kv(cfg, k), _repeat_kv(cfg, v)
-        if mask is None:
-            o = attention(q, k, v, causal=True)
-        else:
-            o = dot_product_attention(q, k, v, causal=True, mask=mask)
-        o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
-        x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
-        h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(cfg, p, h)
-        return with_constraint(x, ("batch", "length", "embed")), None
+    def make_body(window):
+        def body(x, p):
+            h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _attn_proj(cfg, p, h, cos, sin)
+            k, v = _repeat_kv(cfg, k), _repeat_kv(cfg, v)
+            if mask is None:
+                o = attention(q, k, v, causal=True, window=window)
+            else:
+                o = dot_product_attention(q, k, v, causal=True, mask=mask, window=window)
+            o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
+            x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+            h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(cfg, p, h)
+            return with_constraint(x, ("batch", "length", "embed")), None
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
+        return body
+
+    x, _ = _scan_window_split(cfg, make_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("bse,ev->bsv", x, head.astype(cfg.dtype))
@@ -306,8 +338,19 @@ def forward_long(
     at 8k instead (SURVEY.md §5.7); this is the scale-it path.
 
     Semantics match :func:`forward` exactly (same params, causal masking).
+    Sliding-window families are rejected: the ring rotation assumes full
+    causal attention (a window shorter than one shard would make most hops
+    no-ops; implement block-skipping rotation before lifting this).
     """
     from ..ops.ring_attention import ring_attention
+
+    if _window_split(cfg) < cfg.num_layers:
+        # configs where window_layer_start >= num_layers are de-facto full
+        # attention (HF layer_types all "full_attention") and pass through
+        raise NotImplementedError(
+            "forward_long (ring attention) does not support sliding-window "
+            "attention; use forward() — windowed models bound their own context"
+        )
 
     B, S = input_ids.shape
     cos, sin = _rope_tables(cfg, S)
@@ -356,22 +399,26 @@ def prefill(
     cos, sin = _rope_tables(cfg, S)
     x = _embed(params, cfg, input_ids)
 
-    def body(x, p):
-        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _attn_proj(cfg, p, h, cos, sin)
-        kr, vr = _repeat_kv(cfg, k), _repeat_kv(cfg, v)
-        # No pad mask needed: input is right-padded, so causal masking already
-        # restricts every real query to real keys; pad rows' outputs are discarded
-        # (lengths-1 gather below) and their cache entries are overwritten/masked at
-        # decode.  Keeping the call mask-free lets the flash kernel take long buckets.
-        o = attention(q, kr, vr, causal=True)
-        o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
-        x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
-        h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(cfg, p, h)
-        return with_constraint(x, ("batch", "length", "embed")), (k, v)
+    def make_body(window):
+        def body(x, p):
+            h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _attn_proj(cfg, p, h, cos, sin)
+            kr, vr = _repeat_kv(cfg, k), _repeat_kv(cfg, v)
+            # No pad mask needed: input is right-padded, so causal masking already
+            # restricts every real query to real keys; pad rows' outputs are discarded
+            # (lengths-1 gather below) and their cache entries are overwritten/masked at
+            # decode.  Keeping the call mask-free lets the flash kernel take long
+            # buckets — windowed too (the kernel skips kv blocks below the band).
+            o = attention(q, kr, vr, causal=True, window=window)
+            o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
+            x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+            h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(cfg, p, h)
+            return with_constraint(x, ("batch", "length", "embed")), (k, v)
 
-    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+        return body
+
+    x, (ks, vs) = _scan_window_split(cfg, make_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     last = jnp.take_along_axis(
         x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
@@ -445,26 +492,37 @@ def prefill_chunk(
     x = _embed(params, cfg, input_ids)  # [1, C, E]
     # queries attend to every cache position up to their own absolute position
     kpos = jnp.arange(S)[None, None, None, :]
-    attn_mask = kpos <= pos[None, None, :, None]  # [1, 1, C, S]
+    causal_keep = kpos <= pos[None, None, :, None]  # [1, 1, C, S]
 
     k_rows = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0), (L, 1, KH, S, D))
     v_rows = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0), (L, 1, KH, S, D))
 
-    def body(x, inputs):
-        p, k_row, v_row = inputs  # k_row: [1, KH, S, D]
-        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _attn_proj(cfg, p, h, cos, sin)
-        k_row = jax.lax.dynamic_update_slice(k_row, k.astype(k_row.dtype), (0, 0, start, 0))
-        v_row = jax.lax.dynamic_update_slice(v_row, v.astype(v_row.dtype), (0, 0, start, 0))
-        kr, vr = _repeat_kv(cfg, k_row), _repeat_kv(cfg, v_row)
-        o = dot_product_attention(q, kr, vr, mask=attn_mask)  # [1, H, C, D]
-        o = o.transpose(0, 2, 1, 3).reshape(B, C, -1)
-        x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
-        h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(cfg, p, h)
-        return x, (k_row, v_row)
+    def make_body(window):
+        attn_mask = causal_keep
+        if window is not None:
+            # banded over the slot cache: only the window's most recent
+            # absolute positions (including this chunk's own writes) survive
+            attn_mask = attn_mask & (kpos > pos[None, None, :, None] - window)
 
-    x, (k_rows, v_rows) = jax.lax.scan(body, x, (params["layers"], k_rows, v_rows))
+        def body(x, inputs):
+            p, k_row, v_row = inputs  # k_row: [1, KH, S, D]
+            h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _attn_proj(cfg, p, h, cos, sin)
+            k_row = jax.lax.dynamic_update_slice(k_row, k.astype(k_row.dtype), (0, 0, start, 0))
+            v_row = jax.lax.dynamic_update_slice(v_row, v.astype(v_row.dtype), (0, 0, start, 0))
+            kr, vr = _repeat_kv(cfg, k_row), _repeat_kv(cfg, v_row)
+            o = dot_product_attention(q, kr, vr, mask=attn_mask)  # [1, H, C, D]
+            o = o.transpose(0, 2, 1, 3).reshape(B, C, -1)
+            x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+            h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(cfg, p, h)
+            return x, (k_row, v_row)
+
+        return body
+
+    x, (k_rows, v_rows) = _scan_window_split(
+        cfg, make_body, x, (params["layers"], k_rows, v_rows)
+    )
     k = jax.lax.dynamic_update_slice(cache.k, k_rows.astype(cache.k.dtype), (0, slot, 0, 0, 0))
     v = jax.lax.dynamic_update_slice(cache.v, v_rows.astype(cache.v.dtype), (0, slot, 0, 0, 0))
     lengths = jax.lax.dynamic_update_index_in_dim(
@@ -501,37 +559,47 @@ def decode_step(
     x = _embed(params, cfg, tokens)[:, None, :]  # [B,1,E]
     S = cache.max_len
     kpos = jnp.arange(S)[None, :]
-    attn_mask = (kpos <= positions[:, None])[:, None, None, :]  # [B,1,1,S]
+    causal_keep = (kpos <= positions[:, None])[:, None, None, :]  # [B,1,1,S]
 
     H, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
-    def body(x, inputs):
-        p, k_cache, v_cache = inputs
-        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("bse,eo->bso", h, deq(p["wq"], cfg.dtype))
-        k = jnp.einsum("bse,eo->bso", h, deq(p["wk"], cfg.dtype))
-        v = jnp.einsum("bse,eo->bso", h, deq(p["wv"], cfg.dtype))
-        if cfg.attn_bias:
-            q = q + p["bq"]
-            k = k + p["bk"]
-            v = v + p["bv"]
-        q = q.reshape(B, 1, H, D)
-        k = k.reshape(B, 1, KH, D)
-        v = v.reshape(B, 1, KH, D)
-        q = apply_rope(q, cos, sin).transpose(0, 2, 1, 3)
-        k = apply_rope(k, cos, sin).transpose(0, 2, 1, 3)
-        v = v.transpose(0, 2, 1, 3)
-        k_cache = _write_cache(k_cache, k, positions)
-        v_cache = _write_cache(v_cache, v, positions)
-        kr, vr = _repeat_kv(cfg, k_cache), _repeat_kv(cfg, v_cache)
-        o = dot_product_attention(q, kr, vr, mask=attn_mask)  # [B,H,1,D]
-        o = o.transpose(0, 2, 1, 3).reshape(B, 1, -1)
-        x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
-        h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(cfg, p, h)
-        return x, (k_cache, v_cache)
+    def make_body(window):
+        attn_mask = causal_keep
+        if window is not None:
+            # banded mask over the slot cache: per-slot absolute positions
+            attn_mask = attn_mask & (
+                kpos > (positions[:, None] - window)
+            )[:, None, None, :]
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+        def body(x, inputs):
+            p, k_cache, v_cache = inputs
+            h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+            q = jnp.einsum("bse,eo->bso", h, deq(p["wq"], cfg.dtype))
+            k = jnp.einsum("bse,eo->bso", h, deq(p["wk"], cfg.dtype))
+            v = jnp.einsum("bse,eo->bso", h, deq(p["wv"], cfg.dtype))
+            if cfg.attn_bias:
+                q = q + p["bq"]
+                k = k + p["bk"]
+                v = v + p["bv"]
+            q = q.reshape(B, 1, H, D)
+            k = k.reshape(B, 1, KH, D)
+            v = v.reshape(B, 1, KH, D)
+            q = apply_rope(q, cos, sin).transpose(0, 2, 1, 3)
+            k = apply_rope(k, cos, sin).transpose(0, 2, 1, 3)
+            v = v.transpose(0, 2, 1, 3)
+            k_cache = _write_cache(k_cache, k, positions)
+            v_cache = _write_cache(v_cache, v, positions)
+            kr, vr = _repeat_kv(cfg, k_cache), _repeat_kv(cfg, v_cache)
+            o = dot_product_attention(q, kr, vr, mask=attn_mask)  # [B,H,1,D]
+            o = o.transpose(0, 2, 1, 3).reshape(B, 1, -1)
+            x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+            h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(cfg, p, h)
+            return x, (k_cache, v_cache)
+
+        return body
+
+    x, (ks, vs) = _scan_window_split(cfg, make_body, x, (params["layers"], cache.k, cache.v))
     # Inactive (free) slots do get a garbage K/V write at their current `lengths`
     # position, but their lengths don't advance and every new request's prefill
     # overwrites the slot from 0 — so it is never read.  Skipping the masking keeps

@@ -34,16 +34,23 @@ def dot_product_attention(
     causal: bool = False,
     mask: Optional[jnp.ndarray] = None,  # broadcastable to [B, H, Sq, Sk]; True=keep
     q_offset: int | jnp.ndarray = 0,  # absolute position of q[0] (decode w/ KV cache)
+    window: Optional[int] = None,  # sliding window: keep iff kpos > qpos - window
 ) -> jnp.ndarray:
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
-    if causal:
+    if causal or window is not None:
         qpos = jnp.arange(q.shape[2]) + q_offset
         kpos = jnp.arange(k.shape[2])
-        causal_mask = qpos[:, None] >= kpos[None, :]
-        scores = jnp.where(causal_mask[None, None], scores, NEG_INF)
+        keep = jnp.ones((q.shape[2], k.shape[2]), bool)
+        if causal:
+            keep &= qpos[:, None] >= kpos[None, :]
+        if window is not None:
+            # HF sliding-window semantics (masking_utils.sliding_window_overlay):
+            # a query attends to the `window` most recent positions incl. itself
+            keep &= kpos[None, :] > qpos[:, None] - window
+        scores = jnp.where(keep[None, None], scores, NEG_INF)
     if mask is not None:
         scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -55,10 +62,26 @@ def dot_product_attention(
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_len: int, block_kv: int, causal: bool, q_block: int):
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    *,
+    kv_len: int,
+    block_kv: int,
+    causal: bool,
+    q_block: int,
+    window: "Optional[int]" = None,
+):
     """One (batch*head, q-block) program: online softmax over kv blocks.
 
     q_ref: [q_block, D]; k_ref/v_ref: [Sk, D]; o_ref: [q_block, D].
+
+    With ``window`` (sliding-window attention, HF semantics: a query attends to
+    the ``window`` most recent positions including itself) the kv loop also
+    SKIPS blocks entirely below the band — the memory-traffic win that makes
+    long windowed prefill O(S*W) instead of O(S^2).
     """
     qi = pl.program_id(1)
     # keep operands in their storage dtype (bf16): the MXU's fast path; accumulate
@@ -77,16 +100,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_len: int, block_kv: int, cau
         num_iter = jnp.minimum(num_kv_blocks, last_block)
     else:
         num_iter = num_kv_blocks
+    if window is not None:
+        # lowest key any query in this block may see: qpos_min - window + 1
+        first_iter = jnp.maximum(0, qi * q_block - window + 1) // block_kv
+    else:
+        first_iter = 0
 
     def body(ki, carry):
         m, l, o = carry
         k_blk = k_ref[pl.ds(ki * block_kv, block_kv), :]
         v_blk = v_ref[pl.ds(ki * block_kv, block_kv), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale  # [qb, kb]
-        if causal:
+        if causal or window is not None:
             qpos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_kv), 0)
             kpos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_kv), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            keep = qpos >= kpos if causal else (qpos == qpos)
+            if window is not None:
+                keep &= kpos > qpos - window
+            s = jnp.where(keep, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -96,11 +127,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_len: int, block_kv: int, cau
         )
         return m_new, l_new, o_new
 
-    m, l, o = jax.lax.fori_loop(0, num_iter, body, (m0, l0, o0))
+    m, l, o = jax.lax.fori_loop(first_iter, num_iter, body, (m0, l0, o0))
     o_ref[:] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_kv", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_kv", "interpret", "window")
+)
 def flash_attention(
     q: jnp.ndarray,  # [B, H, Sq, D]
     k: jnp.ndarray,  # [B, H, Sk, D]
@@ -110,6 +143,7 @@ def flash_attention(
     block_q: int = 128,
     block_kv: int = 128,
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -130,7 +164,12 @@ def flash_attention(
     vf = v.reshape(B * H, Sk, D)
 
     kernel = functools.partial(
-        _flash_kernel, kv_len=Sk, block_kv=block_kv, causal=causal, q_block=block_q
+        _flash_kernel,
+        kv_len=Sk,
+        block_kv=block_kv,
+        causal=causal,
+        q_block=block_q,
+        window=window,
     )
     out = pl.pallas_call(
         kernel,
@@ -155,11 +194,14 @@ def attention(
     causal: bool = False,
     mask: Optional[jnp.ndarray] = None,
     q_offset: int | jnp.ndarray = 0,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Dispatch: pallas flash kernel on TPU for long un-masked sequences, jnp otherwise.
 
     Decode steps (Sq==1) and padded/masked batches use the jnp path — at those shapes
     the projections dominate and XLA's fused softmax is already bandwidth-optimal.
+    ``window`` (sliding-window attention) rides the flash path: the kernel skips
+    kv blocks below the band entirely.
     """
     D = q.shape[-1]
     use_flash = (
@@ -173,5 +215,7 @@ def attention(
         and q_offset == 0
     )
     if use_flash:
-        return flash_attention(q, k, v, causal=causal)
-    return dot_product_attention(q, k, v, causal=causal, mask=mask, q_offset=q_offset)
+        return flash_attention(q, k, v, causal=causal, window=window)
+    return dot_product_attention(
+        q, k, v, causal=causal, mask=mask, q_offset=q_offset, window=window
+    )

@@ -340,9 +340,7 @@ class VectorIndex:
         host->HBM transfer goes out as bf16 — half the bytes of the raw f32
         rows, which matters when the device link is a remote tunnel."""
         self._join_pending_host()
-        n_pad = self._row_multiple()
-        while n_pad < n:
-            n_pad *= 2
+        n_pad = _next_cap(self._row_multiple(), n)
         mat = np.zeros((n_pad, self.dim), np.dtype(jnp.bfloat16))
         if n:
             # chunked cast keeps the f32->bf16 conversion cache-resident

@@ -6,6 +6,8 @@ disk and loaded through the production safetensors loader — the full load→co
 forward path runs for real, only the scale is fake.
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -218,27 +220,191 @@ def test_llama31_rope_scaling_matches_hf(tmp_path):
     np.testing.assert_allclose(ours, hf_logits, atol=5e-4, rtol=1e-3)
 
 
-def test_sliding_window_clamps_context_unless_disabled():
+def test_sliding_window_config_semantics():
+    """Windowed attention runs natively now: full advertised context stays
+    usable (no clamp) and HF's per-family gating flags map onto
+    (sliding_window, window_layer_start)."""
     from django_assistant_bot_tpu.models.config import DecoderConfig
 
     base = dict(
         vocab_size=128, hidden_size=32, intermediate_size=64,
-        num_hidden_layers=2, num_attention_heads=4,
+        num_hidden_layers=4, num_attention_heads=4,
         max_position_embeddings=4096,
     )
-    # Mistral/Phi-3 style: window active -> context clamps to it
+    # Mistral/Phi-3 style: window active in every layer, context NOT clamped
     cfg = DecoderConfig.from_hf({**base, "sliding_window": 1024})
-    assert cfg.max_seq_len == 1024
-    # Qwen2 style: window present but disabled -> full context
+    assert cfg.max_seq_len == 4096
+    assert cfg.sliding_window == 1024
+    assert cfg.window_layer_start == 0
+    # Qwen2 style: window present but disabled -> full attention
     cfg = DecoderConfig.from_hf(
         {**base, "sliding_window": 1024, "use_sliding_window": False}
     )
-    assert cfg.max_seq_len == 4096
+    assert cfg.sliding_window is None
     # qwen2 family omitting the flag: HF defaults it OFF for qwen2 only
     cfg = DecoderConfig.from_hf(
         {**base, "model_type": "qwen2", "sliding_window": 1024}
     )
-    assert cfg.max_seq_len == 4096
+    assert cfg.sliding_window is None
+    # qwen2 with the flag on: layers [0, max_window_layers) stay full
+    cfg = DecoderConfig.from_hf(
+        {
+            **base,
+            "model_type": "qwen2",
+            "sliding_window": 1024,
+            "use_sliding_window": True,
+            "max_window_layers": 2,
+        }
+    )
+    assert cfg.sliding_window == 1024
+    assert cfg.window_layer_start == 2
+    # absent max_window_layers falls back to HF's default of 28 (not 0 — that
+    # would window every layer HF keeps full)
+    cfg = DecoderConfig.from_hf(
+        {
+            **base,
+            "model_type": "qwen2",
+            "sliding_window": 1024,
+            "use_sliding_window": True,
+        }
+    )
+    assert cfg.window_layer_start == 28
+
+
+def test_mistral_sliding_window_matches_hf(tmp_path):
+    """Prompt LONGER than the window — the parity case the round-2 clamp
+    truncated (reference capability bar: 8k contexts via Ollama serve the
+    full prompt, .env.example:12-19)."""
+    import torch
+    from transformers import MistralConfig, MistralForCausalLM
+
+    cfg = MistralConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        sliding_window=4,
+        tie_word_embeddings=False,
+    )
+    model = MistralForCausalLM(cfg)
+    model.eval()
+    d = tmp_path / "mistral"
+    model.save_pretrained(d, safe_serialization=True)
+    jcfg, params = load_decoder(str(d), dtype=jnp.float32)
+    assert jcfg.sliding_window == 4
+    assert jcfg.max_seq_len == 128
+    ids = np.array([[1, 5, 9, 17, 3, 25, 7, 2, 11, 4, 19, 6]], np.int32)  # 12 > 4
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jcfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=1e-3)
+    # sanity: the window actually changes the result
+    full = dataclasses.replace(jcfg, sliding_window=None)
+    ours_full = np.asarray(llama.forward(params, full, jnp.asarray(ids)))
+    assert np.abs(ours_full - ours).max() > 1e-3
+
+
+def test_qwen2_window_layer_split_matches_hf(tmp_path):
+    """Qwen2 max_window_layers: layer 0 full, layer 1 windowed — the split-scan
+    path must agree with HF's per-layer layer_types masks."""
+    import torch
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    cfg = Qwen2Config(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        use_sliding_window=True,
+        sliding_window=4,
+        max_window_layers=1,
+        tie_word_embeddings=False,
+    )
+    model = Qwen2ForCausalLM(cfg)
+    model.eval()
+    d = tmp_path / "qwen2win"
+    model.save_pretrained(d, safe_serialization=True)
+    jcfg, params = load_decoder(str(d), dtype=jnp.float32)
+    assert jcfg.sliding_window == 4
+    assert jcfg.window_layer_start == 1
+    ids = np.array([[1, 5, 9, 17, 3, 25, 7, 2, 11, 4, 19, 6]], np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jcfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=1e-3)
+
+
+def test_windowed_prefill_chunk_decode_matches_forward(tmp_path):
+    """Windowed banded masks over the slot cache: prefill / chunked prefill /
+    decode must all agree with the full windowed forward beyond the window."""
+    import torch
+    from transformers import MistralConfig, MistralForCausalLM
+
+    hf_cfg = MistralConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        sliding_window=4,
+        tie_word_embeddings=False,
+    )
+    model = MistralForCausalLM(hf_cfg)
+    model.eval()
+    d = tmp_path / "mistral2"
+    model.save_pretrained(d, safe_serialization=True)
+    cfg, params = load_decoder(str(d), dtype=jnp.float32)
+    prompt = np.array([[1, 5, 9, 17, 3, 25, 7, 2, 11, 4]], np.int32)  # 10 > 4
+    n_new = 5
+
+    seq = prompt.copy()
+    for _ in range(n_new):
+        logits = llama.forward(params, cfg, jnp.asarray(seq))
+        seq = np.concatenate([seq, [[int(jnp.argmax(logits[0, -1]))]]], axis=1)
+    expected = seq[0, prompt.shape[1]:].tolist()
+
+    # monolithic prefill + decode
+    cache = llama.init_cache(cfg, batch=1, max_len=32, dtype=jnp.float32)
+    lengths = jnp.asarray([prompt.shape[1]], jnp.int32)
+    logits, ks, vs = llama.prefill(params, cfg, jnp.asarray(prompt), lengths)
+    cache = llama.insert_sequences(cache, ks, vs, lengths, jnp.asarray([0], jnp.int32))
+    got = [int(jnp.argmax(logits[0]))]
+    for _ in range(n_new - 1):
+        logits, cache = llama.decode_step(
+            params, cfg, jnp.asarray([got[-1]], jnp.int32), cache
+        )
+        got.append(int(jnp.argmax(logits[0])))
+    assert got == expected
+
+    # chunked prefill (two chunks of 5; the second spans the window boundary)
+    cache = llama.init_cache(cfg, batch=1, max_len=32, dtype=jnp.float32)
+    slot = jnp.asarray(0, jnp.int32)
+    logits, cache = llama.prefill_chunk(
+        params, cfg, jnp.asarray(prompt[:, :5]), cache, slot,
+        jnp.asarray(0, jnp.int32), jnp.asarray(5, jnp.int32),
+    )
+    logits, cache = llama.prefill_chunk(
+        params, cfg, jnp.asarray(prompt[:, 5:]), cache, slot,
+        jnp.asarray(5, jnp.int32), jnp.asarray(5, jnp.int32),
+    )
+    got = [int(jnp.argmax(logits[0]))]
+    for _ in range(n_new - 1):
+        logits, cache = llama.decode_step(
+            params, cfg, jnp.asarray([got[-1]], jnp.int32), cache
+        )
+        got.append(int(jnp.argmax(logits[0])))
+    assert got == expected
 
 
 def test_unsupported_rope_scaling_rejected(tiny_llama_dir, tmp_path):

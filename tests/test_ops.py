@@ -33,6 +33,27 @@ def test_flash_matches_reference(qkv, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.parametrize("window", [64, 100, 256])
+def test_flash_sliding_window_matches_reference(qkv, window):
+    """Windowed flash (block-skip + in-block band) vs the jnp banded path."""
+    q, k, v = qkv
+    ref = dot_product_attention(q, k, v, causal=True, window=window)
+    out = flash_attention(q, k, v, causal=True, window=window, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
+def test_window_mask_semantics():
+    """keep iff kpos > qpos - W (HF sliding_window_overlay): with W=1 every
+    query sees only itself, so softmax returns exactly its own value row."""
+    rng = np.random.default_rng(2)
+    B, H, S, D = 1, 1, 8, 4
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    out = dot_product_attention(q, k, v, causal=True, window=1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(v), atol=1e-6)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_reference(qkv, mesh8, causal):
     q, k, v = qkv
