@@ -196,6 +196,89 @@ def init(cfg: DecoderConfig, rng: jax.Array) -> Params:
     return params
 
 
+def init_int8(cfg: DecoderConfig, rng: jax.Array) -> Params:
+    """Synthetic int8-quantized params generated ON DEVICE — no host staging.
+
+    For serving benches and sharding dryruns at flagship geometry (e.g.
+    Llama-3-8B: ~8 GB int8): a host-side init would stage 1-2 bytes/param
+    through the host->device link, minutes through a remote tunnel.  Here the
+    int8 weights are random bits drawn directly into HBM and scales are set so
+    dequantized magnitudes match :func:`init`'s normal(0, E^-0.5) — decode
+    throughput is weight-value independent, so the result benches identically
+    to a quantized real checkpoint of the same geometry.
+
+    Layer projections become :class:`~..ops.quant.QTensor` (int8 + per-output
+    -channel f32 scales, contraction dim -2 = 1) exactly like
+    ``quantize_decoder_params`` output; norms/embeddings/head stay in
+    ``cfg.dtype``.  ``random.bits`` at uint8 keeps the transient generation
+    buffer ~1x the result (randint would stage an int32 intermediate, 4x).
+    """
+    from ..ops.quant import QTensor
+
+    E, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    H, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    s = E ** -0.5
+    # uniform int8 has std ~127/sqrt(3); scale recovers the target std
+    UNIFORM_STD = 127.0 / (3.0 ** 0.5)
+    keys = iter(jax.random.split(rng, 16))
+
+    def qdense(shape, target_std=None):
+        q = jax.random.bits(next(keys), shape, jnp.uint8).astype(jnp.int8)
+        scale_shape = shape[:-2] + (1, shape[-1])
+        scale = jnp.full(scale_shape, (target_std or s) / UNIFORM_STD, jnp.float32)
+        return QTensor(q=q, scale=scale)
+
+    layers: Dict[str, Any] = {
+        "attn_norm": jnp.ones((L, E), cfg.dtype),
+        "wq": qdense((L, E, H * D)),
+        "wk": qdense((L, E, KH * D)),
+        "wv": qdense((L, E, KH * D)),
+        "wo": qdense((L, H * D, E)),
+        "mlp_norm": jnp.ones((L, E), cfg.dtype),
+    }
+    if cfg.attn_bias:
+        layers.update(
+            {
+                "bq": jnp.zeros((L, H * D), cfg.dtype),
+                "bk": jnp.zeros((L, KH * D), cfg.dtype),
+                "bv": jnp.zeros((L, KH * D), cfg.dtype),
+            }
+        )
+    if cfg.is_moe:
+        X = cfg.num_experts
+        layers.update(
+            {
+                # the router stays dense: moe_mlp reads it in f32 (and
+                # quantize_decoder_params leaves it out too — tiny + routing
+                # quality is disproportionately sensitive)
+                "router": jax.random.normal(next(keys), (L, E, X), cfg.dtype)
+                * jnp.asarray(s, cfg.dtype),
+                "w_gate": qdense((L, X, E, F)),
+                "w_up": qdense((L, X, E, F)),
+                "w_down": qdense((L, X, F, E), target_std=F ** -0.5),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": qdense((L, E, F)),
+                "w_up": qdense((L, E, F)),
+                "w_down": qdense((L, F, E), target_std=F ** -0.5),
+            }
+        )
+    params: Params = {
+        "tok_embed": jax.random.normal(next(keys), (cfg.vocab_size, E), cfg.dtype),
+        "final_norm": jnp.ones((E,), cfg.dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(next(keys), (E, cfg.vocab_size), cfg.dtype)
+            * jnp.asarray(s, cfg.dtype)
+        )
+    return params
+
+
 def _embed(params: Params, cfg: DecoderConfig, ids: jnp.ndarray) -> jnp.ndarray:
     """Token embedding lookup; Gemma scales by sqrt(E) (in model dtype, like HF)."""
     x = params["tok_embed"][ids].astype(cfg.dtype)

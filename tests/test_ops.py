@@ -42,6 +42,21 @@ def test_flash_sliding_window_matches_reference(qkv, window):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.parametrize("window", [64, 150])
+def test_flash_window_block_skip(window):
+    """S >> window: late q-blocks start their kv loop past block 0
+    (first_iter > 0) — exercises the skip arithmetic, not just the in-block
+    band (S=512, block_kv=128: q-block 3 skips >= 1 kv block for W<=257)."""
+    rng = np.random.default_rng(3)
+    B, H, S, D = 1, 2, 512, 64
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True, window=window)
+    out = flash_attention(q, k, v, causal=True, window=window, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
 def test_window_mask_semantics():
     """keep iff kpos > qpos - W (HF sliding_window_overlay): with W=1 every
     query sees only itself, so softmax returns exactly its own value row."""
