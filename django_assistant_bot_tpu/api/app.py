@@ -56,13 +56,24 @@ def dialog_to_dict(d: models.Dialog) -> dict:
     }
 
 
-def message_to_dict(m: models.Message) -> dict:
+def message_to_dict(m: models.Message, media_base: "str | None" = None) -> dict:
+    # photos persist as files under MEDIA_ROOT (dialog_service._save_photo);
+    # with a per-host absolute base from media_url_middleware the API exposes
+    # them as fetchable URLs — the reference's MEDIA_URL serializer semantics
+    photo_url = None
+    if m.photo and media_base and settings.MEDIA_ROOT:
+        import os
+
+        rel = os.path.relpath(m.photo, settings.MEDIA_ROOT)
+        if not rel.startswith(".."):
+            photo_url = media_base.rstrip("/") + "/" + rel.replace(os.sep, "/")
     return {
         "id": m.id,
         "message_id": m.message_id,
         "dialog_id": m.dialog_id,
         "role": m.role.name if m.role_id else None,
         "text": m.text,
+        "photo": photo_url,
         "timestamp": _dt(m.timestamp),
         "cost": m.cost,
         "cost_details": m.cost_details or {},
@@ -98,8 +109,24 @@ def _page_qs(request: web.Request, qs, serialize) -> dict:
 
 
 @web.middleware
+async def media_url_middleware(request: web.Request, handler):
+    """Reference parity: ``MediaURLMiddleware`` rewrites MEDIA_URL to an
+    absolute per-host URL (reference: assistant/assistant/middleware.py:4-15).
+    Mutating a global setting per request is a data race under async serving,
+    so the absolute URL is computed into ``request['media_url']`` instead and
+    the message serializer absolutizes stored photo paths with it."""
+    base = settings.MEDIA_URL
+    if base.startswith("http"):
+        request["media_url"] = base
+    else:
+        request["media_url"] = f"{request.scheme}://{request.host}{base}"
+    return await handler(request)
+
+
+@web.middleware
 async def auth_middleware(request: web.Request, handler):
-    if request.path.startswith("/admin"):
+    # bound to the actual /admin mount — "/adminfoo" must not take this branch
+    if request.path == "/admin" or request.path.startswith("/admin/"):
         # /admin mutates state from browser forms, so it gets interactive HTTP
         # Basic auth (the Django-admin-login analog) rather than the API token
         # the forms cannot send.  Credentials: DABT_ADMIN_BASIC_AUTH
@@ -119,11 +146,18 @@ async def auth_middleware(request: web.Request, handler):
                 )
         return await handler(request)
     token = getattr(settings, "API_AUTH_TOKEN", None)
-    # docs are public like the reference's AllowAny schema view (urls.py:33-64)
+    # docs are public like the reference's AllowAny schema view (urls.py:33-64);
+    # media must be fetchable by platforms (Telegram downloads sent photos by
+    # URL) — the reference serves MEDIA_ROOT outside DRF auth entirely.
+    # Anchored like /admin above: "/mediafoo" must NOT inherit the exemption.
+    media_base = settings.MEDIA_URL if not settings.MEDIA_URL.startswith("http") else None
+    if media_base:
+        media_base = "/" + media_base.strip("/") + "/"
     exempt = (
         request.path.startswith("/telegram/")
         or request.path == "/healthz"
         or request.path in ("/api/docs", "/api/openapi.json")
+        or bool(media_base and request.path.startswith(media_base))
     )
     if token and not exempt:
         got = request.headers.get("Authorization", "")
@@ -133,7 +167,14 @@ async def auth_middleware(request: web.Request, handler):
 
 
 def create_api_app() -> web.Application:
-    app = web.Application(middlewares=[auth_middleware])
+    app = web.Application(middlewares=[media_url_middleware, auth_middleware])
+    if settings.MEDIA_ROOT and not settings.MEDIA_URL.startswith("http"):
+        import os
+
+        # create eagerly: a fresh deployment's empty volume must not silently
+        # disable media serving until a restart
+        os.makedirs(settings.MEDIA_ROOT, exist_ok=True)
+        app.router.add_static(settings.MEDIA_URL, settings.MEDIA_ROOT)
 
     # ---------------------------------------------------------------- webhook
     async def telegram_webhook(request: web.Request) -> web.Response:
@@ -214,7 +255,10 @@ def create_api_app() -> web.Application:
         if dialog is None:
             return web.json_response({"detail": "not found"}, status=404)
         qs = models.Message.objects.filter(dialog=dialog).order_by("id")
-        return web.json_response(_page_qs(request, qs, message_to_dict))
+        base = request.get("media_url")
+        return web.json_response(
+            _page_qs(request, qs, lambda m: message_to_dict(m, media_base=base))
+        )
 
     async def create_message(request: web.Request) -> web.Response:
         """Synchronous serve path: run the engine inline, return the user message
@@ -260,7 +304,11 @@ def create_api_app() -> web.Application:
                 {"text": p.text, "thinking": p.thinking, "usage": p.usage} for p in parts
             ]
         return web.json_response(
-            {"message": message_to_dict(user_message), "answers": answers}, status=201
+            {
+                "message": message_to_dict(user_message, media_base=request.get("media_url")),
+                "answers": answers,
+            },
+            status=201,
         )
 
     # ------------------------------------------------------------------- wiki

@@ -71,7 +71,12 @@ def get_last_message(dialog: Dialog) -> Optional[Message]:
 
 
 def _save_photo(photo: Photo) -> Optional[str]:
-    media_dir = os.environ.get("DABT_MEDIA_DIR", os.path.join(os.getcwd(), "media", "photos"))
+    # default under MEDIA_ROOT so the API can hand out /media/photos/... URLs
+    from ...conf import settings
+
+    media_dir = os.environ.get("DABT_MEDIA_DIR") or os.path.join(
+        settings.MEDIA_ROOT or os.path.join(os.getcwd(), "media"), "photos"
+    )
     try:
         os.makedirs(media_dir, exist_ok=True)
         path = os.path.join(media_dir, f"{photo.file_id}.{photo.extension}")

@@ -346,9 +346,13 @@ def _config_from_dict(kind: str, d: Mapping[str, Any]):
     kw = dict(d)
     kw["dtype"] = getattr(jnp, str(np.dtype(kw["dtype"])))
     if kw.get("rope_scaling"):
-        # JSON round-trips the tuple as a list; the frozen config must stay
-        # hashable (it rides as a static jit argument in the training step)
-        kw["rope_scaling"] = tuple(kw["rope_scaling"])
+        # JSON round-trips tuples as lists; the frozen config must stay
+        # hashable (it rides as a static jit argument in the training step).
+        # Recursive: longrope carries nested per-frequency factor tuples.
+        def _retuple(v):
+            return tuple(_retuple(x) for x in v) if isinstance(v, list) else v
+
+        kw["rope_scaling"] = _retuple(kw["rope_scaling"])
     return cls(**kw)
 
 

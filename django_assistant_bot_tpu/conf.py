@@ -71,6 +71,10 @@ class Settings:
         # vector schema (reference fixes 768 for ruBert — assistant/storage/models.py:13;
         # configurable here so tiny dev models and other embedders fit the same schema)
         self.EMBEDDING_DIM: int = int(_env("EMBEDDING_DIM", 768))
+        # media plane (reference: settings.MEDIA_URL + MediaURLMiddleware,
+        # assistant/assistant/middleware.py:4-15)
+        self.MEDIA_URL: str = _env("MEDIA_URL", "/media/")
+        self.MEDIA_ROOT: Optional[str] = _env("MEDIA_ROOT")
 
     def import_string(self, path: str):
         module, _, name = path.rpartition(".")

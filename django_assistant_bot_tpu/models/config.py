@@ -121,13 +121,68 @@ class DecoderConfig:
         rs = hf.get("rope_scaling")
         rope_scaling = None
         if rs:
+            import math
+
             kind = rs.get("rope_type") or rs.get("type")
+            max_pos = hf.get("max_position_embeddings", 8192)
             if kind == "llama3":
                 rope_scaling = (
                     float(rs["factor"]),
                     float(rs["low_freq_factor"]),
                     float(rs["high_freq_factor"]),
                     float(rs["original_max_position_embeddings"]),
+                )
+            elif kind == "linear":
+                rope_scaling = ("linear", float(rs["factor"]))
+            elif kind == "longrope":
+                # Phi-3 128k (transformers modeling_rope_utils
+                # _compute_longrope_parameters): per-frequency factor lists +
+                # an attention factor derived from the context extension ratio
+                orig = float(
+                    hf.get("original_max_position_embeddings")
+                    or rs.get("original_max_position_embeddings")
+                    or max_pos
+                )
+                factor = rs.get("factor")
+                if hf.get("original_max_position_embeddings"):
+                    factor = max_pos / float(hf["original_max_position_embeddings"])
+                af = rs.get("attention_factor")
+                if af is None:
+                    af = (
+                        1.0
+                        if factor is None or factor <= 1.0
+                        else math.sqrt(1.0 + math.log(factor) / math.log(orig))
+                    )
+                rope_scaling = (
+                    "longrope",
+                    tuple(float(x) for x in rs["short_factor"]),
+                    tuple(float(x) for x in rs["long_factor"]),
+                    orig,
+                    float(af),
+                )
+            elif kind == "yarn":
+                factor = float(rs["factor"])
+                orig = float(rs.get("original_max_position_embeddings") or max_pos)
+                mscale = rs.get("mscale")
+                mscale_all = rs.get("mscale_all_dim")
+
+                def _mscale(scale, m=1.0):
+                    return 1.0 if scale <= 1.0 else 0.1 * m * math.log(scale) + 1.0
+
+                af = rs.get("attention_factor")
+                if af is None:
+                    if mscale and mscale_all:
+                        af = _mscale(factor, mscale) / _mscale(factor, mscale_all)
+                    else:
+                        af = _mscale(factor)
+                rope_scaling = (
+                    "yarn",
+                    factor,
+                    float(rs.get("beta_fast") or 32),
+                    float(rs.get("beta_slow") or 1),
+                    orig,
+                    float(af),
+                    bool(rs.get("truncate", True)),
                 )
             elif kind != "default":  # HF "default" = plain rope, i.e. None
                 # silently dropping the scaling would mis-place every position

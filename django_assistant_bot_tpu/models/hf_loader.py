@@ -7,13 +7,13 @@ our [in, out] einsum convention, stacked along the leading layer axis (scan layo
 cast to the target dtype on host, then sharded onto the mesh in one ``device_put``
 (:func:`..parallel.sharding.shard_pytree`).
 
-Supported decoder families: Llama-3/-3.1 (incl. llama3 rope scaling) /
-Mistral, Qwen2 (qkv biases), Gemma-1 (GeGLU, (1+w) norm fold, scaled
-embeddings), Phi-3 (fused qkv / gate_up split at load), Mixtral MoE.
+Supported decoder families: Llama-3/-3.1 / Mistral (sliding window), Qwen2
+(qkv biases, optional windowing), Gemma-1 (GeGLU, (1+w) norm fold in f32,
+scaled embeddings), Phi-3 (fused qkv / gate_up split at load, longrope),
+Mixtral MoE.  Rope scalings: llama3, linear, longrope (Phi-3 128k), yarn.
 Encoders: BERT (ruBert-base / MiniLM).  Unknown decoder model_types and
 unsupported rope_scaling types are rejected rather than silently mis-loaded
-(gemma-2/3 add norms this mapping does not carry; longrope/yarn remaps are
-not implemented).
+(gemma-2/3 add norms this mapping does not carry).
 """
 
 from __future__ import annotations
@@ -198,10 +198,13 @@ def load_decoder(model_dir: str, dtype=None) -> tuple[DecoderConfig, Dict[str, A
     }
     if hf.get("model_type") == "gemma":
         # Gemma's RMSNorm multiplies by (1 + w); folding the +1 into the stored
-        # weights keeps a single norm implementation for every family
-        layers["attn_norm"] = layers["attn_norm"] + 1.0
-        layers["mlp_norm"] = layers["mlp_norm"] + 1.0
-        params["final_norm"] = params["final_norm"] + 1.0
+        # weights keeps a single norm implementation for every family.  HF
+        # computes 1+w in float32 inside the norm — fold in f32 too, or the
+        # bf16 addition carries ~2^-9 relative rounding vs reference logits
+        # (the final dtype cast below then matches HF's single rounding).
+        layers["attn_norm"] = np.asarray(layers["attn_norm"], np.float32) + 1.0
+        layers["mlp_norm"] = np.asarray(layers["mlp_norm"], np.float32) + 1.0
+        params["final_norm"] = np.asarray(params["final_norm"], np.float32) + 1.0
     if not cfg.tie_embeddings:
         head = t.get("lm_head.weight")
         if head is None:  # some checkpoints tie implicitly

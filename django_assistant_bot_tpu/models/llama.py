@@ -334,8 +334,16 @@ def _repeat_kv(cfg: DecoderConfig, k: jnp.ndarray) -> jnp.ndarray:
 
 
 def _rope_tables(cfg: DecoderConfig, max_len: int):
+    # deployed_len pins seq-regime-dependent scalings (longrope) to ONE factor
+    # list across prefill (bucket-length tables) and decode (cache-length
+    # tables) — mixed lists would corrupt attention between cached K and
+    # fresh queries
     cos, sin = rope_frequencies(
-        cfg.head_dim, max_len, cfg.rope_theta, scaling=cfg.rope_scaling
+        cfg.head_dim,
+        max_len,
+        cfg.rope_theta,
+        scaling=cfg.rope_scaling,
+        deployed_len=cfg.max_seq_len,
     )
     return jnp.asarray(cos), jnp.asarray(sin)
 

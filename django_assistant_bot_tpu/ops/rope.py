@@ -15,16 +15,62 @@ def rope_frequencies(
     max_len: int,
     theta: float = 500_000.0,
     scaling: "tuple | None" = None,
+    deployed_len: "int | None" = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Return (cos, sin) tables of shape [max_len, head_dim//2] in float32.
 
-    ``scaling`` applies the Llama-3.1 frequency remap as a 4-tuple
-    ``(factor, low_freq_factor, high_freq_factor, original_max_len)``:
-    long-wavelength (low-frequency) components stretch by ``factor``,
-    short-wavelength ones stay, and the band between interpolates smoothly —
-    a one-time host-side table edit, free at run time."""
-    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
-    if scaling is not None:
+    ``scaling`` selects a long-context frequency remap — every variant is a
+    one-time host-side table edit, free at run time (HF recomputes these per
+    forward; reference semantics: transformers modeling_rope_utils):
+
+    - 4-tuple ``(factor, low_freq_factor, high_freq_factor, original_max_len)``
+      — Llama-3.1: long wavelengths stretch by ``factor``, short ones stay,
+      the band between interpolates smoothly.
+    - ``("linear", factor)`` — position interpolation: every frequency /factor.
+    - ``("longrope", short_factors, long_factors, original_max,
+      attention_factor)`` — Phi-3 128k: per-frequency rescale lists; the long
+      list engages when the deployed context exceeds the pretrained one, and
+      cos/sin scale by ``attention_factor``.
+    - ``("yarn", factor, beta_fast, beta_slow, original_max,
+      attention_factor, truncate)`` — NTK-by-parts: interpolate only below the
+      correction band, extrapolate above, linear ramp between; cos/sin scale
+      by the mscale ``attention_factor``.
+    """
+    dim = head_dim
+    inv_freq = 1.0 / (theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    attention_factor = 1.0
+    if scaling is not None and scaling[0] == "linear":
+        inv_freq = inv_freq / float(scaling[1])
+    elif scaling is not None and scaling[0] == "longrope":
+        _, short_f, long_f, orig, attention_factor = scaling
+        # the short/long choice keys on the DEPLOYED context (``deployed_len``,
+        # normally cfg.max_seq_len), NOT this table's length: prefill builds
+        # bucket-sized tables while decode builds cache-sized ones, and the
+        # factor list must be IDENTICAL across them or cached K vectors and
+        # decode queries rotate differently.  HF flips per running sequence; a
+        # static-shape serving stack commits once per deployment, agreeing
+        # with HF whenever the deployment targets the long regime (see tests).
+        ext = np.asarray(long_f if (deployed_len or max_len) > orig else short_f, np.float64)
+        inv_freq = 1.0 / (ext * theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    elif scaling is not None and scaling[0] == "yarn":
+        _, factor, beta_fast, beta_slow, orig, attention_factor, truncate = scaling
+        pos_freqs = theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim)
+        inv_extra = 1.0 / pos_freqs
+        inv_inter = 1.0 / (factor * pos_freqs)
+
+        def corr_dim(num_rot):
+            return (dim * np.log(orig / (num_rot * 2 * np.pi))) / (2 * np.log(theta))
+
+        low, high = corr_dim(beta_fast), corr_dim(beta_slow)
+        if truncate:
+            low, high = np.floor(low), np.ceil(high)
+        low, high = max(low, 0.0), min(high, dim - 1.0)
+        if low == high:
+            high += 0.001  # prevent singularity
+        ramp = np.clip((np.arange(dim // 2, dtype=np.float64) - low) / (high - low), 0.0, 1.0)
+        extra_factor = 1.0 - ramp
+        inv_freq = inv_inter * (1.0 - extra_factor) + inv_extra * extra_factor
+    elif scaling is not None:
         factor, low_f, high_f, orig = scaling
         wavelen = 2.0 * np.pi / inv_freq
         low_wavelen = orig / low_f
@@ -40,7 +86,9 @@ def rope_frequencies(
         )
     t = np.arange(max_len, dtype=np.float64)
     freqs = np.outer(t, inv_freq)
-    return np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
+    cos = (np.cos(freqs) * attention_factor).astype(np.float32)
+    sin = (np.sin(freqs) * attention_factor).astype(np.float32)
+    return cos, sin
 
 
 def apply_rope(

@@ -84,7 +84,19 @@ class Task:
         return self.fn(*args, **kwargs)
 
     def apply(self, *args, **kwargs):
-        """Run inline (possibly async)."""
+        """Run inline (possibly async).
+
+        CONSTRAINT (eager mode only): when called from inside a running event
+        loop, the coroutine executes on a PRIVATE loop in a fresh thread and
+        this call BLOCKS the caller's loop until it finishes.  Task bodies must
+        therefore not capture loop-bound resources created on the caller's
+        loop (e.g. an aiohttp ClientSession opened by the webhook handler) —
+        they would be used from the wrong loop.  Framework task bodies create
+        their own sessions per run, satisfying this.  Production (non-eager)
+        dispatch runs tasks in worker processes where the constraint is moot;
+        eager mode exists for tests/dev parity with Celery's
+        task_always_eager, which has the same loop caveat.
+        """
         result = self.fn(*args, **kwargs)
         if inspect.iscoroutine(result):
             try:

@@ -1,13 +1,23 @@
 """Language detection (reference: assistant/utils/language.py:13-31).
 
-The reference uses langid (en/ru) plus a CJK regex.  langid is not in this image,
-so detection is heuristic: CJK scripts by codepoint range, Cyrillic ratio for ru,
-default en.  Same call surface: ``get_language(text) -> 'en' | 'ru' | 'zh' | ...``.
+The reference calls langid constrained to {en, ru} plus a CJK regex.  langid is
+not in this image, so the built-in detector is a compact profile classifier:
+
+- CJK scripts resolve by codepoint range (zh/ja/ko);
+- Cyrillic resolves ru vs uk by the Ukrainian-only letters;
+- Latin scripts score against per-language function-word and diacritic
+  profiles (en/fr/de/es/it/pt/nl) — the Cavnar-Trenkle idea shrunk to the
+  highest-signal features, which beats trigram tables at chat-message length.
+
+Same call surface as the reference: ``get_language(text) -> 'en' | 'ru' | ...``.
+Deployments with a real classifier (langid, fasttext, CLD3) can install it via
+:func:`set_language_detector` — the bot/pipeline layers stay unchanged.
 """
 
 from __future__ import annotations
 
 import re
+from typing import Callable, Optional
 
 _CJK_RE = re.compile(
     "["
@@ -19,26 +29,102 @@ _CJK_RE = re.compile(
 )
 _CYRILLIC_RE = re.compile("[Ѐ-ӿ]")
 _LATIN_RE = re.compile("[A-Za-z]")
+_UKRAINIAN_RE = re.compile("[іїєґІЇЄҐ]")
+_WORD_RE = re.compile(r"[a-zà-öø-ÿœß]+")
+
+# Most frequent function words per language — high-coverage, short, and
+# (mostly) exclusive between languages; ties are broken by diacritics below.
+_FUNCTION_WORDS = {
+    "en": "the and is of to in that it you for on with as are this be have "
+          "not at what your from we can will do but they his her was",
+    "fr": "le la les des et est une du que qui dans pour pas vous je ce "
+          "cette avec sur aux ne sont nous il elle mais être fait tout",
+    "de": "der die das und ist nicht ich sie ein eine mit für auf den dem zu "
+          "von sich auch werden wir aber oder wie haben kann wenn nach",
+    "es": "el los las que es una por con para se su al lo como más pero sus "
+          "ya está muy hay este esta son tiene entre cuando",
+    "it": "il di che è una per con non si sono del della da al come anche ma "
+          "più questo gli nel alla ha io sia dei queste",
+    "pt": "os as que é um uma para com não se do da em no na por mais como "
+          "mas foi são você ele isso está ser tem muito",
+    "nl": "de het een en van is dat niet ik je met voor op zijn aan maar ook "
+          "er dit was wordt deze bij naar uit hebben",
+}
+# word -> every language it is a top function word of; shared words (que,
+# se, como, ...) split their credit instead of silently belonging to one
+_WORD_LANGS: dict = {}
+for _lang, _words in _FUNCTION_WORDS.items():
+    for _w in _words.split():
+        _WORD_LANGS.setdefault(_w, []).append(_lang)
+
+# Diacritics / characters that are strong single-language signals.
+_DIACRITICS = {
+    "es": "ñ¿¡",
+    "pt": "ãõ",
+    "de": "ß",
+    "fr": "œ",
+}
+# weaker, shared diacritic families
+_DIACRITIC_FAMILIES = [
+    ("äöü", ("de", "nl")),
+    ("çàâêîôûèéù", ("fr", "pt", "it")),
+    ("áéíóúü", ("es", "pt")),
+    ("èòìù", ("it", "fr")),
+]
+
+_DETECTOR: Optional[Callable[[str], str]] = None
+
+
+def set_language_detector(fn: Optional[Callable[[str], str]]) -> None:
+    """Install a replacement detector (e.g. langid/fasttext), or None to
+    restore the built-in profiles.  Mirrors the reference's pluggability at
+    the module seam instead of an import-time hard dependency."""
+    global _DETECTOR
+    _DETECTOR = fn
 
 
 def is_cjk(text: str) -> bool:
     return bool(_CJK_RE.search(text or ""))
 
 
+def _latin_language(text: str) -> str:
+    scores: dict[str, float] = {}
+    for word in _WORD_RE.findall(text.lower()):
+        langs = _WORD_LANGS.get(word)
+        if langs:
+            for lang in langs:
+                scores[lang] = scores.get(lang, 0.0) + 1.0 / len(langs)
+    for ch in text:
+        for lang, chars in _DIACRITICS.items():
+            if ch in chars:
+                scores[lang] = scores.get(lang, 0.0) + 3.0
+        for chars, langs in _DIACRITIC_FAMILIES:
+            if ch.lower() in chars:
+                for lang in langs:
+                    scores[lang] = scores.get(lang, 0.0) + 0.75
+    if not scores:
+        return "en"
+    best = max(scores, key=lambda k: scores[k])
+    # demand real evidence before leaving the reference's default
+    return best if scores[best] >= 1.5 or best == "en" else "en"
+
+
 def get_language(text: str) -> str:
     text = text or ""
     if not text.strip():
         return "en"
+    if _DETECTOR is not None:
+        return _DETECTOR(text)
     cjk = _CJK_RE.findall(text)
     if cjk:
-        sample = cjk[0]
-        if "぀" <= sample <= "ヿ":
+        # kana ANYWHERE means Japanese — ja text usually leads with kanji
+        if any("぀" <= c <= "ヿ" for c in cjk):
             return "ja"
-        if "가" <= sample <= "힯":
+        if any("가" <= c <= "힯" for c in cjk):
             return "ko"
         return "zh"
     cyr = len(_CYRILLIC_RE.findall(text))
     lat = len(_LATIN_RE.findall(text))
     if cyr > lat:
-        return "ru"
-    return "en"
+        return "uk" if _UKRAINIAN_RE.search(text) else "ru"
+    return _latin_language(text)

@@ -129,8 +129,37 @@ def test_language_detection():
     assert get_language("привет мир") == "ru"
     assert get_language("你好世界") == "zh"
     assert get_language("こんにちは") == "ja"
+    assert get_language("今日の天気はどうですか") == "ja"  # kanji-led, kana later
     assert get_language("안녕하세요") == "ko"
     assert get_language("") == "en"
+
+
+def test_language_detection_latin_profiles():
+    """Latin-script languages resolve by function-word/diacritic profiles —
+    the round-2 heuristic returned 'en' for ALL of these, selecting the wrong
+    phrase resources (reference bar: langid, assistant/utils/language.py:13)."""
+    assert get_language("Quel est le temps? Je ne sais pas ce que vous voulez.") == "fr"
+    assert get_language("Ich weiß nicht, was sie mit diesem Programm machen.") == "de"
+    assert get_language("No sé qué es lo que quieres hacer con este programa.") == "es"
+    assert get_language("Non so che cosa vuoi fare con questo programma, ma è bello.") == "it"
+    assert get_language("Não sei o que você quer fazer com este programa.") == "pt"
+    assert get_language("Ik weet niet wat je met dit programma wilt doen.") == "nl"
+    # Ukrainian separates from Russian by its distinct letters
+    assert get_language("Я не знаю, що ви хочете зробити з цією програмою.") == "uk"
+    # weak evidence stays at the reference default
+    assert get_language("ok") == "en"
+    assert get_language("12345 !!") == "en"
+
+
+def test_language_detector_pluggable():
+    from django_assistant_bot_tpu.utils.language import set_language_detector
+
+    set_language_detector(lambda text: "xx")
+    try:
+        assert get_language("anything at all") == "xx"
+    finally:
+        set_language_detector(None)
+    assert get_language("hello world") == "en"
 
 
 def test_truncate_text():

@@ -327,3 +327,99 @@ def test_eager_task_delay_from_running_loop(seeded):
         assert calls == [21]
 
     body()
+
+
+def test_admin_auth_branch_bounded_to_admin_mount(seeded):
+    """/adminfoo must take TOKEN auth (the API branch), not the interactive
+    Basic branch — the old startswith('/admin') matched too broadly."""
+
+    @with_client
+    async def body(client):
+        with settings.override(API_AUTH_TOKEN="sekret", ADMIN_BASIC_AUTH="boss:pw"):
+            resp = await client.get("/adminfoo")
+            # API branch: token-auth JSON 401, not an interactive Basic challenge
+            assert resp.status == 401
+            assert "WWW-Authenticate" not in resp.headers
+            resp = await client.get(
+                "/adminfoo", headers={"Authorization": "Token sekret"}
+            )
+            assert resp.status == 404  # authenticated, route simply absent
+
+    body()
+
+
+def test_media_url_middleware_and_static(tmp_path, seeded):
+    """Reference parity for MediaURLMiddleware (assistant/assistant/
+    middleware.py:4-15): media URLs become absolute per request host, and
+    MEDIA_ROOT serves under MEDIA_URL."""
+    (tmp_path / "pic.txt").write_text("media-bytes")
+
+    @with_client
+    async def body(client):
+        resp = await client.get("/media/pic.txt")
+        assert resp.status == 200
+        assert await resp.text() == "media-bytes"
+
+    with settings.override(MEDIA_ROOT=str(tmp_path)):
+        body()
+
+    # media stays public under token auth (platforms fetch sent photos by URL)
+    @with_client
+    async def body_tokened(client):
+        resp = await client.get("/media/pic.txt")
+        assert resp.status == 200
+        resp = await client.get("/api/v1/bots/")
+        assert resp.status == 401  # the API itself stays locked
+
+    with settings.override(MEDIA_ROOT=str(tmp_path), API_AUTH_TOKEN="tok"):
+        body_tokened()
+
+    # stored photo paths under MEDIA_ROOT serialize as absolute media URLs
+    photos = tmp_path / "photos"
+    photos.mkdir()
+    (photos / "p1.jpg").write_bytes(b"jpegish")
+    bot, instance, dialog = seeded
+    role = models.Role.get_cached("user")
+    models.Message.objects.create(
+        dialog=dialog, message_id=77, role=role, text="see photo",
+        photo=str(photos / "p1.jpg"),
+    )
+
+    @with_client
+    async def body_photo(client):
+        resp = await client.get(f"/api/v1/dialogs/{dialog.id}/messages/")
+        assert resp.status == 200
+        rows = (await resp.json())["results"]
+        by_id = {r["message_id"]: r for r in rows}
+        url = by_id[77]["photo"]
+        assert url and url.endswith("/media/photos/p1.jpg")
+        assert url.startswith("http://")
+        # and the URL actually serves the bytes
+        from urllib.parse import urlparse
+
+        got = await client.get(urlparse(url).path)
+        assert got.status == 200
+        assert await got.read() == b"jpegish"
+
+    with settings.override(MEDIA_ROOT=str(tmp_path)):
+        body_photo()
+
+    # the absolute-URL computation itself (per request host/scheme)
+    from aiohttp.test_utils import make_mocked_request
+
+    from django_assistant_bot_tpu.api.app import media_url_middleware
+
+    async def capture(request):
+        import aiohttp.web as web
+
+        return web.json_response({"media_url": request["media_url"]})
+
+    async def drive():
+        req = make_mocked_request("GET", "/healthz", headers={"Host": "bots.example.com"})
+        resp = await media_url_middleware(req, capture)
+        import json
+
+        return json.loads(resp.body.decode())["media_url"]
+
+    got = asyncio.run(drive())
+    assert got == "http://bots.example.com/media/"

@@ -411,13 +411,124 @@ def test_unsupported_rope_scaling_rejected(tiny_llama_dir, tmp_path):
     import json, shutil
 
     d, _ = tiny_llama_dir
-    bad = tmp_path / "longrope"
+    bad = tmp_path / "badrope"
     shutil.copytree(d, bad)
     cfg = json.loads((bad / "config.json").read_text())
-    cfg["rope_scaling"] = {"rope_type": "longrope", "factor": 4.0}
+    cfg["rope_scaling"] = {"rope_type": "dynamic", "factor": 4.0}
     (bad / "config.json").write_text(json.dumps(cfg))
     with pytest.raises(ValueError, match="unsupported rope_scaling"):
         load_decoder(str(bad))
+
+
+def test_phi3_longrope_matches_hf(tmp_path):
+    """Phi-3 128k longrope: short-factor regime (prompt within the pretrained
+    context) AND long-factor regime (table built past it) both match HF.
+    Round 2 rejected these checkpoints at load (hf_loader)."""
+    import torch
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    common = dict(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=64,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        pad_token_id=0,
+        original_max_position_embeddings=32,
+        rope_scaling={
+            "type": "longrope",
+            "short_factor": [1.0, 1.1, 1.2, 1.3],
+            "long_factor": [2.0, 2.5, 3.0, 4.0],
+        },
+    )
+    rng = np.random.default_rng(4)
+
+    # Our short/long choice is PER DEPLOYMENT (cfg.max_seq_len vs pretrained
+    # original) — one factor list for prefill AND decode, where HF flips per
+    # running sequence.  Each regime therefore gets its own checkpoint whose
+    # deployed context selects the same list HF uses for the tested prompt.
+
+    # short regime: deployed context == pretrained 32 -> short_factor;
+    # HF also uses short_factor for every prompt <= 32
+    model = Phi3ForCausalLM(Phi3Config(**common, max_position_embeddings=32))
+    model.eval()
+    d = tmp_path / "phi3lr_short"
+    model.save_pretrained(d, safe_serialization=True)
+    jcfg, params = load_decoder(str(d), dtype=jnp.float32)
+    assert jcfg.rope_scaling[0] == "longrope"
+    ids = np.asarray(rng.integers(1, 128, (1, 16)), np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jcfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, atol=5e-4, rtol=1e-3)
+
+    # long regime: deployed context 128 > 32 -> long_factor;
+    # HF flips the whole sequence to long_factor once the prompt passes 32
+    model = Phi3ForCausalLM(Phi3Config(**common, max_position_embeddings=128))
+    model.eval()
+    d = tmp_path / "phi3lr_long"
+    model.save_pretrained(d, safe_serialization=True)
+    jcfg, params = load_decoder(str(d), dtype=jnp.float32)
+    ids = np.asarray(rng.integers(1, 128, (1, 48)), np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jcfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, atol=5e-4, rtol=1e-3)
+    # decode path consistency: chained prefill+decode equals repeated forward
+    # (one factor list everywhere; mixed lists would corrupt cached K)
+    prompt = np.asarray(rng.integers(1, 128, (1, 40)), np.int32)
+    seq = prompt.copy()
+    for _ in range(3):
+        lg = llama.forward(params, jcfg, jnp.asarray(seq))
+        seq = np.concatenate([seq, [[int(jnp.argmax(lg[0, -1]))]]], axis=1)
+    expected = seq[0, prompt.shape[1]:].tolist()
+    cache = llama.init_cache(jcfg, batch=1, max_len=64, dtype=jnp.float32)
+    lengths = jnp.asarray([prompt.shape[1]], jnp.int32)
+    lg, ks, vs = llama.prefill(params, jcfg, jnp.asarray(prompt), lengths)
+    cache = llama.insert_sequences(cache, ks, vs, lengths, jnp.asarray([0], jnp.int32))
+    got = [int(jnp.argmax(lg[0]))]
+    for _ in range(2):
+        lg, cache = llama.decode_step(params, jcfg, jnp.asarray([got[-1]], jnp.int32), cache)
+        got.append(int(jnp.argmax(lg[0])))
+    assert got == expected
+
+
+def test_yarn_rope_scaling_matches_hf(tmp_path):
+    """YaRN (Qwen2 long-context variants): NTK-by-parts interpolation with the
+    mscale attention factor."""
+    import torch
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    cfg = Qwen2Config(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        rope_scaling={
+            "rope_type": "yarn",
+            "factor": 4.0,
+            "original_max_position_embeddings": 32,
+        },
+    )
+    model = Qwen2ForCausalLM(cfg)
+    model.eval()
+    d = tmp_path / "qwen2yarn"
+    model.save_pretrained(d, safe_serialization=True)
+    jcfg, params = load_decoder(str(d), dtype=jnp.float32)
+    assert jcfg.rope_scaling[0] == "yarn"
+    ids = np.asarray(np.random.default_rng(5).integers(1, 128, (1, 80)), np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jcfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, atol=5e-4, rtol=1e-3)
 
 
 def test_phi3_matches_hf(tmp_path):

@@ -484,3 +484,99 @@ def test_http_healthz_and_models(http_client):
         assert (await resp.json())["tiny-emb"]["kind"] == "encoder"
 
     loop.run_until_complete(go())
+
+
+def _llama3_style_tokenizer():
+    """A tiny tokenizer with the REAL Llama-3 chat template: char-level vocab,
+    the four Llama-3 specials, and (like Meta's shipped fast tokenizer) a
+    post-processor that prepends BOS on ordinary encode() calls — the exact
+    setup where naive template encoding produces a double BOS."""
+    from tokenizers import Regex, Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Split
+    from tokenizers.processors import TemplateProcessing
+    from transformers import PreTrainedTokenizerFast
+
+    chars = (
+        "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"
+        " !?.,:'0123456789\n"
+    )
+    vocab = {"<unk>": 0}
+    for c in chars:
+        vocab[c] = len(vocab)
+    t = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    t.pre_tokenizer = Split(Regex("[\\s\\S]"), behavior="isolated")
+    from tokenizers.decoders import Fuse
+
+    t.decoder = Fuse()
+    bos = "<|begin_of_text|>"
+    t.add_special_tokens([bos, "<|start_header_id|>", "<|end_header_id|>", "<|eot_id|>"])
+    t.post_processor = TemplateProcessing(
+        single=f"{bos} $A",
+        pair=f"{bos} $A $B",
+        special_tokens=[(bos, t.token_to_id(bos))],
+    )
+    hf = PreTrainedTokenizerFast(
+        tokenizer_object=t,
+        unk_token="<unk>",
+        bos_token=bos,
+        eos_token="<|eot_id|>",
+        additional_special_tokens=["<|start_header_id|>", "<|end_header_id|>"],
+    )
+    # Meta's Llama-3/3.1 chat template (tokenizer_config.json of the family)
+    hf.chat_template = (
+        "{% set loop_messages = messages %}"
+        "{% for message in loop_messages %}"
+        "{% set content = '<|start_header_id|>' + message['role'] + "
+        "'<|end_header_id|>\n\n' + message['content'] | trim + '<|eot_id|>' %}"
+        "{% if loop.index0 == 0 %}{% set content = bos_token + content %}{% endif %}"
+        "{{ content }}{% endfor %}"
+        "{% if add_generation_prompt %}"
+        "{{ '<|start_header_id|>assistant<|end_header_id|>\n\n' }}{% endif %}"
+    )
+    return hf
+
+
+def test_llama3_chat_template_golden_tokens():
+    """encode_chat must produce EXACTLY the token sequence HF's own
+    apply_chat_template(tokenize=True) yields for the Llama-3 template — and
+    exactly one BOS.  The reference never chat-templates at all (it joins
+    'role: content' lines, assistant/ai/providers/transformers.py:50); this
+    pins the behavior that replaces that deficiency."""
+    from django_assistant_bot_tpu.serving.tokenizer import HFTokenizer
+
+    hf = _llama3_style_tokenizer()
+    wrapped = HFTokenizer(hf)
+    msgs = [
+        {"role": "system", "content": "You are a bot."},
+        {"role": "user", "content": "Hello there!"},
+    ]
+    golden = hf.apply_chat_template(msgs, tokenize=True, add_generation_prompt=True)
+    ours = wrapped.encode_chat(msgs)
+    assert ours == golden
+    bos_id = hf.convert_tokens_to_ids("<|begin_of_text|>")
+    assert ours[0] == bos_id
+    assert ours.count(bos_id) == 1
+    # the hazard is real: naive encode() of the rendered template doubles BOS
+    naive = hf.encode(wrapped.apply_chat(msgs))
+    assert naive[:2] == [bos_id, bos_id]
+    # structure: exactly 3 headers (system, user, generation prompt), 2 eots
+    sh = hf.convert_tokens_to_ids("<|start_header_id|>")
+    eot = hf.convert_tokens_to_ids("<|eot_id|>")
+    assert ours.count(sh) == 3
+    assert ours.count(eot) == 2
+    # round-trip sanity: specials drop, text survives
+    assert "You are a bot." in wrapped.decode(ours)
+
+
+def test_chat_template_absent_falls_back_to_plain_join():
+    """No chat_template -> the reference's 'role: content' join semantics
+    (assistant/ai/providers/transformers.py:50), BOS added normally."""
+    from django_assistant_bot_tpu.serving.tokenizer import HFTokenizer, render_plain_chat
+
+    hf = _llama3_style_tokenizer()
+    hf.chat_template = None
+    wrapped = HFTokenizer(hf)
+    msgs = [{"role": "user", "content": "hi"}]
+    assert wrapped.apply_chat(msgs) == "user: hi\nassistant:"
+    assert wrapped.encode_chat(msgs) == hf.encode(render_plain_chat(msgs))
