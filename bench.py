@@ -76,8 +76,11 @@ def _decoder_cfg():
     )
 
 
-def _moe_cfg():
-    """Mixtral-style MoE scaled to one chip: 8 experts, top-2 routing."""
+def _moe_cfg(num_layers=8):
+    """Mixtral-class MoE on one chip: 2048 hidden / 8192 ffn x 8 experts,
+    top-2 routing, int8 experts (weights synthesized on device).  Per-layer
+    expert geometry is half Mixtral-8x7B's (4096/14336) — the largest that
+    fits one 16 GB chip with 8 experts resident."""
     import jax.numpy as jnp
 
     from django_assistant_bot_tpu.models import DecoderConfig
@@ -86,12 +89,12 @@ def _moe_cfg():
         return DecoderConfig.tiny(num_experts=4)
     return DecoderConfig(
         vocab_size=32_000,
-        hidden_size=1024,
-        intermediate_size=4096,
-        num_layers=8,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=num_layers,
         num_heads=16,
         num_kv_heads=8,
-        head_dim=64,
+        head_dim=128,
         max_seq_len=1024,
         rope_theta=1e6,
         num_experts=8,
@@ -159,7 +162,12 @@ def _build_gen_engine(cfg=None, quantize=None, buckets=(128, 512)):
     from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
 
     cfg = cfg or _decoder_cfg()
-    params = llama.init(cfg, jax.random.PRNGKey(0))
+    if quantize == "int8_device":
+        # int8 weights synthesized directly in HBM — no host staging, no
+        # host-side quantization pass (matters for multi-GB geometries)
+        params = llama.init_int8(cfg, jax.random.PRNGKey(0))
+    else:
+        params = llama.init(cfg, jax.random.PRNGKey(0))
     if quantize == "int8":
         from django_assistant_bot_tpu.ops.quant import quantize_decoder_params
 
@@ -186,7 +194,14 @@ def _build_gen_engine(cfg=None, quantize=None, buckets=(128, 512)):
 
 
 def bench_decode(eng) -> dict:
-    """Config 2: continuous-batching decode throughput + TTFT under concurrency."""
+    """Config 2: continuous-batching decode throughput + TTFT under concurrency.
+
+    Also reports achieved HBM weight traffic (every decode step re-reads all
+    weights once for the whole batch — a hard lower bound that excludes
+    KV/activation traffic; v5e HBM peak ~819 GB/s) and decode MFU
+    (~2 FLOPs/param/token against the v5e bf16 peak ~197 TFLOP/s).
+    """
+    import jax
     import numpy as np
 
     rng = np.random.default_rng(1)
@@ -209,12 +224,18 @@ def bench_decode(eng) -> dict:
     total_new = sum(r.completion_tokens for r in results)
     ttfts = sorted(r.ttft_s for r in results)
     p99_idx = min(len(ttfts) - 1, max(0, math.ceil(0.99 * len(ttfts)) - 1))
+    leaves = jax.tree.leaves(eng.params)
+    param_bytes = sum(l.nbytes for l in leaves)
+    n_params = sum(l.size for l in leaves)
+    tok_s = total_new / wall
     return {
-        "decode_tokens_per_s_per_chip": round(total_new / wall, 2),
+        "decode_tokens_per_s_per_chip": round(tok_s, 2),
         "decode_p50_ttft_s": round(statistics.median(ttfts), 4),
         "decode_p99_ttft_s": round(ttfts[p99_idx], 4),
         "decode_concurrency": DECODE_REQUESTS,
         "decode_new_tokens": DECODE_NEW_TOKENS,
+        "decode_hbm_gbps_min": round(tok_s / DECODE_REQUESTS * param_bytes / 1e9, 1),
+        "decode_mfu_pct": round(tok_s * 2 * n_params / 197e12 * 100, 2),
     }
 
 
@@ -228,7 +249,7 @@ def bench_rag(gen_engine) -> dict:
     from django_assistant_bot_tpu.serving import EmbeddingEngine, ByteTokenizer
     from django_assistant_bot_tpu.serving.registry import ModelRegistry, ModelSpec
     from django_assistant_bot_tpu.serving.server import create_app
-    from django_assistant_bot_tpu.storage.knn import VectorIndex
+    from django_assistant_bot_tpu.storage.knn import AsyncSearcher, VectorIndex
 
     import jax
 
@@ -261,15 +282,17 @@ def bench_rag(gen_engine) -> dict:
         for i in range(RAG_CORPUS)
     }
 
+    searcher = AsyncSearcher(index)
+
     async def one_request(client, qid: int) -> dict:
         q = f"benchmark question number {qid} about topic {qid % 7}?"
         r = await client.post(
             "/embeddings/", json={"model": "bench-emb", "texts": [q]}
         )
         emb = (await r.json())["embeddings"][0]
-        # the real search service runs KNN in a thread (asyncio.to_thread) so
-        # concurrent requests overlap their device round trips
-        top = await asyncio.to_thread(index.search, np.asarray(emb, np.float32), 3)
+        # the real search service coalesces concurrent KNN queries into one
+        # batched dispatch (rag/services/search_service.py) — same here
+        top = await searcher.search(np.asarray(emb, np.float32), 3)
         context = "\n".join(docs[i][:200] for i, _ in top)
         r = await client.post(
             "/dialog/",
@@ -321,6 +344,39 @@ def bench_rag(gen_engine) -> dict:
     }
 
 
+def _subprocess_bench(snippet: str, timeout_s: int = 1800):
+    """Run a bench snippet in a FRESH python process and parse its final JSON
+    line.  Multi-GB model builds on the shared chip can fail on fragmentation,
+    and a failed build poisons the parent's device session (deallocation is
+    async through the remote tunnel, so retries see the dead attempt's memory
+    for minutes).  A child process's exit reliably frees its server-side
+    allocations, so each geometry attempt gets a clean slate."""
+    import subprocess
+
+    code = (
+        "import sys, os\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        + snippet
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed((p.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except Exception:
+                continue
+    return None
+
+
 def _flagship_8b_cfg(max_seq_len=512):
     """True Llama-3-8B geometry (32L/4096E/14336F/32H/8KV/128k vocab) — the
     model class the reference serves via Ollama llama3.1:8b (.env.example:12);
@@ -342,86 +398,94 @@ def _flagship_8b_cfg(max_seq_len=512):
     )
 
 
+_8B_SNIPPET = """
+import json, time
+import numpy as np
+import jax
+import bench
+from django_assistant_bot_tpu.models import llama
+from django_assistant_bot_tpu.parallel import get_mesh, shard_pytree
+from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
+
+slots = {slots}
+cfg = bench._flagship_8b_cfg()
+params = llama.init_int8(cfg, jax.random.PRNGKey(0))
+pb = sum(l.nbytes for l in jax.tree.leaves(params))
+n_params = sum(l.size for l in jax.tree.leaves(params))
+mesh = get_mesh()
+with mesh:
+    params = shard_pytree(params, llama.logical_axes(cfg), mesh)
+eng = GenerationEngine(
+    cfg, params, ByteTokenizer(), max_slots=slots, max_seq_len=cfg.max_seq_len,
+    prefill_buckets=(bench._decode_bucket(),), chunk_size=bench._decode_bucket(),
+    mesh=mesh, lookahead=1,
+)
+eng.warmup()
+eng.start()
+try:
+    rng = np.random.default_rng(5)
+
+    def fire(n_req, n_new):
+        prompts = [rng.integers(1, 255, bench.DECODE_PROMPT_LEN).tolist() for _ in range(n_req)]
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_tokens=n_new, temperature=0.8) for p in prompts]
+        results = [f.result(timeout=1500) for f in futs]
+        return results, time.perf_counter() - t0
+
+    fire(min(2, slots), 4)
+    results, wall = fire(slots, bench.DECODE_NEW_TOKENS)
+finally:
+    eng.stop()
+total_new = sum(r.completion_tokens for r in results)
+ttfts = sorted(r.ttft_s for r in results)
+tok_s = total_new / wall
+print(json.dumps({{
+    "decode_8b_int8_tokens_per_s_per_chip": round(tok_s, 2),
+    "decode_8b_int8_p50_ttft_s": round(ttfts[len(ttfts) // 2], 4),
+    "decode_8b_concurrency": slots,
+    "decode_8b_param_gb": round(pb / 1e9, 2),
+    "decode_8b_hbm_gbps_min": round(tok_s / slots * pb / 1e9, 1),
+    "decode_8b_mfu_pct": round(tok_s * 2 * n_params / 197e12 * 100, 2),
+}}))
+"""
+
+
+_MOE_SNIPPET = """
+import json
+import bench
+
+cfg = bench._moe_cfg(num_layers={layers})
+eng, cfg = bench._build_gen_engine(cfg, quantize="int8_device",
+                                   buckets=(bench._decode_bucket(),))
+try:
+    moe = bench.bench_decode(eng)
+finally:
+    eng.stop()
+print(json.dumps({{
+    "moe_decode_tokens_per_s_per_chip": moe["decode_tokens_per_s_per_chip"],
+    "moe_decode_p50_ttft_s": moe["decode_p50_ttft_s"],
+    "moe_decode_hbm_gbps_min": moe["decode_hbm_gbps_min"],
+    "moe_geometry": "%dL/%dE/%dFx%dexperts-int8" % (
+        cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.num_experts),
+}}))
+"""
+
+
 def bench_8b() -> dict:
     """Config 2 at true flagship geometry: 8B-class decode, int8 weight-only.
 
     Weights are synthesized directly on device (llama.init_int8) — staging a
-    host-side 8B init through a remote tunnel would take minutes.  The chip is
-    shared, so HBM headroom varies run to run: retries walk down the slot
-    count and record the geometry that fit.
+    host-side 8B init through a remote tunnel would take minutes.  Each slot
+    count runs in a fresh subprocess (_subprocess_bench) so an OOM on the
+    shared chip can't poison the next attempt.
     """
-    import gc
-
-    import jax
-    import numpy as np
-
-    from django_assistant_bot_tpu.models import llama
-    from django_assistant_bot_tpu.parallel import get_mesh, shard_pytree
-    from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
-
     out: dict = {}
     for slots in (16, 8, 4):
-        eng = None
-        params = None
-        try:
-            cfg = _flagship_8b_cfg()
-            params = llama.init_int8(cfg, jax.random.PRNGKey(0))
-            pb = sum(l.nbytes for l in jax.tree.leaves(params))
-            mesh = get_mesh()
-            with mesh:
-                params = shard_pytree(params, llama.logical_axes(cfg), mesh)
-            eng = GenerationEngine(
-                cfg,
-                params,
-                ByteTokenizer(),
-                max_slots=slots,
-                max_seq_len=cfg.max_seq_len,
-                prefill_buckets=(_decode_bucket(),),
-                chunk_size=_decode_bucket(),
-                mesh=mesh,
-                lookahead=1,
-            )
-            eng.warmup()
-            eng.start()
-            rng = np.random.default_rng(5)
-
-            def fire(n_req, n_new):
-                prompts = [
-                    rng.integers(1, 255, DECODE_PROMPT_LEN).tolist()
-                    for _ in range(n_req)
-                ]
-                t0 = time.perf_counter()
-                futs = [eng.submit(p, max_tokens=n_new, temperature=0.8) for p in prompts]
-                results = [f.result(timeout=1800) for f in futs]
-                return results, time.perf_counter() - t0
-
-            fire(min(2, slots), 4)  # warm the loop
-            results, wall = fire(slots, DECODE_NEW_TOKENS)
-            total_new = sum(r.completion_tokens for r in results)
-            ttfts = sorted(r.ttft_s for r in results)
-            tok_s = total_new / wall
-            out["decode_8b_int8_tokens_per_s_per_chip"] = round(tok_s, 2)
-            out["decode_8b_int8_p50_ttft_s"] = round(ttfts[len(ttfts) // 2], 4)
-            out["decode_8b_concurrency"] = slots
-            out["decode_8b_param_gb"] = round(pb / 1e9, 2)
-            # every decode step re-reads all weights once for the whole batch:
-            # a hard lower bound on achieved HBM traffic (excludes KV/activations)
-            out["decode_8b_hbm_gbps_min"] = round(tok_s / slots * pb / 1e9, 1)
-            # flops/token ~= 2 * active params; v5e bf16 peak ~197 TFLOP/s
-            out["decode_8b_mfu_pct"] = round(tok_s * 2 * 8.03e9 / 197e12 * 100, 2)
+        res = _subprocess_bench(_8B_SNIPPET.format(slots=slots))
+        if res:
+            out.update(res)
             return out
-        except Exception as e:  # noqa: BLE001 — shared-chip OOM is expected
-            out["decode_8b_error"] = f"{type(e).__name__} at slots={slots}"
-        finally:
-            if eng is not None:
-                try:
-                    eng.stop()
-                except Exception:
-                    pass
-            # drop the ~9 GB param pytree BEFORE the retry re-inits, or every
-            # retry holds two full parameter sets and OOMs regardless of slots
-            del eng, params
-            gc.collect()
+        out["decode_8b_error"] = f"failed at slots={slots}"
     return out
 
 
@@ -467,8 +531,29 @@ def bench_ingestion() -> dict:
     out["ingest_docs"] = done
 
     # --- KNN at corpus scale (config 4 ingestion side / VERDICT scale test)
-    n_vec = 20_000 if SMALL else KNN_VECTORS
-    dim = cfg.hidden_size
+    if SMALL:
+        out.update(_knn_scale_body(20_000, cfg.hidden_size, KNN_QUERIES))
+        return out
+    # fresh subprocess per corpus size: a failed multi-GB staging poisons the
+    # parent's device session (see _subprocess_bench); walk down on failure
+    for n_vec in (KNN_VECTORS, KNN_VECTORS // 2, KNN_VECTORS // 4):
+        res = _subprocess_bench(
+            _KNN_SCALE_SNIPPET.format(n_vec=n_vec, dim=cfg.hidden_size, nq=KNN_QUERIES)
+        )
+        if res:
+            out.update(res)
+            return out
+        out["knn_scale_error"] = f"failed at {n_vec} vectors"
+    return out
+
+
+def _knn_scale_body(n_vec: int, dim: int, n_queries: int) -> dict:
+    import numpy as np
+
+    from django_assistant_bot_tpu.storage.knn import VectorIndex
+
+    out: dict = {}
+    rng = np.random.default_rng(17)
     big = rng.normal(size=(n_vec, dim)).astype(np.float32)
     scale_index = VectorIndex(dim)
     t0 = time.perf_counter()
@@ -479,7 +564,7 @@ def bench_ingestion() -> dict:
     # (dispatch is async; round 2 under-reported build and the first live
     # query silently paid the whole transfer)
     t0 = time.perf_counter()
-    scale_index.warmup(ks=(16,), q_rows=(8, KNN_QUERIES))
+    scale_index.warmup(ks=(16,), q_rows=(8, n_queries))
     out["knn_build_s"] = round(time.perf_counter() - t0, 3)
     out["knn_vectors"] = n_vec
     # post-warmup first query — the serving-path reality (no compile stall)
@@ -488,8 +573,8 @@ def bench_ingestion() -> dict:
     out["knn_first_query_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
 
     lat = []
-    q = rng.normal(size=(KNN_QUERIES, dim)).astype(np.float32)
-    for i in range(KNN_QUERIES):
+    q = rng.normal(size=(n_queries, dim)).astype(np.float32)
+    for i in range(n_queries):
         t0 = time.perf_counter()
         scale_index.search(q[i], k=10)
         lat.append(time.perf_counter() - t0)
@@ -500,7 +585,7 @@ def bench_ingestion() -> dict:
     t0 = time.perf_counter()
     scale_index.search_batch(q, k=10)
     out["knn_query_batched_ms_per_query"] = round(
-        (time.perf_counter() - t0) / KNN_QUERIES * 1e3, 3
+        (time.perf_counter() - t0) / n_queries * 1e3, 3
     )
 
     extra = rng.normal(size=(10_000, dim)).astype(np.float32)
@@ -509,6 +594,14 @@ def bench_ingestion() -> dict:
     scale_index.search(extra[0], k=10)
     out["knn_append_10k_s"] = round(time.perf_counter() - t0, 3)
     return out
+
+
+_KNN_SCALE_SNIPPET = """
+import json
+import bench
+
+print(json.dumps(bench._knn_scale_body({n_vec}, {dim}, {nq})))
+"""
 
 
 # --------------------------------------------------------------------- baselines
@@ -633,17 +726,28 @@ def main() -> None:
         q8 = bench_decode(q8_eng)
         extras["decode_int8_tokens_per_s_per_chip"] = q8["decode_tokens_per_s_per_chip"]
         extras["decode_int8_p50_ttft_s"] = q8["decode_p50_ttft_s"]
+        extras["decode_int8_hbm_gbps_min"] = q8["decode_hbm_gbps_min"]
     finally:
         q8_eng.stop()
 
-    # config 5: MoE continuous batching (Mixtral-style top-2 routing)
-    moe_eng, _ = _build_gen_engine(_moe_cfg(), buckets=(_decode_bucket(),))
-    try:
-        moe = bench_decode(moe_eng)
-        extras["moe_decode_tokens_per_s_per_chip"] = moe["decode_tokens_per_s_per_chip"]
-        extras["moe_decode_p50_ttft_s"] = moe["decode_p50_ttft_s"]
-    finally:
-        moe_eng.stop()
+    # config 5: MoE continuous batching (Mixtral-class top-2 routing, int8
+    # experts on device).  Each depth runs in a fresh subprocess so a shared-
+    # chip OOM can't poison the next attempt; records the geometry that ran.
+    if SMALL:
+        moe_eng, _ = _build_gen_engine(_moe_cfg(), buckets=(_decode_bucket(),))
+        try:
+            moe = bench_decode(moe_eng)
+            extras["moe_decode_tokens_per_s_per_chip"] = moe["decode_tokens_per_s_per_chip"]
+            extras["moe_decode_p50_ttft_s"] = moe["decode_p50_ttft_s"]
+        finally:
+            moe_eng.stop()
+    else:
+        for layers in (8, 4, 2):
+            res = _subprocess_bench(_MOE_SNIPPET.format(layers=layers))
+            if res:
+                extras.update(res)
+                break
+            extras["moe_decode_error"] = f"failed at layers={layers}"
 
     # config 2c: TRUE 8B flagship geometry, int8 weight-only, on-device synth
     # weights (BASELINE configs[1]; reference serves llama3.1:8b via Ollama)

@@ -26,7 +26,7 @@ from typing import Any, Dict, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import attention, dot_product_attention
+from ..ops.attention import attention, dot_product_attention, gqa_dot_product_attention
 from ..ops.norms import rms_norm
 from ..ops.quant import deq
 from ..ops.rope import apply_rope, rope_frequencies
@@ -601,8 +601,8 @@ def prefill_chunk(
             q, k, v = _attn_proj(cfg, p, h, cos, sin)
             k_row = jax.lax.dynamic_update_slice(k_row, k.astype(k_row.dtype), (0, 0, start, 0))
             v_row = jax.lax.dynamic_update_slice(v_row, v.astype(v_row.dtype), (0, 0, start, 0))
-            kr, vr = _repeat_kv(cfg, k_row), _repeat_kv(cfg, v_row)
-            o = dot_product_attention(q, kr, vr, mask=attn_mask)  # [1, H, C, D]
+            # grouped attention reads the cache row once (no q_per_kv repeat)
+            o = gqa_dot_product_attention(q, k_row, v_row, mask=attn_mask)  # [1, H, C, D]
             o = o.transpose(0, 2, 1, 3).reshape(B, C, -1)
             x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
             h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
@@ -680,8 +680,10 @@ def decode_step(
             v = v.transpose(0, 2, 1, 3)
             k_cache = _write_cache(k_cache, k, positions)
             v_cache = _write_cache(v_cache, v, positions)
-            kr, vr = _repeat_kv(cfg, k_cache), _repeat_kv(cfg, v_cache)
-            o = dot_product_attention(q, kr, vr, mask=attn_mask)  # [B,H,1,D]
+            # grouped attention: the multi-GB slot cache is read ONCE per step
+            # instead of being materialized q_per_kv-fold by a head repeat —
+            # the decode path's dominant memory traffic after the weights
+            o = gqa_dot_product_attention(q, k_cache, v_cache, mask=attn_mask)  # [B,H,1,D]
             o = o.transpose(0, 2, 1, 3).reshape(B, 1, -1)
             x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
             h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)

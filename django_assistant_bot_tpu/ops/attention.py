@@ -57,6 +57,37 @@ def dot_product_attention(
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def gqa_dot_product_attention(
+    q: jnp.ndarray,  # [B, H, Sq, D]
+    k: jnp.ndarray,  # [B, KH, Sk, D] — KV heads NOT repeated
+    v: jnp.ndarray,  # [B, KH, Sk, D]
+    *,
+    mask: Optional[jnp.ndarray] = None,  # broadcastable to [B, 1, Sq, Sk]; True=keep
+) -> jnp.ndarray:
+    """Grouped-query attention that contracts query groups against the shared
+    KV heads directly — no ``repeat(q_per_kv)`` materialization.
+
+    On the decode path the repeat is the single biggest memory consumer: a
+    [B, KH, S, D] slot cache repeated to H heads writes+reads q_per_kv x the
+    cache bytes EVERY step (multi-GB of pure copy traffic at serving shapes).
+    Grouping the einsum reads the cache once.
+    """
+    B, H, Sq, D = q.shape
+    KH = k.shape[1]
+    G = H // KH
+    scale = D ** -0.5
+    qg = q.reshape(B, KH, G, Sq, D)
+    scores = jnp.einsum(
+        "bkgqd,bksd->bkgqs", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        m = mask[:, :, None] if mask.ndim == 4 else mask  # insert group axis
+        scores = jnp.where(m, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgqs,bksd->bkgqd", probs, v)
+    return out.reshape(B, H, Sq, D)
+
+
 # ---------------------------------------------------------------------------
 # Pallas flash attention
 # ---------------------------------------------------------------------------

@@ -16,11 +16,28 @@ from typing import List, Optional, Sequence, Tuple, Type
 import numpy as np
 
 from ...conf import settings
+from ...storage.knn import AsyncSearcher, VectorIndex
 from ...storage.models import Document, Question, Sentence
 from ...storage.orm import Model
 from ..index_registry import get_index
 
 logger = logging.getLogger(__name__)
+
+# one coalescing searcher per (index, event loop): concurrent requests share a
+# single batched KNN dispatch instead of paying one device RTT each
+_searchers: dict = {}
+
+
+def _searcher_for(index: VectorIndex) -> AsyncSearcher:
+    loop = asyncio.get_running_loop()
+    key = (id(index), id(loop))
+    searcher = _searchers.get(key)
+    if searcher is None or searcher.index is not index:
+        if len(_searchers) > 64:  # dead loops / rebuilt indexes accumulate
+            _searchers.clear()
+        searcher = AsyncSearcher(index)
+        _searchers[key] = searcher
+    return searcher
 
 
 def cosine_similarity(a: np.ndarray, b: np.ndarray) -> float:
@@ -47,14 +64,15 @@ async def _objects_embedding_search(
     allowed_ids: Optional[set] = None,
 ) -> List[Model]:
     """Top-n rows by cosine distance, each annotated with ``.distance``."""
+    # index lookup may trigger a (blocking) rebuild+warmup — keep it off-loop
+    index = await asyncio.to_thread(get_index, model_cls, field)
+    # concurrent searches coalesce into one batched dispatch; an allowlist
+    # becomes a position mask on the same scoring kernel (no full ranking)
+    hits = await _searcher_for(index).search(
+        np.asarray(query_embedding, np.float32), k=n, allowed_ids=allowed_ids
+    )
 
-    def run() -> List[Model]:
-        index = get_index(model_cls, field)
-        # the allowlist becomes a position mask on the scoring kernel — the
-        # same compiled program as the unfiltered path, no full-corpus ranking
-        hits = index.search(
-            np.asarray(query_embedding, np.float32), k=n, allowed_ids=allowed_ids
-        )
+    def fetch() -> List[Model]:
         by_id = {
             obj.id: obj
             for obj in model_cls.objects.filter(id__in=[h[0] for h in hits])
@@ -67,7 +85,7 @@ async def _objects_embedding_search(
                 out.append(obj)
         return out
 
-    return await asyncio.to_thread(run)
+    return await asyncio.to_thread(fetch)
 
 
 async def embedding_search_questions(

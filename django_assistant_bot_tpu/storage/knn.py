@@ -518,6 +518,76 @@ class VectorIndex:
         return index
 
 
+class AsyncSearcher:
+    """Coalesce concurrent async searches into one batched MXU dispatch.
+
+    Each KNN dispatch through a host<->device round trip costs ~1 RTT; under
+    concurrent RAG traffic N serial searches cost N RTTs while ONE batched
+    [N, D] x [D, corpus] matmul costs the same single RTT (the query-row
+    bucketing in :meth:`VectorIndex.search_batch` keeps the compiled kernel
+    shared).  The same coalescing discipline as the serving engines'
+    EmbeddingEngine, applied to retrieval.
+
+    Allow-listed searches bypass coalescing — their position masks are
+    per-query state the batched kernel shares across rows.
+    """
+
+    def __init__(self, index: "VectorIndex", window_s: float = 0.002, max_batch: int = 32):
+        self.index = index
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._pending: list = []  # [(vector, k, asyncio.Future)]
+        self._flusher = None
+
+    async def search(
+        self, query: np.ndarray, k: int = 10, allowed_ids: Optional[set] = None
+    ) -> list[tuple[int, float]]:
+        import asyncio
+
+        if allowed_ids is not None:
+            return await asyncio.to_thread(
+                self.index.search, query, k, allowed_ids=allowed_ids
+            )
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._pending.append((np.asarray(query, np.float32), int(k), fut))
+        if self._flusher is None or self._flusher.done():
+            self._flusher = loop.create_task(self._flush_soon())
+        if len(self._pending) >= self.max_batch:
+            self._flush_now()
+        return await fut
+
+    async def _flush_soon(self):
+        import asyncio
+
+        await asyncio.sleep(self.window_s)
+        self._flush_now()
+
+    def _flush_now(self):
+        import asyncio
+
+        batch, self._pending = self._pending, []
+        if not batch:
+            return
+        vecs = np.stack([v for v, _, _ in batch])
+        k_max = max(k for _, k, _ in batch)
+        loop = asyncio.get_running_loop()
+
+        async def run():
+            try:
+                rows = await asyncio.to_thread(self.index.search_batch, vecs, k_max)
+            except Exception as e:  # pragma: no cover - propagate to every waiter
+                for _, _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                return
+            for (_, k, fut), hits in zip(batch, rows):
+                if not fut.done():
+                    fut.set_result(hits[:k])
+
+        loop.create_task(run())
+
+
 # --------------------------------------------------------------- sharded search
 _sharded_topk_cache: dict = {}
 

@@ -270,6 +270,45 @@ def test_knn_warmup_precompiles_and_blocks():
     assert VectorIndex(8).warmup()._device_index is None
 
 
+def test_knn_async_searcher_coalesces():
+    """Concurrent async searches share ONE batched dispatch and still get
+    per-caller k slicing; allowlist queries bypass coalescing."""
+    import asyncio
+
+    from django_assistant_bot_tpu.storage.knn import AsyncSearcher
+
+    rng = np.random.default_rng(12)
+    vecs = rng.normal(size=(100, 16)).astype(np.float32)
+    index = VectorIndex(16)
+    index.add(list(range(100)), vecs)
+
+    calls = []
+    orig = index.search_batch
+
+    def spy(queries, k=10, allowed_ids=None):
+        calls.append(len(queries))
+        return orig(queries, k, allowed_ids=allowed_ids)
+
+    index.search_batch = spy
+    searcher = AsyncSearcher(index, window_s=0.01)
+
+    async def drive():
+        return await asyncio.gather(
+            *(searcher.search(vecs[i], k=1 + i % 3) for i in range(6))
+        )
+
+    rows = asyncio.run(drive())
+    assert [r[0][0] for r in rows] == list(range(6))  # each finds itself
+    assert [len(r) for r in rows] == [1 + i % 3 for i in range(6)]
+    assert calls == [6]  # one coalesced dispatch for all six
+
+    async def drive_allowed():
+        return await searcher.search(vecs[0], k=2, allowed_ids={5, 7})
+
+    hits = asyncio.run(drive_allowed())
+    assert {i for i, _ in hits} == {5, 7}
+
+
 def test_knn_remove_then_add_same_count_keeps_ids_fresh():
     """Regression: a remove + add netting the same row count must refresh the
     position->id snapshot (it used to be refreshed only on length change)."""
