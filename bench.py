@@ -41,12 +41,14 @@ BASELINE_ITERS = int(os.environ.get("BENCH_BASELINE_ITERS", "2"))
 
 # config 2 (decode) / config 3 (RAG)
 DECODE_REQUESTS = int(os.environ.get("BENCH_DECODE_REQUESTS", "16"))
-DECODE_NEW_TOKENS = int(os.environ.get("BENCH_DECODE_NEW_TOKENS", "64"))
+DECODE_NEW_TOKENS = int(os.environ.get("BENCH_DECODE_NEW_TOKENS", "128"))
 DECODE_PROMPT_LEN = int(os.environ.get("BENCH_DECODE_PROMPT_LEN", "120"))
 RAG_REQUESTS = int(os.environ.get("BENCH_RAG_REQUESTS", "24"))
 RAG_CONCURRENCY = int(os.environ.get("BENCH_RAG_CONCURRENCY", "8"))
 RAG_NEW_TOKENS = int(os.environ.get("BENCH_RAG_NEW_TOKENS", "32"))
-RAG_CORPUS = int(os.environ.get("BENCH_RAG_CORPUS", "10000"))
+# headline composes configs 3+4: the KNN hop runs at CORPUS SCALE (1M vectors,
+# ~1.5 GB bf16 on device next to both models) through the real HTTP path
+RAG_CORPUS = int(os.environ.get("BENCH_RAG_CORPUS", "1000000"))
 BASELINE_DECODE_TOKENS = int(os.environ.get("BENCH_BASELINE_DECODE_TOKENS", "6"))
 
 # config 4 (bulk ingestion + KNN scale)
@@ -154,7 +156,7 @@ def _decode_bucket() -> int:
     return pick_bucket(DECODE_PROMPT_LEN, (128, 512), 512)
 
 
-def _build_gen_engine(cfg=None, quantize=None, buckets=(128, 512)):
+def _build_gen_engine(cfg=None, quantize=None, buckets=(128, 512), prefix_cache=0):
     import jax
 
     from django_assistant_bot_tpu.models import llama
@@ -184,6 +186,7 @@ def _build_gen_engine(cfg=None, quantize=None, buckets=(128, 512)):
         prefill_buckets=buckets,
         chunk_size=buckets[-1],
         mesh=mesh,
+        prefix_cache_size=prefix_cache,
     )
     # compile every (batch, seq) prefill shape BEFORE measuring; the decode-only
     # engines are built with just the bucket their prompts hit (same bucket the
@@ -228,6 +231,33 @@ def bench_decode(eng) -> dict:
     param_bytes = sum(l.nbytes for l in leaves)
     n_params = sum(l.size for l in leaves)
     tok_s = total_new / wall
+    # Pure on-device step cost (no prefill wave, no host loop): the roofline
+    # denominator.  steady tok/s = slots/step; HBM floor counts one full weight
+    # read per step (KV/activation traffic excluded -> a hard lower bound).
+    step_s = eng.probe_decode(iters=12)
+    steady_tok_s = eng.max_slots / step_s
+    stats = eng.tick_stats()
+    # Measured read-bandwidth ceiling over the SAME weight set (chained
+    # convert+reduce stream, serialized through the scalar carry — unchained
+    # dispatches overlap server-side under the tunnel and report fiction).
+    # The denominator for "how close to THIS chip's practical wall are we":
+    # nominal v5e HBM is 819 GB/s, but the shared tunnel chip delivers far
+    # less; achieved/ceiling is the honest utilization number.
+    import jax.numpy as jnp
+
+    big = [l for l in leaves if l.nbytes >= (1 << 20)]
+    big_bytes = sum(l.nbytes for l in big)
+    stream = jax.jit(
+        lambda c, ls: c + sum(jnp.sum(l.astype(jnp.float32)) for l in ls)
+    )
+    acc = jnp.zeros(())
+    acc = stream(acc, big)
+    jax.block_until_ready(acc)
+    t0 = time.perf_counter()
+    for _ in range(6):
+        acc = stream(acc, big)
+    jax.block_until_ready(acc)
+    ceiling_gbps = big_bytes * 6 / (time.perf_counter() - t0) / 1e9
     return {
         "decode_tokens_per_s_per_chip": round(tok_s, 2),
         "decode_p50_ttft_s": round(statistics.median(ttfts), 4),
@@ -236,6 +266,18 @@ def bench_decode(eng) -> dict:
         "decode_new_tokens": DECODE_NEW_TOKENS,
         "decode_hbm_gbps_min": round(tok_s / DECODE_REQUESTS * param_bytes / 1e9, 1),
         "decode_mfu_pct": round(tok_s * 2 * n_params / 197e12 * 100, 2),
+        "decode_pure_step_ms": round(step_s * 1e3, 3),
+        "decode_steady_tokens_per_s": round(steady_tok_s, 2),
+        "decode_steady_hbm_gbps": round(param_bytes / step_s / 1e9, 1),
+        "decode_hbm_ceiling_gbps": round(ceiling_gbps, 1),
+        # meaningless on tiny models whose weights fit in cache (ceiling ~0)
+        "decode_hbm_utilization_pct": round(
+            param_bytes / step_s / 1e9 / ceiling_gbps * 100, 1
+        )
+        if ceiling_gbps > 1.0
+        else None,
+        "decode_tick_issue_ms": stats["issue_ms"],
+        "decode_tick_block_ms": stats["block_ms"],
     }
 
 
@@ -272,19 +314,37 @@ def bench_rag(gen_engine) -> dict:
     registry.embedders["bench-emb"] = emb_eng
     registry.generators["bench-chat"] = gen_engine
 
-    # corpus: random docs, embeddings pre-computed (ingestion is config 4)
+    # corpus: random docs, embeddings pre-computed (ingestion is config 4).
+    # Built in slices to bound host RAM; doc text is generated on demand (a
+    # materialized dict would hold RAG_CORPUS strings for 3 reads each).
     rng = np.random.default_rng(2)
     index = VectorIndex(ecfg.hidden_size)
-    vecs = rng.normal(size=(RAG_CORPUS, ecfg.hidden_size)).astype(np.float32)
-    index.add(list(range(RAG_CORPUS)), vecs)
-    docs = {
-        i: f"Document {i}: " + " ".join(f"fact{i}-{j}" for j in range(30))
-        for i in range(RAG_CORPUS)
-    }
+    n = RAG_CORPUS if not SMALL else min(RAG_CORPUS, 10_000)
+    step = 200_000
+    for lo in range(0, n, step):
+        hi = min(lo + step, n)
+        index.add(
+            range(lo, hi),
+            rng.normal(size=(hi - lo, ecfg.hidden_size)).astype(np.float32),
+        )
+
+    def doc_text(i: int) -> str:
+        return f"Document {i}: " + " ".join(f"fact{i}-{j}" for j in range(30))
+
+    # pay the host->HBM corpus transfer + kernel compiles BEFORE timing starts
+    # (blocks until resident — the serving-path warmup discipline, knn.py)
+    t0 = time.perf_counter()
+    index.warmup(ks=(3, 16), q_rows=(1, RAG_CONCURRENCY))
+    rag_index_warmup_s = time.perf_counter() - t0
 
     searcher = AsyncSearcher(index)
 
-    async def one_request(client, qid: int) -> dict:
+    async def one_dialog(client, qid: int) -> list:
+        """A 2-turn RAG dialog — the reference's real request shape: every turn
+        re-sends system + packed context + history in full
+        (assistant/bot/services/context_service/steps/final_prompt.py:14).
+        Turn 2's prompt extends turn 1's, so the engine's prefix KV cache
+        skips re-prefilling the context block."""
         q = f"benchmark question number {qid} about topic {qid % 7}?"
         r = await client.post(
             "/embeddings/", json={"model": "bench-emb", "texts": [q]}
@@ -293,21 +353,30 @@ def bench_rag(gen_engine) -> dict:
         # the real search service coalesces concurrent KNN queries into one
         # batched dispatch (rag/services/search_service.py) — same here
         top = await searcher.search(np.asarray(emb, np.float32), 3)
-        context = "\n".join(docs[i][:200] for i, _ in top)
-        r = await client.post(
-            "/dialog/",
-            json={
-                "model": "bench-chat",
-                "messages": [
-                    {"role": "system", "content": "Answer from context:\n" + context},
-                    {"role": "user", "content": q},
-                ],
-                "max_tokens": RAG_NEW_TOKENS,
-                "json_format": False,
-            },
-        )
-        data = await r.json()
-        return data["response"]["usage"]
+        context = "\n".join(doc_text(i)[:200] for i, _ in top)
+        messages = [
+            {"role": "system", "content": "Answer from context:\n" + context},
+            {"role": "user", "content": q},
+        ]
+        usages = []
+        for follow_up in (None, "what else does the context say?"):
+            if follow_up is not None:
+                messages.append({"role": "user", "content": follow_up})
+            r = await client.post(
+                "/dialog/",
+                json={
+                    "model": "bench-chat",
+                    "messages": messages,
+                    "max_tokens": RAG_NEW_TOKENS,
+                    "json_format": False,
+                },
+            )
+            data = await r.json()
+            usages.append(data["response"]["usage"])
+            messages.append(
+                {"role": "assistant", "content": data["response"]["result"]}
+            )
+        return usages
 
     async def drive():
         loop = asyncio.get_event_loop()
@@ -316,32 +385,54 @@ def bench_rag(gen_engine) -> dict:
         try:
             # prefill shapes are pre-compiled by engine.warmup(); this warms the
             # HTTP/embed/KNN path end-to-end
-            await one_request(client, 999)
+            await one_dialog(client, 999)
             sem = asyncio.Semaphore(RAG_CONCURRENCY)
 
             async def guarded(i):
                 async with sem:
-                    return await one_request(client, i)
+                    return await one_dialog(client, i)
 
+            n_dialogs = max(1, RAG_REQUESTS // 2)
             t0 = time.perf_counter()
-            usages = await asyncio.gather(*(guarded(i) for i in range(RAG_REQUESTS)))
+            per_dialog = await asyncio.gather(
+                *(guarded(i) for i in range(n_dialogs))
+            )
             wall = time.perf_counter() - t0
         finally:
             await client.close()
-        return usages, wall
+        return per_dialog, wall
 
     try:
-        usages, wall = asyncio.new_event_loop().run_until_complete(drive())
+        per_dialog, wall = asyncio.new_event_loop().run_until_complete(drive())
     finally:
         emb_eng.stop()
-    ttfts = sorted(u["ttft_s"] for u in usages)
+    turn1 = sorted(d[0]["ttft_s"] for d in per_dialog)
+    turn2 = sorted(d[1]["ttft_s"] for d in per_dialog)
+    n_turns = sum(len(d) for d in per_dialog)
     return {
-        "rag_req_per_s": round(RAG_REQUESTS / wall, 3),
-        "rag_p50_ttft_s": round(statistics.median(ttfts), 4),
+        "rag_req_per_s": round(n_turns / wall, 3),
+        "rag_p50_ttft_s": round(statistics.median(turn1 + turn2), 4),
+        # turn 2 re-sends turn 1's whole prompt + answer; the prefix KV cache
+        # skips its recompute, so this TTFT isolates the prefix-cache win
+        "rag_turn2_p50_ttft_s": round(statistics.median(turn2), 4),
         "rag_concurrency": RAG_CONCURRENCY,
-        "rag_corpus_vectors": RAG_CORPUS,
+        "rag_corpus_vectors": n,
         "rag_new_tokens": RAG_NEW_TOKENS,
+        "rag_index_warmup_s": round(rag_index_warmup_s, 3),
+        "rag_prefix_hits": gen_engine.prefix_hits,
+        "rag_prefix_misses": gen_engine.prefix_misses,
     }
+
+
+def _error_tail(stderr: str, max_chars: int = 400) -> str:
+    """The diagnosis-bearing slice of a failed child's stderr: the last
+    exception line (e.g. RESOURCE_EXHAUSTED) plus trailing context."""
+    lines = [l for l in (stderr or "").strip().splitlines() if l.strip()]
+    # last line that looks like an exception summary
+    for line in reversed(lines):
+        if "Error" in line or "Exception" in line or "EXHAUSTED" in line:
+            return line.strip()[:max_chars]
+    return " | ".join(lines[-3:])[:max_chars] if lines else "no stderr"
 
 
 def _subprocess_bench(snippet: str, timeout_s: int = 1800):
@@ -350,7 +441,11 @@ def _subprocess_bench(snippet: str, timeout_s: int = 1800):
     and a failed build poisons the parent's device session (deallocation is
     async through the remote tunnel, so retries see the dead attempt's memory
     for minutes).  A child process's exit reliably frees its server-side
-    allocations, so each geometry attempt gets a clean slate."""
+    allocations, so each geometry attempt gets a clean slate.
+
+    Returns ``(result_dict_or_None, error_tail)`` — failures carry WHY (the
+    child's terminal stderr line: OOM vs crash vs timeout), so the published
+    bench record never says just "failed"."""
     import subprocess
 
     code = (
@@ -366,15 +461,15 @@ def _subprocess_bench(snippet: str, timeout_s: int = 1800):
             timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
-        return None
+        return None, f"timeout after {timeout_s}s"
     for line in reversed((p.stdout or "").strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line)
+                return json.loads(line), ""
             except Exception:
                 continue
-    return None
+    return None, f"rc={p.returncode}: {_error_tail(p.stderr)}"
 
 
 def _flagship_8b_cfg(max_seq_len=512):
@@ -408,7 +503,7 @@ from django_assistant_bot_tpu.parallel import get_mesh, shard_pytree
 from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
 
 slots = {slots}
-cfg = bench._flagship_8b_cfg()
+cfg = bench._flagship_8b_cfg(max_seq_len={seq})
 params = llama.init_int8(cfg, jax.random.PRNGKey(0))
 pb = sum(l.nbytes for l in jax.tree.leaves(params))
 n_params = sum(l.size for l in jax.tree.leaves(params))
@@ -418,7 +513,7 @@ with mesh:
 eng = GenerationEngine(
     cfg, params, ByteTokenizer(), max_slots=slots, max_seq_len=cfg.max_seq_len,
     prefill_buckets=(bench._decode_bucket(),), chunk_size=bench._decode_bucket(),
-    mesh=mesh, lookahead=1,
+    mesh=mesh, lookahead=1, prefix_cache_size=0,
 )
 eng.warmup()
 eng.start()
@@ -480,12 +575,12 @@ def bench_8b() -> dict:
     shared chip can't poison the next attempt.
     """
     out: dict = {}
-    for slots in (16, 8, 4):
-        res = _subprocess_bench(_8B_SNIPPET.format(slots=slots))
+    for slots, seq in ((8, 512), (4, 512), (2, 256)):
+        res, err = _subprocess_bench(_8B_SNIPPET.format(slots=slots, seq=seq))
         if res:
             out.update(res)
             return out
-        out["decode_8b_error"] = f"failed at slots={slots}"
+        out["decode_8b_error"] = f"slots={slots} seq={seq}: {err}"
     return out
 
 
@@ -497,6 +592,24 @@ def bench_ingestion() -> dict:
     batch into pgvector (assistant/processing/tasks.py, pgvector HNSW insert);
     here it is batched jit encode feeding incremental device appends.
     """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from django_assistant_bot_tpu.models import encoder
+    from django_assistant_bot_tpu.storage.knn import VectorIndex
+
+    out: dict = {}
+    cfg = _encoder_cfg()
+    out.update(bench_ingest_only())
+    # KNN at corpus scale: SMALL runs a 20k-vector body in-process; the real
+    # run's 1M walk-down lives in main()'s subprocess sequence
+    out.update(_knn_scale_body(20_000, cfg.hidden_size, KNN_QUERIES))
+    return out
+
+
+def bench_ingest_only() -> dict:
+    """The device-side half of config 4: batched jit encode -> device appends."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -529,21 +642,6 @@ def bench_ingestion() -> dict:
     wall = time.perf_counter() - t0
     out["ingest_docs_per_s_per_chip"] = round(done / wall, 2)
     out["ingest_docs"] = done
-
-    # --- KNN at corpus scale (config 4 ingestion side / VERDICT scale test)
-    if SMALL:
-        out.update(_knn_scale_body(20_000, cfg.hidden_size, KNN_QUERIES))
-        return out
-    # fresh subprocess per corpus size: a failed multi-GB staging poisons the
-    # parent's device session (see _subprocess_bench); walk down on failure
-    for n_vec in (KNN_VECTORS, KNN_VECTORS // 2, KNN_VECTORS // 4):
-        res = _subprocess_bench(
-            _KNN_SCALE_SNIPPET.format(n_vec=n_vec, dim=cfg.hidden_size, nq=KNN_QUERIES)
-        )
-        if res:
-            out.update(res)
-            return out
-        out["knn_scale_error"] = f"failed at {n_vec} vectors"
     return out
 
 
@@ -588,6 +686,26 @@ def _knn_scale_body(n_vec: int, dim: int, n_queries: int) -> dict:
         (time.perf_counter() - t0) / n_queries * 1e3, 3
     )
 
+    # the SERVING-path single query: concurrent callers coalesce into one
+    # batched dispatch (storage/knn.py AsyncSearcher — what the RAG search
+    # service actually calls), so each single query pays ~1/N of the RTT
+    from django_assistant_bot_tpu.storage.knn import AsyncSearcher
+
+    async def _concurrent_singles():
+        searcher = AsyncSearcher(scale_index)
+        lats: list[float] = []
+
+        async def one(i):
+            t0 = time.perf_counter()
+            await searcher.search(q[i], k=10)
+            lats.append(time.perf_counter() - t0)
+
+        await asyncio.gather(*(one(i) for i in range(n_queries)))
+        return lats
+
+    clat = asyncio.new_event_loop().run_until_complete(_concurrent_singles())
+    out["knn_query_concurrent_p50_ms"] = round(statistics.median(clat) * 1e3, 3)
+
     extra = rng.normal(size=(10_000, dim)).astype(np.float32)
     t0 = time.perf_counter()
     scale_index.add(range(n_vec, n_vec + 10_000), extra)
@@ -601,6 +719,66 @@ import json
 import bench
 
 print(json.dumps(bench._knn_scale_body({n_vec}, {dim}, {nq})))
+"""
+
+
+def bench_core() -> dict:
+    """Configs 1-3: embedding + bf16 decode + RAG, one engine build.  ONE body
+    serves both the SMALL in-process run and the real run's subprocess — the
+    measurement sequence can't drift between them."""
+    out: dict = {}
+    out["embedding_docs_per_sec_per_chip"] = round(bench_embedding(), 2)
+    # LRU must hold every live dialog's prefix (each 2-turn dialog registers
+    # up to 2 entries) or concurrent dialogs thrash each other's entries and
+    # rag_turn2_p50_ttft_s stops measuring the prefix-cache win
+    eng, _ = _build_gen_engine(prefix_cache=2 * RAG_CONCURRENCY + 2)
+    try:
+        out.update(bench_decode(eng))
+        out.update(bench_rag(eng))
+    finally:
+        eng.stop()
+    return out
+
+
+def bench_int8() -> dict:
+    """Config 2b: int8 weight-only decode (halves decode HBM reads)."""
+    eng, _ = _build_gen_engine(quantize="int8", buckets=(_decode_bucket(),))
+    try:
+        q8 = bench_decode(eng)
+    finally:
+        eng.stop()
+    return {
+        "decode_int8_tokens_per_s_per_chip": q8["decode_tokens_per_s_per_chip"],
+        "decode_int8_p50_ttft_s": q8["decode_p50_ttft_s"],
+        "decode_int8_hbm_gbps_min": q8["decode_hbm_gbps_min"],
+        "decode_int8_pure_step_ms": q8["decode_pure_step_ms"],
+        "decode_int8_steady_tokens_per_s": q8["decode_steady_tokens_per_s"],
+    }
+
+
+# Each device-using config section runs in its OWN subprocess: the chip is
+# shared across every live process on this host, so a parent that keeps model
+# params resident starves the next section (r3's 8B bench failed exactly this
+# way — the parent still held the 1B engines' HBM when the 9 GB child started).
+_CORE_SNIPPET = """
+import json
+import bench
+
+print(json.dumps(bench.bench_core()))
+"""
+
+_INT8_SNIPPET = """
+import json
+import bench
+
+print(json.dumps(bench.bench_int8()))
+"""
+
+_INGEST_SNIPPET = """
+import json
+import bench
+
+print(json.dumps(bench.bench_ingest_only()))
 """
 
 
@@ -660,6 +838,9 @@ def baseline_decode_torch_cpu() -> float:
             ids,
             attention_mask=torch.ones_like(ids),
             max_new_tokens=n_new,
+            # random weights sample EOS early; the two-point fit needs EXACT
+            # lengths or the slope degenerates (the r3 1e9 sentinel)
+            min_new_tokens=n_new,
             do_sample=True,
             top_p=0.95,
             top_k=50,
@@ -673,7 +854,16 @@ def baseline_decode_torch_cpu() -> float:
         t_small, t_big = gen(n // 2), gen(n)
         # two-point fit separates prefill cost from the per-token decode rate so
         # neither pollutes the other when extrapolating to other request sizes
-        per_token = max((t_big - t_small) / (n - n // 2), 1e-9)
+        per_token = (t_big - t_small) / (n - n // 2)
+        if per_token <= 1e-4:
+            # timing noise swallowed the decode slope (t_big <= t_small) — a
+            # rate extrapolated from it would be fiction.  Raising makes main()
+            # OMIT the torch-decode comparison instead of publishing a
+            # sentinel (r3 shipped 1e9 tok/s; VERDICT r3 "what's weak" #3).
+            raise RuntimeError(
+                f"degenerate torch decode slope ({per_token:.2e}s/token at "
+                f"n={n}); raise BENCH_BASELINE_DECODE_TOKENS"
+            )
         prefill_s = max(t_small - (n // 2) * per_token, 0.0)
     return 1.0 / per_token, prefill_s
 
@@ -709,31 +899,12 @@ def baseline_embedding_torch_cpu_batched() -> float:
 def main() -> None:
     extras: dict = {}
 
-    emb = bench_embedding()
-    extras["embedding_docs_per_sec_per_chip"] = round(emb, 2)
-
-    gen_eng, _ = _build_gen_engine()
-    try:
-        extras.update(bench_decode(gen_eng))
-        rag = bench_rag(gen_eng)
-    finally:
-        gen_eng.stop()
-    extras.update({k: v for k, v in rag.items() if k != "rag_req_per_s"})
-
-    # config 2b: int8 weight-only decode (halves HBM reads on the decode path)
-    q8_eng, _ = _build_gen_engine(quantize="int8", buckets=(_decode_bucket(),))
-    try:
-        q8 = bench_decode(q8_eng)
-        extras["decode_int8_tokens_per_s_per_chip"] = q8["decode_tokens_per_s_per_chip"]
-        extras["decode_int8_p50_ttft_s"] = q8["decode_p50_ttft_s"]
-        extras["decode_int8_hbm_gbps_min"] = q8["decode_hbm_gbps_min"]
-    finally:
-        q8_eng.stop()
-
-    # config 5: MoE continuous batching (Mixtral-class top-2 routing, int8
-    # experts on device).  Each depth runs in a fresh subprocess so a shared-
-    # chip OOM can't poison the next attempt; records the geometry that ran.
     if SMALL:
+        # CI/dev smoke: tiny shapes, one process (the CPU device isn't shared)
+        # — SAME bodies as the real run's subprocess snippets (bench_core /
+        # bench_int8), only the process isolation differs
+        extras.update(bench_core())
+        extras.update(bench_int8())
         moe_eng, _ = _build_gen_engine(_moe_cfg(), buckets=(_decode_bucket(),))
         try:
             moe = bench_decode(moe_eng)
@@ -741,67 +912,109 @@ def main() -> None:
             extras["moe_decode_p50_ttft_s"] = moe["decode_p50_ttft_s"]
         finally:
             moe_eng.stop()
+        extras.update(bench_ingestion())
     else:
+        # One subprocess per device-using section: the parent holds ZERO HBM,
+        # so every section gets the whole (shared, ~16 GB) chip.  r3's 8B and
+        # MoE "failed at ..." records were exactly this contention: the parent
+        # still held the 1B/encoder engines when the big child started.
+        core, err = _subprocess_bench(_CORE_SNIPPET, timeout_s=3600)
+        if core:
+            extras.update(core)
+        else:
+            extras["core_error"] = err
+
+        # config 2b: int8 weight-only decode (halves decode HBM reads)
+        q8, err = _subprocess_bench(_INT8_SNIPPET)
+        if q8:
+            extras.update(q8)
+        else:
+            extras["decode_int8_error"] = err
+
+        # config 5: MoE continuous batching (Mixtral-class top-2 routing, int8
+        # experts on device); walk depth down on failure, record why + what ran
         for layers in (8, 4, 2):
-            res = _subprocess_bench(_MOE_SNIPPET.format(layers=layers))
+            res, err = _subprocess_bench(_MOE_SNIPPET.format(layers=layers))
             if res:
                 extras.update(res)
                 break
-            extras["moe_decode_error"] = f"failed at layers={layers}"
+            extras["moe_decode_error"] = f"layers={layers}: {err}"
 
-    # config 2c: TRUE 8B flagship geometry, int8 weight-only, on-device synth
-    # weights (BASELINE configs[1]; reference serves llama3.1:8b via Ollama)
-    if not SMALL:
+        # config 2c: TRUE 8B flagship geometry, int8 weight-only, on-device
+        # synth weights (BASELINE configs[1]; reference serves llama3.1:8b)
         extras.update(bench_8b())
 
-    # config 4: bulk ingestion + KNN scale (after the engines are stopped so
-    # the 1M x 768 device matrix doesn't contend with model params for HBM)
-    ingest = bench_ingestion()
-    extras.update(ingest)
+        # config 4: bulk ingestion (own subprocess) + KNN scale walk-down
+        ing, err = _subprocess_bench(_INGEST_SNIPPET)
+        if ing:
+            extras.update(ing)
+        else:
+            extras["ingest_error"] = err
+        ecfg = _encoder_cfg()
+        for n_vec in (KNN_VECTORS, KNN_VECTORS // 2, KNN_VECTORS // 4):
+            res, err = _subprocess_bench(
+                _KNN_SCALE_SNIPPET.format(
+                    n_vec=n_vec, dim=ecfg.hidden_size, nq=KNN_QUERIES
+                )
+            )
+            if res:
+                extras.update(res)
+                break
+            extras["knn_scale_error"] = f"{n_vec} vectors: {err}"
 
+    emb = extras.get("embedding_docs_per_sec_per_chip")
     try:
         emb_base = baseline_embedding_torch_cpu()
-        extras["embedding_vs_torch_cpu"] = round(emb / emb_base, 2)
+        if emb:
+            extras["embedding_vs_torch_cpu"] = round(emb / emb_base, 2)
     except Exception:
         emb_base = None
     try:
         emb_base_batched = baseline_embedding_torch_cpu_batched()
-        extras["embedding_vs_torch_cpu_batched"] = round(emb / emb_base_batched, 2)
-        extras["ingest_vs_torch_cpu_batched"] = round(
-            ingest["ingest_docs_per_s_per_chip"] / emb_base_batched, 2
-        )
+        if emb:
+            extras["embedding_vs_torch_cpu_batched"] = round(emb / emb_base_batched, 2)
+        if extras.get("ingest_docs_per_s_per_chip"):
+            extras["ingest_vs_torch_cpu_batched"] = round(
+                extras["ingest_docs_per_s_per_chip"] / emb_base_batched, 2
+            )
     except Exception:
         pass
     try:
         dec_base, prefill_base_s = baseline_decode_torch_cpu()
         extras["decode_baseline_tokens_per_s_torch_cpu"] = round(dec_base, 3)
-        extras["decode_vs_torch_cpu"] = round(
-            extras["decode_tokens_per_s_per_chip"] / dec_base, 2
-        )
+        if extras.get("decode_tokens_per_s_per_chip"):
+            extras["decode_vs_torch_cpu"] = round(
+                extras["decode_tokens_per_s_per_chip"] / dec_base, 2
+            )
     except Exception:
         dec_base = None
 
-    # headline vs_baseline: the reference serves a RAG request single-stream as
-    # prefill + new_tokens decode + one unbatched embed call
+    # headline vs_baseline: the reference serves a RAG turn single-stream as
+    # prefill + new_tokens decode, plus one unbatched embed call on the
+    # retrieval turns only — our dialogs embed once per 2 turns, so the
+    # baseline is charged the same 1/2 embed per turn (not one per turn)
     vs = None
-    if dec_base and emb_base:
+    rag_req_s = extras.get("rag_req_per_s")
+    if dec_base and emb_base and rag_req_s:
         ref_req_s = 1.0 / (
-            prefill_base_s + RAG_NEW_TOKENS / dec_base + 1.0 / emb_base
+            prefill_base_s + RAG_NEW_TOKENS / dec_base + 0.5 / emb_base
         )
         extras["rag_baseline_req_per_s_torch_cpu"] = round(ref_req_s, 4)
-        vs = round(rag["rag_req_per_s"] / ref_req_s, 2)
+        vs = round(rag_req_s / ref_req_s, 2)
 
-    print(
-        json.dumps(
-            {
-                "metric": "rag_req_per_s_plus_p50_ttft",
-                "value": rag["rag_req_per_s"],
-                "unit": "req/s (p50 TTFT %ss)" % rag["rag_p50_ttft_s"],
-                "vs_baseline": vs,
-                "extras": extras,
-            }
-        )
-    )
+    record = {
+        "metric": "rag_req_per_s_plus_p50_ttft",
+        "value": rag_req_s,
+        "unit": "req/s (p50 TTFT %ss)" % extras.get("rag_p50_ttft_s")
+        if rag_req_s
+        else "req/s",
+        "vs_baseline": vs,
+        "extras": extras,
+    }
+    if rag_req_s is None:
+        # the core child died — the failure IS the headline, not a buried extra
+        record["error"] = extras.get("core_error", "core section produced no result")
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -166,12 +166,6 @@ class AssistantBot(Bot):
                 no_store=True,
             )
 
-        if self.instance.state.get("mode") == "image_creation":
-            if text and text.startswith("/"):
-                await self.update_state({"mode": "text"})
-            else:
-                text = f"/image {text}"
-
         self.messages = self._get_messages()
         self.debug_info = {"state": {k: v for k, v in self.instance.state.items() if k != "debug_info"}}
         t0 = time.time()

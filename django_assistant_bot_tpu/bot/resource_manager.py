@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+from typing import Optional
 
 from ..conf import settings
 from .domain import NoMessageFound, NoResourceFound
@@ -20,8 +21,13 @@ DEFAULT_LANGUAGE = "ru"  # reference default (assistant_bot.py DEFAULT_LANGUAGE)
 
 
 class ResourceManager:
-    def __init__(self, codename: str, language: str, default_language: str = DEFAULT_LANGUAGE):
+    def __init__(
+        self, codename: str, language: str, default_language: Optional[str] = None
+    ):
         self.codename = codename
+        if default_language is None:
+            # reference parity: settings.BOT_DEFAULT_LANGUAGE, defaulting 'ru'
+            default_language = settings.BOT_DEFAULT_LANGUAGE or DEFAULT_LANGUAGE
         self.language = language or default_language
         self.default_language = default_language
 

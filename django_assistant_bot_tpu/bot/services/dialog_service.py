@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import base64
 import datetime as _dt
+import hashlib
 import logging
 import os
 from typing import List, Optional
@@ -79,9 +80,16 @@ def _save_photo(photo: Photo) -> Optional[str]:
     )
     try:
         os.makedirs(media_dir, exist_ok=True)
-        path = os.path.join(media_dir, f"{photo.file_id}.{photo.extension}")
+        # media under MEDIA_ROOT is served WITHOUT API-token auth (platforms
+        # fetch it by URL — api/app.py auth exemption), so the filename must be
+        # unguessable — platform file_ids are enumerable.  Content-addressing
+        # (not a random uuid) keeps saves idempotent: a webhook redelivery of
+        # the same photo rewrites the same path instead of orphaning a copy.
+        data = bytes(photo.content)
+        name = hashlib.sha256(data).hexdigest()[:32]
+        path = os.path.join(media_dir, f"{name}.{photo.extension}")
         with open(path, "wb") as f:
-            f.write(bytes(photo.content))
+            f.write(data)
         return path
     except OSError:
         logger.exception("failed to persist photo %s", photo.file_id)

@@ -1,0 +1,185 @@
+"""Fetch / convert model weights so a checkpoint serves from a model name.
+
+Reference parity: ``gpu_service/bin/fetch_models.py:10-30`` pre-downloads every
+configured model via ``AutoModel.from_pretrained`` into the HF cache.  Here the
+same job is split into the two steps a TPU deployment actually needs:
+
+- ``fetch``: download a Hugging Face repo's serving assets (``config.json``,
+  ``*.safetensors``, tokenizer files) into ``<models-dir>/<org>__<name>/`` —
+  the directory layout ``models/hf_loader.py`` reads directly (no torch, no HF
+  cache indirection).  Already-complete directories are skipped, exactly like
+  the reference's ``local_files_only`` probe.
+- ``convert``: optionally re-save a fetched checkpoint as a native sharded
+  checkpoint (``checkpoint.py``), with ``--quantize int8`` pre-quantizing the
+  decoder weights — boot then skips the HF parse AND the quantization pass.
+
+With ``--config`` the model list comes from the serving config
+(``TPU_SERVING_CONFIG``) instead of the command line: every spec whose ``path``
+looks like a hub id (contains "/" but is not an existing directory) is fetched
+to the models dir and can then be served unchanged.
+
+Network access is optional everywhere: in an air-gapped deployment ``fetch``
+reports exactly which assets are missing and exits non-zero instead of raising
+mid-download.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+# the serving assets hf_loader/load_tokenizer read; everything else in a repo
+# (pytorch_model.bin, flax/tf weights, READMEs) is dead weight for this stack
+_PATTERNS = [
+    "config.json",
+    "*.safetensors",
+    "*.safetensors.index.json",
+    "tokenizer.json",
+    "tokenizer.model",
+    "tokenizer_config.json",
+    "special_tokens_map.json",
+    "vocab.txt",
+    "vocab.json",
+    "merges.txt",
+]
+
+
+def default_models_dir() -> str:
+    return os.environ.get("DABT_MODELS_DIR") or os.path.join(os.getcwd(), "models")
+
+
+def local_dir_for(models_dir: str, repo_id: str) -> str:
+    return os.path.join(models_dir, repo_id.replace("/", "__"))
+
+
+def is_complete(path: str) -> bool:
+    """A servable checkpoint dir: config + at least one safetensors shard."""
+    if not os.path.isdir(path):
+        return False
+    if not os.path.exists(os.path.join(path, "config.json")):
+        return False
+    return any(f.endswith(".safetensors") for f in os.listdir(path))
+
+
+def fetch_one(repo_id: str, models_dir: str, revision: Optional[str] = None) -> str:
+    """Download ``repo_id``'s serving assets; returns the local dir."""
+    target = local_dir_for(models_dir, repo_id)
+    if is_complete(target):
+        print(f"{repo_id}: already fetched -> {target}")
+        return target
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:  # air-gapped image without the hub client
+        raise SystemExit(
+            f"{repo_id}: not present at {target} and huggingface_hub is not "
+            f"installed ({e}).  Copy the checkpoint directory (config.json + "
+            f"*.safetensors + tokenizer files) to that path manually."
+        )
+    print(f"{repo_id}: downloading to {target}")
+    try:
+        snapshot_download(
+            repo_id,
+            revision=revision,
+            local_dir=target,
+            allow_patterns=_PATTERNS,
+        )
+    except Exception as e:
+        raise SystemExit(
+            f"{repo_id}: download failed ({type(e).__name__}: {e}).  In an "
+            f"air-gapped deployment place the checkpoint at {target} manually."
+        )
+    if not is_complete(target):
+        raise SystemExit(
+            f"{repo_id}: downloaded, but {target} has no config.json + "
+            "*.safetensors — not a servable checkpoint"
+        )
+    return target
+
+
+def convert_one(src_dir: str, out_dir: str, *, kind: str, quantize: Optional[str]) -> str:
+    """HF checkpoint dir -> native sharded checkpoint (checkpoint.py layout)."""
+    from ..checkpoint import save_model
+    from ..models.hf_loader import load_decoder, load_encoder
+
+    if kind == "encoder":
+        cfg, params = load_encoder(src_dir)
+    else:
+        cfg, params = load_decoder(src_dir)
+        if quantize == "int8":
+            from ..ops.quant import quantize_decoder_params
+
+            params = quantize_decoder_params(params)
+        elif quantize:
+            raise SystemExit(f"unknown --quantize {quantize!r}")
+    path = save_model(out_dir, kind, cfg, params, meta={"tokenizer": src_dir})
+    print(f"{src_dir}: converted ({kind}{', int8' if quantize else ''}) -> {path}")
+    return path
+
+
+def _config_repo_ids(config_path: str) -> List[str]:
+    with open(config_path) as f:
+        cfg = json.load(f)
+    out = []
+    for name, spec in cfg.items():
+        path = (spec or {}).get("path")
+        if path and "/" in path and not os.path.isdir(path):
+            out.append(path)
+    return out
+
+
+def add_parser(sub):
+    p = sub.add_parser(
+        "fetch_models",
+        help="download / convert model checkpoints into the serving layout",
+    )
+    p.add_argument("models", nargs="*", help="HF repo ids (org/name)")
+    p.add_argument(
+        "--config",
+        help="serving config (JSON) to fetch hub-id paths from "
+        "(default: TPU_SERVING_CONFIG)",
+    )
+    p.add_argument("--models-dir", default=None, help="target root (DABT_MODELS_DIR)")
+    p.add_argument("--revision", default=None, help="hub revision/tag")
+    p.add_argument(
+        "--convert",
+        action="store_true",
+        help="also save a native sharded checkpoint next to the HF dir",
+    )
+    p.add_argument(
+        "--kind",
+        choices=("decoder", "encoder"),
+        default="decoder",
+        help="model kind for --convert",
+    )
+    p.add_argument(
+        "--quantize",
+        choices=("int8",),
+        default=None,
+        help="pre-quantize decoder weights during --convert",
+    )
+    return p
+
+
+def run(args) -> int:
+    from ..conf import settings
+
+    models_dir = args.models_dir or default_models_dir()
+    repo_ids = list(args.models)
+    config_path = args.config or settings.TPU_SERVING_CONFIG
+    if not repo_ids and config_path:
+        repo_ids = _config_repo_ids(config_path)
+    if not repo_ids:
+        print("nothing to fetch: pass repo ids or --config with hub-id paths")
+        return 1
+    os.makedirs(models_dir, exist_ok=True)
+    for repo_id in repo_ids:
+        local = fetch_one(repo_id, models_dir, revision=args.revision)
+        if args.convert:
+            convert_one(
+                local,
+                local + ".native" + (".int8" if args.quantize else ""),
+                kind=args.kind,
+                quantize=args.quantize,
+            )
+    return 0

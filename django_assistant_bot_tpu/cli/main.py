@@ -19,6 +19,7 @@ COMMANDS = {
     "worker": ".worker",
     "telegram_poll": ".telegram_poll",
     "tester": ".tester",
+    "fetch_models": ".fetch_models",
 }
 
 

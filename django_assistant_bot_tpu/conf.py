@@ -46,6 +46,9 @@ class Settings:
         self.GROQ_BASE_URL: str = _env("GROQ_BASE_URL", "https://api.groq.com/openai/v1")
         # resources + registries
         self.RESOURCES_DIR: Optional[str] = _env("RESOURCES_DIR")
+        # fallback language for messages/phrases (reference:
+        # settings.BOT_DEFAULT_LANGUAGE, assistant/bot/resource_manager.py:14)
+        self.BOT_DEFAULT_LANGUAGE: str = _env("BOT_DEFAULT_LANGUAGE", "ru")
         self.API_AUTH_TOKEN: Optional[str] = _env("API_AUTH_TOKEN")
         # "user:password" protecting /admin with HTTP Basic; falls back to
         # "admin:<API_AUTH_TOKEN>" when only the token is configured

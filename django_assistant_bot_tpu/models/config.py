@@ -9,9 +9,12 @@ without the transformers modelling code.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Mapping, Optional
 
 import jax.numpy as jnp
+
+_logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,6 +107,24 @@ class DecoderConfig:
     def __post_init__(self):
         if self.head_dim is None:
             object.__setattr__(self, "head_dim", self.hidden_size // self.num_heads)
+        if self.rope_scaling and self.rope_scaling[0] == "longrope":
+            orig = self.rope_scaling[3]
+            if self.max_seq_len > orig:
+                # Static-shape serving commits to ONE factor list per deployment
+                # (ops/rope.py); HF flips short/long per running sequence, so in
+                # a long-context deployment prompts shorter than the pretrained
+                # context get LONG factors where HF uses SHORT ones.
+                _logger.warning(
+                    "longrope deployment with max_seq_len=%d > pretrained "
+                    "context %d: LONG rope factors apply to every sequence, so "
+                    "logits for prompts shorter than %d diverge from HF (which "
+                    "switches factor lists per sequence).  For exact "
+                    "short-context parity deploy with max_seq_len <= %d.",
+                    self.max_seq_len,
+                    int(orig),
+                    int(orig),
+                    int(orig),
+                )
 
     @property
     def q_per_kv(self) -> int:

@@ -96,6 +96,19 @@ def cache_shardings(cfg: DecoderConfig, mesh, batch: int) -> KVCache:
     )
 
 
+def prefix_shardings(cfg: DecoderConfig, mesh):
+    """NamedSharding for cached prefix K/V tensors ([L, KH, P, D]): kv_heads
+    over the TP axis like the slot cache, dropped to replication when the
+    head count doesn't divide the axis (same rule as :func:`cache_shardings`)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import MODEL_AXIS
+
+    if cfg.num_kv_heads % mesh.shape[MODEL_AXIS] == 0 and mesh.shape[MODEL_AXIS] > 1:
+        return NamedSharding(mesh, P(None, MODEL_AXIS, None, None))
+    return NamedSharding(mesh, P())
+
+
 def init_cache(cfg: DecoderConfig, batch: int, max_len: int, dtype=None) -> KVCache:
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, batch, cfg.num_kv_heads, max_len, cfg.head_dim)
@@ -417,6 +430,39 @@ def forward(
     return with_constraint(logits.astype(jnp.float32), ("batch", "length", "vocab_out"))
 
 
+def forward_layers(
+    layer_params: Params,
+    cfg: DecoderConfig,
+    x: jnp.ndarray,  # [B, S, E] activations entering the span
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> jnp.ndarray:
+    """Run a CONTIGUOUS SPAN of stacked decoder layers on activations.
+
+    The pipeline-parallel building block (parallel/pipeline.py): each pipeline
+    stage holds ``L/P`` layers ([Lp, ...] leaves of ``params['layers']``) and
+    advances a microbatch through just its span.  Full causal attention only —
+    the window split of :func:`forward` is per-absolute-layer-index state that
+    a span cannot see; windowed families bound their own context instead
+    (same restriction as :func:`forward_long`).
+    """
+    B, S = x.shape[0], x.shape[1]
+
+    def body(x, p):
+        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _attn_proj(cfg, p, h, cos, sin)
+        k, v = _repeat_kv(cfg, k), _repeat_kv(cfg, v)
+        o = attention(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
+        x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+        h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(cfg, p, h)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, layer_params)
+    return x
+
+
 def forward_long(
     params: Params,
     cfg: DecoderConfig,
@@ -624,6 +670,123 @@ def prefill_chunk(
     head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("e,ev->v", last, head.astype(cfg.dtype))[None]
     return logits.astype(jnp.float32), KVCache(k=k, v=v, lengths=lengths)
+
+
+def prefill_suffix(
+    params: Params,
+    cfg: DecoderConfig,
+    input_ids: jnp.ndarray,  # [B, C] right-padded suffix tokens (C static bucket)
+    cache: KVCache,
+    slots: jnp.ndarray,  # [B] int32 — target cache slot per row
+    starts: jnp.ndarray,  # [B] int32 — tokens already present (the prefix length)
+    valids: jnp.ndarray,  # [B] int32 — real (non-pad) tokens per row
+) -> tuple[jnp.ndarray, KVCache]:
+    """Batched continuation prefill on top of already-cached prefixes.
+
+    The prefix-KV-cache primitive: each row's slot already holds ``starts[b]``
+    tokens of K/V (a shared system/RAG-context prefix inserted from the prefix
+    cache — the reference re-sends that context in full every turn,
+    assistant/bot/services/context_service/steps/final_prompt.py:14, and
+    re-prefills it from scratch).  Here only the per-request suffix runs
+    through the model: queries take absolute positions ``starts[b] + i`` (so
+    RoPE matches a monolithic prefill exactly) and attend to the slot's whole
+    cache row up to their own position.
+
+    One dispatch serves a whole admission wave (unlike :func:`prefill_chunk`,
+    which advances a single slot) — ``slots``/``starts``/``valids`` are traced,
+    so one compiled program per (batch-bucket, C) shape.
+
+    Returns (logits [B, V] f32 at each row's last real token, cache with
+    ``lengths[slot] = start + valid``).
+    """
+    B, C = input_ids.shape
+    S = cache.max_len
+    pos = starts[:, None] + jnp.arange(C)[None, :]  # [B, C] absolute positions
+    cos_t, sin_t = _rope_tables(cfg, S)
+    cos, sin = cos_t[pos], sin_t[pos]  # [B, C, hd/2] — per-row gather
+    x = _embed(params, cfg, input_ids)  # [B, C, E]
+    kpos = jnp.arange(S)[None, None, None, :]
+    causal_keep = kpos <= pos[:, None, :, None]  # [B, 1, C, S]
+
+    # each row's slot cache: [L, B, KH, S, D] (gather, not dynamic_slice — the
+    # rows are independent per-request slots)
+    k_rows = jnp.take(cache.k, slots, axis=1)
+    v_rows = jnp.take(cache.v, slots, axis=1)
+
+    def make_body(window):
+        attn_mask = causal_keep
+        if window is not None:
+            attn_mask = attn_mask & (kpos > pos[:, None, :, None] - window)
+
+        def body(x, inputs):
+            p, k_row, v_row = inputs  # k_row: [B, KH, S, D]
+            h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _attn_proj(cfg, p, h, cos, sin)
+            # write this chunk's K/V at each row's own start (vmap'd slice)
+            k_row = _write_cache(k_row, k, starts)
+            v_row = _write_cache(v_row, v, starts)
+            o = gqa_dot_product_attention(q, k_row, v_row, mask=attn_mask)
+            o = o.transpose(0, 2, 1, 3).reshape(B, C, -1)
+            x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+            h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(cfg, p, h)
+            return x, (k_row, v_row)
+
+        return body
+
+    x, (k_rows, v_rows) = _scan_window_split(
+        cfg, make_body, x, (params["layers"], k_rows, v_rows)
+    )
+
+    # Scatter the updated rows back into their slots via insert_sequences'
+    # sequential scan: batch-bucket pad rows alias a real slot, and a
+    # gather-scatter with duplicate indices has UNDEFINED winner — the
+    # row-order scan makes the later (real) row deterministically overwrite
+    # the pad row's garbage.  (Full-width rows: S == cache.max_len.)
+    cache = insert_sequences(
+        cache, k_rows, v_rows, (starts + valids).astype(cache.lengths.dtype), slots
+    )
+    k, v, lengths = cache.k, cache.v, cache.lengths
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(valids - 1, 0)[:, None, None], axis=1
+    )[:, 0]  # [B, E]
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("be,ev->bv", last, head.astype(cfg.dtype))
+    return logits.astype(jnp.float32), KVCache(k=k, v=v, lengths=lengths)
+
+
+def insert_prefix(
+    cache: KVCache,
+    pk: jnp.ndarray,  # [L, KH, Pb, D] roped prefix K (positions [0, Pb))
+    pv: jnp.ndarray,
+    slot: jnp.ndarray,  # scalar int32
+) -> KVCache:
+    """Copy a cached prefix's K/V into a slot's cache row (positions [0, Pb)).
+
+    Pure HBM copy — no model compute.  ``Pb`` may exceed the true prefix
+    length (bucket padding); the garbage tail is overwritten or masked by the
+    suffix prefill, which also sets the slot's true length.
+    """
+    k = jax.lax.dynamic_update_slice(
+        cache.k, pk[:, None].astype(cache.k.dtype), (0, slot, 0, 0, 0)
+    )
+    v = jax.lax.dynamic_update_slice(
+        cache.v, pv[:, None].astype(cache.v.dtype), (0, slot, 0, 0, 0)
+    )
+    return KVCache(k=k, v=v, lengths=cache.lengths)
+
+
+def extract_prefix(cache: KVCache, slot: jnp.ndarray, pb: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Slice the first ``pb`` cached positions of a slot row -> ([L, KH, pb, D]) x2.
+
+    Captures a just-prefilled request's prefix K/V for the prefix cache (the
+    K values are post-RoPE at absolute positions [0, pb) — position-correct
+    for every future consumer, which places the prefix at the same offsets).
+    """
+    pk = jnp.take(cache.k, slot, axis=1)[:, :, :pb]
+    pv = jnp.take(cache.v, slot, axis=1)[:, :, :pb]
+    return pk, pv
 
 
 def decode_step(

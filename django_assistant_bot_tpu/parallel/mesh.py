@@ -12,6 +12,9 @@ Axis conventions (aligned with the scaling-book recipe):
 - ``model``  — tensor parallelism of attention heads / MLP hidden (TP).
 - ``expert`` — expert parallelism for MoE layers (EP); folded into ``model`` when the
   mesh is too small to give it its own axis.
+- ``pipe``   — pipeline parallelism over layer spans (GPipe microbatch schedule via
+  ``shard_map`` + ``ppermute``; parallel/pipeline.py).  Collectives: one activation
+  ppermute per stage per microbatch step, riding neighbouring ICI links.
 """
 
 from __future__ import annotations
@@ -30,8 +33,9 @@ DATA_AXIS = "data"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
 
-AXIS_ORDER = (DATA_AXIS, SEQ_AXIS, MODEL_AXIS, EXPERT_AXIS)
+AXIS_ORDER = (DATA_AXIS, SEQ_AXIS, MODEL_AXIS, EXPERT_AXIS, PIPE_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,13 +46,14 @@ class MeshAxes:
     seq: int = 1
     model: int = 1
     expert: int = 1
+    pipe: int = 1
 
     @property
     def total(self) -> int:
-        return self.data * self.seq * self.model * self.expert
+        return self.data * self.seq * self.model * self.expert * self.pipe
 
-    def as_tuple(self) -> tuple[int, int, int, int]:
-        return (self.data, self.seq, self.model, self.expert)
+    def as_tuple(self) -> tuple[int, int, int, int, int]:
+        return (self.data, self.seq, self.model, self.expert, self.pipe)
 
 
 def local_device_count() -> int:
@@ -61,6 +66,7 @@ def best_mesh_shape(
     want_model: int = 1,
     want_seq: int = 1,
     want_expert: int = 1,
+    want_pipe: int = 1,
 ) -> MeshAxes:
     """Choose a mesh shape for ``n_devices``: satisfy the requested model/seq/expert
     degrees (clamped to what divides ``n_devices``) and give the remainder to data.
@@ -80,7 +86,9 @@ def best_mesh_shape(
     rest //= seq
     expert = clamp(want_expert, rest)
     rest //= expert
-    return MeshAxes(data=rest, seq=seq, model=model, expert=expert)
+    pipe = clamp(want_pipe, rest)
+    rest //= pipe
+    return MeshAxes(data=rest, seq=seq, model=model, expert=expert, pipe=pipe)
 
 
 def make_mesh(

@@ -36,6 +36,12 @@ class ModelSpec:
     # weight-only quantization for decoders: None | "int8" (ops/quant.py) —
     # halves HBM reads on the bandwidth-bound decode path
     quantize: Optional[str] = None
+    # prefix KV cache: LRU size for shared prompt-prefix K/V (system + RAG
+    # context) reused across requests; 0 disables (serving/engine.py)
+    prefix_cache: int = 8
+    prefix_min_tokens: int = 32
+    # HBM budget for pinned prefix K/V (entries LRU-evict past it)
+    prefix_cache_max_bytes: int = 1 << 30
     # compile every (batch, seq) prefill/activation shape + decode ticks at
     # load time instead of on first traffic (GenerationEngine.warmup) — slower
     # boot, no multi-second serve-time compile stalls.  warmup_json also
@@ -160,6 +166,9 @@ class ModelRegistry:
                 chunk_size=spec.chunk_size,
                 lookahead=spec.lookahead,
                 burst=spec.burst,
+                prefix_cache_size=spec.prefix_cache,
+                prefix_min_tokens=spec.prefix_min_tokens,
+                prefix_cache_max_bytes=spec.prefix_cache_max_bytes,
                 mesh=self.mesh,
             )
             if spec.warmup or spec.warmup_json:

@@ -78,6 +78,60 @@ class HFTokenizer:
         return self.encode(render_plain_chat(messages))
 
 
+def encode_chat_split(tok: Tokenizer, messages: Sequence[dict]) -> tuple[List[int], int]:
+    """Encode a chat and report how many leading tokens form a stable prefix.
+
+    The prefix covers every message before the last (system prompt + history +
+    packed RAG context — the block the engine's prefix KV cache can reuse
+    across requests).  Correctness-first: the split is only reported when the
+    prefix's own encoding is EXACTLY a prefix of the full encoding (BPE merges
+    can straddle the boundary; then 0 is returned and the engine simply
+    prefills in full)."""
+    ids = tok.encode_chat(messages)
+    if len(messages) < 2:
+        return ids, 0
+    head = list(messages[:-1])
+    try:
+        inner = getattr(tok, "_tok", None)
+        if inner is not None and getattr(inner, "chat_template", None):
+            prefix_str = inner.apply_chat_template(
+                head, tokenize=False, add_generation_prompt=False
+            )
+            prefix_ids = _encode_head_cached(
+                tok, prefix_str, lambda: inner.encode(prefix_str, add_special_tokens=False)
+            )
+        else:
+            prefix_str = "\n".join(f"{m['role']}: {m['content']}" for m in head) + "\n"
+            prefix_ids = _encode_head_cached(tok, prefix_str, lambda: tok.encode(prefix_str))
+    except Exception:
+        return ids, 0
+    n = len(prefix_ids)
+    if 0 < n < len(ids) and ids[:n] == prefix_ids:
+        return ids, n
+    return ids, 0
+
+
+def _encode_head_cached(tok, prefix_str: str, encode) -> List[int]:
+    """Memoize the shared head's encoding on the tokenizer instance.
+
+    The prefix-KV workload re-sends a near-identical multi-kilobyte head every
+    turn; without this the hot path tokenizes that head twice per request
+    (full prompt + verification encode).  Small LRU per tokenizer; falls back
+    to plain encode on objects that refuse attributes (e.g. __slots__)."""
+    try:
+        cache = tok.__dict__.setdefault("_head_encode_cache", {})
+    except AttributeError:
+        return encode()
+    hit = cache.get(prefix_str)
+    if hit is not None:
+        return hit
+    out = encode()
+    if len(cache) >= 64:
+        cache.clear()  # tiny, regenerable; wholesale reset beats LRU plumbing
+    cache[prefix_str] = out
+    return out
+
+
 def render_plain_chat(messages: Sequence[dict]) -> str:
     """The reference's prompt construction: newline-joined "role: content" plus a
     trailing assistant cue (reference: assistant/ai/providers/transformers.py:50)."""

@@ -7,6 +7,8 @@ Run with::
 
 from __future__ import annotations
 
+import os
+
 from django_assistant_bot_tpu.conf import settings
 
 BOTS = {
@@ -16,6 +18,12 @@ BOTS = {
     }
 }
 
+# Per-bot file resources (prompts/, messages/<lang>/, phrases/<lang>.json) —
+# the reference ships example/bot/resources/task_manager/phrases/ru.json
+RESOURCES_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "resources")
+
 
 def configure() -> None:
     settings.BOTS = BOTS
+    if not settings.RESOURCES_DIR:
+        settings.RESOURCES_DIR = RESOURCES_DIR

@@ -107,3 +107,51 @@ def test_start_and_help(bot):
     assert "task manager bot" in answer.text
     answer = _send(bot, "/help", 2)
     assert "/new_task" in answer.text
+
+
+def test_example_resources_language_fallback(monkeypatch):
+    """The shipped example resources exercise the full ResourceManager fallback
+    chain (reference: example/bot/resources/task_manager/phrases/ru.json +
+    assistant/bot/resource_manager.py:32-57)."""
+    import example.settings as example_settings
+
+    from django_assistant_bot_tpu.bot.resource_manager import ResourceManager
+    from django_assistant_bot_tpu.conf import settings
+
+    with settings.override(RESOURCES_DIR=example_settings.RESOURCES_DIR):
+        # language present: en phrases served directly
+        rm = ResourceManager("taskmanager", "en")
+        assert rm.get_phrase("Continue") == "Continue"
+        # phrase absent from en.json -> falls through to the default (ru) file
+        assert (
+            rm.get_phrase("`An error occurred while generating the response.`")
+            == "`Произошла ошибка при формировании ответа.`"
+        )
+        # language with no phrase file at all -> default (ru) file
+        rm_de = ResourceManager("taskmanager", "de")
+        assert rm_de.get_phrase("Continue") == "Продолжить"
+        # unknown phrase everywhere -> literal key (reference :57)
+        assert rm_de.get_phrase("No such phrase") == "No such phrase"
+        # messages fall back too: de has no messages/ dir, default_language=en
+        rm_msg = ResourceManager("taskmanager", "de", default_language="en")
+        assert "test message" in rm_msg.get_message("TestMessage.txt")
+        # BOT_DEFAULT_LANGUAGE setting drives the implicit default
+        with settings.override(BOT_DEFAULT_LANGUAGE="en"):
+            rm_cfg = ResourceManager("taskmanager", "de")
+            assert rm_cfg.default_language == "en"
+            assert rm_cfg.get_phrase("Continue") == "Continue"
+
+
+def test_example_bot_serves_continue_phrase(bot, monkeypatch):
+    """End-to-end: a length-limited answer renders the Continue button through
+    the example phrase files (ru user -> Продолжить)."""
+    import example.settings as example_settings
+
+    from django_assistant_bot_tpu.bot.resource_manager import ResourceManager
+    from django_assistant_bot_tpu.conf import settings
+
+    models.BotUser.objects.filter(user_id="u1").update(language="ru")
+    bot.bot_user.language = "ru"
+    with settings.override(RESOURCES_DIR=example_settings.RESOURCES_DIR):
+        rm = ResourceManager(bot.bot.codename, bot.bot_user.language)
+        assert rm.get_phrase("Continue") == "Продолжить"

@@ -122,3 +122,45 @@ def test_norms_match_numpy():
     np.testing.assert_allclose(
         np.asarray(layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))), ln, atol=1e-4
     )
+
+
+def test_top_k_hierarchical_matches_lax_top_k():
+    """Exact at large vocab (the decode hot path): same values, and ids agree
+    wherever values are unique; padding lanes never leak in."""
+    from django_assistant_bot_tpu.ops.sampling import top_k_hierarchical
+
+    rng = np.random.default_rng(0)
+    for V in (16_384, 128_256, 5000):  # aligned, unaligned (pad), small
+        x = jnp.asarray(rng.normal(size=(4, V)).astype(np.float32))
+        vals, idx = jax.jit(lambda a: top_k_hierarchical(a, 50))(x)
+        ref_vals, ref_idx = jax.lax.top_k(x, 50)
+        np.testing.assert_allclose(np.asarray(vals), np.asarray(ref_vals))
+        np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_idx))
+        assert int(idx.max()) < V  # no padded-lane index escapes
+
+
+def test_top_k_hierarchical_adversarial_clusters():
+    """All top-k values packed into ONE group must still all be found (the
+    pigeonhole argument the implementation relies on)."""
+    from django_assistant_bot_tpu.ops.sampling import top_k_hierarchical
+
+    V, k = 32_768, 50
+    x = np.zeros((2, V), np.float32)
+    x[0, 256 : 256 + k] = np.arange(k, 0, -1)  # contiguous block in one group
+    x[1, ::701] = np.arange(len(x[1, ::701]), 0, -1)  # scattered
+    vals, idx = top_k_hierarchical(jnp.asarray(x), k)
+    ref_vals, ref_idx = jax.lax.top_k(jnp.asarray(x), k)
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(ref_vals))
+
+
+def test_sample_logits_large_vocab_greedy_matches_argmax():
+    from django_assistant_bot_tpu.ops.sampling import sample_logits
+
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(3, 128_256)).astype(np.float32))
+    out = sample_logits(
+        logits, jax.random.key(0), temperature=jnp.zeros((3,)), top_k=50, top_p=0.95
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(jnp.argmax(logits, axis=-1))
+    )

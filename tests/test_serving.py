@@ -6,6 +6,7 @@ unknown model, trailing-slash paths.
 """
 
 import asyncio
+import time
 
 import numpy as np
 import pytest
@@ -580,3 +581,238 @@ def test_chat_template_absent_falls_back_to_plain_join():
     msgs = [{"role": "user", "content": "hi"}]
     assert wrapped.apply_chat(msgs) == "user: hi\nassistant:"
     assert wrapped.encode_chat(msgs) == hf.encode(render_plain_chat(msgs))
+
+
+# ------------------------------------------------------------- prefix KV cache
+def test_prefill_suffix_matches_full_prefill():
+    """insert_prefix + prefill_suffix must produce the same logits and cache
+    state as one monolithic prefill of prefix+suffix (the prefix cache must
+    not change the math)."""
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(7))
+    rng = np.random.default_rng(11)
+    P, C, S = 24, 8, 64
+    prefix = rng.integers(1, 255, P).tolist()
+    suffixes = [rng.integers(1, 255, C).tolist() for _ in range(2)]
+
+    # reference: monolithic prefill of each full prompt
+    full_ids = np.asarray([prefix + s for s in suffixes], np.int32)
+    lengths = np.full((2,), P + C, np.int32)
+    ref_logits, ref_ks, ref_vs = llama.prefill(
+        params, cfg, jnp.asarray(full_ids), jnp.asarray(lengths)
+    )
+
+    # prefix path: prefill the prefix once, extract, insert into fresh slots,
+    # then batched suffix prefill
+    p_logits, p_ks, p_vs = llama.prefill(
+        params, cfg, jnp.asarray([prefix], np.int32), jnp.asarray([P], np.int32)
+    )
+    cache = llama.init_cache(cfg, 3, S)
+    cache = llama.insert_sequences(
+        cache, p_ks, p_vs, jnp.asarray([P], np.int32), jnp.asarray([0], np.int32)
+    )
+    pk, pv = llama.extract_prefix(cache, jnp.asarray(0, jnp.int32), P)
+    for slot in (1, 2):
+        cache = llama.insert_prefix(cache, pk, pv, jnp.asarray(slot, jnp.int32))
+    suffix_ids = jnp.asarray(suffixes, np.int32)
+    logits, cache = llama.prefill_suffix(
+        params,
+        cfg,
+        suffix_ids,
+        cache,
+        jnp.asarray([1, 2], np.int32),
+        jnp.asarray([P, P], np.int32),
+        jnp.asarray([C, C], np.int32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+    assert np.asarray(cache.lengths)[1:3].tolist() == [P + C, P + C]
+    # cache K/V of the suffix region must match the monolithic prefill's
+    for slot, row in ((1, 0), (2, 1)):
+        np.testing.assert_allclose(
+            np.asarray(cache.k[:, slot, :, : P + C]),
+            np.asarray(ref_ks[:, row, :, : P + C]),
+            rtol=2e-4,
+            atol=2e-4,
+        )
+
+
+def test_engine_prefix_cache_hit_matches_uncached():
+    """Greedy decode through the prefix cache == greedy decode without it,
+    and the second same-prefix request is served from the cache."""
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(9))
+    tok = ByteTokenizer()
+    system = "You are a terse assistant who answers from provided context only. "
+    prompts = [
+        [{"role": "system", "content": system}, {"role": "user", "content": u}]
+        for u in ("What is a TPU?", "Where do MXUs live?")
+    ]
+    n_new = 5
+
+    def run(prefix_size):
+        eng = GenerationEngine(
+            cfg,
+            params,
+            tok,
+            max_slots=2,
+            max_seq_len=128,
+            prefix_cache_size=prefix_size,
+            prefix_min_tokens=8,
+        ).start()
+        try:
+            outs = []
+            for msgs in prompts:  # sequential: the 2nd request must hit
+                r = asyncio.run(eng.generate(msgs, max_tokens=n_new, temperature=0.0))
+                outs.append(r.token_ids)
+            return outs, eng.prefix_hits, eng.prefix_misses
+        finally:
+            eng.stop()
+
+    base, h0, m0 = run(0)
+    cached, h1, m1 = run(8)
+    assert cached == base
+    assert h0 == 0 and m0 == 0  # disabled path keeps no stats
+    assert m1 >= 1 and h1 >= 1  # first request registers, second hits
+
+
+def test_engine_prefix_cache_concurrent_wave():
+    """A concurrent wave mixing cache hits and misses (suffix + full groups in
+    one admission) stays correct under greedy decoding."""
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(9))
+    tok = ByteTokenizer()
+    system = "Answer from context: context-block-alpha beta gamma delta. "
+    msgs = lambda u: [
+        {"role": "system", "content": system},
+        {"role": "user", "content": u},
+    ]
+    users = ["q one?", "q two?", "q three?", "q four?"]
+    n_new = 4
+
+    eng = GenerationEngine(
+        cfg, params, tok, max_slots=4, max_seq_len=128,
+        prefix_cache_size=8, prefix_min_tokens=8,
+    ).start()
+    try:
+        # prime the cache so the wave below contains hits
+        asyncio.run(eng.generate(msgs("prime"), max_tokens=2, temperature=0.0))
+
+        async def fire_all():
+            return await asyncio.gather(
+                *(eng.generate(msgs(u), max_tokens=n_new, temperature=0.0) for u in users)
+            )
+
+        got = [r.token_ids for r in asyncio.run(fire_all())]
+    finally:
+        eng.stop()
+
+    # reference: plain engine without prefix caching
+    eng2 = GenerationEngine(
+        cfg, params, tok, max_slots=4, max_seq_len=128, prefix_cache_size=0
+    ).start()
+    try:
+        async def fire_all2():
+            return await asyncio.gather(
+                *(eng2.generate(msgs(u), max_tokens=n_new, temperature=0.0) for u in users)
+            )
+
+        want = [r.token_ids for r in asyncio.run(fire_all2())]
+    finally:
+        eng2.stop()
+    assert got == want
+
+
+def test_encode_chat_split_byte_tokenizer():
+    from django_assistant_bot_tpu.serving.tokenizer import encode_chat_split
+
+    tok = ByteTokenizer()
+    msgs = [
+        {"role": "system", "content": "sys prompt"},
+        {"role": "user", "content": "hello"},
+    ]
+    ids, n = encode_chat_split(tok, msgs)
+    assert ids == tok.encode_chat(msgs)
+    assert 0 < n < len(ids)
+    # the prefix must cover the system message but none of the user turn
+    assert tok.decode(ids[:n]).endswith("sys prompt\n")
+    # single message: nothing shareable
+    ids1, n1 = encode_chat_split(tok, msgs[-1:])
+    assert n1 == 0 and ids1 == tok.encode_chat(msgs[-1:])
+
+
+def test_probe_decode_and_tick_stats():
+    """probe_decode measures idle-engine step time without corrupting state;
+    tick_stats accumulates the per-tick breakdown after real traffic."""
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(3))
+    eng = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=64,
+        prefix_cache_size=0,
+    ).start()
+    try:
+        step_s = eng.probe_decode(iters=2)
+        assert step_s > 0
+        # the probe must leave the engine fully serviceable
+        r = asyncio.run(
+            eng.generate([{"role": "user", "content": "hi"}], max_tokens=3,
+                         temperature=0.0)
+        )
+        assert len(r.token_ids) == 3
+        stats = eng.tick_stats()
+        assert stats["ticks"] >= 1
+        assert stats["issue_ms"] >= 0 and stats["block_ms"] >= 0
+    finally:
+        eng.stop()
+    # probing with in-flight work must be refused (it would race the loop);
+    # exercised on a stopped engine so the fake tick can't reach the loop
+    eng._inflight.append(object())
+    with pytest.raises(RuntimeError, match="idle"):
+        eng.probe_decode(iters=1)
+
+
+def test_prefix_cache_byte_cap_and_bucket():
+    """Prefix device shape never falls back to max_seq_len (the ~1 GB/entry
+    pinning at 8B geometry), and the byte budget LRU-evicts."""
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(5))
+    eng = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=512,
+        prefill_buckets=(32, 64), chunk_size=64,
+        prefix_cache_size=8, prefix_min_tokens=8,
+    )
+    # bucket: fits a prefill bucket -> that bucket; else multiples of the
+    # largest bucket, capped at the engine's (cfg-clamped) max_seq_len —
+    # never the raw max_seq_len fallback for short prefixes
+    assert eng._prefix_bucket(20) == 32
+    assert eng._prefix_bucket(64) == 64
+    assert eng._prefix_bucket(65) == 128
+    assert eng._prefix_bucket(130) == 192
+    assert eng._prefix_bucket(10_000) == eng.max_seq_len
+
+    eng.start()
+    try:
+        sys_a = "context block alpha " * 4
+        sys_b = "context block beta " * 4
+        for s in (sys_a, sys_b):
+            asyncio.run(eng.generate(
+                [{"role": "system", "content": s}, {"role": "user", "content": "q"}],
+                max_tokens=2, temperature=0.0,
+            ))
+        assert len(eng._prefix_lru) == 2
+        assert eng._prefix_bytes == sum(
+            e.pk.nbytes + e.pv.nbytes for e in eng._prefix_lru.values()
+        )
+        # shrink the budget below one entry: next registration evicts to fit
+        one = next(iter(eng._prefix_lru.values()))
+        eng.prefix_cache_max_bytes = one.pk.nbytes + one.pv.nbytes
+        asyncio.run(eng.generate(
+            [{"role": "system", "content": "context block gamma " * 4},
+             {"role": "user", "content": "q"}],
+            max_tokens=2, temperature=0.0,
+        ))
+        assert len(eng._prefix_lru) == 1
+        assert eng._prefix_bytes <= eng.prefix_cache_max_bytes
+    finally:
+        eng.stop()
