@@ -425,12 +425,18 @@ def bench_rag(gen_engine) -> dict:
 
 
 def _error_tail(stderr: str, max_chars: int = 400) -> str:
-    """The diagnosis-bearing slice of a failed child's stderr: the last
-    exception line (e.g. RESOURCE_EXHAUSTED) plus trailing context."""
+    """The diagnosis-bearing slice of a failed child's stderr.
+
+    Root-cause markers (OOM, XLA runtime faults, timeouts) win over the
+    generic wrapper the failure surfaces as ("generation engine failure" is
+    the engine's _fail_all re-raise, not the diagnosis)."""
     lines = [l for l in (stderr or "").strip().splitlines() if l.strip()]
-    # last line that looks like an exception summary
+    for marker in ("RESOURCE_EXHAUSTED", "XlaRuntimeError", "DEADLINE", "INTERNAL:"):
+        for line in reversed(lines):
+            if marker in line:
+                return line.strip()[:max_chars]
     for line in reversed(lines):
-        if "Error" in line or "Exception" in line or "EXHAUSTED" in line:
+        if "Error" in line or "Exception" in line:
             return line.strip()[:max_chars]
     return " | ".join(lines[-3:])[:max_chars] if lines else "no stderr"
 
@@ -504,7 +510,10 @@ from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
 
 slots = {slots}
 cfg = bench._flagship_8b_cfg(max_seq_len={seq})
-params = llama.init_int8(cfg, jax.random.PRNGKey(0))
+# int8 embed/head too: ~1 GB less HBM — headroom against other tenants'
+# allocations on the shared chip (the r3/r4 OOMs struck MID-DECODE while a
+# 12 GiB probe succeeded minutes earlier)
+params = llama.init_int8(cfg, jax.random.PRNGKey(0), quantize_embed=True)
 pb = sum(l.nbytes for l in jax.tree.leaves(params))
 n_params = sum(l.size for l in jax.tree.leaves(params))
 mesh = get_mesh()
@@ -513,7 +522,7 @@ with mesh:
 eng = GenerationEngine(
     cfg, params, ByteTokenizer(), max_slots=slots, max_seq_len=cfg.max_seq_len,
     prefill_buckets=(bench._decode_bucket(),), chunk_size=bench._decode_bucket(),
-    mesh=mesh, lookahead=1, prefix_cache_size=0,
+    mesh=mesh, lookahead=2, burst=1, prefix_cache_size=0,
 )
 eng.warmup()
 eng.start()
@@ -566,21 +575,128 @@ print(json.dumps({{
 """
 
 
+# The continuous-batching serving math WITHOUT the engine wrapper: one wave of
+# `slots` prompts prefills together, then chained (decode_step + sample)
+# dispatches stream tokens with the dispatch queue as the lookahead pipeline.
+# The engine's fused tick program set has OOM'd on the shared chip at 8B (its
+# program-set load needs more headroom than the chip reliably has — recorded
+# as decode_8b_engine_error); this path is the same per-token math as the
+# engine steady state, one program per stage, and is what the number means.
+_8B_MANUAL_SNIPPET = """
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp
+import bench
+from django_assistant_bot_tpu.models import llama
+from django_assistant_bot_tpu.ops.sampling import sample_logits
+
+slots = {slots}
+cfg = bench._flagship_8b_cfg(max_seq_len={seq})
+params = llama.init_int8(cfg, jax.random.PRNGKey(0), quantize_embed=True)
+jax.block_until_ready(params)
+pb = sum(l.nbytes for l in jax.tree.leaves(params))
+n_params = sum(l.size for l in jax.tree.leaves(params))
+
+B = slots
+prompt_len = bench.DECODE_PROMPT_LEN
+bucket = 128
+rng = np.random.default_rng(5)
+ids = np.zeros((B, bucket), np.int32)
+ids[:, :prompt_len] = rng.integers(1, 255, (B, prompt_len))
+lengths = np.full((B,), prompt_len, np.int32)
+temps = jnp.full((B,), 0.8); tps = jnp.full((B,), 0.95)
+
+pf = jax.jit(lambda p, i, l: llama.prefill(p, cfg, i, l))
+ins = jax.jit(llama.insert_sequences, donate_argnums=(0,))
+samp = jax.jit(lambda l, r: sample_logits(l, r, temperature=temps, top_k=50, top_p=tps))
+step = jax.jit(lambda p, t, c: llama.decode_step(p, cfg, t, c), donate_argnums=(2,))
+
+# build + compile everything once (warmup wave)
+cache = llama.init_cache(cfg, B, cfg.max_seq_len)
+logits, ks, vs = pf(params, jnp.asarray(ids), jnp.asarray(lengths))
+cache = ins(cache, ks, vs, jnp.asarray(lengths), jnp.asarray(np.arange(B, dtype=np.int32)))
+toks = samp(logits, jax.random.key(0))
+lg, cache = step(params, toks, cache)
+jax.block_until_ready(lg)
+
+# measured wave: fresh prefill (TTFT) + n_new chained decode steps
+n_new = bench.DECODE_NEW_TOKENS
+t0 = time.perf_counter()
+logits, ks, vs = pf(params, jnp.asarray(ids), jnp.asarray(lengths))
+cache = ins(cache, ks, vs, jnp.asarray(lengths), jnp.asarray(np.arange(B, dtype=np.int32)))
+toks = samp(logits, jax.random.key(1))
+jax.block_until_ready(toks)
+ttft = time.perf_counter() - t0
+t1 = time.perf_counter()
+for i in range(n_new - 1):
+    lg, cache = step(params, toks, cache)
+    toks = samp(lg, jax.random.key(i + 2))
+jax.block_until_ready(toks)
+decode_wall = time.perf_counter() - t1
+step_s = decode_wall / (n_new - 1)
+tok_s = B * n_new / (ttft + decode_wall)
+print(json.dumps({{
+    "decode_8b_int8_tokens_per_s_per_chip": round(tok_s, 2),
+    "decode_8b_int8_steady_tokens_per_s": round(B / step_s, 2),
+    "decode_8b_int8_p50_ttft_s": round(ttft, 4),
+    "decode_8b_concurrency": B,
+    "decode_8b_new_tokens": n_new,
+    "decode_8b_param_gb": round(pb / 1e9, 2),
+    "decode_8b_hbm_gbps_min": round(pb / step_s / 1e9, 1),
+    "decode_8b_mfu_pct": round((B / step_s) * 2 * n_params / 197e12 * 100, 2),
+    "decode_8b_path": "staged-dispatch (prefill/insert/sample/step as separate programs)",
+}}))
+"""
+
+
+_HBM_PROBE_SNIPPET = """
+import json
+import jax, jax.numpy as jnp
+
+free = 0.0
+for gb in (12, 10, 8, 6, 4, 2):
+    try:
+        a = jnp.ones((int(gb * 2**30) // 2,), jnp.bfloat16)
+        jax.block_until_ready(a)
+        free = float(gb)
+        break
+    except Exception:
+        continue
+print(json.dumps({"hbm_free_probe_gb": free}))
+"""
+
+
 def bench_8b() -> dict:
-    """Config 2 at true flagship geometry: 8B-class decode, int8 weight-only.
+    """Config 2 at true flagship geometry: 8B-class decode, int8 weight-only
+    including embed/head (~8 GB total).
 
     Weights are synthesized directly on device (llama.init_int8) — staging a
-    host-side 8B init through a remote tunnel would take minutes.  Each slot
-    count runs in a fresh subprocess (_subprocess_bench) so an OOM on the
-    shared chip can't poison the next attempt.
+    host-side 8B init through a remote tunnel would take minutes.  Each
+    attempt runs in a fresh subprocess (_subprocess_bench) so an OOM on the
+    shared chip can't poison the next attempt.  The chip is SHARED with other
+    tenants and free HBM fluctuates — a free-HBM probe runs first (recorded as
+    evidence), and the primary config is retried once before walking down:
+    failures are usually contention timing, not our footprint.
     """
     out: dict = {}
-    for slots, seq in ((8, 512), (4, 512), (2, 256)):
+    probe, _ = _subprocess_bench(_HBM_PROBE_SNIPPET, timeout_s=300)
+    if probe:
+        out.update(probe)
+    for slots, seq in ((8, 512), (4, 512)):
         res, err = _subprocess_bench(_8B_SNIPPET.format(slots=slots, seq=seq))
         if res:
             out.update(res)
             return out
-        out["decode_8b_error"] = f"slots={slots} seq={seq}: {err}"
+        # per-attempt keys: a later attempt must not overwrite the first
+        # failure's diagnosis (usually the root-cause OOM line)
+        out[f"decode_8b_engine_error_{slots}x{seq}"] = err
+    # engine program set didn't fit — same serving math as staged dispatches
+    for slots, seq in ((8, 512), (4, 512), (2, 256)):
+        res, err = _subprocess_bench(_8B_MANUAL_SNIPPET.format(slots=slots, seq=seq))
+        if res:
+            out.update(res)
+            return out
+        out[f"decode_8b_error_{slots}x{seq}"] = err
     return out
 
 
@@ -660,10 +776,28 @@ def _knn_scale_body(n_vec: int, dim: int, n_queries: int) -> dict:
     # warmup = the real cost of making the corpus serveable: bf16 host->HBM
     # transfer + normalize + query-bucket compiles, BLOCKED until resident
     # (dispatch is async; round 2 under-reported build and the first live
-    # query silently paid the whole transfer)
+    # query silently paid the whole transfer).  Broken down (VERDICT r3 weak
+    # #8): stage (h2d transfer + on-device normalize) vs kernel compiles, with
+    # a raw device_put of the same bytes as the transfer floor.
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    raw = big[: min(n_vec, 100_000)].astype(np.dtype(_jnp.bfloat16))
+    t0 = time.perf_counter()
+    _jax.block_until_ready(_jax.device_put(raw))
+    put_s = time.perf_counter() - t0
+    out["knn_h2d_gbps"] = round(raw.nbytes / put_s / 1e9, 2)
+    t0 = time.perf_counter()
+    scale_index._ensure_device()
+    # _ensure_device dispatches async; a real fetch is the only barrier
+    _jax.block_until_ready(scale_index._device_index)
+    out["knn_build_stage_s"] = round(time.perf_counter() - t0, 3)
     t0 = time.perf_counter()
     scale_index.warmup(ks=(16,), q_rows=(8, n_queries))
-    out["knn_build_s"] = round(time.perf_counter() - t0, 3)
+    out["knn_build_kernels_s"] = round(time.perf_counter() - t0, 3)
+    out["knn_build_s"] = round(
+        out["knn_build_stage_s"] + out["knn_build_kernels_s"], 3
+    )
     out["knn_vectors"] = n_vec
     # post-warmup first query — the serving-path reality (no compile stall)
     t0 = time.perf_counter()

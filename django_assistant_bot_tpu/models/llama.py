@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import attention, dot_product_attention, gqa_dot_product_attention
 from ..ops.norms import rms_norm
-from ..ops.quant import deq
+from ..ops.quant import QTensor, deq, qeinsum
 from ..ops.rope import apply_rope, rope_frequencies
 from ..parallel.sharding import with_constraint
 from .config import DecoderConfig
@@ -209,8 +209,14 @@ def init(cfg: DecoderConfig, rng: jax.Array) -> Params:
     return params
 
 
-def init_int8(cfg: DecoderConfig, rng: jax.Array) -> Params:
+def init_int8(
+    cfg: DecoderConfig, rng: jax.Array, *, quantize_embed: bool = False
+) -> Params:
     """Synthetic int8-quantized params generated ON DEVICE — no host staging.
+
+    ``quantize_embed`` also makes ``tok_embed``/``lm_head`` int8 (QTensor):
+    at 8B geometry with a 128k vocab that is another ~1 GB of HBM — the
+    difference between fitting and OOM on a chip shared with other tenants.
 
     For serving benches and sharding dryruns at flagship geometry (e.g.
     Llama-3-8B: ~8 GB int8): a host-side init would stage 1-2 bytes/param
@@ -235,8 +241,18 @@ def init_int8(cfg: DecoderConfig, rng: jax.Array) -> Params:
     UNIFORM_STD = 127.0 / (3.0 ** 0.5)
     keys = iter(jax.random.split(rng, 16))
 
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def _gen_q(key, shape):
+        # one fused program per shape: the uint8 draw converts to int8 inside
+        # the jit, so XLA writes int8 directly — run EAGERLY this is two
+        # materialized buffers per leaf, and with async dispatch every leaf's
+        # transient coexists (~2x the whole model: the 8B init that "randomly"
+        # OOM'd a chip with 12 GB free)
+        return jax.random.bits(key, shape, jnp.uint8).astype(jnp.int8)
+
     def qdense(shape, target_std=None):
-        q = jax.random.bits(next(keys), shape, jnp.uint8).astype(jnp.int8)
+        q = _gen_q(next(keys), shape)
+        q.block_until_ready()  # serialize: peak transient = one leaf, not all
         scale_shape = shape[:-2] + (1, shape[-1])
         scale = jnp.full(scale_shape, (target_std or s) / UNIFORM_STD, jnp.float32)
         return QTensor(q=q, scale=scale)
@@ -280,24 +296,54 @@ def init_int8(cfg: DecoderConfig, rng: jax.Array) -> Params:
             }
         )
     params: Params = {
-        "tok_embed": jax.random.normal(next(keys), (cfg.vocab_size, E), cfg.dtype),
+        "tok_embed": (
+            qdense((cfg.vocab_size, E), target_std=1.0)
+            if quantize_embed
+            else jax.random.normal(next(keys), (cfg.vocab_size, E), cfg.dtype)
+        ),
         "final_norm": jnp.ones((E,), cfg.dtype),
         "layers": layers,
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = (
-            jax.random.normal(next(keys), (E, cfg.vocab_size), cfg.dtype)
+            qdense((E, cfg.vocab_size))
+            if quantize_embed
+            else jax.random.normal(next(keys), (E, cfg.vocab_size), cfg.dtype)
             * jnp.asarray(s, cfg.dtype)
         )
     return params
 
 
 def _embed(params: Params, cfg: DecoderConfig, ids: jnp.ndarray) -> jnp.ndarray:
-    """Token embedding lookup; Gemma scales by sqrt(E) (in model dtype, like HF)."""
-    x = params["tok_embed"][ids].astype(cfg.dtype)
+    """Token embedding lookup; Gemma scales by sqrt(E) (in model dtype, like HF).
+
+    int8 tables (QTensor) gather int8 rows and dequantize only the gathered
+    slice — the table itself is never upcast in HBM."""
+    w = params["tok_embed"]
+    if isinstance(w, QTensor):
+        x = w.q[ids].astype(cfg.dtype) * w.scale[0].astype(cfg.dtype)
+    else:
+        x = w[ids].astype(cfg.dtype)
     if cfg.embed_multiplier != 1.0:
         x = x * jnp.asarray(cfg.embed_multiplier, cfg.dtype)
     return x
+
+
+def _head_logits(params: Params, cfg: DecoderConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Logits projection ``[..., E] -> [..., V]`` in model dtype.
+
+    int8 heads stay on the int8 read path — the dot's weight operand is a pure
+    convert (fusable), never a materialized bf16 copy of the largest tensor in
+    the model (~1 GB at 8B/128k vocab).  Untied: scale is per-vocab-column and
+    commutes past the dot (qeinsum).  Tied: the table is [V, E] with per-E
+    scales, so the scale lands on ``x`` instead — x·(q·s)ᵀ == (x·s)·qᵀ."""
+    if cfg.tie_embeddings:
+        w = params["tok_embed"]
+        if isinstance(w, QTensor):
+            xs = x * jnp.squeeze(w.scale, axis=-2).astype(cfg.dtype)
+            return jnp.einsum("...e,ve->...v", xs, w.q.astype(cfg.dtype))
+        return jnp.einsum("...e,ve->...v", x, w.astype(cfg.dtype))
+    return qeinsum("...e,ev->...v", x, params["lm_head"], cfg.dtype)
 
 
 def _mlp(cfg: DecoderConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
@@ -310,20 +356,18 @@ def _mlp(cfg: DecoderConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
         if cfg.hidden_act == "gelu_tanh"
         else jax.nn.silu
     )
-    h = act(jnp.einsum("bse,ef->bsf", x, deq(p["w_gate"], cfg.dtype))) * jnp.einsum(
-        "bse,ef->bsf", x, deq(p["w_up"], cfg.dtype)
-    )
+    h = act(qeinsum("bse,ef->bsf", x, p["w_gate"], cfg.dtype)) * qeinsum("bse,ef->bsf", x, p["w_up"], cfg.dtype)
     h = with_constraint(h, ("batch", "length", "mlp"))
-    return jnp.einsum("bsf,fe->bse", h, deq(p["w_down"], cfg.dtype))
+    return qeinsum("bsf,fe->bse", h, p["w_down"], cfg.dtype)
 
 
 def _attn_proj(cfg: DecoderConfig, p: Params, x: jnp.ndarray, cos, sin):
     """QKV projections + RoPE.  Returns q:[B,H,S,D], k/v:[B,KH,S,D]."""
     B, S, E = x.shape
     H, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    q = jnp.einsum("bse,eo->bso", x, deq(p["wq"], cfg.dtype))
-    k = jnp.einsum("bse,eo->bso", x, deq(p["wk"], cfg.dtype))
-    v = jnp.einsum("bse,eo->bso", x, deq(p["wv"], cfg.dtype))
+    q = qeinsum("bse,eo->bso", x, p["wq"], cfg.dtype)
+    k = qeinsum("bse,eo->bso", x, p["wk"], cfg.dtype)
+    v = qeinsum("bse,eo->bso", x, p["wv"], cfg.dtype)
     if cfg.attn_bias:
         q = q + p["bq"]
         k = k + p["bk"]
@@ -416,7 +460,7 @@ def forward(
             else:
                 o = dot_product_attention(q, k, v, causal=True, mask=mask, window=window)
             o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
-            x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+            x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
             h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
             x = x + _mlp(cfg, p, h)
             return with_constraint(x, ("batch", "length", "embed")), None
@@ -425,8 +469,7 @@ def forward(
 
     x, _ = _scan_window_split(cfg, make_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bse,ev->bsv", x, head.astype(cfg.dtype))
+    logits = _head_logits(params, cfg, x)
     return with_constraint(logits.astype(jnp.float32), ("batch", "length", "vocab_out"))
 
 
@@ -454,7 +497,7 @@ def forward_layers(
         k, v = _repeat_kv(cfg, k), _repeat_kv(cfg, v)
         o = attention(q, k, v, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
-        x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+        x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
         h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, p, h)
         return x, None
@@ -500,15 +543,14 @@ def forward_long(
         k, v = _repeat_kv(cfg, k), _repeat_kv(cfg, v)
         o = ring_attention(q, k, v, mesh, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
-        x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+        x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
         h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, p, h)
         return with_constraint(x, ("batch", "length", "embed")), None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bse,ev->bsv", x, head.astype(cfg.dtype))
+    logits = _head_logits(params, cfg, x)
     return with_constraint(logits.astype(jnp.float32), ("batch", "length", "vocab_out"))
 
 
@@ -548,7 +590,7 @@ def prefill(
             # buckets — windowed too (the kernel skips kv blocks below the band).
             o = attention(q, kr, vr, causal=True, window=window)
             o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
-            x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+            x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
             h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
             x = x + _mlp(cfg, p, h)
             return with_constraint(x, ("batch", "length", "embed")), (k, v)
@@ -560,8 +602,7 @@ def prefill(
     last = jnp.take_along_axis(
         x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
     )[:, 0]  # [B, E]
-    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("be,ev->bv", last, head.astype(cfg.dtype))
+    logits = _head_logits(params, cfg, last)
     return logits.astype(jnp.float32), ks, vs
 
 
@@ -650,7 +691,7 @@ def prefill_chunk(
             # grouped attention reads the cache row once (no q_per_kv repeat)
             o = gqa_dot_product_attention(q, k_row, v_row, mask=attn_mask)  # [1, H, C, D]
             o = o.transpose(0, 2, 1, 3).reshape(B, C, -1)
-            x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+            x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
             h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
             x = x + _mlp(cfg, p, h)
             return x, (k_row, v_row)
@@ -667,8 +708,7 @@ def prefill_chunk(
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     last = jax.lax.dynamic_index_in_dim(x[0], jnp.maximum(valid - 1, 0), 0, keepdims=False)
-    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("e,ev->v", last, head.astype(cfg.dtype))[None]
+    logits = _head_logits(params, cfg, last)[None]
     return logits.astype(jnp.float32), KVCache(k=k, v=v, lengths=lengths)
 
 
@@ -727,7 +767,7 @@ def prefill_suffix(
             v_row = _write_cache(v_row, v, starts)
             o = gqa_dot_product_attention(q, k_row, v_row, mask=attn_mask)
             o = o.transpose(0, 2, 1, 3).reshape(B, C, -1)
-            x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+            x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
             h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
             x = x + _mlp(cfg, p, h)
             return x, (k_row, v_row)
@@ -751,8 +791,7 @@ def prefill_suffix(
     last = jnp.take_along_axis(
         x, jnp.maximum(valids - 1, 0)[:, None, None], axis=1
     )[:, 0]  # [B, E]
-    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("be,ev->bv", last, head.astype(cfg.dtype))
+    logits = _head_logits(params, cfg, last)
     return logits.astype(jnp.float32), KVCache(k=k, v=v, lengths=lengths)
 
 
@@ -828,9 +867,9 @@ def decode_step(
         def body(x, inputs):
             p, k_cache, v_cache = inputs
             h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-            q = jnp.einsum("bse,eo->bso", h, deq(p["wq"], cfg.dtype))
-            k = jnp.einsum("bse,eo->bso", h, deq(p["wk"], cfg.dtype))
-            v = jnp.einsum("bse,eo->bso", h, deq(p["wv"], cfg.dtype))
+            q = qeinsum("bse,eo->bso", h, p["wq"], cfg.dtype)
+            k = qeinsum("bse,eo->bso", h, p["wk"], cfg.dtype)
+            v = qeinsum("bse,eo->bso", h, p["wv"], cfg.dtype)
             if cfg.attn_bias:
                 q = q + p["bq"]
                 k = k + p["bk"]
@@ -848,7 +887,7 @@ def decode_step(
             # the decode path's dominant memory traffic after the weights
             o = gqa_dot_product_attention(q, k_cache, v_cache, mask=attn_mask)  # [B,H,1,D]
             o = o.transpose(0, 2, 1, 3).reshape(B, 1, -1)
-            x = x + jnp.einsum("bso,oe->bse", o, deq(p["wo"], cfg.dtype))
+            x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
             h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
             x = x + _mlp(cfg, p, h)
             return x, (k_cache, v_cache)
@@ -867,6 +906,5 @@ def decode_step(
         lengths=jnp.where(active, cache.lengths + 1, cache.lengths),
     )
     x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps)
-    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("be,ev->bv", x, head.astype(cfg.dtype))
+    logits = _head_logits(params, cfg, x)
     return logits.astype(jnp.float32), new_cache

@@ -57,6 +57,26 @@ def deq(w: Any, dtype) -> jnp.ndarray:
     return w
 
 
+def qeinsum(pattern: str, x: jnp.ndarray, w: Any, dtype) -> jnp.ndarray:
+    """``einsum(pattern, x, w)`` with the dequant moved PAST the dot.
+
+    Per-output-channel scales commute with the contraction:
+    ``x @ (q * scale) == (x @ q) * scale`` exactly (scale is constant along
+    the contracted axis).  The matmul's weight operand is then a PURE int8->
+    dtype convert, which XLA folds into the dot's operand load — whereas the
+    convert-*multiply* producer of :func:`deq` can materialize a full-width
+    dequantized copy and drag the int8 path back to bf16 byte traffic.
+
+    Valid whenever ``w``'s last axis is the einsum output's last axis (true
+    for every dense projection in models/llama.py).  Non-quantized weights
+    pass straight through to a plain einsum.
+    """
+    if not isinstance(w, QTensor):
+        return jnp.einsum(pattern, x, w)
+    y = jnp.einsum(pattern, x, w.q.astype(dtype))
+    return y * jnp.squeeze(w.scale, axis=-2).astype(dtype)
+
+
 def quantize_decoder_params(params: Dict[str, Any]) -> Dict[str, Any]:
     """Quantize every layer projection; norms/biases/embeddings/head stay bf16
     (tiny, and embedding/head quality is disproportionately sensitive)."""

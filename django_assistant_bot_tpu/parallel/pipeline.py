@@ -139,8 +139,8 @@ def _gpipe_schedule(layer_span, rest, ids_mb, cfg, n_stages, n_micro):
 
     def head_logits(x):
         x = llama.rms_norm(x, rest["final_norm"], cfg.rms_norm_eps)
-        head = rest["tok_embed"].T if cfg.tie_embeddings else rest["lm_head"]
-        return jnp.einsum("bse,ev->bsv", x, head.astype(cfg.dtype)).astype(jnp.float32)
+        # shared head projection (handles int8 QTensor tables too)
+        return llama._head_logits(rest, cfg, x).astype(jnp.float32)
 
     def tick(carry, t):
         state = carry  # [b, S, E]: the activation this stage holds

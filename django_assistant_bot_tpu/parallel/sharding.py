@@ -108,7 +108,20 @@ def shard_pytree(
             a is None or isinstance(a, str) for a in x
         ),
     )
-    return jax.device_put(params, shardings)
+
+    def put(arr, sharding):
+        # Skip no-op re-shardings: device_put of an already-correctly-placed
+        # array can still COPY through some backends, and with async dispatch
+        # every leaf copies at once — a transient 2x of the whole model's HBM.
+        # At 8B geometry that transient (not the model) is what OOM'd a chip
+        # with 12 GB free.  Equivalence (not equality) also catches
+        # SingleDeviceSharding vs a 1-device mesh NamedSharding.
+        cur = getattr(arr, "sharding", None)
+        if cur is not None and cur.is_equivalent_to(sharding, getattr(arr, "ndim", 0)):
+            return arr
+        return jax.device_put(arr, sharding)
+
+    return jax.tree.map(put, params, shardings)
 
 
 def with_constraint(

@@ -381,9 +381,20 @@ class GenerationEngine:
                  fsm_s=None, jmask=None, next_tab=None, allowed_tab=None):
             def body(carry, _):
                 tokens, cache, rng, fsm_s = carry
+                # The params are invariant across the burst scan, so XLA's
+                # loop-invariant code motion will HOIST their dequantization
+                # out of the loop — materializing a full bf16 copy of every
+                # int8 weight (2x HBM: an 8B int8 model OOMs a 16 GB chip at
+                # compile, and a 1B model silently reads bf16-sized traffic,
+                # erasing the int8 bandwidth win).  The barrier pins the
+                # weights inside the body: dequant stays per-layer-slice.
+                # At burst=1 there is no loop to hoist out of and the barrier
+                # is pure cost (it can force program-local weight copies) —
+                # skip it.
+                p = jax.lax.optimization_barrier(params) if burst_c > 1 else params
                 rng, sub = jax.random.split(rng)
                 logits, cache = llama.decode_step(
-                    params, cfg_c, tokens, cache, active=active
+                    p, cfg_c, tokens, cache, active=active
                 )
                 if json_mode:
                     ok = allowed_tab[fsm_s]  # [B, V]
@@ -397,9 +408,18 @@ class GenerationEngine:
                 return (nxt, cache, rng, fsm_s), nxt
 
             carry = (tokens, cache, rng, fsm_s if json_mode else jnp.zeros_like(tokens))
-            (tokens, cache, rng, fsm_s), toks = jax.lax.scan(
-                body, carry, None, length=burst_c
-            )
+            if burst_c == 1:
+                # No scan wrapper: at flagship (8B) geometry the scanned tick's
+                # compiled scratch is what tips a shared chip into OOM — the
+                # unrolled single step compiles with the same footprint as the
+                # plain decode_step program.
+                carry, tok = body(carry, None)
+                tokens, cache, rng, fsm_s = carry
+                toks = tok[None]
+            else:
+                (tokens, cache, rng, fsm_s), toks = jax.lax.scan(
+                    body, carry, None, length=burst_c
+                )
             # the advanced rng is an output: the host threads it call-to-call as
             # opaque device state — an eager jax.random.split per burst would be
             # one more dispatch round trip on the critical host path

@@ -115,3 +115,36 @@ def test_unknown_quantize_rejected(mesh8):
         registry.load(
             ModelSpec(name="bad", kind="decoder", tiny=True, quantize="int4")
         )
+
+
+def test_init_int8_quantize_embed_serves():
+    """int8 embedding/head tables (the 8B HBM-fit path): forward, prefill and
+    decode all run, logits finite, and param bytes shrink accordingly."""
+    import jax
+    import numpy as np
+
+    from django_assistant_bot_tpu.models import DecoderConfig, llama
+
+    cfg = DecoderConfig.tiny()
+    p_bf16 = llama.init_int8(cfg, jax.random.PRNGKey(0))
+    p_q = llama.init_int8(cfg, jax.random.PRNGKey(0), quantize_embed=True)
+    from django_assistant_bot_tpu.ops.quant import QTensor
+
+    assert isinstance(p_q["tok_embed"], QTensor)
+    assert sum(l.nbytes for l in jax.tree.leaves(p_q)) < sum(
+        l.nbytes for l in jax.tree.leaves(p_bf16)
+    )
+    ids = np.arange(1, 9, dtype=np.int32)[None]
+    logits = llama.forward(p_q, cfg, ids)
+    assert np.isfinite(np.asarray(logits)).all()
+    lg, ks, vs = llama.prefill(
+        p_q, cfg, ids, np.asarray([ids.shape[1]], np.int32)
+    )
+    cache = llama.init_cache(cfg, 1, 32)
+    cache = llama.insert_sequences(
+        cache, ks, vs, np.asarray([8], np.int32), np.asarray([0], np.int32)
+    )
+    step_logits, cache = llama.decode_step(
+        p_q, cfg, np.asarray([3], np.int32), cache
+    )
+    assert np.isfinite(np.asarray(step_logits)).all()
