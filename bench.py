@@ -237,12 +237,13 @@ def bench_decode(eng) -> dict:
     step_s = eng.probe_decode(iters=12)
     steady_tok_s = eng.max_slots / step_s
     stats = eng.tick_stats()
-    # Measured read-bandwidth ceiling over the SAME weight set (chained
-    # convert+reduce stream, serialized through the scalar carry — unchained
-    # dispatches overlap server-side under the tunnel and report fiction).
-    # The denominator for "how close to THIS chip's practical wall are we":
-    # nominal v5e HBM is 819 GB/s, but the shared tunnel chip delivers far
-    # less; achieved/ceiling is the honest utilization number.
+    # Reference point: a chained convert+reduce stream over the SAME weight
+    # set (serialized through the scalar carry — unchained dispatches overlap
+    # server-side under the tunnel and report fiction).  NOT a ceiling: a
+    # reduction is itself less bandwidth-efficient than the matmul pipeline
+    # (measured runs have the decode step outrunning this probe), and the
+    # shared chip's effective rate moves run to run — so it is recorded as a
+    # probe alongside the achieved number, with no utilization% derived.
     import jax.numpy as jnp
 
     big = [l for l in leaves if l.nbytes >= (1 << 20)]
@@ -269,13 +270,7 @@ def bench_decode(eng) -> dict:
         "decode_pure_step_ms": round(step_s * 1e3, 3),
         "decode_steady_tokens_per_s": round(steady_tok_s, 2),
         "decode_steady_hbm_gbps": round(param_bytes / step_s / 1e9, 1),
-        "decode_hbm_ceiling_gbps": round(ceiling_gbps, 1),
-        # meaningless on tiny models whose weights fit in cache (ceiling ~0)
-        "decode_hbm_utilization_pct": round(
-            param_bytes / step_s / 1e9 / ceiling_gbps * 100, 1
-        )
-        if ceiling_gbps > 1.0
-        else None,
+        "decode_hbm_stream_probe_gbps": round(ceiling_gbps, 1),
         "decode_tick_issue_ms": stats["issue_ms"],
         "decode_tick_block_ms": stats["block_ms"],
     }
@@ -332,9 +327,12 @@ def bench_rag(gen_engine) -> dict:
         return f"Document {i}: " + " ".join(f"fact{i}-{j}" for j in range(30))
 
     # pay the host->HBM corpus transfer + kernel compiles BEFORE timing starts
-    # (blocks until resident — the serving-path warmup discipline, knn.py)
+    # (blocks until resident — the serving-path warmup discipline, knn.py).
+    # Only the shapes this bench's searches hit: k=3 and the coalesced query
+    # batch sizes — every extra (q, k) bucket is another ~1-2 min kernel
+    # compile at 1M x 768 through the remote compile service.
     t0 = time.perf_counter()
-    index.warmup(ks=(3, 16), q_rows=(1, RAG_CONCURRENCY))
+    index.warmup(ks=(3,), q_rows=(1, RAG_CONCURRENCY))
     rag_index_warmup_s = time.perf_counter() - t0
 
     searcher = AsyncSearcher(index)

@@ -1,0 +1,50 @@
+"""Host-side bench helpers (no device): failure diagnosis + record hygiene.
+
+The bench record is the round's canonical evidence (BENCH_r*.json) — these
+lock the helpers that keep failures diagnosable (VERDICT r3 weak #1: failures
+were recorded blind) and the headline well-formed.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def test_error_tail_prefers_root_cause_over_wrapper():
+    stderr = "\n".join(
+        [
+            "Traceback (most recent call last):",
+            '  File "x.py", line 1, in <module>',
+            "jax.errors.JaxRuntimeError: RESOURCE_EXHAUSTED: TPU backend error.",
+            "During handling of the above exception, another exception occurred:",
+            "RuntimeError: generation engine failure",
+        ]
+    )
+    tail = bench._error_tail(stderr)
+    assert "RESOURCE_EXHAUSTED" in tail
+    assert "generation engine failure" not in tail
+
+
+def test_error_tail_falls_back_to_last_exception_line():
+    assert "ValueError: boom" in bench._error_tail("ValueError: boom")
+    assert bench._error_tail("") == "no stderr"
+    out = bench._error_tail("line1\nline2\nline3\nline4")
+    assert "line4" in out
+
+
+def test_subprocess_bench_returns_error_tail():
+    res, err = bench._subprocess_bench(
+        "raise RuntimeError('intentional-test-failure')", timeout_s=120
+    )
+    assert res is None
+    assert "intentional-test-failure" in err
+
+
+def test_subprocess_bench_parses_final_json_line():
+    res, err = bench._subprocess_bench(
+        "import json\nprint('noise'); print(json.dumps({'ok': 1}))", timeout_s=120
+    )
+    assert res == {"ok": 1} and err == ""

@@ -97,3 +97,48 @@ def test_queue_cli_list_clear_remove(tmp_db, capsys):
     assert TaskRecord.objects.count() == 0
     # remove without --id is a usage error
     assert queue_cmd.run(argparse.Namespace(action="remove", queue=None, id=None, status=None)) == 1
+
+
+def test_fetch_models_skips_complete_and_reports_missing(tmp_path, capsys, monkeypatch):
+    """fetch: an already-complete checkpoint dir is skipped (the reference's
+    local_files_only probe, gpu_service/bin/fetch_models.py:10-30); an
+    incomplete one without the hub client exits with guidance."""
+    from django_assistant_bot_tpu.cli import fetch_models as fm
+
+    models_dir = tmp_path / "models"
+    done = models_dir / "org__done"
+    done.mkdir(parents=True)
+    (done / "config.json").write_text("{}")
+    (done / "model.safetensors").write_text("x")
+    assert fm.fetch_one("org/done", str(models_dir)) == str(done)
+    assert "already fetched" in capsys.readouterr().out
+
+    # force the no-hub-client path deterministically
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_hub(name, *a, **k):
+        if name == "huggingface_hub":
+            raise ImportError("no hub in test")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_hub)
+    with pytest.raises(SystemExit, match="manually"):
+        fm.fetch_one("org/missing", str(models_dir))
+
+
+def test_fetch_models_config_repo_ids(tmp_path):
+    import json
+
+    from django_assistant_bot_tpu.cli import fetch_models as fm
+
+    cfg = tmp_path / "serving.json"
+    local_dir = tmp_path / "local_ckpt"
+    local_dir.mkdir()
+    cfg.write_text(json.dumps({
+        "chat": {"kind": "decoder", "path": "meta-llama/Llama-3.2-1B"},
+        "tiny": {"kind": "decoder", "tiny": True},
+        "local": {"kind": "decoder", "path": str(local_dir)},
+    }))
+    assert fm._config_repo_ids(str(cfg)) == ["meta-llama/Llama-3.2-1B"]

@@ -816,3 +816,31 @@ def test_prefix_cache_byte_cap_and_bucket():
         assert eng._prefix_bytes <= eng.prefix_cache_max_bytes
     finally:
         eng.stop()
+
+
+def test_encode_chat_split_memoizes_head_encoding():
+    """The shared head's encode is cached on the tokenizer (the prefix-KV
+    workload re-sends a near-identical multi-KB head every turn)."""
+    from django_assistant_bot_tpu.serving.tokenizer import encode_chat_split
+
+    class CountingTok(ByteTokenizer):
+        def __init__(self):
+            super().__init__()
+            self.encodes = 0
+
+        def encode(self, text):
+            self.encodes += 1
+            return super().encode(text)
+
+    tok = CountingTok()
+    msgs = [
+        {"role": "system", "content": "ctx " * 50},
+        {"role": "user", "content": "q1"},
+    ]
+    ids1, n1 = encode_chat_split(tok, msgs)
+    first = tok.encodes
+    msgs2 = [msgs[0], {"role": "user", "content": "q2"}]
+    ids2, n2 = encode_chat_split(tok, msgs2)
+    assert n1 == n2 > 0
+    # second call re-encoded the full prompt but served the head from cache
+    assert tok.encodes == first + 1
