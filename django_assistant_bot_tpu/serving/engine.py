@@ -673,6 +673,9 @@ class GenerationEngine:
         """End-of-loop drain, run BY the engine thread: fail live slots and
         everything queued.  Keeping this on the engine thread means stop() can
         deadline its join without racing engine-private state."""
+        # however the loop exited (stop(), loop crash, failed recovery), the
+        # flag must drop so submit()'s post-put re-check fails new work fast
+        self._running = False
         err = RuntimeError("generation engine stopped")
         self._inflight.clear()
         for i, s in enumerate(self._slots):
@@ -1343,15 +1346,27 @@ class GenerationEngine:
         # lineage — drop them with the rest of the device state
         self._prefix_lru.clear()
         self._prefix_bytes = 0
-        # the cache may have been donated into a failed call — rebuild it
-        self._cache = self._fresh_cache()
-        self._tokens_dev = self._fresh_tokens()
-        self._fsm_states_dev = self._fresh_tokens()
-        # the rng threads through jit outputs, so a failed device call may have
-        # poisoned it — rebuild it like the rest of the device state, with a
-        # reseed counter so even back-to-back failures get distinct streams
-        self._reseeds += 1
-        self._rng = self._fresh_rng(self.steps + self._reseeds)
+        try:
+            # the cache may have been donated into a failed call — rebuild it
+            self._cache = self._fresh_cache()
+            self._tokens_dev = self._fresh_tokens()
+            self._fsm_states_dev = self._fresh_tokens()
+            # the rng threads through jit outputs, so a failed device call may
+            # have poisoned it — rebuild it like the rest of the device state,
+            # with a reseed counter so back-to-back failures get distinct streams
+            self._reseeds += 1
+            self._rng = self._fresh_rng(self.steps + self._reseeds)
+        except Exception:
+            # Recovery itself failed (seen in practice: the original fault was
+            # an OOM and the fresh cache can't allocate either).  Declare the
+            # engine dead with an explicit diagnosis instead of letting the
+            # raise escape as an anonymous loop crash — either way the loop
+            # exits and _shutdown (which drops _running) fails everything
+            # queued, so later submits fail fast rather than enqueue forever.
+            logger.exception(
+                "engine recovery failed; declaring the engine dead"
+            )
+            self._running = False
 
 
 class EmbeddingEngine:

@@ -844,3 +844,38 @@ def test_encode_chat_split_memoizes_head_encoding():
     assert n1 == n2 > 0
     # second call re-encoded the full prompt but served the head from cache
     assert tok.encodes == first + 1
+
+
+def test_engine_declares_dead_when_recovery_fails():
+    """If the post-failure cache rebuild ALSO fails (e.g. the original fault
+    was an OOM), the engine must die cleanly: queued futures fail, the loop
+    exits, and later submits fail fast instead of enqueueing forever."""
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(1))
+    eng = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=64
+    ).start()
+    try:
+        def tick_boom(*a, **k):
+            raise RuntimeError("injected device failure")
+
+        def rebuild_boom(*a, **k):
+            raise RuntimeError("injected rebuild failure")
+
+        eng._decode_tick = tick_boom
+        eng._fresh_cache = rebuild_boom
+        fut = eng.submit([1, 2, 3], max_tokens=5, temperature=0.0)
+        with pytest.raises(RuntimeError):
+            fut.result(timeout=120)
+        # loop exited via the dead-engine path; the thread drains and stops
+        for _ in range(500):
+            if not eng._running and not (eng._thread and eng._thread.is_alive()):
+                break
+            time.sleep(0.01)
+        assert not eng._running
+        # post-death submits fail fast (no eternal enqueue)
+        fut2 = eng.submit([1, 2, 3], max_tokens=5, temperature=0.0)
+        with pytest.raises(RuntimeError, match="stopped"):
+            fut2.result(timeout=10)
+    finally:
+        eng.stop()
