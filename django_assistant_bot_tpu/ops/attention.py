@@ -76,6 +76,11 @@ def gqa_dot_product_attention(
     KH = k.shape[1]
     G = H // KH
     scale = D ** -0.5
+    if k.dtype != q.dtype:
+        # reduced-precision KV cache (e.g. fp8): a pure convert on the matmul
+        # operand — fused into the dot, so the cache is READ at its own width
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
     qg = q.reshape(B, KH, G, Sq, D)
     scores = jnp.einsum(
         "bkgqd,bksd->bkgqs", qg, k, preferred_element_type=jnp.float32

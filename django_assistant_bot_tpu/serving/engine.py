@@ -98,6 +98,16 @@ class _Request:
     first_token_at: Optional[float] = None
 
 
+# slot-cache precision knob -> concrete dtype (None = the model's cfg.dtype);
+# "bf16" is explicit bfloat16 even on f32 dev models, fp8 halves KV bytes
+KV_CACHE_DTYPES = {
+    None: None,
+    "bf16": jnp.bfloat16,
+    "fp8": jnp.float8_e4m3fn,
+    "fp8_e5m2": jnp.float8_e5m2,
+}
+
+
 @dataclasses.dataclass
 class _Prefix:
     """One cached prompt prefix: post-RoPE K/V at absolute positions [0, pb).
@@ -173,6 +183,7 @@ class GenerationEngine:
         prefix_cache_size: int = 8,
         prefix_min_tokens: int = 32,
         prefix_cache_max_bytes: int = 1 << 30,
+        kv_cache_dtype: Optional[str] = None,
         mesh=None,
     ):
         self.cfg = cfg
@@ -230,6 +241,16 @@ class GenerationEngine:
         # `model`, slots → `data` — llama.CACHE_AXES) and every device step is jit'd
         # with explicit cache out_shardings so donation updates shards in place.
         # Without it a v5e-8 would hold 8 *replicas* of a multi-GB cache.
+        # Reduced-precision slot cache: "fp8" halves KV bytes (the dominant
+        # HBM consumer after the weights at long context) — K/V convert to
+        # fp8 at cache-write and upcast inside the attention dot at read.
+        # Lossy (~2 significand bits): opt-in per model.
+        if kv_cache_dtype not in KV_CACHE_DTYPES:
+            raise ValueError(
+                f"unknown kv_cache_dtype {kv_cache_dtype!r}; "
+                f"expected one of {sorted(k for k in KV_CACHE_DTYPES if k)}"
+            )
+        self.kv_cache_dtype = KV_CACHE_DTYPES[kv_cache_dtype]
         self.mesh = mesh
         self._cache_shardings = (
             llama.cache_shardings(cfg, mesh, max_slots) if mesh is not None else None
@@ -476,16 +497,19 @@ class GenerationEngine:
         return jax.device_put(z)
 
     def _fresh_cache(self):
+        dt = self.kv_cache_dtype
         if self._cache_shardings is not None:
             # Allocate *sharded*: an eager init_cache would materialise the whole
             # cache on device 0 first — at slice-sized caches that alone overflows
             # one chip's HBM.
             with self.mesh:
                 return jax.jit(
-                    lambda: llama.init_cache(self.cfg, self.max_slots, self.max_seq_len),
+                    lambda: llama.init_cache(
+                        self.cfg, self.max_slots, self.max_seq_len, dtype=dt
+                    ),
                     out_shardings=self._cache_shardings,
                 )()
-        return llama.init_cache(self.cfg, self.max_slots, self.max_seq_len)
+        return llama.init_cache(self.cfg, self.max_slots, self.max_seq_len, dtype=dt)
 
     def _mesh_scope(self):
         """Trace/run device steps inside the mesh so sharding constraints bind."""

@@ -42,6 +42,9 @@ class ModelSpec:
     prefix_min_tokens: int = 32
     # HBM budget for pinned prefix K/V (entries LRU-evict past it)
     prefix_cache_max_bytes: int = 1 << 30
+    # slot-cache precision: None/"bf16" | "fp8" (e4m3) | "fp8_e5m2" — fp8
+    # halves KV bytes (lossy; opt-in per model)
+    kv_cache_dtype: Optional[str] = None
     # compile every (batch, seq) prefill/activation shape + decode ticks at
     # load time instead of on first traffic (GenerationEngine.warmup) — slower
     # boot, no multi-second serve-time compile stalls.  warmup_json also
@@ -101,6 +104,18 @@ class ModelRegistry:
             raise ValueError(f"model {name}: unknown quantize={spec.quantize!r}")
         if spec.warmup_json and spec.kind == "encoder":
             raise ValueError(f"model {name}: warmup_json is decoder-only")
+        from .engine import KV_CACHE_DTYPES
+
+        if spec.kv_cache_dtype is not None and spec.kind == "encoder":
+            raise ValueError(
+                f"model {name}: kv_cache_dtype is decoder-only (encoders have "
+                "no KV cache)"
+            )
+        if spec.kv_cache_dtype not in KV_CACHE_DTYPES:
+            raise ValueError(
+                f"model {name}: unknown kv_cache_dtype={spec.kv_cache_dtype!r}; "
+                f"expected one of {sorted(k for k in KV_CACHE_DTYPES if k)}"
+            )
         tokenizer_path = spec.path
         logger.info("loading model %r (%s, tiny=%s)", name, spec.kind, spec.tiny)
 
@@ -169,6 +184,7 @@ class ModelRegistry:
                 prefix_cache_size=spec.prefix_cache,
                 prefix_min_tokens=spec.prefix_min_tokens,
                 prefix_cache_max_bytes=spec.prefix_cache_max_bytes,
+                kv_cache_dtype=spec.kv_cache_dtype,
                 mesh=self.mesh,
             )
             if spec.warmup or spec.warmup_json:

@@ -879,3 +879,84 @@ def test_engine_declares_dead_when_recovery_fails():
             fut2.result(timeout=10)
     finally:
         eng.stop()
+
+
+def test_engine_fp8_kv_cache_serves():
+    """fp8 slot cache: halves KV bytes, serves correctly (lossy but close —
+    decode_step logits track the bf16-cache engine's), prefix cache included."""
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(13))
+    tok = ByteTokenizer()
+    msgs = [
+        {"role": "system", "content": "shared system preamble for the cache"},
+        {"role": "user", "content": "tell me about tpus"},
+    ]
+
+    def run(kv_dtype):
+        eng = GenerationEngine(
+            cfg, params, tok, max_slots=2, max_seq_len=128,
+            prefix_cache_size=4, prefix_min_tokens=8, kv_cache_dtype=kv_dtype,
+        ).start()
+        try:
+            outs = []
+            for _ in range(2):  # second request exercises the fp8 prefix cache
+                r = asyncio.run(eng.generate(msgs, max_tokens=6, temperature=0.0))
+                outs.append(r.token_ids)
+            return outs, eng._cache.k.dtype, eng.prefix_hits
+        finally:
+            eng.stop()
+
+    base, dt_b, _ = run(None)
+    got, dt_q, hits = run("fp8")
+    assert dt_b == cfg.dtype and dt_q == jnp.float8_e4m3fn
+    assert hits >= 1
+    assert all(len(o) == 6 for o in got)
+    # fp8 rounding may flip late greedy tokens; the first must survive
+    assert [o[0] for o in got] == [b[0] for b in base]
+
+    # logit-level closeness: one decode step from identical prefills
+    ids = np.asarray([tok.encode("check fp8 kv cache closeness")], np.int32)
+    lengths = np.asarray([ids.shape[1]], np.int32)
+    lg, ks, vs = llama.prefill(params, cfg, jnp.asarray(ids), jnp.asarray(lengths))
+    outs = {}
+    for dt in (None, jnp.float8_e4m3fn):
+        cache = llama.init_cache(cfg, 1, 64, dtype=dt)
+        cache = llama.insert_sequences(
+            cache, ks, vs, jnp.asarray(lengths), jnp.asarray([0], np.int32)
+        )
+        step_lg, _ = llama.decode_step(
+            params, cfg, jnp.asarray([5], np.int32), cache
+        )
+        outs[dt] = np.asarray(step_lg[0])
+    a, b = outs[None], outs[jnp.float8_e4m3fn]
+    cos = float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos > 0.98, cos
+
+
+def test_kv_cache_dtype_validation():
+    """Bad kv_cache_dtype fails BEFORE any weight load; \"bf16\" is explicit
+    bfloat16 even on f32 dev models (not an alias for the model dtype)."""
+    from django_assistant_bot_tpu.serving.registry import ModelSpec
+
+    reg = ModelRegistry()
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        reg.load(
+            ModelSpec(name="bad", kind="decoder", tiny=True, kv_cache_dtype="fp16")
+        )
+    with pytest.raises(ValueError, match="decoder-only"):
+        reg.load(
+            ModelSpec(name="enc", kind="encoder", tiny=True, kv_cache_dtype="fp8")
+        )
+
+    cfg = DecoderConfig.tiny()  # tiny() is float32
+    params = llama.init(cfg, jax.random.key(0))
+    eng = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=64,
+        kv_cache_dtype="bf16",
+    )
+    assert eng._cache.k.dtype == jnp.bfloat16
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        GenerationEngine(
+            cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=64,
+            kv_cache_dtype="fp16",
+        )
