@@ -509,6 +509,7 @@ from django_assistant_bot_tpu.parallel import get_mesh, shard_pytree
 from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
 
 slots = {slots}
+tag = {tag!r}
 cfg = bench._flagship_8b_cfg(max_seq_len={seq})
 # int8 embed/head too: ~1 GB less HBM — headroom against other tenants'
 # allocations on the shared chip (the r3/r4 OOMs struck MID-DECODE while a
@@ -523,6 +524,7 @@ eng = GenerationEngine(
     cfg, params, ByteTokenizer(), max_slots=slots, max_seq_len=cfg.max_seq_len,
     prefill_buckets=(bench._decode_bucket(),), chunk_size=bench._decode_bucket(),
     mesh=mesh, lookahead=2, burst=1, prefix_cache_size=0,
+    kv_cache_dtype={kv!r},
 )
 eng.warmup()
 eng.start()
@@ -544,12 +546,12 @@ total_new = sum(r.completion_tokens for r in results)
 ttfts = sorted(r.ttft_s for r in results)
 tok_s = total_new / wall
 print(json.dumps({{
-    "decode_8b_int8_tokens_per_s_per_chip": round(tok_s, 2),
-    "decode_8b_int8_p50_ttft_s": round(ttfts[len(ttfts) // 2], 4),
-    "decode_8b_concurrency": slots,
+    "decode_8b%s_tokens_per_s_per_chip" % tag: round(tok_s, 2),
+    "decode_8b%s_p50_ttft_s" % tag: round(ttfts[len(ttfts) // 2], 4),
+    "decode_8b%s_concurrency" % tag: slots,
     "decode_8b_param_gb": round(pb / 1e9, 2),
-    "decode_8b_hbm_gbps_min": round(tok_s / slots * pb / 1e9, 1),
-    "decode_8b_mfu_pct": round(tok_s * 2 * n_params / 197e12 * 100, 2),
+    "decode_8b%s_hbm_gbps_min" % tag: round(tok_s / slots * pb / 1e9, 1),
+    "decode_8b%s_mfu_pct" % tag: round(tok_s * 2 * n_params / 197e12 * 100, 2),
 }}))
 """
 
@@ -682,21 +684,43 @@ def bench_8b() -> dict:
     probe, _ = _subprocess_bench(_HBM_PROBE_SNIPPET, timeout_s=300)
     if probe:
         out.update(probe)
-    for slots, seq in ((8, 512), (4, 512)):
-        res, err = _subprocess_bench(_8B_SNIPPET.format(slots=slots, seq=seq))
+    engine_fit = False
+    for slots, seq, kv, tag in (
+        (8, 512, None, "_int8"),
+        (4, 512, None, "_int8"),
+    ):
+        res, err = _subprocess_bench(
+            _8B_SNIPPET.format(slots=slots, seq=seq, kv=kv, tag=tag)
+        )
         if res:
             out.update(res)
-            return out
+            engine_fit = True
+            break
         # per-attempt keys: a later attempt must not overwrite the first
         # failure's diagnosis (usually the root-cause OOM line)
         out[f"decode_8b_engine_error_{slots}x{seq}"] = err
-    # engine program set didn't fit — same serving math as staged dispatches
-    for slots, seq in ((8, 512), (4, 512), (2, 256)):
-        res, err = _subprocess_bench(_8B_MANUAL_SNIPPET.format(slots=slots, seq=seq))
+    else:
+        # engine program set didn't fit — same serving math, staged dispatches
+        for slots, seq in ((8, 512), (4, 512), (2, 256)):
+            res, err = _subprocess_bench(
+                _8B_MANUAL_SNIPPET.format(slots=slots, seq=seq)
+            )
+            if res:
+                out.update(res)
+                break
+            out[f"decode_8b_error_{slots}x{seq}"] = err
+    # fp8 KV variant: half-width cache doubles the slot count that fits —
+    # measured 197 -> 446 tok/s going (slots=8, bf16 KV) -> (16, fp8).  Only
+    # when the engine path fit at all: if the smaller bf16 configs just
+    # OOM'd, this equal-footprint attempt would burn its timeout for nothing.
+    if engine_fit:
+        res, err = _subprocess_bench(
+            _8B_SNIPPET.format(slots=16, seq=512, kv="fp8", tag="_int8_fp8kv")
+        )
         if res:
             out.update(res)
-            return out
-        out[f"decode_8b_error_{slots}x{seq}"] = err
+        else:
+            out["decode_8b_fp8kv_error"] = err
     return out
 
 
