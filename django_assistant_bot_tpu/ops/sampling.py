@@ -51,11 +51,16 @@ def top_k_hierarchical(x: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray
     return vals, idx.astype(jnp.int32)
 
 
-def _top_k(x: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+def top_k_auto(x: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Size-dispatching exact top-k: hierarchical past _HIER_TOPK_MIN_VOCAB
+    (where the flat sort's cost dominates), plain lax.top_k below it."""
     if x.shape[-1] >= _HIER_TOPK_MIN_VOCAB:
         return top_k_hierarchical(x, k)
     vals, idx = jax.lax.top_k(x, k)
     return vals, idx.astype(jnp.int32)
+
+
+_top_k = top_k_auto  # internal alias used by sample_logits
 
 
 def sample_logits(

@@ -405,3 +405,31 @@ def test_get_returns_error_on_missing(tmp_db):
     with pytest.raises(DoesNotExist):
         models.Bot.objects.get(codename="nope")
     assert models.Bot.objects.get_or_none(codename="nope") is None
+
+
+def test_knn_search_exact_at_hierarchical_topk_scale():
+    """Corpora past the hierarchical-top-k threshold (16384 rows) still return
+    exact top-k (the KNN kernel switches to the two-stage top-k there — the
+    flat sort over 1M scores dominated the batched query latency)."""
+    from django_assistant_bot_tpu.ops.sampling import _HIER_TOPK_MIN_VOCAB
+    from django_assistant_bot_tpu.storage.knn import VectorIndex
+
+    n, dim = _HIER_TOPK_MIN_VOCAB + 1000, 32
+    rng = np.random.default_rng(7)
+    vecs = rng.normal(size=(n, dim)).astype(np.float32)
+    index = VectorIndex(dim)
+    index.add(range(n), vecs)
+    q = rng.normal(size=(dim,)).astype(np.float32)
+
+    got = index.search(q, k=10)
+    # numpy reference: bf16-rounded rows (the device path normalizes in bf16)
+    import jax.numpy as jnp
+
+    rows = np.asarray(vecs, dtype=jnp.bfloat16).astype(np.float32)
+    rows /= np.maximum(np.linalg.norm(rows, axis=1, keepdims=True), 1e-12)
+    # the device path rounds the normalized rows back to bf16 — mirror it
+    rows = rows.astype(jnp.bfloat16).astype(np.float32)
+    qn = q / max(np.linalg.norm(q), 1e-12)
+    scores = rows @ np.asarray(qn, dtype=jnp.bfloat16).astype(np.float32)
+    want = np.argsort(-scores)[:10]
+    assert [i for i, _ in got] == want.tolist()
