@@ -170,8 +170,13 @@ def test_forward_long_matches_forward(mesh8):
 
 
 # ---------------------------------------------------------- pipeline parallelism
-def test_pipeline_forward_matches_dense():
-    """GPipe schedule over a pipe>=2 mesh == monolithic forward (same params)."""
+@pytest.mark.parametrize("n_micro", [2, 4])
+def test_pipeline_forward_matches_dense(n_micro):
+    """GPipe schedule over a pipe>=2 mesh == monolithic forward (same params).
+
+    n_micro=2 is the M == stages case; n_micro=4 > stages exercises the
+    steady state where both stages work on different microbatches between
+    inject and collect."""
     from django_assistant_bot_tpu.parallel import best_mesh_shape, make_mesh
     from django_assistant_bot_tpu.parallel.pipeline import (
         pipeline_forward,
@@ -180,10 +185,10 @@ def test_pipeline_forward_matches_dense():
     from django_assistant_bot_tpu.models import llama
     from jax.sharding import NamedSharding
 
-    cfg = DecoderConfig.tiny()  # 4 layers -> 2 per stage
+    cfg = DecoderConfig.tiny()  # 2 layers -> 1 per stage
     params = llama.init(cfg, jax.random.PRNGKey(21))
     ids = jnp.asarray(
-        np.random.default_rng(22).integers(1, cfg.vocab_size, (8, 32)), jnp.int32
+        np.random.default_rng(22).integers(1, cfg.vocab_size, (16, 32)), jnp.int32
     )
     ref = np.asarray(llama.forward(params, cfg, ids))
 
@@ -195,7 +200,7 @@ def test_pipeline_forward_matches_dense():
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
         )
         out = jax.jit(
-            lambda p, i: pipeline_forward(p, cfg, i, mesh, n_micro=2)
+            lambda p, i: pipeline_forward(p, cfg, i, mesh, n_micro=n_micro)
         )(sharded, ids)
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=2e-3)
 
