@@ -153,6 +153,12 @@ async def auth_middleware(request: web.Request, handler):
     media_base = settings.MEDIA_URL if not settings.MEDIA_URL.startswith("http") else None
     if media_base:
         media_base = "/" + media_base.strip("/") + "/"
+    if media_base and request.path.startswith(media_base):
+        # the static handler serves dotfiles; nothing hidden under MEDIA_ROOT
+        # is ever meant to be public (defense in depth — secrets live OUTSIDE
+        # the root, but a stray .file must not leak through the auth exemption)
+        if any(seg.startswith(".") for seg in request.path.split("/")):
+            return web.json_response({"detail": "Not Found"}, status=404)
     exempt = (
         request.path.startswith("/telegram/")
         or request.path == "/healthz"

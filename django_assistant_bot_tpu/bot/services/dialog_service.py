@@ -71,8 +71,51 @@ def get_last_message(dialog: Dialog) -> Optional[Message]:
     return Message.objects.filter(dialog=dialog).order_by("-timestamp", "-id").first()
 
 
+def _media_secret(media_root: str) -> bytes:
+    """Per-install random secret mixed into media filenames.
+
+    A plain content hash is unguessable only if the content is: an attacker
+    holding a candidate photo (a known screenshot, a forwarded image) could
+    derive its URL and confirm it was uploaded.  Keying the hash on a secret
+    created once per install closes that while staying deterministic —
+    unlike a uuid4 per save, a webhook redelivery still rewrites the SAME
+    path instead of orphaning a copy.
+
+    The secret lives as a SIBLING of the SERVED media root
+    (``<root>.secret``), never inside it: everything under MEDIA_ROOT is
+    statically served auth-exempt (api/app.py), so a secret stored within
+    would itself be downloadable.  Creation is write-tmp + atomic replace —
+    a crashed or racing creator can never leave a partial/empty file that
+    wedges every later save."""
+    path = os.path.normpath(media_root) + ".secret"
+    try:
+        with open(path, "rb") as f:
+            secret = f.read()
+        if secret:
+            return secret
+    except OSError:
+        pass
+    fresh = os.urandom(32)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    # O_TRUNC, not O_EXCL: a stale tmp (crashed earlier run, recycled pid)
+    # must not wedge creation; the pid suffix keeps cross-process tmps apart
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, fresh)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    # a racing creator may have replaced after us — re-read so concurrent
+    # processes converge on whichever complete file won
+    with open(path, "rb") as f:
+        return f.read() or fresh
+
+
 def _save_photo(photo: Photo) -> Optional[str]:
     # default under MEDIA_ROOT so the API can hand out /media/photos/... URLs
+    import hmac
+
     from ...conf import settings
 
     media_dir = os.environ.get("DABT_MEDIA_DIR") or os.path.join(
@@ -81,12 +124,22 @@ def _save_photo(photo: Photo) -> Optional[str]:
     try:
         os.makedirs(media_dir, exist_ok=True)
         # media under MEDIA_ROOT is served WITHOUT API-token auth (platforms
-        # fetch it by URL — api/app.py auth exemption), so the filename must be
-        # unguessable — platform file_ids are enumerable.  Content-addressing
-        # (not a random uuid) keeps saves idempotent: a webhook redelivery of
-        # the same photo rewrites the same path instead of orphaning a copy.
+        # fetch it by URL — api/app.py auth exemption), so the filename must
+        # be unguessable — platform file_ids are enumerable, and a bare
+        # content hash is derivable from known content.  HMAC(install-secret,
+        # content) is unguessable either way yet idempotent per photo.
         data = bytes(photo.content)
-        name = hashlib.sha256(data).hexdigest()[:32]
+        # anchor the secret on the SERVED root when one is configured: with a
+        # nested or trailing-slash DABT_MEDIA_DIR, dirname(media_dir) can
+        # still be inside MEDIA_ROOT — i.e. inside the auth-exempt static
+        # tree (r5 review finding).  MEDIA_ROOT's own sibling never is.
+        if settings.MEDIA_ROOT:
+            anchor = os.path.normpath(settings.MEDIA_ROOT)
+        else:
+            d = os.path.normpath(media_dir)
+            anchor = os.path.dirname(d) or d
+        secret = _media_secret(anchor)
+        name = hmac.new(secret, data, hashlib.sha256).hexdigest()[:32]
         path = os.path.join(media_dir, f"{name}.{photo.extension}")
         with open(path, "wb") as f:
             f.write(data)

@@ -63,7 +63,14 @@ def is_complete(path: str) -> bool:
 
 
 def fetch_one(repo_id: str, models_dir: str, revision: Optional[str] = None) -> str:
-    """Download ``repo_id``'s serving assets; returns the local dir."""
+    """Download ``repo_id``'s serving assets; returns the local dir.
+
+    An existing local checkpoint directory (e.g. one written by
+    ``synth_checkpoint``, or copied in by hand in an air-gapped deployment)
+    is accepted as already fetched — no hub round trip."""
+    if os.path.isdir(repo_id) and is_complete(repo_id):
+        print(f"{repo_id}: local checkpoint dir, nothing to fetch")
+        return repo_id
     target = local_dir_for(models_dir, repo_id)
     if is_complete(target):
         print(f"{repo_id}: already fetched -> {target}")
@@ -117,13 +124,39 @@ def convert_one(src_dir: str, out_dir: str, *, kind: str, quantize: Optional[str
     return path
 
 
+import re
+
+# a hub id is exactly org/name, one slash, no path-y characters
+_REPO_ID_RE = re.compile(r"^[\w.-]+/[\w.-]+$")
+
+
+def looks_like_repo_id(path: str) -> bool:
+    """True only for an ``org/name`` hub id — NOT for filesystem-looking specs.
+
+    A config pointing at a not-yet-created local checkpoint (``models/x.native``,
+    ``./ckpt``, ``/abs/path``) must not be sent to ``snapshot_download`` (r4
+    advisor: it aborted the whole fetch run)."""
+    if os.path.isabs(path) or path.startswith(("./", "../", "~")):
+        return False
+    if os.path.isdir(path):
+        return False
+    # `models/foo.native` passes the org/name shape but is a local checkpoint
+    # convert_one will create: an existing first segment marks a relative
+    # path, and `.native` is this stack's converted-checkpoint suffix
+    if os.path.isdir(path.split("/", 1)[0]):
+        return False
+    if ".native" in os.path.basename(path):
+        return False
+    return bool(_REPO_ID_RE.fullmatch(path))
+
+
 def _config_repo_ids(config_path: str) -> List[str]:
     with open(config_path) as f:
         cfg = json.load(f)
     out = []
     for name, spec in cfg.items():
         path = (spec or {}).get("path")
-        if path and "/" in path and not os.path.isdir(path):
+        if path and looks_like_repo_id(path):
             out.append(path)
     return out
 
@@ -173,13 +206,25 @@ def run(args) -> int:
         print("nothing to fetch: pass repo ids or --config with hub-id paths")
         return 1
     os.makedirs(models_dir, exist_ok=True)
+    failures = 0
     for repo_id in repo_ids:
-        local = fetch_one(repo_id, models_dir, revision=args.revision)
-        if args.convert:
-            convert_one(
-                local,
-                local + ".native" + (".int8" if args.quantize else ""),
-                kind=args.kind,
-                quantize=args.quantize,
-            )
-    return 0
+        # one model's failure must not abort the rest of the fetch run (r4
+        # advisor) — report it, keep going, and exit non-zero at the end
+        try:
+            local = fetch_one(repo_id, models_dir, revision=args.revision)
+            if args.convert:
+                convert_one(
+                    local,
+                    local + ".native" + (".int8" if args.quantize else ""),
+                    kind=args.kind,
+                    quantize=args.quantize,
+                )
+        except SystemExit as e:
+            print(str(e))
+            failures += 1
+        except Exception as e:
+            print(f"{repo_id}: failed ({type(e).__name__}: {e})")
+            failures += 1
+    if failures:
+        print(f"{failures}/{len(repo_ids)} models failed")
+    return 1 if failures else 0

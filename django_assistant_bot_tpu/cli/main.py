@@ -20,6 +20,7 @@ COMMANDS = {
     "telegram_poll": ".telegram_poll",
     "tester": ".tester",
     "fetch_models": ".fetch_models",
+    "synth_checkpoint": ".synth_checkpoint",
 }
 
 

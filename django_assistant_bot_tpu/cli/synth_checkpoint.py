@@ -1,0 +1,50 @@
+"""``synth_checkpoint``: write a REAL-format HF checkpoint locally.
+
+Air-gapped bootstrap for the weights path the reference proves by downloading
+(reference: gpu_service/bin/fetch_models.py:10-30): the emitted directory is
+the exact layout ``fetch_models --convert`` and ``serve`` consume —
+``model.safetensors`` + ``config.json`` + a trained ``tokenizer.json`` with a
+chat template — so the full fetch -> convert -> serve -> /dialog path runs
+with zero egress.  Weight values are random; every format/code path is real.
+"""
+
+from __future__ import annotations
+
+
+def add_parser(sub):
+    p = sub.add_parser(
+        "synth_checkpoint",
+        help="write a real-format (safetensors + tokenizer.json) checkpoint locally",
+    )
+    p.add_argument("out_dir", help="target checkpoint directory")
+    p.add_argument(
+        "--kind", choices=("decoder", "encoder"), default="decoder",
+    )
+    p.add_argument("--vocab-size", type=int, default=512)
+    p.add_argument("--hidden-size", type=int, default=None)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def run(args) -> int:
+    from ..models import synth
+
+    if args.kind == "encoder":
+        out = synth.synth_encoder(
+            args.out_dir,
+            vocab_size=args.vocab_size,
+            hidden_size=args.hidden_size or 64,
+            num_layers=args.num_layers,
+            seed=args.seed,
+        )
+    else:
+        out = synth.synth_decoder(
+            args.out_dir,
+            vocab_size=args.vocab_size,
+            hidden_size=args.hidden_size or 128,
+            num_layers=args.num_layers,
+            seed=args.seed,
+        )
+    print(f"synthesized {args.kind} checkpoint -> {out}")
+    return 0

@@ -74,6 +74,11 @@ class Settings:
         # vector schema (reference fixes 768 for ruBert — assistant/storage/models.py:13;
         # configurable here so tiny dev models and other embedders fit the same schema)
         self.EMBEDDING_DIM: int = int(_env("EMBEDDING_DIM", 768))
+        # shard RAG vector indexes over the mesh `data` axis (storage/knn.py
+        # sharded variant): corpora beyond one chip's HBM score shard-locally
+        # with an all-gather top-k merge.  Off by default — single-chip
+        # deployments replicate-free either way.
+        self.KNN_MESH: bool = str(_env("DABT_KNN_MESH", "0")) in ("1", "true", "True")
         # media plane (reference: settings.MEDIA_URL + MediaURLMiddleware,
         # assistant/assistant/middleware.py:4-15)
         self.MEDIA_URL: str = _env("MEDIA_URL", "/media/")

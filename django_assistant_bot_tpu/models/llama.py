@@ -25,6 +25,7 @@ from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops.attention import attention, dot_product_attention, gqa_dot_product_attention
 from ..ops.norms import rms_norm
@@ -210,7 +211,11 @@ def init(cfg: DecoderConfig, rng: jax.Array) -> Params:
 
 
 def init_int8(
-    cfg: DecoderConfig, rng: jax.Array, *, quantize_embed: bool = False
+    cfg: DecoderConfig,
+    rng: jax.Array,
+    *,
+    quantize_embed: bool = False,
+    host_rng: bool = False,
 ) -> Params:
     """Synthetic int8-quantized params generated ON DEVICE — no host staging.
 
@@ -231,6 +236,12 @@ def init_int8(
     ``quantize_decoder_params`` output; norms/embeddings/head stay in
     ``cfg.dtype``.  ``random.bits`` at uint8 keeps the transient generation
     buffer ~1x the result (randint would stage an int32 intermediate, 4x).
+
+    ``host_rng`` draws the int8 bytes with numpy on the host instead of
+    on-device threefry.  On a real chip the device draw wins (no transfer);
+    on the virtual CPU mesh threefry runs on the same cores it's "offloading"
+    to and is ~100x slower than numpy — the 8B/Mixtral dryrun stages spent
+    minutes of their budget inside it (r4's multichip timeout).
     """
     from ..ops.quant import QTensor
 
@@ -250,9 +261,18 @@ def init_int8(
         # OOM'd a chip with 12 GB free)
         return jax.random.bits(key, shape, jnp.uint8).astype(jnp.int8)
 
+    host = (
+        np.random.default_rng(int(np.asarray(jax.random.key_data(rng)).ravel()[-1]))
+        if host_rng
+        else None
+    )
+
     def qdense(shape, target_std=None):
-        q = _gen_q(next(keys), shape)
-        q.block_until_ready()  # serialize: peak transient = one leaf, not all
+        if host is not None:
+            q = jnp.asarray(host.integers(-127, 128, shape, np.int8))
+        else:
+            q = _gen_q(next(keys), shape)
+            q.block_until_ready()  # serialize: peak transient = one leaf, not all
         scale_shape = shape[:-2] + (1, shape[-1])
         scale = jnp.full(scale_shape, (target_std or s) / UNIFORM_STD, jnp.float32)
         return QTensor(q=q, scale=scale)
@@ -273,6 +293,15 @@ def init_int8(
                 "bv": jnp.zeros((L, KH * D), cfg.dtype),
             }
         )
+    def ndense(shape, scale=1.0):
+        # dense (non-quantized) leaves: embeddings/head/router
+        if host is not None:
+            arr = host.standard_normal(shape, np.float32) * scale
+            return jnp.asarray(arr).astype(cfg.dtype)
+        return jax.random.normal(next(keys), shape, cfg.dtype) * jnp.asarray(
+            scale, cfg.dtype
+        )
+
     if cfg.is_moe:
         X = cfg.num_experts
         layers.update(
@@ -280,8 +309,7 @@ def init_int8(
                 # the router stays dense: moe_mlp reads it in f32 (and
                 # quantize_decoder_params leaves it out too — tiny + routing
                 # quality is disproportionately sensitive)
-                "router": jax.random.normal(next(keys), (L, E, X), cfg.dtype)
-                * jnp.asarray(s, cfg.dtype),
+                "router": ndense((L, E, X), s),
                 "w_gate": qdense((L, X, E, F)),
                 "w_up": qdense((L, X, E, F)),
                 "w_down": qdense((L, X, F, E), target_std=F ** -0.5),
@@ -299,7 +327,7 @@ def init_int8(
         "tok_embed": (
             qdense((cfg.vocab_size, E), target_std=1.0)
             if quantize_embed
-            else jax.random.normal(next(keys), (cfg.vocab_size, E), cfg.dtype)
+            else ndense((cfg.vocab_size, E))
         ),
         "final_norm": jnp.ones((E,), cfg.dtype),
         "layers": layers,
@@ -308,8 +336,7 @@ def init_int8(
         params["lm_head"] = (
             qdense((E, cfg.vocab_size))
             if quantize_embed
-            else jax.random.normal(next(keys), (E, cfg.vocab_size), cfg.dtype)
-            * jnp.asarray(s, cfg.dtype)
+            else ndense((E, cfg.vocab_size), s)
         )
     return params
 

@@ -48,6 +48,13 @@ def top_k_hierarchical(x: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray
     cand = jnp.take_along_axis(xg, gidx[:, :, None], axis=1).reshape(B, kg * _GROUP)
     vals, cidx = jax.lax.top_k(cand, k)  # [B, k] within candidates
     idx = jnp.take_along_axis(gidx, cidx // _GROUP, axis=1) * _GROUP + cidx % _GROUP
+    # Pad lanes hold NEG_INF (finite): with fewer than k candidates above it
+    # (e.g. a degenerate FSM state masking everything at an unaligned vocab) a
+    # pad lane can win a slot and carry an index >= V — and a uniform draw over
+    # all-NEG_INF rows could then emit an out-of-vocab id.  lax.top_k never
+    # returns out-of-range ids; match that contract by clamping (the clamped
+    # slot's value is still NEG_INF, so it can't outrank any real candidate).
+    idx = jnp.minimum(idx, V - 1)
     return vals, idx.astype(jnp.int32)
 
 

@@ -137,11 +137,6 @@ def _gpipe_schedule(layer_span, rest, ids_mb, cfg, n_stages, n_micro):
     is_first = stage == 0
     is_last = stage == n_stages - 1
 
-    def head_logits(x):
-        x = llama.rms_norm(x, rest["final_norm"], cfg.rms_norm_eps)
-        # shared head projection (handles int8 QTensor tables too)
-        return llama._head_logits(rest, cfg, x).astype(jnp.float32)
-
     def tick(carry, t):
         state = carry  # [b, S, E]: the activation this stage holds
         # stage 0 injects microbatch t (clamped index; past-M ticks feed
@@ -149,11 +144,14 @@ def _gpipe_schedule(layer_span, rest, ids_mb, cfg, n_stages, n_micro):
         inject = llama._embed({"tok_embed": rest["tok_embed"]}, cfg, ids_mb[jnp.minimum(t, M - 1)])
         x = jnp.where(is_first, inject, state)
         x = llama.forward_layers(layer_span, cfg, x, cos, sin)
-        # the last stage finishes microbatch m = t - (P-1) at tick t
+        # the last stage finishes microbatch m = t - (P-1) at tick t; collect
+        # the E-wide ACTIVATION, not logits — the final-norm+head runs once
+        # after the scan, so the [*, V] tensor (the largest in training at a
+        # 128k vocab) is neither computed P times per tick nor psum'd
+        # pipe-wide (r4 advisor finding)
         m = t - (n_stages - 1)
-        logits = head_logits(x)
-        collect = (is_last & (m >= 0)).astype(logits.dtype)
-        out_t = (logits * collect, jnp.maximum(m, 0))
+        collect = (is_last & (m >= 0)).astype(x.dtype)
+        out_t = (x * collect, jnp.maximum(m, 0))
         # rotate activations one stage forward (P-1 -> 0 carries garbage that
         # stage 0 overwrites by injecting)
         nxt = jax.lax.ppermute(
@@ -168,10 +166,15 @@ def _gpipe_schedule(layer_span, rest, ids_mb, cfg, n_stages, n_micro):
     # scatter the T collected slots into [M, ...] (non-collect ticks wrote
     # zeros at m=0; summing with the one real m=0 entry keeps it intact only
     # if the zeros stay zero — they do, `collect` zeroes whole blocks)
-    logits_mb = jnp.zeros((M, b, S, outs.shape[-1]), outs.dtype)
-    logits_mb = logits_mb.at[ms].add(outs)
+    acts_mb = jnp.zeros((M, b, S, cfg.hidden_size), outs.dtype)
+    acts_mb = acts_mb.at[ms].add(outs)
     # only the final stage holds real values; psum replicates them pipe-wide
-    return jax.lax.psum(logits_mb, PIPE_AXIS)
+    # (E-wide — V/E-fold less collective traffic than psum'ing logits)
+    acts_mb = jax.lax.psum(acts_mb, PIPE_AXIS)
+    # final norm + shared head projection (handles int8 QTensor tables too),
+    # applied ONCE over all microbatches
+    normed = llama.rms_norm(acts_mb, rest["final_norm"], cfg.rms_norm_eps)
+    return llama._head_logits(rest, cfg, normed).astype(jnp.float32)
 
 
 def pipeline_loss(

@@ -41,23 +41,17 @@ def expected_language(source_text: str) -> Optional[str]:
     return get_language(source_text or "")
 
 
-# Codes the built-in detector can jitter between on short chunks (ru text with
+# Pairs the built-in detector can jitter between on short chunks (ru text with
 # a stray і/ї/є/ґ reads as uk; short Latin text defaults to en).  The reference
 # never sees this — its langid is constrained to {en, ru} — so a strict
 # equality here would fail chunks the reference accepts and spin the
-# repeat_until regeneration loop.  Cross-SCRIPT mismatches (the real failure
-# mode: the LLM answering a Cyrillic document in English) still fail.
-_SCRIPT_GROUPS = {
-    "ru": "cyrillic",
-    "uk": "cyrillic",
-    "en": "latin",
-    "fr": "latin",
-    "de": "latin",
-    "es": "latin",
-    "it": "latin",
-    "pt": "latin",
-    "nl": "latin",
-}
+# repeat_until regeneration loop.  ONLY the known jitter pairs are equivalent
+# (r4 advisor: whole-script-group equivalence let a German answer pass for an
+# English-expected document); every other mismatch — including latin->latin —
+# still fails.
+_CYRILLIC_JITTER = {"ru", "uk"}
+# Latin-script languages whose short chunks the n-gram profiles default to 'en'
+_LATIN = {"en", "fr", "de", "es", "it", "pt", "nl"}
 
 
 def language_matches(expected: Optional[str], text: str) -> bool:
@@ -66,5 +60,8 @@ def language_matches(expected: Optional[str], text: str) -> bool:
     detected = get_language(text)
     if detected == expected:
         return True
-    group = _SCRIPT_GROUPS.get(expected)
-    return group is not None and _SCRIPT_GROUPS.get(detected) == group
+    if expected in _CYRILLIC_JITTER and detected in _CYRILLIC_JITTER:
+        return True
+    # short Latin chunks read as 'en'; accepting only detected=='en' keeps a
+    # genuinely-German answer to an English document failing
+    return detected == "en" and expected in _LATIN

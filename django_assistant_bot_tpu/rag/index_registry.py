@@ -16,6 +16,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Tuple, Type
 
+from ..conf import settings
 from ..storage.db import get_database
 from ..storage.knn import VectorIndex
 from ..storage.orm import Model
@@ -65,7 +66,14 @@ def get_index(model_cls: Type[Model], field: str = "embedding") -> VectorIndex:
             # query-shape buckets, and BLOCKS until resident — so rebuilds pay
             # the transfer in the (worker) thread that caused them, never a
             # live query
-            fresh = VectorIndex.from_model(model_cls, field=field).warmup()
+            mesh = None
+            if getattr(settings, "KNN_MESH", False):
+                # shard corpus rows over the mesh `data` axis: each device
+                # scores its shard, one all-gather merges top-k (knn.py)
+                from ..parallel import get_mesh
+
+                mesh = get_mesh()
+            fresh = VectorIndex.from_model(model_cls, field=field, mesh=mesh).warmup()
             with _lock:
                 # only adopt if no invalidation landed during the rebuild;
                 # otherwise keep the stale marker so the next caller rebuilds

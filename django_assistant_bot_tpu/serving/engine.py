@@ -536,26 +536,36 @@ class GenerationEngine:
         The engine thread drains its own private state (slots, pending queue)
         when its loop exits — ``stop`` only waits for that, bounded by
         ``drain_timeout_s``.  A first-call XLA compile can hold a device step
-        for minutes; past the deadline we return (one error line, no spam) and
-        the daemon thread finishes the drain itself when the in-flight call
+        for minutes; past the deadline we dump the engine thread's stack (so
+        a hung drain is diagnosable from the log alone) and return — the
+        daemon thread finishes the drain itself when the in-flight call
         returns, so no future is ever left dangling."""
         self._running = False
         t = self._thread
         if t is not None:
+            start = time.monotonic()
+            deadline = start + drain_timeout_s
             t.join(timeout=min(5.0, drain_timeout_s))
-            if t.is_alive():
+            while t.is_alive() and time.monotonic() < deadline:
                 logger.warning(
                     "engine thread still draining (device step or compile in "
-                    "flight); waiting up to %.0fs",
+                    "flight); %.0fs elapsed, waiting up to %.0fs",
+                    time.monotonic() - start,
                     drain_timeout_s,
                 )
-                t.join(timeout=drain_timeout_s)
+                t.join(timeout=min(15.0, max(0.0, deadline - time.monotonic())))
             if t.is_alive():
                 logger.error(
                     "engine thread did not drain within %.0fs; its requests "
                     "will fail when the in-flight XLA call returns",
                     drain_timeout_s,
                 )
+                try:  # diagnose the stuck XLA call: where is the thread?
+                    import faulthandler, sys
+
+                    faulthandler.dump_traceback(file=sys.stderr)
+                except Exception:  # pragma: no cover - diagnostics only
+                    pass
             else:
                 self._thread = None
         # anything submitted after the loop exited (or with no thread at all)
@@ -1217,6 +1227,8 @@ class GenerationEngine:
             self._iter_lock.release()
 
     def _probe_decode_locked(self, iters: int) -> float:
+        import numpy as _np
+
         self._refresh_sampling()
         with self._mesh_scope():
             # one warm call (jit cache is hot after warmup(); cheap regardless)
@@ -1225,7 +1237,14 @@ class GenerationEngine:
                 self._temps_dev, self._top_ps_dev, self._rng,
             )
             self._tokens_dev = last
-            jax.block_until_ready(toks)
+            _np.asarray(toks)  # fetch: the only barrier this backend honors
+            # one empty-pipeline fetch bounds the tunnel RTT so it can be
+            # subtracted from the timed chain below (block_until_ready has
+            # been observed returning early on remote backends — a fetch of
+            # the final chained value is the trustworthy sync)
+            t0 = time.monotonic()
+            _np.asarray(self._tokens_dev)
+            rtt = time.monotonic() - t0
             t0 = time.monotonic()
             for _ in range(iters):
                 toks, last, self._cache, self._rng = self._decode_tick(
@@ -1233,8 +1252,9 @@ class GenerationEngine:
                     self._temps_dev, self._top_ps_dev, self._rng,
                 )
                 self._tokens_dev = last
-            jax.block_until_ready(toks)
-        return (time.monotonic() - t0) / (iters * self.burst)
+            _np.asarray(toks)
+        wall = time.monotonic() - t0
+        return max(wall - rtt, wall * 0.5) / (iters * self.burst)
 
     def _issue_tick(self):
         """Dispatch one decode tick without waiting for its result.  The token

@@ -167,6 +167,7 @@ def test_truncate_text():
     assert truncate_text("abcdefghij", 5) == "abcd…"
 
 
+@pytest.mark.slow
 def test_tpu_provider_tiny_end_to_end():
     """tpu: prefix loads a tiny random decoder and generates through the
     continuous-batching engine — the full in-process serving path."""

@@ -94,6 +94,7 @@ def test_save_is_atomic_against_partial_state(tmp_path):
     np.testing.assert_array_equal(restored["x"], np.arange(4))
 
 
+@pytest.mark.slow
 def test_kill_and_resume_training_matches_straight_run(tmp_path, mesh8):
     """Train 2 steps -> checkpoint -> 'die' -> restore into a FRESH state -> 1 more
     step == 3 straight steps, bit-for-bit on params."""

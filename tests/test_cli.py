@@ -142,3 +142,50 @@ def test_fetch_models_config_repo_ids(tmp_path):
         "local": {"kind": "decoder", "path": str(local_dir)},
     }))
     assert fm._config_repo_ids(str(cfg)) == ["meta-llama/Llama-3.2-1B"]
+
+
+def test_fetch_models_config_skips_filesystem_paths(tmp_path, monkeypatch):
+    """Filesystem-looking specs must never reach snapshot_download (r4 advisor:
+    a not-yet-created local path like models/foo.native aborted the run)."""
+    import json
+
+    from django_assistant_bot_tpu.cli import fetch_models as fm
+
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "models").mkdir()
+    cfg = tmp_path / "serving.json"
+    cfg.write_text(json.dumps({
+        "hub": {"kind": "decoder", "path": "org/real-repo"},
+        "native": {"kind": "decoder", "path": "models/foo.native"},
+        "native8": {"kind": "decoder", "path": "other/foo.native.int8"},
+        "dot": {"kind": "decoder", "path": "./ckpt/dir"},
+        "abs": {"kind": "decoder", "path": str(tmp_path / "nope")},
+        "deep": {"kind": "decoder", "path": "a/b/c"},
+    }))
+    assert fm._config_repo_ids(str(cfg)) == ["org/real-repo"]
+
+
+def test_fetch_models_continues_past_failures(tmp_path, monkeypatch, capsys):
+    """One model failing must not abort the rest of the fetch run."""
+    from types import SimpleNamespace
+
+    from django_assistant_bot_tpu.cli import fetch_models as fm
+
+    calls = []
+
+    def fake_fetch(repo_id, models_dir, revision=None):
+        calls.append(repo_id)
+        if repo_id == "org/bad":
+            raise SystemExit(f"{repo_id}: download failed")
+        d = tmp_path / repo_id.replace("/", "__")
+        d.mkdir(exist_ok=True)
+        return str(d)
+
+    monkeypatch.setattr(fm, "fetch_one", fake_fetch)
+    args = SimpleNamespace(
+        models=["org/bad", "org/good"], config=None, models_dir=str(tmp_path),
+        revision=None, convert=False, kind="decoder", quantize=None,
+    )
+    rc = fm.run(args)
+    assert calls == ["org/bad", "org/good"]  # kept going past the failure
+    assert rc == 1  # but the run still reports it

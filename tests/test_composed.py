@@ -1,0 +1,163 @@
+"""Composed planes on the 8-device mesh (VERDICT r4 #6).
+
+ONE request flows the REAL production path end to end — webhook HTTP POST ->
+persisted user message + queued answer task -> worker-side answer task ->
+context pipeline (query embedding on the mesh-sharded TPU encoder ->
+mesh-SHARDED exact-KNN over the bot's question vectors -> context packing) ->
+TP-sharded continuous-batching generation engine -> platform reply — with
+every device array (encoder params, corpus rows, decoder params, KV cache)
+sharded over the virtual 8-device mesh.  The LLM *semantics* of the classify/
+choose steps are scripted (their contracts are covered in test_bot.py); every
+data plane is real.
+"""
+
+import asyncio
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from django_assistant_bot_tpu.ai.providers.echo import EchoProvider
+from django_assistant_bot_tpu.bot.domain import BotPlatform, Update, User
+from django_assistant_bot_tpu.conf import settings
+from django_assistant_bot_tpu.storage import models
+
+
+class RecordingPlatform(BotPlatform):
+    def __init__(self):
+        self.posted = []
+
+    @property
+    def codename(self):
+        return "telegram"
+
+    async def get_update(self, request):  # pragma: no cover - not driven here
+        raise NotImplementedError
+
+    async def post_answer(self, chat_id, answer):
+        self.posted.append((chat_id, answer))
+
+    async def action_typing(self, chat_id):
+        pass
+
+
+@pytest.mark.slow
+def test_composed_planes_webhook_to_generation(tmp_db, monkeypatch):
+    import jax
+
+    from django_assistant_bot_tpu.ai.providers.tpu import (
+        get_shared_registry,
+        reset_shared_registry,
+    )
+    from django_assistant_bot_tpu.ai.services.ai_service import get_ai_embedder
+    from django_assistant_bot_tpu.bot.services.context_service.steps import (
+        base as steps_base,
+    )
+    from django_assistant_bot_tpu.bot.tasks import _answer_task
+    from django_assistant_bot_tpu.rag.index_registry import get_index, reset_indexes
+    from django_assistant_bot_tpu.tasks import TaskRecord
+
+    with settings.override(
+        EMBEDDING_DIM=64,  # tiny encoder hidden size
+        KNN_MESH=True,  # corpus rows shard over the mesh `data` axis
+        EMBEDDING_AI_MODEL="tpu:tiny-emb",
+        DEFAULT_AI_MODEL="tpu:tiny-chat",
+        DIALOG_FAST_AI_MODEL="tpu:tiny-chat",
+        DIALOG_STRONG_AI_MODEL="tpu:tiny-chat",
+    ):
+        reset_shared_registry()
+        reset_indexes()
+        try:
+            bot = models.Bot.objects.create(
+                codename="composed-bot", telegram_token="1:composed"
+            )
+            user = models.BotUser.objects.create(user_id="c1", platform="telegram")
+            instance = models.Instance.objects.create(bot=bot, user=user)
+
+            # KB embedded by the REAL mesh-sharded TPU encoder
+            wiki = models.WikiDocument.objects.create(bot=bot, title="Billing")
+            models.WikiDocumentProcessing.objects.create(
+                wiki_document=wiki,
+                status=models.WikiDocumentProcessing.COMPLETED,
+            )
+            doc = models.Document.objects.create(
+                wiki=wiki, name="Billing FAQ", content="Pay invoices in the portal."
+            )
+            embedder = get_ai_embedder("tpu:tiny-emb")
+            qs = [f"How to pay invoice? #{i}" for i in range(8)]
+            vecs = asyncio.run(embedder.embeddings(qs))
+            for i, (q, v) in enumerate(zip(qs, vecs)):
+                models.Question.objects.create(
+                    document=doc,
+                    text=q,
+                    order=i,
+                    embedding=np.asarray(v, np.float32),
+                )
+
+            # 1) webhook ingress over HTTP: persists the user message and
+            #    queues the answer task (the api plane)
+            from aiohttp.test_utils import TestClient, TestServer
+
+            from django_assistant_bot_tpu.api.app import create_api_app
+
+            async def webhook():
+                client = TestClient(TestServer(create_api_app()))
+                await client.start_server()
+                try:
+                    resp = await client.post(
+                        "/telegram/composed-bot/",
+                        json={
+                            "message": {
+                                "message_id": 11,
+                                "chat": {"id": "c1"},
+                                "text": "How to pay invoice?",
+                                "from": {"id": "c1", "username": "composer"},
+                            }
+                        },
+                    )
+                    assert resp.status == 200
+                finally:
+                    await client.close()
+
+            asyncio.run(webhook())
+            queued = [t for t in TaskRecord.objects.all().all() if "answer_task" in t.name]
+            assert queued, "webhook must queue the answer task"
+            saved = models.Message.objects.filter(message_id=11).all()
+            assert len(saved) == 1
+            dialog = models.Dialog.objects.get(id=saved[0].dialog_id)
+
+            # 2) worker-side execution of that task: context pipeline with the
+            #    real embedder + sharded KNN, then the real TP engine generates
+            scripted = EchoProvider(script=[{"topic": "Billing"}, {"question": None}])
+            monkeypatch.setattr(steps_base, "get_ai_provider", lambda model: scripted)
+            platform = RecordingPlatform()
+            upd = Update(
+                chat_id="c1", message_id=11, text="How to pay invoice?",
+                user=User(id="c1", username="composer"),
+            ).to_dict()
+            asyncio.run(
+                _answer_task("composed-bot", dialog.id, "telegram", upd, platform=platform)
+            )
+            assert platform.posted, "the generated answer must reach the platform"
+            answer = platform.posted[0][1]
+            text = getattr(answer, "text", None) or getattr(answer, "parts", None)
+            assert text, answer
+
+            # 3) sharding evidence: every plane's arrays live on all 8 devices
+            idx = get_index(models.Question)
+            assert idx.mesh is not None and idx.mesh.shape["data"] > 1
+            reg = get_shared_registry()
+            gen = reg.get_generator("tiny-chat")
+            emb = reg.get_embedder("tiny-emb")
+            for eng in (gen, emb):
+                leaves = jax.tree.leaves(eng.params)
+                assert any(len(l.sharding.device_set) == 8 for l in leaves), (
+                    "params must be mesh-sharded"
+                )
+            assert gen.mesh is not None  # KV cache shards via cache_shardings
+        finally:
+            reset_shared_registry()
+            reset_indexes()

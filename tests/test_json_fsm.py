@@ -127,6 +127,7 @@ def test_hf_token_bytes_preserve_leading_space():
     assert tb[wrapped.eos_id] == b""
 
 
+@pytest.mark.slow
 def test_engine_json_mode_always_parses_at_high_temperature():
     """20 constrained generations at temperature 0.8 on random weights: every
     output parses; unconstrained, none of them do (sanity of the premise)."""

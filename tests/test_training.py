@@ -76,6 +76,7 @@ def test_train_step_covers_family_variants(mesh8):
         assert plain_loss != pytest.approx(float(metrics["loss"]), rel=1e-6)
 
 
+@pytest.mark.slow
 def test_sharded_step_matches_single_device(mesh8):
     cfg = DecoderConfig.tiny()
     optimizer = optax.adamw(1e-3)
@@ -97,6 +98,7 @@ def test_sharded_step_matches_single_device(mesh8):
     )
 
 
+@pytest.mark.slow
 def test_remat_step_matches_plain():
     cfg = DecoderConfig.tiny()
     optimizer = optax.sgd(1e-2)
@@ -113,6 +115,7 @@ def test_remat_step_matches_plain():
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_moe_train_step_runs():
     from django_assistant_bot_tpu.parallel import best_mesh_shape, make_mesh
 
@@ -130,6 +133,7 @@ def test_moe_train_step_runs():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_long_context_ring_step_matches_dense(mesh8):
     """Ring-attention (sequence-parallel) training step == dense step."""
     from django_assistant_bot_tpu.parallel import best_mesh_shape, make_mesh
@@ -205,6 +209,7 @@ def test_pipeline_forward_matches_dense(n_micro):
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_pipeline_train_step_matches_dense():
     """PP x DP train step: loss and updated params == the single-device step."""
     from django_assistant_bot_tpu.parallel import best_mesh_shape, make_mesh
