@@ -47,17 +47,22 @@ EMB_ITERS = int(os.environ.get("BENCH_ITERS", "20"))
 BASELINE_ITERS = int(os.environ.get("BENCH_BASELINE_ITERS", "2"))
 
 # config 2 (decode) / config 3 (RAG)
-DECODE_REQUESTS = int(os.environ.get("BENCH_DECODE_REQUESTS", "16"))
+DECODE_REQUESTS = int(os.environ.get("BENCH_DECODE_REQUESTS", "32"))
 DECODE_NEW_TOKENS = int(os.environ.get("BENCH_DECODE_NEW_TOKENS", "128"))
 DECODE_PROMPT_LEN = int(os.environ.get("BENCH_DECODE_PROMPT_LEN", "120"))
-# concurrency matches the generation engine's 16 slots (8 left ~half the
-# decode slots idle: measured 2.8 -> 5.8 req/s going 8 -> 16)
+# concurrency matches the engine slot count: 8 -> 16 measured 2.8 -> 5.8 req/s
+# (r3); 16 -> 32 measured 5.7 -> 9.2 req/s same-session (r5 — the ledger's
+# dispatch-floor amortization applied to the headline)
 RAG_REQUESTS = int(os.environ.get("BENCH_RAG_REQUESTS", "64"))
-RAG_CONCURRENCY = int(os.environ.get("BENCH_RAG_CONCURRENCY", "16"))
+RAG_CONCURRENCY = int(os.environ.get("BENCH_RAG_CONCURRENCY", "32"))
 RAG_NEW_TOKENS = int(os.environ.get("BENCH_RAG_NEW_TOKENS", "32"))
 # headline composes configs 3+4: the KNN hop runs at CORPUS SCALE (1M vectors,
 # ~1.5 GB bf16 on device next to both models) through the real HTTP path
 RAG_CORPUS = int(os.environ.get("BENCH_RAG_CORPUS", "1000000"))
+# engine slot count for the core decode/RAG engine (the r5 ledger found a
+# ~7.4 ms dispatch floor at 1B geometry — slots amortize it; 32 is the
+# measured knee, 64 regresses)
+SLOTS = int(os.environ.get("BENCH_SLOTS", "32"))
 BASELINE_DECODE_TOKENS = int(os.environ.get("BENCH_BASELINE_DECODE_TOKENS", "6"))
 
 # config 4 (bulk ingestion + KNN scale)
@@ -196,8 +201,10 @@ def _build_gen_engine(
     buckets=(128, 512),
     prefix_cache=0,
     kv_dtype=None,
-    max_slots=16,
+    max_slots=None,
+    speculative=0,
 ):
+    max_slots = max_slots or SLOTS
     import jax
 
     from django_assistant_bot_tpu.models import llama
@@ -232,6 +239,7 @@ def _build_gen_engine(
         mesh=mesh,
         prefix_cache_size=prefix_cache,
         kv_cache_dtype=kv_dtype,
+        speculative=speculative,
     )
     # compile every (batch, seq) prefill shape BEFORE measuring; the decode-only
     # engines are built with just the bucket their prompts hit (same bucket the
@@ -995,16 +1003,17 @@ def bench_int8() -> dict:
         out["decode_int8full_fp8kv_ledger"] = decode_byte_ledger(eng)
     finally:
         eng.stop()
-    # the floor amortizer: 32 slots at near-constant weight bytes (measured
-    # knee — 64 slots regresses).  This is the 1B int8 production config.
+    # the floor-contrast point: the same int8 config at 16 slots — near-equal
+    # step time at half the tokens/step is the dispatch-floor signature the
+    # r5 ledger documented (32 is the measured knee; 64 regresses)
     eng, _ = _build_gen_engine(
-        quantize="int8_device", buckets=(_decode_bucket(),), max_slots=32
+        quantize="int8_device", buckets=(_decode_bucket(),), max_slots=16
     )
     try:
         step_s = eng.probe_decode(iters=12)
-        out["decode_int8_slots32_steady_tokens_per_s"] = round(32 / step_s, 2)
-        out["decode_int8_slots32_pure_step_ms"] = round(step_s * 1e3, 3)
-        out["decode_int8_slots32_ledger"] = decode_byte_ledger(eng)
+        out["decode_int8_slots16_steady_tokens_per_s"] = round(16 / step_s, 2)
+        out["decode_int8_slots16_pure_step_ms"] = round(step_s * 1e3, 3)
+        out["decode_int8_slots16_ledger"] = decode_byte_ledger(eng)
     finally:
         eng.stop()
     return out
@@ -1148,6 +1157,60 @@ def baseline_embedding_torch_cpu_batched() -> float:
             out.last_hidden_state.mean(dim=1)
         dt = time.perf_counter() - t0
     return (EMB_BATCH * BASELINE_ITERS) / dt
+
+
+# Prompt-lookup speculative decoding (ops/speculative.py): single-stream
+# greedy, spec-on vs spec-off, on a context-copying prompt.  Acceptance on
+# RANDOM weights is near zero (no induction behavior), so this section
+# honestly records the mechanism's overhead bound + the accept counters; the
+# bit-identical-output guarantee and the accepted-draft fast path are proven
+# by tests/test_speculative.py, and real checkpoints answering from context
+# are the high-acceptance regime.
+_SPEC_SNIPPET = """
+import json, time
+import bench
+from django_assistant_bot_tpu.serving import ByteTokenizer
+
+prompt = ("the invoice portal accepts payment by card. " * 6).encode()
+
+def run(spec):
+    eng, _ = bench._build_gen_engine(
+        quantize="int8_device", buckets=(bench._decode_bucket(),),
+        max_slots=4, speculative=spec)
+    tok = ByteTokenizer()
+    ids = [tok.bos_id] + list(prompt)[: bench.DECODE_PROMPT_LEN - 1]
+    try:
+        eng.submit(ids, max_tokens=8, temperature=0.0).result(timeout=600)  # warm
+        t0 = time.perf_counter()
+        r = eng.submit(ids, max_tokens=128, temperature=0.0).result(timeout=600)
+        wall = time.perf_counter() - t0
+        stats = eng.tick_stats()
+    finally:
+        eng.stop()
+    return r.completion_tokens / wall, stats, r.token_ids
+
+plain_tok_s, _, plain_ids = run(0)
+spec_tok_s, stats, spec_ids = run(6)
+# greedy equivalence is exact in exact arithmetic (bit-identical on the f32
+# CPU mesh, tests/test_speculative.py); on the bf16 MXU the 1-token and
+# (K+1)-token programs accumulate in different orders, so near-tie argmax
+# (measured delta ~5e-5) may break differently — record the overlap instead
+# of asserting across two differently-shaped programs
+match = 0
+for a, b in zip(spec_ids, plain_ids):
+    if a != b:
+        break
+    match += 1
+print(json.dumps({
+    "spec_decode_single_stream_tokens_per_s": round(spec_tok_s, 2),
+    "spec_decode_plain_single_stream_tokens_per_s": round(plain_tok_s, 2),
+    "spec_decode_speedup": round(spec_tok_s / plain_tok_s, 3),
+    "spec_decode_accept_rate": stats.get("spec_accept_rate", 0.0),
+    "spec_decode_drafted": stats.get("spec_drafted", 0),
+    "spec_decode_greedy_match_prefix": match,
+    "spec_decode_tokens_compared": min(len(spec_ids), len(plain_ids)),
+}))
+"""
 
 
 # The full real-weights path on chip (VERDICT r4 missing #1): a REAL-format
@@ -1385,6 +1448,8 @@ def main() -> None:
     run("ingest", _INGEST_SNIPPET, cap_s=500)
     # 7) the real-weights path: real-format checkpoint -> convert -> /dialog
     run("real_ckpt", _REAL_CKPT_SNIPPET, cap_s=400)
+    # 8) prompt-lookup speculative decoding: overhead bound + accept counters
+    run("spec", _SPEC_SNIPPET, cap_s=500)
 
     baseline_thread.join(timeout=max(30.0, min(600.0, left())))
     if baseline_thread.is_alive():

@@ -855,6 +855,70 @@ def extract_prefix(cache: KVCache, slot: jnp.ndarray, pb: int) -> tuple[jnp.ndar
     return pk, pv
 
 
+def verify_step(
+    params: Params,
+    cfg: DecoderConfig,
+    seq: jnp.ndarray,  # [B, C] int32 — input token + C-1 speculative drafts
+    cache: KVCache,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Multi-position decode forward for speculative verification.
+
+    Runs ``C`` contiguous positions per slot starting at ``cache.lengths[b]``
+    (the same position a plain :func:`decode_step` would use), writing K/V for
+    all of them, and returns logits at EVERY position ([B, C, V] f32) so the
+    caller (ops/speculative.accept_drafts) can accept the longest matching
+    draft prefix.  ``cache.lengths`` is NOT advanced here — the caller sets it
+    to ``lengths + n_new`` once acceptance is known; K/V written beyond that
+    point sits past the valid length, is masked out of every future attention,
+    and is overwritten when real tokens land there (the exact discipline
+    decode_step already relies on for freed slots).  Callers must guarantee
+    ``lengths + C <= max_len`` for rows whose acceptance they will take (the
+    engine finishes spec-mode requests ``C-1`` tokens before the cache limit,
+    so live rows always fit); free slots' garbage writes are harmless exactly
+    as in decode_step.
+
+    Structurally this is :func:`prefill_suffix` with identity slots (rows ARE
+    slots, so the duplicate-slot scatter scan is unnecessary) plus
+    all-position logits instead of last-token logits."""
+    B, C = seq.shape
+    S = cache.max_len
+    lengths = cache.lengths
+    pos = lengths[:, None] + jnp.arange(C)[None, :]  # [B, C] absolute positions
+    pos = jnp.minimum(pos, S - 1)
+    cos_t, sin_t = _rope_tables(cfg, S)
+    cos, sin = cos_t[pos], sin_t[pos]
+    x = _embed(params, cfg, seq)  # [B, C, E]
+    kpos = jnp.arange(S)[None, None, None, :]
+    causal_keep = kpos <= pos[:, None, :, None]  # [B, 1, C, S]
+
+    def make_body(window):
+        attn_mask = causal_keep
+        if window is not None:
+            attn_mask = attn_mask & (kpos > pos[:, None, :, None] - window)
+
+        def body(x, inputs):
+            p, k_cache, v_cache = inputs  # [B, KH, S, D] cache rows
+            h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _attn_proj(cfg, p, h, cos, sin)
+            k_cache = _write_cache(k_cache, k, lengths)
+            v_cache = _write_cache(v_cache, v, lengths)
+            o = gqa_dot_product_attention(q, k_cache, v_cache, mask=attn_mask)
+            o = o.transpose(0, 2, 1, 3).reshape(B, C, -1)
+            x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
+            h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(cfg, p, h)
+            return x, (k_cache, v_cache)
+
+        return body
+
+    x, (ks, vs) = _scan_window_split(
+        cfg, make_body, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = _head_logits(params, cfg, x)  # [B, C, V]
+    return logits.astype(jnp.float32), KVCache(k=ks, v=vs, lengths=lengths)
+
+
 def decode_step(
     params: Params,
     cfg: DecoderConfig,

@@ -148,6 +148,9 @@ class _TickRef:
     slots: List[tuple]
     first: bool = False
     offset: int = 0
+    # speculative tick: [max_slots] valid-token counts — entry k of nxt[:, b]
+    # is real only for k < n_new[b] (the rest are rejected-draft garbage)
+    n_new: Any = None
 
 
 @dataclasses.dataclass
@@ -184,6 +187,7 @@ class GenerationEngine:
         prefix_min_tokens: int = 32,
         prefix_cache_max_bytes: int = 1 << 30,
         kv_cache_dtype: Optional[str] = None,
+        speculative: int = 0,
         mesh=None,
     ):
         self.cfg = cfg
@@ -217,6 +221,30 @@ class GenerationEngine:
         # burst in flight — bounded by burst * per-step time, same order as a
         # prefill chunk.
         self.burst = max(1, int(burst))
+        # Prompt-lookup speculative decoding (ops/speculative.py): K n-gram
+        # draft tokens per tick, drafted ON DEVICE from a token-history buffer
+        # and verified in one fused (K+1)-position forward — greedy rows
+        # advance up to K+1 tokens per tick at bit-identical output.  The
+        # reference's answer-from-context workload is the high-acceptance
+        # regime.  Replaces burst (one tick IS multi-token); incompatible with
+        # JSON-constrained decoding (FSM state is inherently sequential) —
+        # submit() rejects json_format when enabled.
+        self.speculative = max(0, int(speculative))
+        if self.speculative:
+            # the verify tick writes K+1 positions and _should_finish reserves
+            # K tokens of headroom — a K near max_seq_len would crash the
+            # jitted tick (opaquely) or instantly length-limit every request;
+            # fail at load with the same clarity as the other config knobs
+            if self.speculative > self.max_seq_len // 4:
+                raise ValueError(
+                    f"speculative={self.speculative} too large for "
+                    f"max_seq_len={self.max_seq_len}: each tick writes K+1 "
+                    f"positions and K tokens of finish headroom are reserved; "
+                    f"keep K <= max_seq_len // 4 ({self.max_seq_len // 4})"
+                )
+            self.burst = 1
+        self.spec_drafted = 0  # draft tokens proposed (greedy rows only)
+        self.spec_accepted = 0  # draft tokens accepted
         # Prefix KV cache: K/V of shared prompt prefixes (system + packed RAG
         # context) are kept on device and re-inserted into slots instead of
         # being re-prefilled — the reference re-sends and recomputes that
@@ -306,6 +334,17 @@ class GenerationEngine:
         self._decode_tick = self._make_decode_tick(json_mode=False)
         self._activate_fn = self._make_activate(json_mode=False)
         self._activate_fn_json = None  # built in _ensure_fsm
+        self._spec_tick = self._make_spec_tick() if self.speculative else None
+        self._history_dev = self._fresh_history() if self.speculative else None
+        if self.speculative:
+            rep = _replicated(self.mesh) if self.mesh is not None else None
+            self._hist_set = jax.jit(
+                lambda h, row, slot: jax.lax.dynamic_update_slice(
+                    h, row[None], (slot, 0)
+                ),
+                donate_argnums=(0,),
+                out_shardings=rep,
+            )
 
         if mesh is not None:
             insert_out = self._cache_shardings
@@ -496,6 +535,57 @@ class GenerationEngine:
             return jax.device_put(z, _replicated(self.mesh))
         return jax.device_put(z)
 
+    def _fresh_history(self):
+        """Zeroed [max_slots, max_seq_len] int32 device token history (the
+        prompt-lookup draft source), replicated like the token array."""
+        z = jnp.zeros((self.max_slots, self.max_seq_len), jnp.int32)
+        if self.mesh is not None:
+            return jax.device_put(z, _replicated(self.mesh))
+        return jax.device_put(z)
+
+    def _make_spec_tick(self):
+        """Fused prompt-lookup speculative tick: on-device n-gram draft ->
+        (K+1)-position verify forward -> longest-prefix acceptance -> history/
+        cache/length update, all chained device state (lookahead-compatible;
+        zero host round trips per tick).  See ops/speculative.py for the
+        acceptance semantics and models/llama.verify_step for the forward."""
+        from ..ops.speculative import accept_drafts, build_prompt_lookup_draft
+
+        cfg_c, top_k_c, K = self.cfg, self.top_k, self.speculative
+        S = self.max_seq_len
+
+        def tick(params, tokens, history, cache, active, temps, top_ps, rng):
+            draft = build_prompt_lookup_draft(history, cache.lengths, tokens, K)
+            seq = jnp.concatenate([tokens[:, None], draft], axis=1)  # [B, K+1]
+            logits, cache = llama.verify_step(params, cfg_c, seq, cache)
+            out, n_new, bonus, rng = accept_drafts(
+                logits, seq, rng, temperature=temps, top_k=top_k_c, top_p=top_ps
+            )
+            n_new = jnp.where(active, n_new, 0)
+            # persist this tick's input token + candidates into the history at
+            # sequence positions lengths..lengths+K+1; positions beyond the
+            # accepted run hold garbage that later ticks overwrite (exactly
+            # the KV-cache discipline), and the draft search never reads past
+            # the valid length
+            row_tokens = jnp.concatenate([tokens[:, None], out], axis=1)
+            upd = jax.vmap(
+                lambda h, t, p: jax.lax.dynamic_update_slice(h, t, (p,))
+            )(history, row_tokens, jnp.minimum(cache.lengths, S - (K + 2)))
+            history = jnp.where(active[:, None], upd, history)
+            new_len = jnp.where(
+                active, jnp.minimum(cache.lengths + n_new, S), cache.lengths
+            )
+            cache = cache._replace(lengths=new_len.astype(cache.lengths.dtype))
+            tokens = jnp.where(active, bonus, tokens)
+            return out.T, n_new, tokens, history, cache, rng
+
+        if self.mesh is not None:
+            rep = _replicated(self.mesh)
+            out_sh = (rep, rep, rep, rep, self._cache_shardings, rep)
+        else:
+            out_sh = None
+        return jax.jit(tick, donate_argnums=(2, 3), out_shardings=out_sh)
+
     def _fresh_cache(self):
         dt = self.kv_cache_dtype
         if self._cache_shardings is not None:
@@ -608,6 +698,12 @@ class GenerationEngine:
         — the engine reuses their K/V across requests when it can.  Purely an
         optimization hint: results are identical with 0."""
         prompt_ids = list(prompt_ids)
+        if json_format and self.speculative:
+            raise ValueError(
+                "speculative decoding and json_format are mutually exclusive "
+                "(the JSON token-FSM advances one sequential state per token); "
+                "serve JSON traffic from a non-speculative model entry"
+            )
         # keep room for at least one generated token
         limit = self.max_seq_len - 1
         if len(prompt_ids) > limit:
@@ -924,6 +1020,25 @@ class GenerationEngine:
                 jnp.asarray(self._top_ps),
                 self._rng,
             )
+            if self.speculative:
+                # the spec tick + the per-admission history write
+                self._history_dev = self._hist_set(
+                    self._history_dev,
+                    jnp.zeros((self.max_seq_len,), jnp.int32),
+                    jnp.int32(0),
+                )
+                _, _, last2, self._history_dev, self._cache, self._rng = (
+                    self._spec_tick(
+                        self.params,
+                        last,
+                        self._history_dev,
+                        self._cache,
+                        jnp.zeros((self.max_slots,), bool),
+                        jnp.asarray(self._temps),
+                        jnp.asarray(self._top_ps),
+                        self._rng,
+                    )
+                )
             if json:
                 toks, last, self._cache, self._rng, _ = self._decode_tick_json(
                     self.params,
@@ -1171,6 +1286,17 @@ class GenerationEngine:
             self._top_ps[slot] = req.top_p
             self._json[slot] = req.json
             ref_slots.append((slot, self._slot_epoch[slot]))
+            if self.speculative:
+                # seed the slot's device token history with the prompt — the
+                # prompt IS the draft source (prompt-lookup); ~2-4 KB h2d per
+                # admission, off the decode hot path
+                row = np.zeros((self.max_seq_len,), np.int32)
+                n = min(len(req.prompt_ids), self.max_seq_len)
+                row[:n] = req.prompt_ids[:n]
+                with self._mesh_scope():
+                    self._history_dev = self._hist_set(
+                        self._history_dev, jnp.asarray(row), jnp.int32(slot)
+                    )
         self._sampling_dirty = True
         try:
             first.copy_to_host_async()
@@ -1194,11 +1320,18 @@ class GenerationEngine:
         means the device (or the tunnel) is the bottleneck and burst/slots are
         the knobs; `issue` dominating means dispatch enqueue is."""
         n = max(1, self._ticks_issued)
-        return {
+        out = {
             "ticks": self._ticks_issued,
             "issue_ms": round(self._tick_issue_s / n * 1e3, 3),
             "block_ms": round(self._tick_block_s / max(1, self._ticks_processed) * 1e3, 3),
         }
+        if self.speculative:
+            out["spec_drafted"] = self.spec_drafted
+            out["spec_accepted"] = self.spec_accepted
+            out["spec_accept_rate"] = round(
+                self.spec_accepted / max(1, self.spec_drafted), 4
+            )
+        return out
 
     def probe_decode(self, iters: int = 16) -> float:
         """Pure device decode rate: `iters` burst ticks issued back-to-back with
@@ -1263,6 +1396,9 @@ class GenerationEngine:
         :meth:`_process_tick`."""
         t0 = time.monotonic()
         self._refresh_sampling()
+        if self.speculative:
+            self._issue_spec_tick(t0)
+            return
         with self._mesh_scope():
             if self._json.any():
                 toks, last, self._cache, self._rng, self._fsm_states_dev = (
@@ -1303,6 +1439,37 @@ class GenerationEngine:
         ]
         self._inflight.append(_TickRef(nxt=toks, slots=live))
 
+    def _issue_spec_tick(self, t0: float):
+        """Dispatch one fused prompt-lookup speculative tick (draft + verify +
+        accept on device, chained state — same pipelining discipline as the
+        burst tick, but each tick advances a variable 1..K+1 tokens/slot)."""
+        with self._mesh_scope():
+            toks, n_new, last, self._history_dev, self._cache, self._rng = (
+                self._spec_tick(
+                    self.params,
+                    self._tokens_dev,
+                    self._history_dev,
+                    self._cache,
+                    self._active_dev,
+                    self._temps_dev,
+                    self._top_ps_dev,
+                    self._rng,
+                )
+            )
+        for arr in (toks, n_new):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+        self._tokens_dev = last
+        self.steps += 1
+        self._tick_issue_s += time.monotonic() - t0
+        self._ticks_issued += 1
+        live = [
+            (i, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None
+        ]
+        self._inflight.append(_TickRef(nxt=toks, slots=live, n_new=n_new))
+
     def _process_tick(self):
         """Consume the oldest in-flight result (blocks until it arrives)."""
         ref = self._inflight.popleft()
@@ -1320,6 +1487,27 @@ class GenerationEngine:
                 s.generated.append(tok)
                 if self._should_finish(slot, tok):
                     self._finish(slot)
+            return
+        if ref.n_new is not None:  # speculative tick: variable tokens/slot
+            counts = np.asarray(ref.n_new)
+            K = self.speculative
+            for slot, epoch in ref.slots:
+                s = self._slots[slot]
+                if s is None or self._slot_epoch[slot] != epoch:
+                    continue
+                n = int(counts[slot])
+                # greedy rows proposed K drafts and n-1 were accepted
+                if s.request.temperature <= 0:
+                    self.spec_drafted += K
+                    self.spec_accepted += max(0, n - 1)
+                if s.request.first_token_at is None and n > 0:
+                    s.request.first_token_at = time.monotonic()
+                for k in range(n):
+                    tok = int(vals[k, slot])
+                    s.generated.append(tok)
+                    if self._should_finish(slot, tok):
+                        self._finish(slot)
+                        break  # remaining accepted tokens are post-EOS garbage
             return
         for k in range(vals.shape[0]):  # burst steps, oldest first
             for slot, epoch in ref.slots:
@@ -1340,8 +1528,15 @@ class GenerationEngine:
             return True
         if len(s.generated) >= s.request.max_tokens:
             return True
-        # cache full -> decode_step freezes the slot; finish as length-limited
-        if len(s.request.prompt_ids) + len(s.generated) >= self.max_seq_len:
+        # cache full -> decode_step freezes the slot; finish as length-limited.
+        # Speculative mode leaves K tokens of headroom: a verify tick writes
+        # K+1 positions, so live rows must always fit them (verify_step
+        # docstring) — those last K tokens would have been length_limited a
+        # tick later anyway.
+        if (
+            len(s.request.prompt_ids) + len(s.generated)
+            >= self.max_seq_len - self.speculative
+        ):
             return True
         return False
 
@@ -1395,6 +1590,8 @@ class GenerationEngine:
             self._cache = self._fresh_cache()
             self._tokens_dev = self._fresh_tokens()
             self._fsm_states_dev = self._fresh_tokens()
+            if self.speculative:
+                self._history_dev = self._fresh_history()
             # the rng threads through jit outputs, so a failed device call may
             # have poisoned it — rebuild it like the rest of the device state,
             # with a reseed counter so back-to-back failures get distinct streams

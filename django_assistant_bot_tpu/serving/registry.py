@@ -45,6 +45,11 @@ class ModelSpec:
     # slot-cache precision: None/"bf16" | "fp8" (e4m3) | "fp8_e5m2" — fp8
     # halves KV bytes (lossy; opt-in per model)
     kv_cache_dtype: Optional[str] = None
+    # prompt-lookup speculative decoding: K on-device n-gram draft tokens
+    # verified per tick (greedy rows advance up to K+1 tokens/tick,
+    # bit-identical output; ops/speculative.py).  Excludes json_format
+    # traffic on this model entry.
+    speculative: int = 0
     # compile every (batch, seq) prefill/activation shape + decode ticks at
     # load time instead of on first traffic (GenerationEngine.warmup) — slower
     # boot, no multi-second serve-time compile stalls.  warmup_json also
@@ -104,6 +109,13 @@ class ModelRegistry:
             raise ValueError(f"model {name}: unknown quantize={spec.quantize!r}")
         if spec.warmup_json and spec.kind == "encoder":
             raise ValueError(f"model {name}: warmup_json is decoder-only")
+        if spec.speculative and spec.kind == "encoder":
+            raise ValueError(f"model {name}: speculative is decoder-only")
+        if spec.speculative and spec.warmup_json:
+            raise ValueError(
+                f"model {name}: speculative excludes JSON-constrained decoding "
+                "(the token FSM is sequential); use a separate model entry"
+            )
         from .engine import KV_CACHE_DTYPES
 
         if spec.kv_cache_dtype is not None and spec.kind == "encoder":
@@ -185,6 +197,7 @@ class ModelRegistry:
                 prefix_min_tokens=spec.prefix_min_tokens,
                 prefix_cache_max_bytes=spec.prefix_cache_max_bytes,
                 kv_cache_dtype=spec.kv_cache_dtype,
+                speculative=spec.speculative,
                 mesh=self.mesh,
             )
             if spec.warmup or spec.warmup_json:
