@@ -48,3 +48,26 @@ def test_subprocess_bench_parses_final_json_line():
         "import json\nprint('noise'); print(json.dumps({'ok': 1}))", timeout_s=120
     )
     assert res == {"ok": 1} and err == ""
+
+
+def test_bench_8b_budget_walk_semantics(monkeypatch):
+    """The 8B section's budget discipline (what blew the r4 driver cap):
+    exhausted budget records a skip without spawning anything; the fp8
+    walk-down uses SHRINKING per-attempt caps (900 then 400) so a hang can't
+    eat three full timeouts; per-slot error keys never overwrite each other."""
+    out = bench.bench_8b(time_left=lambda: 100)
+    assert out == {"decode_8b_skipped": "budget exhausted (100s left)"}
+
+    calls = []
+
+    def fake(snippet, timeout_s=1800):
+        calls.append(timeout_s)
+        if len(calls) == 1:
+            return {"decode_8b_int8_tokens_per_s_per_chip": 1.0}, ""
+        return None, "simulated OOM"
+
+    monkeypatch.setattr(bench, "_subprocess_bench", fake)
+    out = bench.bench_8b(time_left=lambda: 10**6)
+    assert calls == [900, 900, 400, 400]
+    assert {"decode_8b_fp8kv_error_64", "decode_8b_fp8kv_error_32",
+            "decode_8b_fp8kv_error_16"} <= set(out)
