@@ -1162,6 +1162,38 @@ def baseline_embedding_torch_cpu_batched() -> float:
     return (EMB_BATCH * BASELINE_ITERS) / dt
 
 
+# Long-context prefill at 1B geometry through the chunked-KV pallas flash
+# kernel (ops/attention.py): the whole-row kernel died at 16k (VMEM scoped
+# stack); this records real-chip throughput at 8k/16k/32k — the long-context
+# capability (ring/sequence parallelism covers multi-chip; this is the
+# single-chip flash path the serving engine's prefill uses).
+_LONGCTX_SNIPPET = """
+import json, time
+import numpy as np, jax, jax.numpy as jnp
+from django_assistant_bot_tpu.models import DecoderConfig, llama
+
+cfg = DecoderConfig(
+    vocab_size=128_256, hidden_size=2048, intermediate_size=8192,
+    num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+    max_seq_len=32768, dtype=jnp.bfloat16)
+params = llama.init(cfg, jax.random.PRNGKey(0))
+jax.block_until_ready(params)
+pf = jax.jit(lambda p, i, l: llama.prefill(p, cfg, i, l))
+out = {}
+for S in (8192, 16384, 32768):
+    ids = jnp.ones((1, S), jnp.int32)
+    lens = jnp.asarray([S], jnp.int32)
+    lg, ks, vs = pf(params, ids, lens); np.asarray(lg)  # compile + warm
+    t0 = time.perf_counter()
+    lg, ks, vs = pf(params, ids, lens)
+    lg2, ks, vs = pf(params, ids, lens)
+    np.asarray(lg2)
+    dt = (time.perf_counter() - t0) / 2
+    out[f"longctx_prefill_{S}_tokens_per_s"] = round(S / dt, 1)
+print(json.dumps(out))
+"""
+
+
 # Prompt-lookup speculative decoding (ops/speculative.py): single-stream
 # greedy, spec-on vs spec-off, on a context-copying prompt.  Acceptance on
 # RANDOM weights is near zero (no induction behavior), so this section
@@ -1451,7 +1483,9 @@ def main() -> None:
     run("ingest", _INGEST_SNIPPET, cap_s=500)
     # 7) the real-weights path: real-format checkpoint -> convert -> /dialog
     run("real_ckpt", _REAL_CKPT_SNIPPET, cap_s=400)
-    # 8) prompt-lookup speculative decoding: overhead bound + accept counters
+    # 8) long-context prefill through the chunked-KV flash kernel
+    run("longctx", _LONGCTX_SNIPPET, cap_s=450)
+    # 9) prompt-lookup speculative decoding: overhead bound + accept counters
     run("spec", _SPEC_SNIPPET, cap_s=500)
 
     baseline_thread.join(timeout=max(30.0, min(600.0, left())))

@@ -22,6 +22,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -103,32 +104,47 @@ def _flash_kernel(
     k_ref,
     v_ref,
     o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
     *,
     kv_len: int,
     block_kv: int,
+    chunk_kv: int,
     causal: bool,
     q_block: int,
     window: "Optional[int]" = None,
 ):
-    """One (batch*head, q-block) program: online softmax over kv blocks.
+    """One (batch*head, q-block, kv-chunk) program: online softmax, chunked KV.
 
-    q_ref: [q_block, D]; k_ref/v_ref: [Sk, D]; o_ref: [q_block, D].
+    q_ref: [q_block, D]; k_ref/v_ref: [chunk_kv, D] — K/V stream through VMEM
+    one CHUNK per grid step instead of residing whole-row (a [Sk, D] resident
+    block caps context at ~8k before the 16 MB VMEM scoped-stack limit; the
+    chunked pipeline scales to any Sk).  The online-softmax state (m, l, acc)
+    lives in VMEM scratch across the kv-chunk grid dimension; o_ref is
+    written once, on the final chunk.
 
-    With ``window`` (sliding-window attention, HF semantics: a query attends to
-    the ``window`` most recent positions including itself) the kv loop also
-    SKIPS blocks entirely below the band — the memory-traffic win that makes
-    long windowed prefill O(S*W) instead of O(S^2).
+    Inside a chunk the kv loop runs at ``block_kv`` granularity with the same
+    skip logic as before: causal q-blocks stop at the diagonal, and ``window``
+    (sliding-window attention, HF semantics) skips sub-blocks entirely below
+    the band — O(S*W) compute for long windowed prefill.
     """
     qi = pl.program_id(1)
+    ci = pl.program_id(2)
+    num_chunks = pl.num_programs(2)
+
+    @pl.when(ci == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
     # keep operands in their storage dtype (bf16): the MXU's fast path; accumulate
     # in f32 via preferred_element_type.  Scaling folds into the f32 scores.
     q = q_ref[:]
     scale = q.shape[-1] ** -0.5
 
-    m0 = jnp.full((q_block, 1), NEG_INF, dtype=jnp.float32)
-    l0 = jnp.zeros((q_block, 1), dtype=jnp.float32)
-    o0 = jnp.zeros((q_block, q.shape[-1]), dtype=jnp.float32)
-
+    spc = chunk_kv // block_kv  # sub-blocks per chunk
     num_kv_blocks = kv_len // block_kv
     if causal:
         # only kv blocks up to and including the diagonal participate
@@ -141,6 +157,9 @@ def _flash_kernel(
         first_iter = jnp.maximum(0, qi * q_block - window + 1) // block_kv
     else:
         first_iter = 0
+    # intersect the global [first_iter, num_iter) range with this chunk
+    lo = jnp.maximum(first_iter, ci * spc) - ci * spc
+    hi = jnp.minimum(num_iter, (ci + 1) * spc) - ci * spc
 
     def body(ki, carry):
         m, l, o = carry
@@ -149,7 +168,9 @@ def _flash_kernel(
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale  # [qb, kb]
         if causal or window is not None:
             qpos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_kv), 0)
-            kpos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_kv), 1)
+            kpos = (ci * spc + ki) * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, block_kv), 1
+            )
             keep = qpos >= kpos if causal else (qpos == qpos)
             if window is not None:
                 keep &= kpos > qpos - window
@@ -163,12 +184,23 @@ def _flash_kernel(
         )
         return m_new, l_new, o_new
 
-    m, l, o = jax.lax.fori_loop(first_iter, num_iter, body, (m0, l0, o0))
-    o_ref[:] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    m, l, o = jax.lax.fori_loop(
+        lo, hi, body, (m_scr[:, :1], l_scr[:, :1], acc_scr[:])
+    )
+    m_scr[:, :1] = m
+    l_scr[:, :1] = l
+    acc_scr[:] = o
+
+    @pl.when(ci == num_chunks - 1)
+    def _finalize():
+        o_ref[:] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_kv", "interpret", "window")
+    jax.jit,
+    static_argnames=(
+        "causal", "block_q", "block_kv", "interpret", "window", "chunk_kv"
+    ),
 )
 def flash_attention(
     q: jnp.ndarray,  # [B, H, Sq, D]
@@ -180,6 +212,7 @@ def flash_attention(
     block_kv: int = 128,
     interpret: bool = False,
     window: Optional[int] = None,
+    chunk_kv: Optional[int] = None,  # default: min(8192, Sk); tests force smaller
 ) -> jnp.ndarray:
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -199,24 +232,62 @@ def flash_attention(
     kf = k.reshape(B * H, Sk, D)
     vf = v.reshape(B * H, Sk, D)
 
+    # K/V stream through VMEM one chunk per grid step (double-buffered by the
+    # pallas pipeline).  A whole-row [Sk, D] resident block dies at Sk=16k
+    # (16 MB VMEM scoped-stack limit — measured 16.12M at exactly 16k/D=64);
+    # 8192-wide chunks stay at the old kernel's single-chunk performance for
+    # Sk <= 8k (measured: chunking 8k into 2048s cost ~33% — extra per-chunk
+    # programs + causal upper-triangle fetches) while scaling to any context.
+    if chunk_kv is None:
+        # largest chunk <= 8192 that divides Sk into block multiples (a
+        # drop straight to block_kv at e.g. Sk=12288 would mean 96 chunk
+        # programs per q-block — per-chunk overhead far beyond the ~33%
+        # measured at 2048-wide chunks)
+        chunk_kv = min(8192, Sk)
+        while Sk % chunk_kv or chunk_kv % block_kv:
+            chunk_kv -= block_kv
+    if Sk % chunk_kv or chunk_kv % min(block_kv, chunk_kv):
+        raise ValueError(f"chunk_kv={chunk_kv} must divide Sk={Sk} into block multiples")
     kernel = functools.partial(
         _flash_kernel,
         kv_len=Sk,
-        block_kv=block_kv,
+        block_kv=min(block_kv, chunk_kv),
+        chunk_kv=chunk_kv,
         causal=causal,
         q_block=block_q,
         window=window,
     )
+    def kv_index(bh, qi, ci):
+        # Clamp dead chunks onto the nearest live one: grid steps whose chunk
+        # is entirely past the causal diagonal (or below the window band) run
+        # zero kernel iterations, and mapping them to a repeated block index
+        # makes the pallas pipeline SKIP the copy — without this, causal
+        # prefill streams ~2x the live K/V bytes and windowed prefill loses
+        # its O(S*W) traffic property.
+        c = ci
+        if causal:
+            last = ((qi + 1) * block_q - 1) // chunk_kv
+            c = jnp.minimum(c, last)
+        if window is not None:
+            first = jnp.maximum(0, qi * block_q - window + 1) // chunk_kv
+            c = jnp.maximum(c, first)
+        return (bh, c, 0)
+
     out = pl.pallas_call(
         kernel,
-        grid=(B * H, Sq // block_q),
+        grid=(B * H, Sq // block_q, Sk // chunk_kv),
         in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, Sk, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, Sk, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda bh, qi, ci: (bh, qi, 0)),
+            pl.BlockSpec((None, chunk_kv, D), kv_index),
+            pl.BlockSpec((None, chunk_kv, D), kv_index),
         ],
-        out_specs=pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((None, block_q, D), lambda bh, qi, ci: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # m (col 0 used)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # l (col 0 used)
+            pltpu.VMEM((block_q, D), jnp.float32),  # acc
+        ],
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(B, H, Sq, D)

@@ -196,3 +196,21 @@ def test_longrope_long_regime_warns_short_does_not():
     with warnings.catch_warnings():
         warnings.simplefilter("error")  # short regime: silent
         rope_frequencies(8, 16, theta=1e4, scaling=scaling, deployed_len=32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("window", [None, 100])
+def test_flash_multi_chunk_kv_matches_reference(qkv, causal, window):
+    """The chunked-KV pipeline path (num_chunks > 1 — what long contexts use;
+    a whole-row resident block dies at 16k VMEM) must match the reference
+    exactly, incl. the online-softmax state carried across chunk programs and
+    the dead-chunk index clamping in every causal/window combination."""
+    if window is not None and not causal:
+        pytest.skip("window implies causal in the model paths")
+    q, k, v = qkv  # S=256 -> 4 chunks of 64
+    ref = dot_product_attention(q, k, v, causal=causal, window=window)
+    out = flash_attention(
+        q, k, v, causal=causal, window=window, interpret=True,
+        block_q=64, block_kv=64, chunk_kv=64,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
