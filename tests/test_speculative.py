@@ -243,3 +243,47 @@ def test_spec_k_bounded_against_max_seq_len():
             cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=64,
             speculative=40,
         )
+
+
+@pytest.mark.slow
+def test_spec_engine_with_prefix_cache_matches_plain(mesh8):
+    """Speculation composed with the prefix KV cache (the production RAG
+    combination: shared context prefix + greedy answer) must still match the
+    plain engine's greedy output bit-for-bit on the f32 mesh, and the prefix
+    cache must actually hit."""
+    from django_assistant_bot_tpu.parallel import shard_pytree
+    from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
+
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.PRNGKey(6))
+    with mesh8:
+        params = shard_pytree(params, llama.logical_axes(cfg), mesh8)
+    tok = ByteTokenizer()
+    shared = "context: pay invoices in the portal. " * 2
+    prompts = [shared + "q1?", shared + "q2 about invoices?"]
+    # the byte tokenizer has no merges: [bos] + bytes(shared) is exactly the
+    # shared leading block of both prompts
+    plen = len(tok.encode(shared))
+
+    def run(spec):
+        eng = GenerationEngine(
+            cfg, params, tok, max_slots=2, max_seq_len=160, mesh=mesh8,
+            prefix_cache_size=4, prefix_min_tokens=8, speculative=spec,
+        ).start()
+        try:
+            outs = []
+            for p in prompts:  # sequential: turn 2 hits turn 1's prefix
+                f = eng.submit(
+                    tok.encode(p), max_tokens=16, temperature=0.0,
+                    prefix_len=plen,
+                )
+                outs.append(f.result(timeout=600).token_ids)
+            hits = eng.prefix_hits
+        finally:
+            eng.stop(drain_timeout_s=60.0)
+        return outs, hits
+
+    plain, _ = run(0)
+    spec, hits = run(5)
+    assert spec == plain
+    assert hits >= 1  # the shared context block was reused from the cache
