@@ -279,11 +279,14 @@ def test_spec_engine_with_prefix_cache_matches_plain(mesh8):
                 )
                 outs.append(f.result(timeout=600).token_ids)
             hits = eng.prefix_hits
+            stats = eng.tick_stats()
         finally:
             eng.stop(drain_timeout_s=60.0)
-        return outs, hits
+        return outs, hits, stats
 
-    plain, _ = run(0)
-    spec, hits = run(5)
+    plain, _, _ = run(0)
+    spec, hits, stats = run(5)
     assert spec == plain
     assert hits >= 1  # the shared context block was reused from the cache
+    # the spec path must have actually run (not a silent plain fallback)
+    assert stats.get("spec_drafted", 0) > 0, stats
