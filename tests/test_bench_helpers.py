@@ -71,3 +71,42 @@ def test_bench_8b_budget_walk_semantics(monkeypatch):
     assert calls == [900, 900, 400, 400]
     assert {"decode_8b_fp8kv_error_64", "decode_8b_fp8kv_error_32",
             "decode_8b_fp8kv_error_16"} <= set(out)
+
+
+def test_transient_compile_failure_retries_once(monkeypatch):
+    """A remote-compile-service connection drop (environmental) earns exactly
+    one fresh-subprocess retry, with the transient recorded; real failures
+    and exhausted budgets do not retry."""
+    calls = []
+
+    def flaky(snippet, timeout_s=1800):
+        calls.append(timeout_s)
+        if len(calls) == 1:
+            return None, "rc=1: INTERNAL: remote_compile: read body: closed"
+        return {"ok": 1}, ""
+
+    monkeypatch.setattr(bench, "_subprocess_bench", flaky)
+    extras = {}
+    res, err = bench._run_with_transient_retry("x", 300, lambda: 1000, extras, "s")
+    assert res == {"ok": 1} and len(calls) == 2
+    assert "remote_compile" in extras["s_transient"]
+
+    calls.clear()
+    monkeypatch.setattr(
+        bench, "_subprocess_bench", lambda s, timeout_s=0: (None, "real OOM")
+    )
+    extras = {}
+    res, err = bench._run_with_transient_retry("x", 300, lambda: 1000, extras, "s")
+    assert res is None and "s_transient" not in extras  # non-transient: no retry
+
+
+def test_transient_predicate_excludes_deterministic_compile_failures():
+    """Only connection-drop signatures retry; a deterministic remote-compile
+    failure (e.g. VMEM OOM) must not burn a second full attempt."""
+    assert bench._is_transient_compile_error(
+        "INTERNAL: http://x/remote_compile: read body: response body closed"
+    )
+    assert not bench._is_transient_compile_error(
+        "INTERNAL: http://x/remote_compile: AOT PJRT error: Ran out of memory"
+    )
+    assert not bench._is_transient_compile_error("RESOURCE_EXHAUSTED: plain OOM")
