@@ -288,7 +288,10 @@ def bench_decode(eng) -> dict:
     # Pure on-device step cost (no prefill wave, no host loop): the roofline
     # denominator.  steady tok/s = slots/step; HBM floor counts one full weight
     # read per step (KV/activation traffic excluded -> a hard lower bound).
-    step_s = eng.probe_decode(iters=12)
+    # fill_len pins the probe at this bench's own context fill — with the
+    # length-bucketed decode read, an empty-cache probe would read almost no
+    # KV and overstate the steady rate
+    step_s = eng.probe_decode(iters=12, fill_len=DECODE_PROMPT_LEN + DECODE_NEW_TOKENS)
     steady_tok_s = eng.max_slots / step_s
     stats = eng.tick_stats()
     # Reference point: a chained convert+reduce stream over the SAME weight
@@ -327,6 +330,10 @@ def bench_decode(eng) -> dict:
         "decode_hbm_stream_probe_gbps": round(ceiling_gbps, 1),
         "decode_tick_issue_ms": stats["issue_ms"],
         "decode_tick_block_ms": stats["block_ms"],
+        # fraction of the allocated KV cache the decode attention actually
+        # read (< 1 = the length-bucketed read is skipping invalid positions)
+        "decode_kv_read_frac": stats["kv_read_frac"],
+        "decode_kv_chunk": eng.decode_kv_chunk or 0,
     }
 
 
@@ -509,6 +516,12 @@ def _subprocess_bench(snippet: str, timeout_s: int = 1800):
     code = (
         "import sys, os\n"
         f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        # every section child shares the persistent XLA compile cache: kernel
+        # compiles (the dominant cold cost at 1M-KNN/8B scale) are paid once
+        # across sections AND runs (VERDICT r5 #6)
+        "from django_assistant_bot_tpu.utils.compile_cache import "
+        "enable_persistent_compile_cache\n"
+        "enable_persistent_compile_cache()\n"
         + snippet
     )
     try:
@@ -592,6 +605,14 @@ try:
 
     fire(min(2, slots), 4)
     results, wall = fire(slots, bench.DECODE_NEW_TOKENS)
+    fill = bench.DECODE_PROMPT_LEN + bench.DECODE_NEW_TOKENS
+    # the ledger + a fill-pinned probe, pointed at THIS config (VERDICT r5 #2:
+    # the 8B fp8-KV arm ran at 150 GB/s vs 227 without fp8 and no byte
+    # accounting existed for it) — step time at the bench's own context fill,
+    # bytes split into weights/head/KV-read-vs-allocated
+    step_s = eng.probe_decode(iters=8, fill_len=fill)
+    ledger = bench.decode_byte_ledger(eng, fill_len=fill)
+    kv_frac = eng.tick_stats()["kv_read_frac"]
 finally:
     eng.stop()
 total_new = sum(r.completion_tokens for r in results)
@@ -604,6 +625,12 @@ print(json.dumps({{
     "decode_8b_param_gb": round(pb / 1e9, 2),
     "decode_8b%s_hbm_gbps_min" % tag: round(tok_s / slots * pb / 1e9, 1),
     "decode_8b%s_mfu_pct" % tag: round(tok_s * 2 * n_params / 197e12 * 100, 2),
+    "decode_8b%s_pure_step_ms" % tag: round(step_s * 1e3, 3),
+    "decode_8b%s_steady_tokens_per_s" % tag: round(slots / step_s, 2),
+    "decode_8b%s_steady_gbps" % tag: round(
+        ledger["total_gb_per_step"] / step_s, 1),
+    "decode_8b%s_ledger" % tag: ledger,
+    "decode_8b%s_kv_read_frac" % tag: kv_frac,
 }}))
 """
 
@@ -861,12 +888,58 @@ def _knn_scale_body(n_vec: int, dim: int, n_queries: int) -> dict:
     # _ensure_device dispatches async; a real fetch is the only barrier
     _jax.block_until_ready(scale_index._device_index)
     out["knn_build_stage_s"] = round(time.perf_counter() - t0, 3)
-    t0 = time.perf_counter()
-    scale_index.warmup(ks=(16,), q_rows=(8, n_queries))
-    out["knn_build_kernels_s"] = round(time.perf_counter() - t0, 3)
-    out["knn_build_s"] = round(
-        out["knn_build_stage_s"] + out["knn_build_kernels_s"], 3
-    )
+    # cold vs warm COMPILE cost (VERDICT r5 #6): both sides time the kernel
+    # warmup ONLY — staging (h2d + normalize) is re-paid by every boot whether
+    # or not the compile cache hits, so including it in "cold" would credit
+    # the cache with time it cannot save (it lives in knn_build_stage_s).
+    # The pair runs against a FRESH on-disk cache dir: the section child
+    # enables the persistent cache globally, so a prior run (or any `serve`
+    # boot) would otherwise serve the "cold" compile from disk and collapse
+    # the contrast these two keys exist to demonstrate.  "warm" re-runs the
+    # same warmup after dropping the in-memory executables, so it must
+    # round-trip the on-disk cache — the second-`serve`-boot compile number
+    # the cache wiring buys.
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    orig_cache_dir = getattr(_jax.config, "jax_compilation_cache_dir", None)
+
+    def _set_cache_dir(d):
+        # returns True when the CONFIG changed (the finally must then restore
+        # it even if the private reset below is unavailable on this jax)
+        try:
+            _jax.config.update("jax_compilation_cache_dir", d)
+        except Exception:
+            return False
+        try:
+            # the persistent cache is a once-initialized singleton: if any
+            # earlier compile latched it (the staging above did), a config
+            # update alone never reaches it — reset so the new dir is live
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+        return True
+
+    fresh_cache = _tempfile.mkdtemp(prefix="dabt_cold_cache_")
+    redirected = _set_cache_dir(fresh_cache)
+    try:
+        t0 = time.perf_counter()
+        scale_index.warmup(ks=(16,), q_rows=(8, n_queries))
+        out["knn_build_kernels_s"] = round(time.perf_counter() - t0, 3)
+        out["knn_build_s"] = round(
+            out["knn_build_stage_s"] + out["knn_build_kernels_s"], 3
+        )
+        out["knn_build_cold_s"] = out["knn_build_kernels_s"]
+        _jax.clear_caches()
+        t0 = time.perf_counter()
+        scale_index.warmup(ks=(16,), q_rows=(8, n_queries))
+        out["knn_build_warm_s"] = round(time.perf_counter() - t0, 3)
+    finally:
+        if redirected:
+            _set_cache_dir(orig_cache_dir)
+        _shutil.rmtree(fresh_cache, ignore_errors=True)
     out["knn_vectors"] = n_vec
     # post-warmup first query — the serving-path reality (no compile stall)
     t0 = time.perf_counter()
@@ -943,17 +1016,20 @@ def bench_core() -> dict:
     return out
 
 
-def decode_byte_ledger(eng) -> dict:
+def decode_byte_ledger(eng, fill_len=None) -> dict:
     """Per-decode-step HBM byte model for the engine's geometry (GB).
 
     Closes VERDICT r4 weak #3 (the int8 ledger): a decode step reads (a) the
-    layer weights, (b) the lm_head, and (c) the KV cache — and (c) uses the
-    engine's ALLOCATED shape, because static-shape decode attention reads all
-    ``max_slots x max_seq_len`` rows regardless of live lengths.  At 1B/512
-    ctx/16 slots the bf16 KV read (~2.1 GB) RIVALS the weights (~2.4 GB):
-    int8 halves only (a)+(b), so its steady-state ceiling over bf16 is
-    ~1.25x, not 2x — the "missing" bf16 stream r4 couldn't account for.
-    fp8 KV halves (c) on top, which is what restores a ~2x total-byte cut.
+    layer weights, (b) the lm_head, and (c) the KV cache.  Historically (c)
+    used the engine's ALLOCATED shape — static-shape decode attention read all
+    ``max_slots x max_seq_len`` rows regardless of live lengths; the
+    length-bucketed decode read (``decode_kv_chunk``) now bounds it at the
+    chunk-roundup of the batch's fill instead, so the ledger takes
+    ``fill_len`` (the context the engine is serving) and reports both the
+    allocated KV bytes and what the bucketed read actually streams.  At
+    1B/512 ctx/16 slots the bf16 KV read (~2.1 GB) RIVALS the weights
+    (~2.4 GB): int8 halves only (a)+(b) — fp8 KV and the bucketed read are
+    what cut (c).
     """
     import jax
     import jax.numpy as jnp
@@ -963,20 +1039,28 @@ def decode_byte_ledger(eng) -> dict:
     head = eng.params.get("lm_head", eng.params["tok_embed"])
     head_b = sum(l.nbytes for l in jax.tree.leaves(head))
     kv_itemsize = jnp.dtype(eng.kv_cache_dtype or cfg.dtype).itemsize
-    kv_b = (
+    row_b = (
         eng.max_slots
-        * eng.max_seq_len
         * cfg.num_layers
         * cfg.num_kv_heads
         * cfg.head_dim
         * 2  # K and V
         * kv_itemsize
     )
+    kv_alloc_b = row_b * eng.max_seq_len
+    c = eng.decode_kv_chunk
+    if c and fill_len is not None:
+        covered = min(eng.max_seq_len, (min(fill_len, eng.max_seq_len - 1) // c + 1) * c)
+    else:
+        covered = eng.max_seq_len
+    kv_b = row_b * covered
     total = layer_b + head_b + kv_b
     return {
         "weights_layers_gb": round(layer_b / 1e9, 3),
         "head_gb": round(head_b / 1e9, 3),
         "kv_read_gb": round(kv_b / 1e9, 3),
+        "kv_alloc_gb": round(kv_alloc_b / 1e9, 3),
+        "kv_read_frac": round(covered / eng.max_seq_len, 4),
         "total_gb_per_step": round(total / 1e9, 3),
     }
 
@@ -984,12 +1068,13 @@ def decode_byte_ledger(eng) -> dict:
 def bench_int8() -> dict:
     """Config 2b: int8 weight-only decode, WITH the bytes ledger.
 
-    Two engines at the 1B geometry: (1) int8 layer weights at the default
-    (32-slot) size, (2) the same config at 16 slots — the dispatch-floor
-    contrast pair.  Each records its per-step byte model
-    (:func:`decode_byte_ledger`) so PERF.md's analysis is measured, not
-    inferred."""
+    One full-traffic engine at the default (32-slot) size, then the 16-vs-32
+    slot question settled with INTERLEAVED A/B/A probe trials
+    (:func:`bench_slots_ab`) — a single A-then-B sample per run cannot carry
+    the default on a shared chip whose effective rate swings ~2x between
+    sessions (VERDICT r5 #3: the r5 artifact contradicted its own default)."""
     out: dict = {}
+    fill = DECODE_PROMPT_LEN + DECODE_NEW_TOKENS
     eng, _ = _build_gen_engine(quantize="int8", buckets=(_decode_bucket(),))
     try:
         q8 = bench_decode(eng)
@@ -1000,7 +1085,8 @@ def bench_int8() -> dict:
                 "decode_int8_hbm_gbps_min": q8["decode_hbm_gbps_min"],
                 "decode_int8_pure_step_ms": q8["decode_pure_step_ms"],
                 "decode_int8_steady_tokens_per_s": q8["decode_steady_tokens_per_s"],
-                "decode_int8_ledger": decode_byte_ledger(eng),
+                "decode_int8_kv_read_frac": q8["decode_kv_read_frac"],
+                "decode_int8_ledger": decode_byte_ledger(eng, fill_len=fill),
             }
         )
     finally:
@@ -1008,20 +1094,96 @@ def bench_int8() -> dict:
     # (the 1B int8+embed/head+fp8KV engine that closed the ledger lives in
     # PERF.md's table; re-measuring it every run bought ~200 s of budget for
     # no new information — the recorded fp8 evidence is the 8B config)
-    # the floor-contrast point: the same int8 config at 16 slots — near-equal
-    # step time at half the tokens/step is the dispatch-floor signature the
-    # r5 ledger documented (32 is the measured knee; 64 regresses)
-    eng, _ = _build_gen_engine(
-        quantize="int8_device", buckets=(_decode_bucket(),), max_slots=16
-    )
-    try:
-        step_s = eng.probe_decode(iters=12)
-        out["decode_int8_slots16_steady_tokens_per_s"] = round(16 / step_s, 2)
-        out["decode_int8_slots16_pure_step_ms"] = round(step_s * 1e3, 3)
-        out["decode_int8_slots16_ledger"] = decode_byte_ledger(eng)
-    finally:
-        eng.stop()
+    out.update(bench_slots_ab())
     return out
+
+
+def bench_slots_ab(trials: int = 3) -> dict:
+    """Interleaved A/B/A slot-count trials on ONE shared int8 param set.
+
+    Builds the SLOTS-slot (A) and SLOTS/2-slot (B) engines over the same
+    weights (engines donate only their caches, never params), then alternates
+    probe trials A,B,A,B,... inside one session so chip-rate drift hits both
+    arms equally.  Records per-arm trial lists, medians, and spread; the
+    winner key is what the canonical record cites for the default."""
+    import jax
+
+    from django_assistant_bot_tpu.models import llama
+    from django_assistant_bot_tpu.parallel import get_mesh, shard_pytree
+    from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
+
+    cfg = _decoder_cfg()
+    params = llama.init_int8(cfg, jax.random.PRNGKey(0))
+    mesh = get_mesh()
+    with mesh:
+        params = shard_pytree(params, llama.logical_axes(cfg), mesh)
+    slots_a, slots_b = SLOTS, max(1, SLOTS // 2)
+    if slots_a == slots_b:
+        # BENCH_SLOTS=1: both arms collapse to the same geometry — the dict
+        # key would collide (leaking the first engine) and the "contrast"
+        # would probe one arm twice
+        return {"slots_ab_winner": slots_a, "slots_ab_default": SLOTS}
+    fill = DECODE_PROMPT_LEN + DECODE_NEW_TOKENS
+    engines = {}
+    out: dict = {}
+    try:
+        for slots in (slots_a, slots_b):
+            eng = GenerationEngine(
+                cfg,
+                params,
+                ByteTokenizer(),
+                max_slots=slots,
+                max_seq_len=min(1024, cfg.max_seq_len),
+                prefill_buckets=(_decode_bucket(),),
+                chunk_size=_decode_bucket(),
+                mesh=mesh,
+                prefix_cache_size=0,
+            )
+            eng.warmup()
+            eng.start()
+            engines[slots] = eng
+        samples: dict = {slots_a: [], slots_b: []}
+        for _ in range(trials):
+            for slots in (slots_a, slots_b):  # interleaved: A B A B A B
+                samples[slots].append(
+                    engines[slots].probe_decode(iters=8, fill_len=fill)
+                )
+        for slots, ss in samples.items():
+            ms = sorted(x * 1e3 for x in ss)
+            med = statistics.median(ms)
+            out[f"slots{slots}_step_ms_trials"] = [round(x, 3) for x in ms]
+            out[f"slots{slots}_step_ms_median"] = round(med, 3)
+            out[f"slots{slots}_step_ms_spread"] = round(ms[-1] - ms[0], 3)
+            out[f"slots{slots}_steady_tokens_per_s"] = round(slots / (med / 1e3), 2)
+        winner = max(
+            (slots_a, slots_b), key=lambda s: out[f"slots{s}_steady_tokens_per_s"]
+        )
+        ledger_b = decode_byte_ledger(engines[slots_b], fill_len=fill)
+    finally:
+        for eng in engines.values():
+            eng.stop()
+    return {
+        "decode_int8_slots_ab": out,
+        "slots_ab_winner": winner,
+        "slots_ab_default": SLOTS,
+        # contrast keys the r5 record established under the "slots16" name —
+        # the suffix tracks the ACTUAL B-arm geometry so a BENCH_SLOTS
+        # override can't record a different slot count under the 16 label
+        f"decode_int8_slots{slots_b}_steady_tokens_per_s": out[
+            f"slots{slots_b}_steady_tokens_per_s"
+        ],
+        f"decode_int8_slots{slots_b}_pure_step_ms": out[
+            f"slots{slots_b}_step_ms_median"
+        ],
+        f"decode_int8_slots{slots_b}_ledger": ledger_b,
+        # geometry-stable alias for the compact record: the suffixed key's
+        # name changes under a BENCH_SLOTS override, which would drop the
+        # B-arm headline from the bounded last-line record
+        "decode_int8_slots_b_steady_tokens_per_s": out[
+            f"slots{slots_b}_steady_tokens_per_s"
+        ],
+        "decode_int8_slots_b": slots_b,
+    }
 
 
 # Each device-using config section runs in its OWN subprocess: the chip is
@@ -1193,6 +1355,115 @@ for S in (8192, 16384, 32768):
     dt = (time.perf_counter() - t0) / 2
     out[f"longctx_prefill_{S}_tokens_per_s"] = round(S / dt, 1)
 print(json.dumps(out))
+"""
+
+
+def bench_longctx_decode(ctx: int = 16384, slots: int = 8) -> dict:
+    """Long-context DECODE (VERDICT r5 #7): tok/s and step cost at a 16k-token
+    allocated cache, length-bucketed KV read vs the full-cache read.
+
+    Two engines over ONE int8 1B param set (params are never donated), same
+    session: ``bucketed`` (decode_kv_chunk auto) and ``full`` (disabled).
+    Short traffic in the long-allocated cache is exactly the case the ledger
+    flagged — the full read streams all ``slots x ctx`` KV rows per step while
+    the valid context is ~200 tokens.  Probes are pinned at two fills (the
+    bench's short fill and 12k) so the win is recorded where it is large AND
+    where it tapers."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from django_assistant_bot_tpu.models import DecoderConfig, llama
+    from django_assistant_bot_tpu.parallel import get_mesh, shard_pytree
+    from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
+
+    if SMALL:
+        cfg = DecoderConfig.tiny()
+        ctx = min(ctx, cfg.max_seq_len)
+    else:
+        cfg = DecoderConfig(
+            vocab_size=128_256,
+            hidden_size=2048,
+            intermediate_size=8192,
+            num_layers=16,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=64,
+            max_seq_len=ctx,
+            dtype=jnp.bfloat16,
+        )
+        # int8 incl. embed/head: the 16k-ctx KV cache (~4.3 GB bf16 at 8
+        # slots) needs the weight-side headroom on a shared 16 GB chip
+    params = (
+        llama.init(cfg, jax.random.PRNGKey(0))
+        if SMALL
+        else llama.init_int8(cfg, jax.random.PRNGKey(0), quantize_embed=True)
+    )
+    mesh = get_mesh()
+    with mesh:
+        params = shard_pytree(params, llama.logical_axes(cfg), mesh)
+    rng = np.random.default_rng(9)
+    out: dict = {"longctx_decode_ctx": ctx, "longctx_decode_slots": slots}
+    fill_short = DECODE_PROMPT_LEN + DECODE_NEW_TOKENS
+    prompt_len = min(DECODE_PROMPT_LEN, ctx // 4)
+    for label, chunk in (("bucketed", 0), ("full", None)):
+        eng = GenerationEngine(
+            cfg,
+            params,
+            ByteTokenizer(),
+            max_slots=slots,
+            max_seq_len=ctx,
+            prefill_buckets=(128,),
+            chunk_size=128,
+            mesh=mesh,
+            prefix_cache_size=0,
+            decode_kv_chunk=chunk,
+        )
+        eng.warmup()
+        eng.start()
+        try:
+            prompts = [
+                rng.integers(1, 255, prompt_len).tolist() for _ in range(slots)
+            ]
+            futs = [eng.submit(p, max_tokens=8, temperature=0.8) for p in prompts]
+            [f.result(timeout=900) for f in futs]  # warm the loop/sampling
+            t0 = time.perf_counter()
+            futs = [
+                eng.submit(p, max_tokens=DECODE_NEW_TOKENS, temperature=0.8)
+                for p in prompts
+            ]
+            results = [f.result(timeout=900) for f in futs]
+            wall = time.perf_counter() - t0
+            out[f"longctx_decode_{label}_tokens_per_s"] = round(
+                sum(r.completion_tokens for r in results) / wall, 2
+            )
+            out[f"longctx_decode_{label}_step_ms_short"] = round(
+                eng.probe_decode(iters=6, fill_len=fill_short) * 1e3, 3
+            )
+            deep = min(12288, max(ctx // 2, ctx - 64))
+            out[f"longctx_decode_{label}_step_ms_deep"] = round(
+                eng.probe_decode(iters=6, fill_len=deep) * 1e3, 3
+            )
+            if label == "bucketed":
+                out["longctx_decode_kv_read_frac"] = eng.tick_stats()["kv_read_frac"]
+                out["longctx_decode_kv_chunk"] = eng.decode_kv_chunk or 0
+                out["longctx_decode_ledger"] = decode_byte_ledger(
+                    eng, fill_len=fill_short
+                )
+        finally:
+            eng.stop()
+    full_ms = out.get("longctx_decode_full_step_ms_short")
+    buck_ms = out.get("longctx_decode_bucketed_step_ms_short")
+    if full_ms and buck_ms:
+        out["longctx_decode_step_speedup_short"] = round(full_ms / buck_ms, 3)
+    return out
+
+
+_LONGCTX_DECODE_SNIPPET = """
+import json
+import bench
+
+print(json.dumps(bench.bench_longctx_decode()))
 """
 
 
@@ -1418,11 +1689,92 @@ def _build_record(extras: dict, box: dict) -> dict:
     return record
 
 
+# Headline keys for the bounded compact record, in PRIORITY order — when the
+# line would exceed the budget, keys drop from the END of this list first.
+# (VERDICT r5 #1: the full record outgrew the driver's 2,000-char tail window
+# twice, so the canonical artifact lost `rag_req_per_s` — the compact record
+# is what the driver's tail is guaranteed to capture.)
+_COMPACT_KEYS = (
+    "rag_req_per_s",
+    "rag_p50_ttft_s",
+    "embedding_docs_per_sec_per_chip",
+    "decode_tokens_per_s_per_chip",
+    "decode_steady_tokens_per_s",
+    "decode_kv_read_frac",
+    "decode_int8_steady_tokens_per_s",
+    "decode_int8_slots_b_steady_tokens_per_s",
+    "decode_int8_slots_b",
+    "slots_ab_winner",
+    "decode_8b_int8_tokens_per_s_per_chip",
+    "decode_8b_int8_fp8kv_tokens_per_s_per_chip",
+    "longctx_decode_bucketed_tokens_per_s",
+    "longctx_decode_full_tokens_per_s",
+    "longctx_decode_kv_read_frac",
+    "moe_decode_tokens_per_s_per_chip",
+    "moe_geometry",
+    "knn_build_cold_s",
+    "knn_build_warm_s",
+    "knn_query_batched_ms_per_query",
+    "ingest_docs_per_s_per_chip",
+    "real_ckpt_decode_tokens_per_s",
+    "longctx_prefill_32768_tokens_per_s",
+    "spec_decode_speedup",
+    "rag_turn2_p50_ttft_s",
+    "bench_elapsed_s",
+)
+
+_COMPACT_BUDGET = 1450  # chars; hard driver tail is 2000, issue asks < 1500
+
+
+def _sig4(v):
+    """4 significant digits for floats; everything else passes through.
+
+    Non-finite floats become None: json.dumps would emit bare ``NaN`` /
+    ``Infinity``, which strict parsers reject — the exact failure the
+    compact record exists to prevent."""
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, float):
+        return float(f"{v:.4g}") if math.isfinite(v) else None
+    return v
+
+
+def _compact_record(record: dict) -> str:
+    """The bounded-size summary line: headline + must-have keys, 4 sig figs.
+
+    Always < ~1,500 chars (keys drop lowest-priority-first if ever needed), so
+    the driver's 2,000-char stdout tail captures a parseable record whatever
+    the full record grew to."""
+    extras = record.get("extras", {})
+    compact: dict = {
+        "metric": record.get("metric"),
+        "value": _sig4(record.get("value")),
+        "vs_baseline": _sig4(record.get("vs_baseline")),
+    }
+    if record.get("error"):
+        compact["error"] = str(record["error"])[:180]
+    keys = [k for k in _COMPACT_KEYS if k in extras]
+    for k in keys:
+        compact[k] = _sig4(extras[k])
+    line = json.dumps(compact)
+    while len(line) > _COMPACT_BUDGET and keys:
+        compact.pop(keys.pop())  # drop from the tail of the priority list
+        line = json.dumps(compact)
+    return line
+
+
 def main() -> None:
     import threading
 
+    from django_assistant_bot_tpu.utils.compile_cache import (
+        enable_persistent_compile_cache,
+    )
+
     extras: dict = {}
     t_start = time.monotonic()
+    cache_dir = enable_persistent_compile_cache()
+    if cache_dir:
+        extras["compile_cache_dir"] = cache_dir
 
     def left() -> float:
         return BUDGET_S - (time.monotonic() - t_start)
@@ -1435,7 +1787,12 @@ def main() -> None:
     def emit() -> None:
         extras["bench_elapsed_s"] = round(time.monotonic() - t_start, 1)
         _finalize_vs_baseline(extras, box)
-        print(json.dumps(_build_record(extras, box)), flush=True)
+        record = _build_record(extras, box)
+        # full record first, bounded compact record LAST: the driver tails
+        # stdout, so whatever line the capture window ends on, the final one
+        # is always the parseable <1,500-char summary (VERDICT r5 #1)
+        print(json.dumps(record), flush=True)
+        print(_compact_record(record), flush=True)
 
     if SMALL:
         # CI/dev smoke: tiny shapes, one process (the CPU device isn't shared)
@@ -1444,6 +1801,7 @@ def main() -> None:
         baseline_thread.start()
         extras.update(bench_core())
         extras.update(bench_int8())
+        extras.update(bench_longctx_decode(slots=4))
         moe_eng, _ = _build_gen_engine(_moe_cfg(), buckets=(_decode_bucket(),))
         try:
             moe = bench_decode(moe_eng)
@@ -1489,7 +1847,11 @@ def main() -> None:
     extras.setdefault("section_s", {})["8b"] = round(time.monotonic() - t0, 1)
     emit()
     # 3) config 2b: int8 weight-only decode at 1B (halves decode HBM reads)
-    run("int8", _INT8_SNIPPET, cap_s=700)
+    #    + the interleaved 16-vs-32 slot A/B/A trials
+    run("int8", _INT8_SNIPPET, cap_s=900)
+    # 3b) long-context DECODE: 16k-allocated cache at 8 slots, bucketed KV
+    #     read vs full-cache read (the tentpole's canonical evidence)
+    run("longctx_decode", _LONGCTX_DECODE_SNIPPET, cap_s=700)
     # 4) config 4b: KNN at 1M-corpus scale (build/append/query latency)
     ecfg = _encoder_cfg()
     run(

@@ -69,7 +69,19 @@ def _ensure_loaded(name: str, kind: str):
     with _registry_lock:
         eng = getter(name)
         if eng is None:
-            reg.load(ModelSpec(name=name.lower(), kind=kind, tiny=True, dtype="float32"))
+            reg.load(
+                ModelSpec(
+                    name=name.lower(),
+                    kind=kind,
+                    tiny=True,
+                    dtype="float32",
+                    # a byte-tokenized RAG-enriched prompt easily exceeds the
+                    # tiny factory's 256-token context; dev-mode decoders get
+                    # room to actually answer (submit() truncates otherwise,
+                    # leaving ~1 token of generation headroom)
+                    max_seq_len=1024 if kind == "decoder" else None,
+                )
+            )
             eng = getter(name)
     return eng
 

@@ -138,16 +138,27 @@ def looks_like_repo_id(path: str) -> bool:
     advisor: it aborted the whole fetch run)."""
     if os.path.isabs(path) or path.startswith(("./", "../", "~")):
         return False
-    if os.path.isdir(path):
+    if os.path.exists(path):
+        # an existing file OR directory at the full path is always a local
+        # checkpoint, never a hub id
         return False
     # `models/foo.native` passes the org/name shape but is a local checkpoint
-    # convert_one will create: an existing first segment marks a relative
-    # path, and `.native` is this stack's converted-checkpoint suffix
-    if os.path.isdir(path.split("/", 1)[0]):
-        return False
+    # convert_one will create: `.native` is this stack's converted-checkpoint
+    # suffix.  An existing first segment alone is NOT a local marker — a
+    # `google/` directory in CWD must not silently swallow `google/gemma-2b`
+    # (the full path was already checked above); log the ambiguity instead.
     if ".native" in os.path.basename(path):
         return False
-    return bool(_REPO_ID_RE.fullmatch(path))
+    if not _REPO_ID_RE.fullmatch(path):
+        return False
+    first = path.split("/", 1)[0]
+    if os.path.isdir(first):
+        print(
+            f"note: {first!r} exists locally but {path!r} does not — "
+            f"treating it as a hub id (place a checkpoint at {path} to "
+            f"override)"
+        )
+    return True
 
 
 def _config_repo_ids(config_path: str) -> List[str]:

@@ -27,7 +27,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.attention import attention, dot_product_attention, gqa_dot_product_attention
+from ..ops.attention import (
+    attention,
+    chunked_gqa_decode_attention,
+    dot_product_attention,
+    gqa_dot_product_attention,
+)
 from ..ops.norms import rms_norm
 from ..ops.quant import QTensor, deq, qeinsum
 from ..ops.rope import apply_rope, rope_frequencies
@@ -926,8 +931,17 @@ def decode_step(
     cache: KVCache,
     *,
     active: Optional[jnp.ndarray] = None,  # [B] bool; inactive slots are frozen
+    kv_chunk: Optional[int] = None,  # static: chunked length-aware KV read
 ) -> tuple[jnp.ndarray, KVCache]:
-    """One autoregressive step for every active slot -> (logits [B,V] f32, cache)."""
+    """One autoregressive step for every active slot -> (logits [B,V] f32, cache).
+
+    ``kv_chunk`` (static) switches the attention read to the length-bucketed
+    chunked path (ops/attention.chunked_gqa_decode_attention): only cache
+    chunks up to the batch's maximum valid position are read, instead of the
+    whole allocated ``max_len`` every step — the decode-side analog of the
+    prefill flash kernel's chunked-KV discipline.  Must divide ``max_len``;
+    ``None`` (or a chunk >= ``max_len``) keeps the full-cache read.
+    """
     B = tokens.shape[0]
     if active is None:
         active = jnp.ones((B,), bool)
@@ -942,6 +956,12 @@ def decode_step(
 
     x = _embed(params, cfg, tokens)[:, None, :]  # [B,1,E]
     S = cache.max_len
+    if kv_chunk is not None and kv_chunk < S and (kv_chunk <= 0 or S % kv_chunk):
+        raise ValueError(
+            f"kv_chunk={kv_chunk} must divide cache max_len={S} "
+            "(or be None / >= max_len for the full-cache read)"
+        )
+    chunked = kv_chunk is not None and kv_chunk < S
     kpos = jnp.arange(S)[None, :]
     causal_keep = (kpos <= positions[:, None])[:, None, None, :]  # [B,1,1,S]
 
@@ -976,7 +996,13 @@ def decode_step(
             # grouped attention: the multi-GB slot cache is read ONCE per step
             # instead of being materialized q_per_kv-fold by a head repeat —
             # the decode path's dominant memory traffic after the weights
-            o = gqa_dot_product_attention(q, k_cache, v_cache, mask=attn_mask)  # [B,H,1,D]
+            if chunked:
+                o = chunked_gqa_decode_attention(
+                    q, k_cache, v_cache, positions,
+                    chunk=kv_chunk, active=active, window=window,
+                )  # [B,H,1,D]
+            else:
+                o = gqa_dot_product_attention(q, k_cache, v_cache, mask=attn_mask)  # [B,H,1,D]
             o = o.transpose(0, 2, 1, 3).reshape(B, 1, -1)
             x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
             h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)

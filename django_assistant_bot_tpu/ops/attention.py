@@ -94,6 +94,101 @@ def gqa_dot_product_attention(
     return out.reshape(B, H, Sq, D)
 
 
+def chunked_gqa_decode_attention(
+    q: jnp.ndarray,  # [B, H, 1, D]
+    k: jnp.ndarray,  # [B, KH, S, D] slot cache, storage dtype (bf16 / fp8)
+    v: jnp.ndarray,  # [B, KH, S, D]
+    positions: jnp.ndarray,  # [B] int32 — absolute position of each slot's query
+    *,
+    chunk: int,
+    active: Optional[jnp.ndarray] = None,  # [B] bool; inactive rows don't widen the read
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Length-aware decode attention: read the slot cache in fixed ``chunk``-wide
+    slices and SKIP every chunk past the batch's maximum valid position.
+
+    The static-shape decode path otherwise reads the whole allocated
+    ``[B, KH, S, D]`` cache every step — at 16k–32k allocated contexts serving
+    short/ragged traffic, most of that bandwidth is spent on invalid positions
+    (PERF.md's byte ledger: the KV read rivals the weights).  Here the chunk
+    count actually read is a *traced* ``fori_loop`` bound derived from
+    ``positions`` — one compiled program for every fill level (the "buckets"
+    are chunk multiples), no dynamic shapes, no recompiles.  Per-slot validity
+    inside the boundary chunk is handled by masking, exactly like the full
+    read.
+
+    Reduced-precision caches dequantize PER CHUNK: the ``astype`` sits on the
+    sliced operand inside the loop body, so XLA reads fp8 from HBM and upcasts
+    in registers/VMEM — never materializing a bf16-sized copy of the cache
+    (the fix for the fp8-KV bandwidth regression, VERDICT r5 #2).
+
+    Numerics: online softmax (flash discipline) with f32 running max/sum/acc —
+    equal to the full-cache softmax up to reduction order (tested to per-dtype
+    tolerance across ragged lengths and chunk boundaries).  A row whose band
+    starts past the first processed chunk self-corrects: its all-masked chunks
+    contribute with ``m = -inf`` and are zeroed by ``alpha = exp(-inf - m_new)``
+    once a live chunk arrives.
+    """
+    B, H, Sq, D = q.shape
+    if Sq != 1:
+        raise ValueError(f"decode attention expects Sq=1 queries, got {Sq}")
+    KH = k.shape[1]
+    S = k.shape[2]
+    if S % chunk:
+        raise ValueError(f"chunk={chunk} must divide cache length {S}")
+    G = H // KH
+    scale = D ** -0.5
+    if active is None:
+        active = jnp.ones((B,), bool)
+    qg = q.reshape(B, KH, G, D)
+
+    # chunks [lo, hi) cover every active row's valid keys; inactive rows are
+    # excluded so one stale long slot can't widen a short batch's read window
+    act_pos = jnp.where(active, positions, 0)
+    hi = jnp.max(act_pos) // chunk + 1
+    if window is not None:
+        # lowest key any active row may see: its position - window + 1
+        min_pos = jnp.min(jnp.where(active, positions, S))
+        lo = jnp.minimum(jnp.maximum(min_pos - window + 1, 0) // chunk, hi)
+    else:
+        lo = jnp.zeros((), hi.dtype)
+
+    def body(ci, carry):
+        m, l, acc = carry
+        start = ci * chunk
+        k_blk = jax.lax.dynamic_slice(k, (0, 0, start, 0), (B, KH, chunk, D))
+        v_blk = jax.lax.dynamic_slice(v, (0, 0, start, 0), (B, KH, chunk, D))
+        if k_blk.dtype != q.dtype:
+            # per-chunk dequant: a pure convert on the sliced operand, fused
+            # into the dot — the cache streams from HBM at its own width
+            k_blk = k_blk.astype(q.dtype)
+            v_blk = v_blk.astype(q.dtype)
+        s = jnp.einsum(
+            "bkgd,bksd->bkgs", qg, k_blk, preferred_element_type=jnp.float32
+        ) * scale  # [B, KH, G, chunk]
+        kpos = start + jnp.arange(chunk)
+        keep = kpos[None, :] <= positions[:, None]  # [B, chunk]
+        if window is not None:
+            keep &= kpos[None, :] > positions[:, None] - window
+        s = jnp.where(keep[:, None, None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jnp.einsum(
+            "bkgs,bksd->bkgd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((B, KH, G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, KH, G, 1), jnp.float32)
+    a0 = jnp.zeros((B, KH, G, D), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(lo, hi, body, (m0, l0, a0))
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    return out.reshape(B, H, 1, D)
+
+
 # ---------------------------------------------------------------------------
 # Pallas flash attention
 # ---------------------------------------------------------------------------

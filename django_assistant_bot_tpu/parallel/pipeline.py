@@ -40,10 +40,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import optax
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+from .sharding import compat_shard_map as shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import llama
@@ -112,13 +109,18 @@ def pipeline_forward(
     rest = {k: v for k, v in params.items() if k != "layers"}
     ids_mb = input_ids.reshape(n_micro, B // n_micro, S)
 
-    out = shard_map(
-        spmd,
-        mesh=mesh,
-        in_specs=(P(PIPE_AXIS), P(), P(None, DATA_AXIS)),
-        out_specs=P(None, DATA_AXIS),
-        check_vma=False,
-    )(layers, rest, ids_mb)
+    # the body runs fully manual — suppress the model code's logical-axis
+    # constraints while it traces (older jax rejects them at lowering)
+    from .sharding import constraints_disabled
+
+    with constraints_disabled():
+        out = shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(PIPE_AXIS), P(), P(None, DATA_AXIS)),
+            out_specs=P(None, DATA_AXIS),
+            check_vma=False,
+        )(layers, rest, ids_mb)
     return out.reshape(B, S, -1)
 
 

@@ -8,6 +8,8 @@ recipe: pick a mesh, annotate shardings, let XLA insert the collectives.
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Any, Mapping, Optional
 
 import jax
@@ -30,6 +32,25 @@ DEFAULT_RULES: Mapping[str, Optional[str]] = {
     "vocab_in": None,
     "pos": None,
 }
+
+
+def compat_shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes it at the top level with ``check_vma``; older releases
+    (<= 0.4.x) ship ``jax.experimental.shard_map.shard_map`` where the same
+    knob is called ``check_rep``.  Callers use the new spelling; this shim
+    keeps the package importable (and the 8-device CPU test mesh green) on
+    both."""
+    try:
+        sm = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm_old
+
+        return sm_old(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+        )
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma)
 
 
 def logical_to_pspec(
@@ -124,12 +145,35 @@ def shard_pytree(
     return jax.tree.map(put, params, shardings)
 
 
+_constraints_off = threading.local()
+
+
+@contextlib.contextmanager
+def constraints_disabled():
+    """Suppress :func:`with_constraint` in this thread's dynamic extent.
+
+    Inside a ``shard_map`` body every mesh axis is manual and the body is
+    already explicitly partitioned — the logical-axis constraints the model
+    code emits are advisory there at best, and older jax rejects them at
+    LOWERING time ("axis ... also found in manual_axes"), where the call-site
+    try/except below can't reach.  Wrapping the shard_map call keeps the
+    primitive out of the trace entirely."""
+    prev = getattr(_constraints_off, "depth", 0)
+    _constraints_off.depth = prev + 1
+    try:
+        yield
+    finally:
+        _constraints_off.depth = prev
+
+
 def with_constraint(
     x: jax.Array,
     logical_axes: tuple[Optional[str], ...],
     rules: Mapping[str, Optional[str]] = DEFAULT_RULES,
 ) -> jax.Array:
     """`with_sharding_constraint` by logical axis names (no-op outside jit/mesh)."""
+    if getattr(_constraints_off, "depth", 0):
+        return x
     try:
         return jax.lax.with_sharding_constraint(x, logical_to_pspec(logical_axes, rules))
     except (ValueError, RuntimeError):

@@ -188,6 +188,7 @@ class GenerationEngine:
         prefix_cache_max_bytes: int = 1 << 30,
         kv_cache_dtype: Optional[str] = None,
         speculative: int = 0,
+        decode_kv_chunk: Optional[int] = 0,
         mesh=None,
     ):
         self.cfg = cfg
@@ -279,6 +280,15 @@ class GenerationEngine:
                 f"expected one of {sorted(k for k in KV_CACHE_DTYPES if k)}"
             )
         self.kv_cache_dtype = KV_CACHE_DTYPES[kv_cache_dtype]
+        # Length-aware decode attention: read the slot cache in `decode_kv_chunk`
+        # -wide slices and skip chunks past the batch's max valid position
+        # (models/llama.decode_step kv_chunk -> ops/attention.
+        # chunked_gqa_decode_attention).  0 = auto (largest of 512/256/128 that
+        # divides max_seq_len, when that leaves >= 2 chunks); None disables —
+        # the full-cache read.  The per-tick fraction actually read is tracked
+        # host-side and reported as ``kv_read_frac`` in :meth:`tick_stats`.
+        self.decode_kv_chunk = self._resolve_kv_chunk(decode_kv_chunk)
+        self._kv_frac_sum = 0.0
         self.mesh = mesh
         self._cache_shardings = (
             llama.cache_shardings(cfg, mesh, max_slots) if mesh is not None else None
@@ -436,6 +446,7 @@ class GenerationEngine:
         from ..ops.attention import NEG_INF
 
         cfg_c, top_k_c, burst_c = self.cfg, self.top_k, self.burst
+        kv_chunk_c = self.decode_kv_chunk
 
         def tick(params, tokens, cache, active, temps, top_ps, rng,
                  fsm_s=None, jmask=None, next_tab=None, allowed_tab=None):
@@ -454,7 +465,7 @@ class GenerationEngine:
                 p = jax.lax.optimization_barrier(params) if burst_c > 1 else params
                 rng, sub = jax.random.split(rng)
                 logits, cache = llama.decode_step(
-                    p, cfg_c, tokens, cache, active=active
+                    p, cfg_c, tokens, cache, active=active, kv_chunk=kv_chunk_c
                 )
                 if json_mode:
                     ok = allowed_tab[fsm_s]  # [B, V]
@@ -568,9 +579,17 @@ class GenerationEngine:
             # the KV-cache discipline), and the draft search never reads past
             # the valid length
             row_tokens = jnp.concatenate([tokens[:, None], out], axis=1)
-            upd = jax.vmap(
-                lambda h, t, p: jax.lax.dynamic_update_slice(h, t, (p,))
-            )(history, row_tokens, jnp.minimum(cache.lengths, S - (K + 2)))
+            # gather+where instead of a vmapped dynamic_update_slice: the
+            # per-row scatter that vmap lowers to trips this jaxlib's HLO
+            # verifier (broadcast rank RET_CHECK) on CPU; the masked gather
+            # writes the identical window and lowers everywhere
+            pos = jnp.minimum(cache.lengths, S - (K + 2))  # [B]
+            rel = jnp.arange(S)[None, :] - pos[:, None]  # [B,S]
+            in_window = (rel >= 0) & (rel < K + 2)
+            gathered = jnp.take_along_axis(
+                row_tokens, jnp.clip(rel, 0, K + 1), axis=1
+            )
+            upd = jnp.where(in_window, gathered, history)
             history = jnp.where(active[:, None], upd, history)
             new_len = jnp.where(
                 active, jnp.minimum(cache.lengths + n_new, S), cache.lengths
@@ -1055,6 +1074,44 @@ class GenerationEngine:
                 )
             jax.block_until_ready(last)
 
+    def _resolve_kv_chunk(self, decode_kv_chunk: Optional[int]) -> Optional[int]:
+        """Concrete decode KV chunk width, or None for the full-cache read.
+
+        0 = auto: the largest of (512, 256, 128) that divides ``max_seq_len``
+        into at least 2 chunks — below that the "chunked" read covers the whole
+        cache anyway and the plain path has one fewer loop."""
+        if decode_kv_chunk is None:
+            return None
+        if decode_kv_chunk == 0:
+            for c in (512, 256, 128):
+                if self.max_seq_len % c == 0 and self.max_seq_len // c >= 2:
+                    return c
+            return None
+        c = int(decode_kv_chunk)
+        if c <= 0 or self.max_seq_len % c or self.max_seq_len // c < 2:
+            raise ValueError(
+                f"decode_kv_chunk={decode_kv_chunk} must divide "
+                f"max_seq_len={self.max_seq_len} into >= 2 chunks "
+                f"(or be 0=auto / None=disabled)"
+            )
+        return c
+
+    def _kv_read_frac(self) -> float:
+        """Host-side mirror of the device's chunked-read window for THIS tick:
+        chunks covering the longest live slot / total chunks.  An estimate (a
+        burst advances positions mid-tick; in-flight speculation lags a little),
+        but it tracks the device's traced ``hi`` bound to within one chunk."""
+        c = self.decode_kv_chunk
+        if not c:
+            return 1.0
+        n_chunks = self.max_seq_len // c
+        mx = 0
+        for s in self._slots:
+            if s is not None:
+                pos = len(s.request.prompt_ids) + len(s.generated)
+                mx = max(mx, min(pos, self.max_seq_len - 1))
+        return (mx // c + 1) / n_chunks
+
     def _batch_buckets(self) -> tuple:
         """Prefill batch-dim buckets: {1, 4, max_slots} — a whole admission wave
         prefills in ONE dispatch while the compiled-shape space stays 3 x
@@ -1324,6 +1381,12 @@ class GenerationEngine:
             "ticks": self._ticks_issued,
             "issue_ms": round(self._tick_issue_s / n * 1e3, 3),
             "block_ms": round(self._tick_block_s / max(1, self._ticks_processed) * 1e3, 3),
+            # average fraction of the allocated KV cache the decode attention
+            # actually read (< 1 whenever live contexts are shorter than the
+            # allocation and the chunked read is on; 1.0 with it disabled)
+            "kv_read_frac": round(self._kv_frac_sum / n, 4)
+            if self._ticks_issued
+            else 1.0,
         }
         if self.speculative:
             out["spec_drafted"] = self.spec_drafted
@@ -1333,15 +1396,24 @@ class GenerationEngine:
             )
         return out
 
-    def probe_decode(self, iters: int = 16) -> float:
+    def probe_decode(self, iters: int = 16, fill_len: Optional[int] = None) -> float:
         """Pure device decode rate: `iters` burst ticks issued back-to-back with
         device-chained state, one block at the end -> seconds per STEP (not per
         burst).  Separates the model's on-device step cost from engine/host
-        overhead — the roofline denominator.  All slots inactive, so cache
-        lengths don't advance and engine state stays sound; the loop-iteration
-        lock excludes the engine thread for the probe's whole duration, so a
-        request submitted mid-probe waits in the queue instead of racing the
-        probe over the donated cache.
+        overhead — the roofline denominator.  The loop-iteration lock excludes
+        the engine thread for the probe's whole duration, so a request
+        submitted mid-probe waits in the queue instead of racing the probe over
+        the donated cache.
+
+        ``fill_len=None`` probes with every slot inactive (cache lengths don't
+        advance) — with the length-bucketed decode read that measures a
+        near-empty cache, so callers wanting the cost at a *given* context fill
+        pass ``fill_len``: the probe sets every free slot's cache length there
+        and runs the ticks active, so the chunked attention reads the same KV
+        window real traffic at that fill would.  Lengths advance by
+        ``iters * burst`` and are reset to 0 afterwards; the garbage K/V the
+        active probe writes sits beyond every future request's valid length
+        until overwritten — the cache discipline decode already relies on.
 
         Waits up to 10 s for the loop to drain its speculative lookahead ticks
         (requests resolve `lookahead` ticks before the deque empties)."""
@@ -1355,33 +1427,72 @@ class GenerationEngine:
                 raise RuntimeError("probe_decode requires an idle engine")
             time.sleep(0.01)
         try:
-            return self._probe_decode_locked(iters)
+            return self._probe_decode_locked(iters, fill_len)
         finally:
             self._iter_lock.release()
 
-    def _probe_decode_locked(self, iters: int) -> float:
+    def _set_cache_lengths(self, values) -> None:
+        lens = jnp.asarray(values, jnp.int32)
+        if self._cache_shardings is not None:
+            lens = jax.device_put(lens, self._cache_shardings.lengths)
+        self._cache = self._cache._replace(lengths=lens)
+
+    def _probe_decode_locked(self, iters: int, fill_len: Optional[int]) -> float:
+        self._refresh_sampling()
+        active = self._active_dev
+        if fill_len is not None:
+            # keep headroom so rows stay active (unfrozen) for the whole probe:
+            # the warm tick below also advances lengths by one burst, hence
+            # iters + 1 — under-reserving would freeze rows mid-final-tick and
+            # silently time near-idle micro-steps
+            fill = max(
+                0,
+                min(int(fill_len), self.max_seq_len - (iters + 1) * self.burst - 2),
+            )
+            self._set_cache_lengths(np.full((self.max_slots,), fill, np.int32))
+            active = jnp.ones((self.max_slots,), bool)
+        try:
+            return self._probe_decode_timed(iters, active)
+        finally:
+            if fill_len is not None:
+                # every slot is free (probe requires an idle engine): stale
+                # lengths carry no meaning, and zeroing keeps the next live
+                # batch's chunked read window minimal.  In a finally so a
+                # mid-probe dispatch error can't leave phantom fill lengths
+                # widening every later batch's read window.
+                self._set_cache_lengths(np.zeros((self.max_slots,), np.int32))
+
+    def _probe_decode_timed(self, iters: int, active) -> float:
         import numpy as _np
 
-        self._refresh_sampling()
         with self._mesh_scope():
             # one warm call (jit cache is hot after warmup(); cheap regardless)
             toks, last, self._cache, self._rng = self._decode_tick(
-                self.params, self._tokens_dev, self._cache, self._active_dev,
+                self.params, self._tokens_dev, self._cache, active,
                 self._temps_dev, self._top_ps_dev, self._rng,
             )
             self._tokens_dev = last
             _np.asarray(toks)  # fetch: the only barrier this backend honors
-            # one empty-pipeline fetch bounds the tunnel RTT so it can be
+            # empty-pipeline fetches bound the tunnel RTT so it can be
             # subtracted from the timed chain below (block_until_ready has
             # been observed returning early on remote backends — a fetch of
-            # the final chained value is the trustworthy sync)
-            t0 = time.monotonic()
-            _np.asarray(self._tokens_dev)
-            rtt = time.monotonic() - t0
+            # the final chained value is the trustworthy sync).  Min of 3
+            # samples: a single slow probe (GC pause, tunnel hiccup) would
+            # over-subtract and overstate steady tok/s up to 2x (ADVICE r5).
+            # each sample must be a FRESH device round-trip: re-fetching the
+            # same jax.Array reads its cached host value (~us) and would
+            # collapse rtt to ~0, disabling the subtraction entirely.  A tiny
+            # elementwise op forces a new array per sample; the one-time
+            # compile of that op is absorbed by the min.
+            rtt = float("inf")
+            for _ in range(3):
+                t0 = time.monotonic()
+                _np.asarray(self._tokens_dev + 0)
+                rtt = min(rtt, time.monotonic() - t0)
             t0 = time.monotonic()
             for _ in range(iters):
                 toks, last, self._cache, self._rng = self._decode_tick(
-                    self.params, self._tokens_dev, self._cache, self._active_dev,
+                    self.params, self._tokens_dev, self._cache, active,
                     self._temps_dev, self._top_ps_dev, self._rng,
                 )
                 self._tokens_dev = last
@@ -1434,6 +1545,7 @@ class GenerationEngine:
         self.steps += self.burst
         self._tick_issue_s += time.monotonic() - t0
         self._ticks_issued += 1
+        self._kv_frac_sum += self._kv_read_frac()
         live = [
             (i, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None
         ]
@@ -1465,6 +1577,7 @@ class GenerationEngine:
         self.steps += 1
         self._tick_issue_s += time.monotonic() - t0
         self._ticks_issued += 1
+        self._kv_frac_sum += 1.0  # verify_step reads the full cache row
         live = [
             (i, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None
         ]

@@ -48,8 +48,20 @@ class ModelSpec:
     # prompt-lookup speculative decoding: K on-device n-gram draft tokens
     # verified per tick (greedy rows advance up to K+1 tokens/tick,
     # bit-identical output; ops/speculative.py).  Excludes json_format
-    # traffic on this model entry.
+    # traffic on this model entry.  NOTE on sampled traffic: only greedy
+    # (temperature == 0) rows accept drafts — sampled rows pay the
+    # (K+1)-position verify forward every tick with near-zero acceptance,
+    # i.e. they decode SLOWER than plain ticks (measured 0.24x single-stream
+    # at K=6 / ~5% acceptance, PERF.md).  Enable only on model entries whose
+    # traffic is greedy and copy-from-context shaped; watch `spec_accept_rate`
+    # in tick_stats before keeping it on.
     speculative: int = 0
+    # length-aware decode attention: KV-cache chunk width for the bucketed
+    # decode read (serving/engine.py decode_kv_chunk).  0 = auto (512/256/128,
+    # whichever divides max_seq_len into >= 2 chunks), None/"off" disables —
+    # every decode step then reads the whole allocated max_slots x max_seq_len
+    # cache regardless of live lengths.
+    decode_kv_chunk: Optional[int] = 0
     # compile every (batch, seq) prefill/activation shape + decode ticks at
     # load time instead of on first traffic (GenerationEngine.warmup) — slower
     # boot, no multi-second serve-time compile stalls.  warmup_json also
@@ -173,6 +185,14 @@ class ModelRegistry:
                 cfg, params = load_decoder(spec.path, dtype=dtype)
             elif spec.tiny:
                 cfg = DecoderConfig.tiny(num_experts=spec.num_experts)
+                if spec.max_seq_len and spec.max_seq_len > cfg.max_seq_len:
+                    # synthetic tiny models have no pretrained context limit:
+                    # let the spec RAISE it (the engine clamps max_seq_len to
+                    # cfg.max_seq_len, so without this a tiny model is stuck
+                    # at the factory's 256 no matter what the config asks for)
+                    cfg = dataclasses.replace(
+                        cfg, max_seq_len=int(spec.max_seq_len)
+                    )
                 params = llama.init(cfg, jax.random.key(0))
             else:
                 raise ValueError(f"model {name}: need path, checkpoint, or tiny=true")
@@ -198,6 +218,10 @@ class ModelRegistry:
                 prefix_cache_max_bytes=spec.prefix_cache_max_bytes,
                 kv_cache_dtype=spec.kv_cache_dtype,
                 speculative=spec.speculative,
+                decode_kv_chunk=(
+                    None if spec.decode_kv_chunk in (None, "off")
+                    else int(spec.decode_kv_chunk)
+                ),
                 mesh=self.mesh,
             )
             if spec.warmup or spec.warmup_json:

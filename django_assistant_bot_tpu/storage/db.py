@@ -6,10 +6,13 @@ The default database lives at ``$DABT_DB_PATH`` (or ``./dabt.sqlite3``).  Tests 
 
 from __future__ import annotations
 
+import logging
 import os
 import sqlite3
 import threading
 from typing import Iterable, Optional
+
+logger = logging.getLogger(__name__)
 
 
 class Database:
@@ -26,7 +29,25 @@ class Database:
         if conn is None:
             conn = sqlite3.connect(self.path, timeout=30.0)
             conn.row_factory = sqlite3.Row
-            conn.execute("PRAGMA journal_mode=WAL")
+            try:
+                mode = conn.execute("PRAGMA journal_mode=WAL").fetchone()[0]
+            except sqlite3.OperationalError:
+                # switching journal modes needs an exclusive lock and can
+                # return SQLITE_BUSY immediately (bypassing the busy handler)
+                # when another thread's write txn is open at connect time.
+                # WAL is a persistent property of the database FILE — when a
+                # prior connection set it, this connection joins that mode.
+                mode = conn.execute("PRAGMA journal_mode").fetchone()[0]
+            if str(mode).lower() != "wal":
+                # a busy race on a BRAND-NEW file can leave no connection
+                # having set WAL at all — rollback-journal mode silently
+                # degrades reader/writer concurrency, so make it visible
+                logger.warning(
+                    "sqlite %s running in %s journal mode (WAL switch was "
+                    "busy); reader/writer concurrency is degraded",
+                    self.path,
+                    mode,
+                )
             conn.execute("PRAGMA synchronous=NORMAL")
             conn.execute("PRAGMA foreign_keys=ON")
             self._local.conn = conn

@@ -634,8 +634,10 @@ def _sharded_topk(mesh, index: jnp.ndarray, queries: jnp.ndarray, valid: jnp.nda
             i_fin = jnp.take_along_axis(i_all, pos, axis=1)
             return s_fin, i_fin
 
+        from ..parallel.sharding import compat_shard_map
+
         fn = jax.jit(
-            jax.shard_map(
+            compat_shard_map(
                 local_merge,
                 mesh=mesh,
                 in_specs=(P("data", None), P(None, None), P("data")),

@@ -100,6 +100,50 @@ def test_transient_compile_failure_retries_once(monkeypatch):
     assert res is None and "s_transient" not in extras  # non-transient: no retry
 
 
+def test_compact_record_is_bounded_and_parseable():
+    """The LAST stdout line must always fit the driver's 2,000-char tail and
+    carry the headline (VERDICT r5 #1: two rounds of `parsed: null`)."""
+    import json
+
+    extras = {k: 1234.5678 for k in bench._COMPACT_KEYS}
+    extras["rag_req_per_s"] = 9.87654
+    record = {
+        "metric": "rag_req_per_s_plus_p50_ttft",
+        "value": 9.87654,
+        "unit": "req/s",
+        "vs_baseline": 171.959,
+        "extras": extras,
+    }
+    line = bench._compact_record(record)
+    assert len(line) < 1500
+    parsed = json.loads(line)
+    assert parsed["rag_req_per_s"] == 9.877  # 4 sig figs
+    assert parsed["value"] == 9.877
+    # a pathologically bloated extras set still fits: low-priority keys drop,
+    # the headline survives
+    extras["moe_geometry"] = "x" * 4000
+    line = bench._compact_record(record)
+    assert len(line) < 1500
+    assert "rag_req_per_s" in json.loads(line)
+
+
+def test_compact_record_carries_error_headline():
+    import json
+
+    record = {"metric": "m", "value": None, "vs_baseline": None,
+              "error": "core section produced no result (yet)", "extras": {}}
+    parsed = json.loads(bench._compact_record(record))
+    assert "core section" in parsed["error"]
+
+
+def test_sig4_rounding():
+    assert bench._sig4(1234.5678) == 1235.0
+    assert bench._sig4(0.0123456) == 0.01235
+    assert bench._sig4(12) == 12  # ints pass through
+    assert bench._sig4("str") == "str"
+    assert bench._sig4(True) is True
+
+
 def test_transient_predicate_excludes_deterministic_compile_failures():
     """Only connection-drop signatures retry; a deterministic remote-compile
     failure (e.g. VMEM OOM) must not burn a second full attempt."""

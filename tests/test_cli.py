@@ -189,3 +189,42 @@ def test_fetch_models_continues_past_failures(tmp_path, monkeypatch, capsys):
     rc = fm.run(args)
     assert calls == ["org/bad", "org/good"]  # kept going past the failure
     assert rc == 1  # but the run still reports it
+
+
+def test_fetch_models_hub_id_not_swallowed_by_local_dir(tmp_path, monkeypatch, capsys):
+    """A `google/` directory in CWD must not silently drop `google/gemma-2b`
+    (ADVICE r5): only an EXISTING full path (or a .native convert target) is a
+    local marker; the ambiguous case is logged and treated as a hub id."""
+    from django_assistant_bot_tpu.cli import fetch_models as fm
+
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "google").mkdir()
+    assert fm.looks_like_repo_id("google/gemma-2b")
+    assert "treating it as a hub id" in capsys.readouterr().out
+    # a not-yet-created converted checkpoint under an existing dir stays local
+    (tmp_path / "models").mkdir()
+    assert not fm.looks_like_repo_id("models/foo.native")
+    # an existing full path stays local (no note)
+    (tmp_path / "google" / "ckpt").mkdir()
+    assert not fm.looks_like_repo_id("google/ckpt")
+    assert "treating it as a hub id" not in capsys.readouterr().out
+
+
+def test_persistent_compile_cache_wiring(tmp_path, monkeypatch):
+    """enable_persistent_compile_cache points jax at the dir, creates it, and
+    honors the opt-out env; failures must degrade to None, never raise."""
+    import jax
+
+    from django_assistant_bot_tpu.utils import compile_cache as cc
+
+    prev = jax.config.jax_compilation_cache_dir
+    target = tmp_path / "xla-cache"
+    try:
+        got = cc.enable_persistent_compile_cache(str(target))
+        assert got == str(target)
+        assert target.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(target)
+        monkeypatch.setenv(cc.ENV_DISABLE, "1")
+        assert cc.enable_persistent_compile_cache(str(target)) is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
