@@ -204,6 +204,7 @@ def _build_gen_engine(
     kv_dtype=None,
     max_slots=None,
     speculative=0,
+    scheduler=None,
 ):
     max_slots = max_slots or SLOTS
     import jax
@@ -241,6 +242,7 @@ def _build_gen_engine(
         prefix_cache_size=prefix_cache,
         kv_cache_dtype=kv_dtype,
         speculative=speculative,
+        scheduler=scheduler,
     )
     # compile every (batch, seq) prefill shape BEFORE measuring; the decode-only
     # engines are built with just the bucket their prompts hit (same bucket the
@@ -1213,6 +1215,135 @@ print(json.dumps(bench.bench_ingest_only()))
 
 
 # --------------------------------------------------------------------- baselines
+def bench_overload() -> dict:
+    """Overload section: arrival rate above decode capacity, mixed
+    interactive/background traffic, FIFO vs the admission-controlled
+    scheduler on the SAME trace (serving/scheduler.py).
+
+    The trace floods the engine with background requests (the ingestion
+    burst), then submits interactive dialog turns.  Measured per arm:
+    interactive p50/p95 queue wait (TTFT — submit to first token).  The
+    scheduler arm additionally demonstrates the overload contract: excess
+    background load sheds with a Retry-After hint instead of queueing
+    unboundedly, and an expired-deadline request frees its decode slot
+    mid-decode (reclaim latency recorded next to the per-tick time)."""
+    from django_assistant_bot_tpu.serving import (
+        DeadlineExceeded,
+        RequestScheduler,
+        SchedulerConfig,
+        SchedulerRejected,
+    )
+
+    import numpy as np
+
+    n_bg, n_int = 20, 8
+    bg_tokens, int_tokens = 48, 8
+    rng = np.random.default_rng(7)
+    bg_prompts = [rng.integers(1, 255, 24).tolist() for _ in range(n_bg)]
+    int_prompts = [rng.integers(1, 255, 24).tolist() for _ in range(n_int)]
+
+    def drive(eng) -> dict:
+        # warm the loop (shapes are compiled by engine.warmup())
+        eng.submit([1, 2, 3], max_tokens=4, temperature=0.0).result(timeout=600)
+        arm: dict = {"shed": 0, "retry_after_s": None, "int_retries": 0}
+        bg_futs = []
+        for p in bg_prompts:
+            try:
+                bg_futs.append(
+                    eng.submit(p, max_tokens=bg_tokens, temperature=0.8,
+                               priority="background", tenant="ingest")
+                )
+            except SchedulerRejected as e:
+                arm["shed"] += 1
+                arm["retry_after_s"] = e.retry_after_s
+        int_futs = []
+        for p in int_prompts:
+            # interactive clients honor Retry-After (the provider-layer retry
+            # policy, ai/providers/http_service.py) — bounded re-submission
+            for _ in range(100):
+                try:
+                    int_futs.append(
+                        eng.submit(p, max_tokens=int_tokens, temperature=0.8,
+                                   priority="interactive", tenant="dialog")
+                    )
+                    break
+                except SchedulerRejected as e:
+                    arm["int_retries"] += 1
+                    time.sleep(min(0.2, e.retry_after_s))
+            else:
+                arm["int_never_admitted"] = arm.get("int_never_admitted", 0) + 1
+        int_waits = sorted(f.result(timeout=1200).ttft_s for f in int_futs)
+        for f in bg_futs:
+            try:
+                f.result(timeout=1200)
+            except (SchedulerRejected, DeadlineExceeded):
+                pass
+        arm["bg_done"] = len(bg_futs)
+        arm["p50"] = statistics.median(int_waits)
+        arm["p95"] = int_waits[min(len(int_waits) - 1, math.ceil(0.95 * len(int_waits)) - 1)]
+        return arm
+
+    out: dict = {}
+    # arm A: legacy unbounded FIFO (scheduler=None)
+    eng, _ = _build_gen_engine(max_slots=4, buckets=(32,))
+    try:
+        fifo = drive(eng)
+    finally:
+        eng.stop()
+    # arm B: admission-controlled scheduler, bounded queue.  Degradation and
+    # the estimated-wait test are off so the contrast isolates ordering +
+    # depth-bound shedding; the knobs get their own coverage in tests.
+    sched = RequestScheduler(
+        SchedulerConfig(max_queue=12, admit_max_wait_s=None, degrade_at=1.0)
+    )
+    eng, _ = _build_gen_engine(max_slots=4, buckets=(32,), scheduler=sched)
+    try:
+        s = drive(eng)
+        # deadline reclaim: a deliberately-too-tight deadline on a warm
+        # engine; the slot must come back within ~a decode tick
+        t0 = time.perf_counter()
+        fut = eng.submit([9] * 16, max_tokens=512, temperature=0.0, deadline_s=0.05)
+        try:
+            fut.result(timeout=600)
+            out["overload_deadline_reclaimed"] = False
+        except DeadlineExceeded:
+            out["overload_deadline_reclaimed"] = True
+            out["overload_deadline_reclaim_s"] = round(
+                max(0.0, time.perf_counter() - t0 - 0.05), 4
+            )
+        stats = eng.tick_stats()
+    finally:
+        eng.stop()
+    out.update(
+        {
+            "overload_fifo_interactive_p50_wait_s": round(fifo["p50"], 4),
+            "overload_fifo_interactive_p95_wait_s": round(fifo["p95"], 4),
+            "overload_sched_interactive_p50_wait_s": round(s["p50"], 4),
+            "overload_sched_interactive_p95_wait_s": round(s["p95"], 4),
+            "overload_interactive_p95_speedup": round(
+                fifo["p95"] / max(1e-9, s["p95"]), 2
+            ),
+            "overload_shed": s["shed"],
+            "overload_retry_after_s": round(s["retry_after_s"], 3)
+            if s["retry_after_s"] is not None
+            else None,
+            "overload_interactive_retries": s["int_retries"],
+            "overload_bg_requests": n_bg,
+            "overload_interactive_requests": n_int,
+            "overload_reclaimed_slots": stats.get("reclaimed_slots", 0),
+            "overload_sched_wait_stats": stats.get("sched", {}).get("wait", {}),
+        }
+    )
+    return out
+
+
+_OVERLOAD_SNIPPET = """
+import json
+import bench
+print(json.dumps(bench.bench_overload()))
+"""
+
+
 def baseline_embedding_torch_cpu() -> float:
     """Reference serving path: per-text torch forward loop (unbatched), CPU."""
     import torch
@@ -1719,6 +1850,11 @@ _COMPACT_KEYS = (
     "real_ckpt_decode_tokens_per_s",
     "longctx_prefill_32768_tokens_per_s",
     "spec_decode_speedup",
+    "overload_interactive_p95_speedup",
+    "overload_fifo_interactive_p95_wait_s",
+    "overload_sched_interactive_p95_wait_s",
+    "overload_shed",
+    "overload_deadline_reclaim_s",
     "rag_turn2_p50_ttft_s",
     "bench_elapsed_s",
 )
@@ -1810,6 +1946,7 @@ def main() -> None:
         finally:
             moe_eng.stop()
         extras.update(bench_ingestion())
+        extras.update(bench_overload())
         baseline_thread.join(timeout=600)
         emit()
         return
@@ -1852,6 +1989,10 @@ def main() -> None:
     # 3b) long-context DECODE: 16k-allocated cache at 8 slots, bucketed KV
     #     read vs full-cache read (the tentpole's canonical evidence)
     run("longctx_decode", _LONGCTX_DECODE_SNIPPET, cap_s=700)
+    # 3c) overload: FIFO vs admission-controlled scheduler on the same
+    #     above-capacity mixed trace (interactive p50/p95 wait, shed + 429
+    #     contract, deadline slot reclaim — serving/scheduler.py evidence)
+    run("overload", _OVERLOAD_SNIPPET, cap_s=400)
     # 4) config 4b: KNN at 1M-corpus scale (build/append/query latency)
     ecfg = _encoder_cfg()
     run(

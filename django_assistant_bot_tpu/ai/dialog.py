@@ -10,9 +10,9 @@ from .services.ai_service import get_ai_provider
 
 
 class AIDialog(AIProvider):
-    def __init__(self, model: str):
+    def __init__(self, model: str, *, priority: str = "interactive", tenant: str = "default"):
         self._model = model
-        self._provider = get_ai_provider(model)
+        self._provider = get_ai_provider(model, priority=priority, tenant=tenant)
 
     async def prompt(self, context: str, role: str = "user", **kwargs) -> AIResponse:
         return await self._provider.get_response(

@@ -8,18 +8,60 @@ reference's torch microservice and this framework's own TPU server
 
 from __future__ import annotations
 
-from typing import List
+import asyncio
+import logging
+from typing import List, Optional
 
 import aiohttp
 
 from ..domain import AIResponse, Message
 from .base import AIEmbedder, AIProvider, approx_tokens, parse_json_response
 
+logger = logging.getLogger(__name__)
+
+# load-shed (429) retry policy: bounded attempts, Retry-After-honoring sleeps
+SHED_RETRIES = 3
+SHED_MAX_SLEEP_S = 10.0
+
+
+async def _post_with_shed_retry(session, url: str, payload: dict):
+    """POST, honoring 429 + ``Retry-After`` from the scheduler's load shedding:
+    sleep the hinted back-off (capped) and retry a bounded number of times;
+    a still-shedding server surfaces the final 429 to the caller."""
+    for attempt in range(SHED_RETRIES + 1):
+        resp = await session.post(url, json=payload)
+        if resp.status != 429 or attempt == SHED_RETRIES:
+            resp.raise_for_status()
+            return resp
+        try:
+            retry_after = float(resp.headers.get("Retry-After", "1"))
+        except ValueError:
+            retry_after = 1.0
+        resp.release()
+        logger.info(
+            "%s shed the request (429); retrying in %.1fs (%d/%d)",
+            url, retry_after, attempt + 1, SHED_RETRIES,
+        )
+        await asyncio.sleep(min(SHED_MAX_SLEEP_S, max(0.0, retry_after)))
+    raise RuntimeError("unreachable")  # pragma: no cover
+
 
 class GPUServiceProvider(AIProvider):
-    def __init__(self, base_url: str, model: str, timeout_s: float = 120.0):
+    def __init__(
+        self,
+        base_url: str,
+        model: str,
+        timeout_s: float = 120.0,
+        *,
+        priority: str = "interactive",
+        tenant: str = "default",
+        deadline_s: Optional[float] = None,
+    ):
         self._base = base_url.rstrip("/")
         self._model = model
+        self._priority = priority
+        self._tenant = tenant
+        self._deadline_s = deadline_s
         self._timeout = aiohttp.ClientTimeout(total=timeout_s)
         self.calls_attempts: List[int] = []
 
@@ -42,10 +84,15 @@ class GPUServiceProvider(AIProvider):
             "messages": list(messages),
             "max_tokens": max_tokens,
             "json_format": json_format,
+            "priority": self._priority,
+            "tenant": self._tenant,
         }
+        if self._deadline_s is not None:
+            payload["deadline_s"] = self._deadline_s
         async with aiohttp.ClientSession(timeout=self._timeout) as session:
-            async with session.post(f"{self._base}/dialog/", json=payload) as resp:
-                resp.raise_for_status()
+            async with await _post_with_shed_retry(
+                session, f"{self._base}/dialog/", payload
+            ) as resp:
                 data = await resp.json()
         body = data["response"]
         result = body["result"]
@@ -68,7 +115,8 @@ class GPUServiceEmbedder(AIEmbedder):
     async def embeddings(self, input: List[str]) -> List[List[float]]:
         payload = {"model": self._model, "texts": list(input)}
         async with aiohttp.ClientSession(timeout=self._timeout) as session:
-            async with session.post(f"{self._base}/embeddings/", json=payload) as resp:
-                resp.raise_for_status()
+            async with await _post_with_shed_retry(
+                session, f"{self._base}/embeddings/", payload
+            ) as resp:
                 data = await resp.json()
         return data["embeddings"]

@@ -87,8 +87,23 @@ def _ensure_loaded(name: str, kind: str):
 
 
 class TPUProvider(AIProvider):
-    def __init__(self, model: str):
+    """In-process provider.  ``priority``/``tenant``/``deadline_s`` tag every
+    request end-to-end into the serving scheduler: interactive dialog turns
+    outrank background ingestion (question/sentence generation) without a
+    second model replica — see serving/scheduler.py."""
+
+    def __init__(
+        self,
+        model: str,
+        *,
+        priority: str = "interactive",
+        tenant: str = "default",
+        deadline_s: Optional[float] = None,
+    ):
         self._model = model
+        self._priority = priority
+        self._tenant = tenant
+        self._deadline_s = deadline_s
         self.calls_attempts: List[int] = []
         self._engine = _ensure_loaded(model, "decoder")
 
@@ -115,6 +130,9 @@ class TPUProvider(AIProvider):
                 max_tokens=max_tokens,
                 temperature=0.8,
                 json_format=json_format,
+                priority=self._priority,
+                tenant=self._tenant,
+                deadline_s=self._deadline_s,
             )
             usage = {
                 "model": self._model,

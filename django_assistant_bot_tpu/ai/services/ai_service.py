@@ -21,12 +21,27 @@ from ..providers.base import AIEmbedder, AIProvider
 logger = logging.getLogger(__name__)
 
 
-def get_ai_provider(model: str) -> AIProvider:
+def get_ai_provider(
+    model: str,
+    *,
+    priority: str = "interactive",
+    tenant: str = "default",
+    deadline_s: Optional[float] = None,
+) -> AIProvider:
+    """``priority``/``tenant``/``deadline_s`` tag requests for the serving
+    scheduler (serving/scheduler.py): interactive dialog turns outrank
+    background ingestion.  Providers without a scheduling plane (OpenAI,
+    Ollama, ...) simply ignore the tags."""
     logger.debug("getting AI provider for model %s", model)
     if model.startswith("tpu:"):
         from ..providers.tpu import TPUProvider
 
-        return TPUProvider(model[len("tpu:"):])
+        return TPUProvider(
+            model[len("tpu:"):],
+            priority=priority,
+            tenant=tenant,
+            deadline_s=deadline_s,
+        )
     if model.startswith("groq:"):
         from ..providers.openai_api import GroqAIProvider
 
@@ -39,7 +54,11 @@ def get_ai_provider(model: str) -> AIProvider:
         from ..providers.http_service import GPUServiceProvider
 
         return GPUServiceProvider(
-            base_url=settings.GPU_SERVICE_ENDPOINT, model=model[len("gpu_service:"):]
+            base_url=settings.GPU_SERVICE_ENDPOINT,
+            model=model[len("gpu_service:"):],
+            priority=priority,
+            tenant=tenant,
+            deadline_s=deadline_s,
         )
     if model.startswith("ollama:") or model.startswith("llama"):
         from ..providers.ollama import OllamaAIProvider

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import html
 import json
-from typing import Optional
 
 from aiohttp import web
 

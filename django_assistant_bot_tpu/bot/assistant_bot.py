@@ -29,7 +29,6 @@ from .domain import (
     BotPlatform,
     Button,
     MultiPartAnswer,
-    NoMessageFound,
     Photo,
     SingleAnswer,
     Update,

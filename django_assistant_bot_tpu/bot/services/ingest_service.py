@@ -7,7 +7,7 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 from ...storage.models import BotUser, Dialog, Instance
-from ..domain import BotPlatform, Update
+from ..domain import Update
 from .dialog_service import create_user_message, get_dialog
 
 

@@ -21,6 +21,28 @@ def add_parser(sub):
         "(JSON-constrained programs compile on first json request unless "
         "warmup_json is set per model in the config file)",
     )
+    p.add_argument(
+        "--no-scheduler",
+        action="store_true",
+        help="disable the admission-controlled scheduler on every decoder "
+        "(reverts to unbounded FIFO admission; see docs/SCHEDULING.md)",
+    )
+    p.add_argument(
+        "--sched-max-queue",
+        type=int,
+        default=None,
+        metavar="N",
+        help="override every decoder's admission-queue bound (requests past "
+        "it shed with HTTP 429 + Retry-After)",
+    )
+    p.add_argument(
+        "--sched-deadline-s",
+        type=float,
+        default=None,
+        metavar="S",
+        help="default per-request deadline in seconds applied when the client "
+        "sends none (expired requests free their decode slot immediately)",
+    )
     return p
 
 
@@ -48,6 +70,20 @@ def run(args) -> int:
     if args.warmup:
         config = {
             name: {**spec, "warmup": True} for name, spec in config.items()
+        }
+    # scheduler overrides apply to decoder entries only (encoders have no
+    # admission scheduler; their coalescer bound is the max_queue spec knob)
+    sched_overrides = {}
+    if getattr(args, "no_scheduler", False):
+        sched_overrides["scheduler"] = False
+    if getattr(args, "sched_max_queue", None) is not None:
+        sched_overrides["sched_max_queue"] = args.sched_max_queue
+    if getattr(args, "sched_deadline_s", None) is not None:
+        sched_overrides["sched_default_deadline_s"] = args.sched_deadline_s
+    if sched_overrides:
+        config = {
+            name: {**spec, **(sched_overrides if spec.get("kind") == "decoder" else {})}
+            for name, spec in config.items()
         }
     registry = ModelRegistry.from_config(config)
     run_server(host=args.host, port=args.port, registry=registry)

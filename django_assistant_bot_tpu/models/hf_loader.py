@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Iterable
+from typing import Any, Dict
 
 import numpy as np
 

@@ -34,7 +34,7 @@ from ..ops.attention import (
     gqa_dot_product_attention,
 )
 from ..ops.norms import rms_norm
-from ..ops.quant import QTensor, deq, qeinsum
+from ..ops.quant import QTensor, qeinsum
 from ..ops.rope import apply_rope, rope_frequencies
 from ..parallel.sharding import with_constraint
 from .config import DecoderConfig

@@ -19,7 +19,6 @@ is the production one.
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 # A plain-text corpus for tokenizer training: enough lexical variety that BPE
 # learns real merges (multi-byte tokens), which is what shakes out id-space

@@ -12,7 +12,7 @@ from .base import DocumentProcessingStep
 class DocumentFormatStep(DocumentProcessingStep):
     def __init__(self, document):
         super().__init__(document)
-        self._ai = AIDialog(settings.FORMAT_AI_MODEL)
+        self._ai = AIDialog(settings.FORMAT_AI_MODEL, priority="background")
 
     async def run(self) -> None:
         self._logger.info("format document %s", self._document.id)

@@ -25,7 +25,7 @@ MERGE_DISTANCE = 0.05
 class GenerateQuestionsStep(DocumentProcessingStep):
     def __init__(self, document):
         super().__init__(document)
-        self._ai = AIDialog(settings.QUESTIONS_AI_MODEL)
+        self._ai = AIDialog(settings.QUESTIONS_AI_MODEL, priority="background")
 
     async def run(self) -> None:
         self._logger.info("generate questions for document %s", self._document.id)
@@ -77,7 +77,7 @@ class GenerateQuestionsStep(DocumentProcessingStep):
 class MergeQuestionsStep(DocumentProcessingStep):
     def __init__(self, document):
         super().__init__(document)
-        self._ai = AIDialog(settings.QUESTIONS_AI_MODEL)
+        self._ai = AIDialog(settings.QUESTIONS_AI_MODEL, priority="background")
 
     async def run(self) -> None:
         self._logger.info("merge questions for document %s", self._document.id)

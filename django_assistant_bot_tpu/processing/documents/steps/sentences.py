@@ -65,7 +65,7 @@ async def split_text_to_sentences(text: str, ai: AIDialog) -> List[str]:
 class ExtractSentencesStep(DocumentProcessingStep):
     def __init__(self, document):
         super().__init__(document)
-        self._ai = AIDialog(settings.SENTENCES_AI_MODEL)
+        self._ai = AIDialog(settings.SENTENCES_AI_MODEL, priority="background")
 
     async def run(self) -> None:
         self._logger.info("extract sentences for document %s", self._document.id)

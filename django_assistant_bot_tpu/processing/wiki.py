@@ -21,7 +21,7 @@ logger = logging.getLogger(__name__)
 class WikiDocumentSplitter:
     def __init__(self, wiki_document: WikiDocument):
         self._wiki_document = wiki_document
-        self._ai = AIDialog(settings.SPLIT_AI_MODEL)
+        self._ai = AIDialog(settings.SPLIT_AI_MODEL, priority="background")
         self._lang = expected_language(wiki_document.content)
 
     async def run(self) -> WikiDocumentProcessing:

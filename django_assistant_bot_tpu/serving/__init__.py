@@ -9,6 +9,8 @@ deficiencies).  This plane is one process driving the whole TPU slice:
 - :mod:`.tokenizer` — HF tokenizer wrapper + byte-level fallback, chat templating;
 - :mod:`.engine`    — continuous-batching generation engine (slot-based KV cache,
   bucketed prefill, jit decode tick) and a coalescing batched embedding engine;
+- :mod:`.scheduler` — admission-controlled request scheduler (priority classes,
+  weighted per-tenant fair share, deadlines, bounded queue + load shedding);
 - :mod:`.registry`  — model registry loading checkpoints onto the mesh;
 - :mod:`.server`    — aiohttp app exposing the reference's exact HTTP contract
   (``POST /embeddings/``, ``POST /dialog/``).
@@ -16,4 +18,10 @@ deficiencies).  This plane is one process driving the whole TPU slice:
 
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer  # noqa: F401
 from .engine import EmbeddingEngine, GenerationEngine, GenerationResult  # noqa: F401
+from .scheduler import (  # noqa: F401
+    DeadlineExceeded,
+    RequestScheduler,
+    SchedulerConfig,
+    SchedulerRejected,
+)
 from .registry import ModelRegistry, ModelSpec  # noqa: F401

@@ -41,6 +41,7 @@ import numpy as np
 
 from ..models import DecoderConfig, EncoderConfig, encoder, llama
 from ..ops.sampling import sample_logits
+from .scheduler import DeadlineExceeded, RequestScheduler, SchedulerRejected
 from .tokenizer import Tokenizer
 
 logger = logging.getLogger(__name__)
@@ -96,6 +97,15 @@ class _Request:
     # + packed RAG context); 0 = no prefix-cache participation
     prefix_len: int = 0
     first_token_at: Optional[float] = None
+    # scheduling metadata (serving/scheduler.py): class tag, fair-share tenant,
+    # absolute monotonic deadline, and whether try_admit already reserved depth
+    priority: str = "interactive"
+    tenant: str = "default"
+    deadline_at: Optional[float] = None
+    admitted: bool = False
+    # slot-residency start (prefill begins): the service-time sample the
+    # scheduler's estimated-wait model is fed on finish
+    started_at: Optional[float] = None
 
 
 # slot-cache precision knob -> concrete dtype (None = the model's cfg.dtype);
@@ -189,6 +199,7 @@ class GenerationEngine:
         kv_cache_dtype: Optional[str] = None,
         speculative: int = 0,
         decode_kv_chunk: Optional[int] = 0,
+        scheduler: Optional[RequestScheduler] = None,
         mesh=None,
     ):
         self.cfg = cfg
@@ -289,6 +300,17 @@ class GenerationEngine:
         # host-side and reported as ``kv_read_frac`` in :meth:`tick_stats`.
         self.decode_kv_chunk = self._resolve_kv_chunk(decode_kv_chunk)
         self._kv_frac_sum = 0.0
+        # Admission-controlled scheduling (serving/scheduler.py): when present,
+        # submit() runs its admission test (bounded queue, estimated wait) and
+        # _admit pulls requests in weighted-fair-share order instead of FIFO.
+        # None = the legacy unbounded FIFO path (kept as the baseline the
+        # overload bench compares against).
+        self.scheduler = scheduler
+        if scheduler is not None:
+            scheduler.bind_slots(max_slots)
+        # live slots reclaimed before finishing (expired deadline / client
+        # cancel) — each one freed mid-decode instead of burning ticks
+        self.reclaimed_slots = 0
         self.mesh = mesh
         self._cache_shardings = (
             llama.cache_shardings(cfg, mesh, max_slots) if mesh is not None else None
@@ -670,7 +692,8 @@ class GenerationEngine:
                     drain_timeout_s,
                 )
                 try:  # diagnose the stuck XLA call: where is the thread?
-                    import faulthandler, sys
+                    import faulthandler
+                    import sys
 
                     faulthandler.dump_traceback(file=sys.stderr)
                 except Exception:  # pragma: no cover - diagnostics only
@@ -689,6 +712,8 @@ class GenerationEngine:
             self._chunking = None
         while self._pending:
             _safe_resolve(self._pending.popleft().future, exc=err)
+        if self.scheduler is not None:
+            self.scheduler.drain(err)
         self._drain_incoming(err)
 
     def _drain_incoming(self, err: BaseException):
@@ -709,13 +734,22 @@ class GenerationEngine:
         top_p: float = 0.95,
         json_format: bool = False,
         prefix_len: int = 0,
+        priority: str = "interactive",
+        tenant: str = "default",
+        deadline_s: Optional[float] = None,
     ) -> Future:
         """Thread-safe submission; returns a concurrent Future[GenerationResult].
 
         ``prefix_len``: the first N prompt tokens are a shared, cacheable
         prefix (identical across requests, e.g. the system + RAG-context block)
         — the engine reuses their K/V across requests when it can.  Purely an
-        optimization hint: results are identical with 0."""
+        optimization hint: results are identical with 0.
+
+        ``priority``/``tenant``/``deadline_s``: scheduling metadata (see
+        serving/scheduler.py).  With a scheduler attached, submission may
+        raise :class:`SchedulerRejected` synchronously (load shed — the
+        request was never queued); an expired deadline fails the future with
+        :class:`DeadlineExceeded` and frees its decode slot."""
         prompt_ids = list(prompt_ids)
         if json_format and self.speculative:
             raise ValueError(
@@ -723,12 +757,23 @@ class GenerationEngine:
                 "(the JSON token-FSM advances one sequential state per token); "
                 "serve JSON traffic from a non-speculative model entry"
             )
+        admitted = False
+        if self.scheduler is not None:
+            if deadline_s is None:
+                deadline_s = self.scheduler.cfg.default_deadline_s
+            adm = self.scheduler.try_admit(priority, deadline_s)
+            if not adm.ok:
+                raise SchedulerRejected(adm.reason, adm.retry_after_s)
+            if adm.clamp_max_tokens is not None:
+                max_tokens = min(max_tokens, adm.clamp_max_tokens)
+            admitted = True
         # keep room for at least one generated token
         limit = self.max_seq_len - 1
         if len(prompt_ids) > limit:
             prompt_ids = prompt_ids[-limit:]
             prefix_len = 0  # truncation drops leading tokens — prefix gone
         prefix_len = max(0, min(int(prefix_len), len(prompt_ids) - 1))
+        now = time.monotonic()
         fut: Future = Future()
         self._queue.put(
             _Request(
@@ -737,9 +782,13 @@ class GenerationEngine:
                 temperature=temperature,
                 top_p=top_p,
                 future=fut,
-                submitted_at=time.monotonic(),
+                submitted_at=now,
                 json=json_format,
                 prefix_len=prefix_len,
+                priority=priority,
+                tenant=tenant,
+                deadline_at=(now + deadline_s) if deadline_s is not None else None,
+                admitted=admitted,
             )
         )
         # A stop() racing (or preceding) the put above would leave the request
@@ -759,6 +808,9 @@ class GenerationEngine:
         temperature: float = 0.8,
         top_p: float = 0.95,
         json_format: bool = False,
+        priority: str = "interactive",
+        tenant: str = "default",
+        deadline_s: Optional[float] = None,
     ) -> GenerationResult:
         """Async convenience: tokenize (chat-templating message lists), run, decode."""
         import asyncio
@@ -778,6 +830,9 @@ class GenerationEngine:
             top_p=top_p,
             json_format=json_format,
             prefix_len=plen,
+            priority=priority,
+            tenant=tenant,
+            deadline_s=deadline_s,
         )
         return await asyncio.wrap_future(fut)
 
@@ -795,6 +850,7 @@ class GenerationEngine:
             while self._running:
                 try:
                     with self._iter_lock:  # excludes probe_decode (see there)
+                        self._reap_dead_slots()
                         admitted = self._admit()
                         if self._chunking is not None:
                             self._chunk_step()
@@ -834,6 +890,63 @@ class GenerationEngine:
                 self._slot_epoch[i] += 1
         self._drain_queue(err)
 
+    def _reap_dead_slots(self) -> None:
+        """Free live slots whose request is dead: deadline expired or future
+        cancelled by the client.  Runs at the top of every loop iteration, so
+        an expired request's slot is reclaimed within ONE decode tick — the
+        epoch bump drops its in-flight speculative tokens and the inactive row
+        stops burning decode work (``active=False`` in the next tick; the
+        stale cache row is overwritten by the next admission, the same
+        discipline ``_finish`` relies on).
+
+        QUEUED dead entries are reaped here too — every iteration, not only
+        when a free slot pulls them to the fair-share head — so a queued
+        request's DeadlineExceeded lands at ~its deadline even on a saturated
+        engine, and dead entries stop inflating queue depth (which would shed
+        admittable work with spurious queue_full 429s)."""
+        now = time.monotonic()
+        if self.scheduler is not None:
+            self.scheduler.reap(now)
+        elif self._pending:
+            keep: "collections.deque[_Request]" = collections.deque()
+            while self._pending:
+                req = self._pending.popleft()
+                if req.future.cancelled():
+                    continue
+                if req.deadline_at is not None and now >= req.deadline_at:
+                    _safe_resolve(
+                        req.future,
+                        exc=DeadlineExceeded(
+                            f"deadline expired after "
+                            f"{now - req.submitted_at:.2f}s in queue"
+                        ),
+                    )
+                    continue
+                keep.append(req)
+            self._pending = keep
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            req = s.request
+            expired = req.deadline_at is not None and now >= req.deadline_at
+            if not expired and not req.future.cancelled():
+                continue
+            self._slots[i] = None
+            self._slot_epoch[i] += 1
+            self._json[i] = False
+            self._sampling_dirty = True
+            self.reclaimed_slots += 1
+            if expired:
+                _safe_resolve(
+                    req.future,
+                    exc=DeadlineExceeded(
+                        f"deadline expired after {len(s.generated)} generated "
+                        f"tokens ({now - req.submitted_at:.2f}s since submit)"
+                    ),
+                )
+                if self.scheduler is not None:
+                    self.scheduler.note_expired_running(req.priority)
+
     def _prefix_lookup(self, req: _Request) -> Optional[_Prefix]:
         """LONGEST cached prefix this prompt starts with, or None.
 
@@ -854,34 +967,68 @@ class GenerationEngine:
             self._prefix_lru.move_to_end(best_key)
         return best
 
-    def _admit(self) -> bool:
-        admitted = False
-        # stage queued requests so the head can be inspected without losing order
-        while True:
-            try:
-                self._pending.append(self._queue.get_nowait())
-            except queue.Empty:
-                break
-        free = self._free_slots()
-        batch: List[tuple[int, _Request, Optional[_Prefix]]] = []
-        while free and self._pending:
+    def _peek_next(self, now: float) -> Optional[_Request]:
+        """Head-of-queue inspection without removal.  Scheduler path: the
+        weighted-fair-share winner (dead entries reaped inside).  Legacy FIFO
+        path: the `_pending` head, skipping cancelled/expired entries."""
+        if self.scheduler is not None:
+            return self.scheduler.peek(now)
+        while self._pending:
             req = self._pending[0]
             if req.future.cancelled():
                 self._pending.popleft()
                 continue
+            if req.deadline_at is not None and now >= req.deadline_at:
+                self._pending.popleft()
+                _safe_resolve(
+                    req.future,
+                    exc=DeadlineExceeded(
+                        f"deadline expired after {now - req.submitted_at:.2f}s in queue"
+                    ),
+                )
+                continue
+            return req
+        return None
+
+    def _take_next(self, now: float) -> Optional[_Request]:
+        if self.scheduler is not None:
+            return self.scheduler.pop(now)
+        return self._pending.popleft() if self._pending else None
+
+    def _admit(self) -> bool:
+        admitted = False
+        # stage queued requests: into the scheduler (which orders them by
+        # class/tenant fair share) or the FIFO deque so the head can be
+        # inspected without losing order
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if self.scheduler is not None:
+                self.scheduler.enqueue(req)
+            else:
+                self._pending.append(req)
+        now = time.monotonic()
+        free = self._free_slots()
+        batch: List[tuple[int, _Request, Optional[_Prefix]]] = []
+        while free:
+            req = self._peek_next(now)
+            if req is None:
+                break
             hit = self._prefix_lookup(req)
             # with a cached prefix only the suffix runs through the model, so
             # the chunked path is needed only when the REMAINDER exceeds a chunk
             n_eff = len(req.prompt_ids) - (hit.length if hit else 0)
             if n_eff > self.chunk_size:
                 if self._chunking is not None or batch:
-                    break  # one chunked prefill at a time; FIFO order preserved
-                self._pending.popleft()
+                    break  # one chunked prefill at a time; scheduling order preserved
+                self._take_next(now)
                 self._count_prefix(req, hit)
                 self._begin_chunked(free.pop(0), req, prefix=hit)
                 admitted = True
             else:
-                self._pending.popleft()
+                self._take_next(now)
                 self._count_prefix(req, hit)
                 batch.append((free.pop(0), req, hit))
         if batch:
@@ -1268,6 +1415,7 @@ class GenerationEngine:
                 self._cache = self._insert_prefix(
                     self._cache, prefix.pk, prefix.pv, jnp.asarray(slot, jnp.int32)
                 )
+        req.started_at = time.monotonic()
         self._chunking = _ChunkedPrefill(
             request=req, slot=slot, ids=ids, starts=starts, n=n
         )
@@ -1287,6 +1435,18 @@ class GenerationEngine:
             )
         st.step += 1
         if st.request.future.cancelled():
+            self._chunking = None
+            return
+        dl = st.request.deadline_at
+        if dl is not None and time.monotonic() >= dl:
+            # expired mid-prefill: abandon the remaining chunks entirely
+            self.reclaimed_slots += 1
+            if self.scheduler is not None:
+                self.scheduler.note_expired_running(st.request.priority)
+            _safe_resolve(
+                st.request.future,
+                exc=DeadlineExceeded("deadline expired during chunked prefill"),
+            )
             self._chunking = None
             return
         if st.step >= len(st.starts):
@@ -1337,7 +1497,10 @@ class GenerationEngine:
                     logits, self._tokens_dev, self._rng, temps, top_ps, scatter_idx
                 )
         ref_slots = []
+        now_started = time.monotonic()
         for slot, req in zip(slots, reqs):
+            if req.started_at is None:  # chunked prefills set it at begin
+                req.started_at = now_started
             self._slots[slot] = _Slot(request=req)
             self._temps[slot] = req.temperature
             self._top_ps[slot] = req.top_p
@@ -1394,6 +1557,10 @@ class GenerationEngine:
             out["spec_accept_rate"] = round(
                 self.spec_accepted / max(1, self.spec_drafted), 4
             )
+        out["reclaimed_slots"] = self.reclaimed_slots
+        if self.scheduler is not None:
+            # queue-pressure snapshot: depth/pressure/shed/wait percentiles
+            out["sched"] = self.scheduler.stats()
         return out
 
     def probe_decode(self, iters: int = 16, fill_len: Optional[int] = None) -> float:
@@ -1507,9 +1674,19 @@ class GenerationEngine:
         :meth:`_process_tick`."""
         t0 = time.monotonic()
         self._refresh_sampling()
-        if self.speculative:
+        if self.speculative and not (
+            # graceful degradation: under queue pressure the (K+1)-position
+            # verify forward is wasted work at low acceptance — fall back to
+            # the plain tick (correctness is tick-kind-independent; only the
+            # draft source quality suffers when speculation resumes)
+            self.scheduler is not None
+            and self.scheduler.degraded()
+        ):
             self._issue_spec_tick(t0)
             return
+        # (a degraded speculative engine falls through to the plain tick:
+        # burst is pinned to 1 there, so _decode_tick is the single-step
+        # program and the cache/token chaining is identical)
         with self._mesh_scope():
             if self._json.any():
                 toks, last, self._cache, self._rng, self._fsm_states_dev = (
@@ -1675,6 +1852,14 @@ class GenerationEngine:
             ttft_s=(req.first_token_at or now) - req.submitted_at,
             latency_s=now - req.submitted_at,
         )
+        if self.scheduler is not None:
+            # feed the estimated-wait admission model with true service time:
+            # slot residency from prefill start (latency minus queue wait) —
+            # first_token_at would omit the prefill, and under long-prompt
+            # traffic prefill is the dominant component
+            self.scheduler.note_service(
+                now - (req.started_at or req.first_token_at or now)
+            )
         _safe_resolve(req.future, result=result)
 
     def _fail_all(self):
@@ -1740,6 +1925,7 @@ class EmbeddingEngine:
         max_batch: int = 64,
         seq_buckets: Sequence[int] = (32, 64, 128, 256, 512),
         normalize: bool = False,
+        max_queue: int = 1024,
         mesh=None,
     ):
         self.cfg = cfg
@@ -1751,7 +1937,14 @@ class EmbeddingEngine:
         ) or (cfg.max_position_embeddings,)
         self.normalize = normalize
         self.mesh = mesh
-        self._queue: "queue.Queue[tuple[List[str], Future]]" = queue.Queue()
+        # bounded: an ingestion burst must shed (429 at the server) instead of
+        # queueing unboundedly behind a single coalescer thread
+        self.max_queue = max(1, int(max_queue))
+        self.shed = 0
+        self.dropped_cancelled = 0
+        self._queue: "queue.Queue[tuple[List[str], Future]]" = queue.Queue(
+            maxsize=self.max_queue
+        )
         self._running = False
         self._thread: Optional[threading.Thread] = None
 
@@ -1803,7 +1996,15 @@ class EmbeddingEngine:
         if not texts:
             return []
         fut: Future = Future()
-        self._queue.put((list(texts), fut))
+        try:
+            self._queue.put_nowait((list(texts), fut))
+        except queue.Full:
+            self.shed += 1
+            # retry hint: one queue's worth of batches at ~the coalescer's
+            # cadence; coarse but monotone in backlog size
+            raise SchedulerRejected(
+                "embedding queue full", retry_after_s=min(30.0, 1.0 + self.max_queue * 0.01)
+            ) from None
         if not self._running:
             self.start()
         return await asyncio.wrap_future(fut)
@@ -1815,16 +2016,28 @@ class EmbeddingEngine:
                 texts, fut = self._queue.get(timeout=0.05)
             except queue.Empty:
                 continue
-            # coalesce whatever else is waiting right now
-            jobs: List[tuple[List[str], Future]] = [(texts, fut)]
-            total = len(texts)
+            # coalesce whatever else is waiting right now; clients that
+            # already cancelled are dropped HERE — before their texts pad out
+            # a batched forward pass nobody will read
+            jobs: List[tuple[List[str], Future]] = []
+            total = 0
+            if not fut.cancelled():
+                jobs.append((texts, fut))
+                total = len(texts)
+            else:
+                self.dropped_cancelled += 1
             while total < self.max_batch:
                 try:
                     t2, f2 = self._queue.get_nowait()
                 except queue.Empty:
                     break
+                if f2.cancelled():
+                    self.dropped_cancelled += 1
+                    continue
                 jobs.append((t2, f2))
                 total += len(t2)
+            if not jobs:
+                continue
             flat = [t for ts, _ in jobs for t in ts]
             try:
                 embs = self.embed_sync(flat)

@@ -72,6 +72,27 @@ class ModelSpec:
     max_batch: int = 64
     normalize: bool = False
     num_experts: int = 0
+    # --- admission-controlled scheduling (serving/scheduler.py) ---
+    # scheduler=False reverts to the legacy unbounded FIFO admission path
+    scheduler: bool = True
+    # bound on queued-but-not-slotted generation requests; past it /dialog/
+    # sheds with 429 + Retry-After instead of queueing unboundedly
+    sched_max_queue: int = 256
+    # priority-class weights (weighted share, not strict priority) and
+    # per-tenant weights within a class; None = scheduler defaults (8:1)
+    sched_class_weights: Optional[Mapping[str, float]] = None
+    sched_tenant_weights: Optional[Mapping[str, float]] = None
+    # estimated-wait admission ceiling in seconds (None disables the test)
+    sched_admit_max_wait_s: Optional[float] = 60.0
+    # deadline applied when the client sends none (None = no deadline)
+    sched_default_deadline_s: Optional[float] = None
+    # degradation band: past this queue-pressure fraction, clamp max_tokens
+    # and disable speculative decoding; 1.0 disables the band
+    sched_degrade_at: float = 0.75
+    sched_degrade_max_tokens: int = 256
+    # embedding coalescer queue bound (encoder entries): past it /embeddings/
+    # sheds with 429 instead of queueing unboundedly
+    max_queue: int = 1024
 
     @classmethod
     def from_dict(cls, name: str, d: Mapping[str, Any]) -> "ModelSpec":
@@ -172,6 +193,7 @@ class ModelRegistry:
                 tokenizer,
                 max_batch=spec.max_batch,
                 normalize=spec.normalize,
+                max_queue=spec.max_queue,
                 mesh=self.mesh,
             )
             if spec.warmup:
@@ -204,6 +226,23 @@ class ModelRegistry:
                 params = quantize_decoder_params(params)
             with self.mesh:
                 params = shard_pytree(params, llama.logical_axes(cfg), self.mesh)
+            sched = None
+            if spec.scheduler:
+                from .scheduler import RequestScheduler, SchedulerConfig
+
+                sched = RequestScheduler(
+                    SchedulerConfig.from_knobs(
+                        max_queue=spec.sched_max_queue,
+                        class_weights=spec.sched_class_weights,
+                        tenant_weights=spec.sched_tenant_weights,
+                        degrade_at=spec.sched_degrade_at,
+                        degrade_max_tokens=spec.sched_degrade_max_tokens,
+                    )
+                )
+                # these two are None-able knobs (None is meaningful: "off"),
+                # so they bypass the None-dropping from_knobs filter
+                sched.cfg.admit_max_wait_s = spec.sched_admit_max_wait_s
+                sched.cfg.default_deadline_s = spec.sched_default_deadline_s
             eng = GenerationEngine(
                 cfg,
                 params,
@@ -222,6 +261,7 @@ class ModelRegistry:
                     None if spec.decode_kv_chunk in (None, "off")
                     else int(spec.decode_kv_chunk)
                 ),
+                scheduler=sched,
                 mesh=self.mesh,
             )
             if spec.warmup or spec.warmup_json:

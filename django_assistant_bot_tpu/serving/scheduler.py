@@ -1,0 +1,408 @@
+"""Admission-controlled request scheduler for the serving plane.
+
+The engines used to feed from an unbounded FIFO ``queue.Queue()``: every
+``/dialog/`` request was accepted unconditionally, and a burst of background
+ingestion traffic (question/sentence generation, embedding batches) could
+starve interactive dialog turns indefinitely.  The reference pushed the same
+problem onto Celery queues between services; a single-process TPU batcher
+needs its own scheduler — the standard shape in production LLM serving stacks
+(vLLM-style continuous batching with admission control, Orca-style
+iteration-level scheduling).
+
+This module is deliberately engine-agnostic: it orders and admits anything
+exposing ``.future``, ``.submitted_at``, ``.priority``, ``.tenant`` and
+``.deadline_at`` (the engine's ``_Request`` does), so every policy is unit
+testable without a device.
+
+Policies, in one place:
+
+- **Priority classes.**  Requests carry a class tag (``interactive`` dialog >
+  ``background`` ingestion/embedding), propagated end-to-end from the provider
+  layer and HTTP headers.  Classes share by *weight* (default 8:1), not strict
+  priority — background work cannot be starved forever, but interactive turns
+  take ~8 of every 9 free slots under contention.
+- **Weighted per-tenant fair share.**  Within a class, tenants (workspaces)
+  interleave by stride scheduling over virtual time: one chatty tenant cannot
+  monopolize slots.  Both levels collapse into a single stride: each
+  ``(class, tenant)`` queue advances its virtual *pass* by
+  ``1 / (class_weight * tenant_weight)`` per admitted request and the lowest
+  pass runs next — the classic deterministic approximation of weighted fair
+  queueing.
+- **Deadlines.**  A request may carry an absolute deadline; expired entries
+  are dropped at the queue head (future fails with :class:`DeadlineExceeded`)
+  and the engine reaps expired *running* slots so an expired request stops
+  burning decode ticks (see ``GenerationEngine._reap_dead_slots``).
+- **Overload behavior.**  The queue is bounded; past the bound — or past an
+  estimated-wait ceiling derived from an EMA of observed service times —
+  submission fails *synchronously* with :class:`SchedulerRejected` carrying a
+  ``retry_after_s`` hint (HTTP 429 + ``Retry-After`` at the server).  Between
+  "fine" and "shed" there is a degradation band: past ``degrade_at`` queue
+  pressure the scheduler clamps ``max_tokens`` and asks the engine to disable
+  speculative decoding (its verify forward is wasted work at low acceptance).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Deque, Dict, Mapping, Optional, Tuple
+
+INTERACTIVE = "interactive"
+BACKGROUND = "background"
+
+
+class SchedulerRejected(RuntimeError):
+    """Load shed: the request was NOT queued.  ``retry_after_s`` is the
+    client back-off hint (HTTP 429 + ``Retry-After``)."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(f"request shed: {reason} (retry after {retry_after_s:.1f}s)")
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before it finished; its queue entry (or
+    live decode slot) was reclaimed."""
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    # bound on queued-but-not-yet-slotted requests (the admission queue; live
+    # decode slots are bounded separately by the engine's max_slots)
+    max_queue: int = 256
+    # class name -> weight; unknown classes get weight 1.  Weighted share,
+    # not strict priority: background drains at weight/(sum) under contention.
+    class_weights: Mapping[str, float] = dataclasses.field(
+        default_factory=lambda: {INTERACTIVE: 8.0, BACKGROUND: 1.0}
+    )
+    # tenant name -> weight within its class (unlisted tenants get 1.0)
+    tenant_weights: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    # estimated-wait admission ceiling: shed when the queue's estimated wait
+    # exceeds this (None disables the test; the depth bound still applies)
+    admit_max_wait_s: Optional[float] = 60.0
+    # deadline applied when the client sends none (None = no deadline)
+    default_deadline_s: Optional[float] = None
+    # graceful degradation band: past this fraction of max_queue, clamp
+    # max_tokens and disable speculative decoding; 1.0 disables the band
+    degrade_at: float = 0.75
+    degrade_max_tokens: int = 256
+    # per-request service-time EMA seed (seconds) for the estimated-wait test
+    # before any request has finished; decays fast once real finishes arrive
+    service_time_init: float = 1.0
+    service_time_alpha: float = 0.2
+    # wait-time sample window per class for the p50/p95 health stats
+    wait_window: int = 512
+
+    @classmethod
+    def from_knobs(cls, **kw) -> "SchedulerConfig":
+        """Build from flat ModelSpec-style knobs, ignoring Nones."""
+        return cls(**{k: v for k, v in kw.items() if v is not None})
+
+
+@dataclasses.dataclass
+class Admission:
+    ok: bool
+    reason: str = ""
+    retry_after_s: float = 0.0
+    # degradation: clamp max_tokens to this when set (queue pressure band)
+    clamp_max_tokens: Optional[int] = None
+
+
+class RequestScheduler:
+    """Two-level weighted fair queue with bounded admission.
+
+    Thread contract: :meth:`try_admit`, :meth:`note_service`, :meth:`stats`
+    and the counters are safe from any thread (one internal lock);
+    :meth:`enqueue` / :meth:`peek` / :meth:`pop` / :meth:`drain` mutate the
+    queue structure and are engine-thread-only (they still take the lock so
+    the cross-thread counters stay coherent).
+    """
+
+    def __init__(self, cfg: Optional[SchedulerConfig] = None, *, slots: int = 8):
+        self.cfg = cfg or SchedulerConfig()
+        self._slots = max(1, int(slots))
+        self._lock = threading.Lock()
+        self._queues: Dict[Tuple[str, str], Deque] = {}
+        self._pass: Dict[Tuple[str, str], float] = {}
+        self._vtime = 0.0
+        self._depth = 0
+        self._service_ema_s = float(self.cfg.service_time_init)
+        # per-class counters (created lazily so new classes just appear)
+        self.submitted: Dict[str, int] = collections.defaultdict(int)
+        self.admitted: Dict[str, int] = collections.defaultdict(int)
+        self.shed: Dict[str, int] = collections.defaultdict(int)  # by reason
+        self.expired_queued: Dict[str, int] = collections.defaultdict(int)
+        self.expired_running: Dict[str, int] = collections.defaultdict(int)
+        self.cancelled_queued: Dict[str, int] = collections.defaultdict(int)
+        self._waits: Dict[str, Deque[float]] = collections.defaultdict(
+            lambda: collections.deque(maxlen=self.cfg.wait_window)
+        )
+
+    # ------------------------------------------------------------- admission
+    def bind_slots(self, slots: int) -> "RequestScheduler":
+        """Engine capacity for the estimated-wait model (est wait =
+        depth * service_ema / slots)."""
+        self._slots = max(1, int(slots))
+        return self
+
+    def _est_wait_s_locked(self, extra: int = 0) -> float:
+        return (self._depth + extra) * self._service_ema_s / self._slots
+
+    def try_admit(
+        self,
+        priority: str = INTERACTIVE,
+        deadline_s: Optional[float] = None,
+        *,
+        now: Optional[float] = None,
+    ) -> Admission:
+        """The synchronous admission test (any thread).  On ``ok`` the caller
+        MUST follow through with :meth:`enqueue` (depth is reserved here so a
+        racing burst cannot overshoot the bound)."""
+        cfg = self.cfg
+        with self._lock:
+            self.submitted[priority] += 1
+            # time until this request could START (everything ahead of it over
+            # the engine's slots) — its own service time is the client's
+            # business, the deadline test below only covers the queue wait
+            est = self._est_wait_s_locked()
+            retry = min(30.0, max(0.2, est / 2.0))
+            if self._depth >= cfg.max_queue:
+                self.shed["queue_full"] += 1
+                return Admission(False, "queue_full", retry)
+            if cfg.admit_max_wait_s is not None and est > cfg.admit_max_wait_s:
+                self.shed["est_wait"] += 1
+                return Admission(False, "estimated_wait", retry)
+            if deadline_s is not None and est > deadline_s:
+                # the queue alone would eat the whole deadline — shedding now
+                # is kinder than a guaranteed DeadlineExceeded later
+                self.shed["deadline_infeasible"] += 1
+                return Admission(False, "deadline_infeasible", retry)
+            self._depth += 1
+            clamp = None
+            if (
+                cfg.degrade_at < 1.0
+                and self._depth >= cfg.degrade_at * cfg.max_queue
+            ):
+                clamp = int(cfg.degrade_max_tokens)
+            return Admission(True, clamp_max_tokens=clamp)
+
+    def degraded(self) -> bool:
+        """Queue pressure is in the degradation band: the engine should skip
+        speculative decoding (wasted verify forwards under load)."""
+        cfg = self.cfg
+        with self._lock:
+            return cfg.degrade_at < 1.0 and (
+                self._depth >= cfg.degrade_at * cfg.max_queue
+            )
+
+    # ------------------------------------------------------------- the queue
+    def _weight(self, key: Tuple[str, str]) -> float:
+        cls_w = float(self.cfg.class_weights.get(key[0], 1.0))
+        ten_w = float(self.cfg.tenant_weights.get(key[1], 1.0))
+        return max(1e-6, cls_w * ten_w)
+
+    def enqueue(self, req) -> None:
+        """Insert an (already admitted) request.  Requests that bypassed
+        :meth:`try_admit` (internal/test paths writing the engine queue
+        directly) are counted here so depth accounting stays true."""
+        key = (
+            getattr(req, "priority", INTERACTIVE) or INTERACTIVE,
+            getattr(req, "tenant", "default") or "default",
+        )
+        with self._lock:
+            if not getattr(req, "admitted", False):
+                self._depth += 1
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = collections.deque()
+            if not q:
+                # an idle queue must not bank credit: restart at current vtime
+                self._pass[key] = max(self._pass.get(key, 0.0), self._vtime)
+            q.append(req)
+
+    def _best_key_locked(self) -> Optional[Tuple[str, str]]:
+        best = None
+        for key, q in self._queues.items():
+            if not q:
+                continue
+            cand = (self._pass[key], -self._weight(key), key)
+            if best is None or cand < best[0]:
+                best = (cand, key)
+        return best[1] if best is not None else None
+
+    def _reap_head_locked(self, now: float):
+        """Drop dead entries (cancelled / expired) from whichever queue is
+        next up, resolving their futures; returns the live (key, req) head or
+        None when everything is empty."""
+        from .engine import _safe_resolve  # local import: engine imports us too
+
+        while True:
+            key = self._best_key_locked()
+            if key is None:
+                return None
+            q = self._queues[key]
+            req = q[0]
+            if req.future.cancelled():
+                q.popleft()
+                self._depth = max(0, self._depth - 1)
+                self.cancelled_queued[key[0]] += 1
+                continue
+            dl = getattr(req, "deadline_at", None)
+            if dl is not None and now >= dl:
+                q.popleft()
+                self._depth = max(0, self._depth - 1)
+                self.expired_queued[key[0]] += 1
+                _safe_resolve(
+                    req.future,
+                    exc=DeadlineExceeded(
+                        f"deadline expired after {now - req.submitted_at:.2f}s in queue"
+                    ),
+                )
+                continue
+            return key, req
+
+    def peek(self, now: Optional[float] = None):
+        """Next request the fair-share policy would run, without removing it
+        (dead heads are reaped as a side effect)."""
+        with self._lock:
+            head = self._reap_head_locked(now if now is not None else time.monotonic())
+            return head[1] if head else None
+
+    def pop(self, now: Optional[float] = None):
+        """Remove and return the next request; charges its queue's virtual
+        pass (this is the fair-share accounting step)."""
+        now = now if now is not None else time.monotonic()
+        with self._lock:
+            head = self._reap_head_locked(now)
+            if head is None:
+                return None
+            key, req = head
+            self._queues[key].popleft()
+            self._depth = max(0, self._depth - 1)
+            self._vtime = self._pass[key]
+            self._pass[key] += 1.0 / self._weight(key)
+            self.admitted[key[0]] += 1
+            self._waits[key[0]].append(now - req.submitted_at)
+            return req
+
+    def reap(self, now: Optional[float] = None) -> int:
+        """Drop cancelled/deadline-expired entries ANYWHERE in the queues
+        (not just at pop time): the engine calls this every loop iteration so
+        a queued request's DeadlineExceeded lands at ~its deadline even when
+        every decode slot is busy — and the dead entry stops inflating depth
+        (which would shed admittable work with spurious queue_full 429s).
+        Returns the number of entries dropped."""
+        from .engine import _safe_resolve
+
+        now = now if now is not None else time.monotonic()
+        dropped = 0
+        with self._lock:
+            for key, q in self._queues.items():
+                if not q:
+                    continue
+                keep: Deque = collections.deque()
+                while q:
+                    req = q.popleft()
+                    if req.future.cancelled():
+                        self._depth = max(0, self._depth - 1)
+                        self.cancelled_queued[key[0]] += 1
+                        dropped += 1
+                        continue
+                    dl = getattr(req, "deadline_at", None)
+                    if dl is not None and now >= dl:
+                        self._depth = max(0, self._depth - 1)
+                        self.expired_queued[key[0]] += 1
+                        dropped += 1
+                        _safe_resolve(
+                            req.future,
+                            exc=DeadlineExceeded(
+                                f"deadline expired after "
+                                f"{now - req.submitted_at:.2f}s in queue"
+                            ),
+                        )
+                        continue
+                    keep.append(req)
+                q.extend(keep)
+        return dropped
+
+    def drain(self, err: BaseException) -> None:
+        """Fail everything still queued (engine shutdown)."""
+        from .engine import _safe_resolve
+
+        with self._lock:
+            for q in self._queues.values():
+                while q:
+                    _safe_resolve(q.popleft().future, exc=err)
+                    self._depth = max(0, self._depth - 1)
+            self._depth = max(0, self._depth)
+
+    # ------------------------------------------------------------- telemetry
+    def note_service(self, seconds: float) -> None:
+        """Fold one finished request's service time into the EMA driving the
+        estimated-wait admission test."""
+        a = self.cfg.service_time_alpha
+        with self._lock:
+            self._service_ema_s = (1 - a) * self._service_ema_s + a * max(
+                0.0, float(seconds)
+            )
+
+    def note_expired_running(self, priority: str) -> None:
+        with self._lock:
+            self.expired_running[priority or INTERACTIVE] += 1
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._depth
+
+    def pressure(self) -> float:
+        with self._lock:
+            return self._depth / max(1, self.cfg.max_queue)
+
+    def est_wait_s(self) -> float:
+        with self._lock:
+            return self._est_wait_s_locked()
+
+    @staticmethod
+    def _pctl(sorted_vals, frac: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = min(len(sorted_vals) - 1, max(0, round(frac * (len(sorted_vals) - 1))))
+        return sorted_vals[idx]
+
+    def wait_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-class queue-wait percentiles (ms) over the sample window."""
+        with self._lock:
+            out = {}
+            for cls, samples in self._waits.items():
+                vals = sorted(samples)
+                out[cls] = {
+                    "n": len(vals),
+                    "p50_ms": round(self._pctl(vals, 0.50) * 1e3, 2),
+                    "p95_ms": round(self._pctl(vals, 0.95) * 1e3, 2),
+                }
+            return out
+
+    def stats(self) -> dict:
+        """One JSON-able snapshot for /healthz and tick_stats."""
+        waits = self.wait_stats()
+        with self._lock:
+            return {
+                "queue_depth": self._depth,
+                "max_queue": self.cfg.max_queue,
+                "pressure": round(self._depth / max(1, self.cfg.max_queue), 4),
+                "est_wait_s": round(self._est_wait_s_locked(), 4),
+                "service_ema_s": round(self._service_ema_s, 4),
+                "degraded": self.cfg.degrade_at < 1.0
+                and self._depth >= self.cfg.degrade_at * self.cfg.max_queue,
+                "submitted": dict(self.submitted),
+                "admitted": dict(self.admitted),
+                "shed": dict(self.shed),
+                "expired_queued": dict(self.expired_queued),
+                "expired_running": dict(self.expired_running),
+                "cancelled_queued": dict(self.cancelled_queued),
+                "wait": waits,
+            }

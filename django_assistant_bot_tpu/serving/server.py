@@ -15,15 +15,81 @@ gives cross-request batching instead of gunicorn worker replicas.
 from __future__ import annotations
 
 import logging
-from typing import Any, Mapping
+import math
+from typing import Any, Mapping, Optional
 
 from aiohttp import web
 
 from .registry import ModelRegistry
+from .scheduler import DeadlineExceeded, SchedulerRejected
 
 logger = logging.getLogger(__name__)
 
 REGISTRY_KEY: web.AppKey[ModelRegistry] = web.AppKey("registry", ModelRegistry)
+
+MAX_MAX_TOKENS = 1 << 17  # sanity ceiling; engines clamp to max_seq_len anyway
+PRIORITIES = ("interactive", "background")
+
+
+class _BadRequest(ValueError):
+    """Validation failure carrying the client-facing detail message."""
+
+
+def _validate_sampling(body: Mapping[str, Any]) -> tuple:
+    """Pull and range-check the sampling knobs.  NaN/negative/huge values used
+    to flow straight into the device sampler (NaN temperature poisons the
+    whole batched softmax row); they are a 422 now."""
+    temperature = body.get("temperature", 0.8)
+    top_p = body.get("top_p", 0.95)
+    max_tokens = body.get("max_tokens", 1024)
+    if isinstance(temperature, bool) or not isinstance(temperature, (int, float)):
+        raise _BadRequest("temperature must be a number")
+    temperature = float(temperature)
+    if not math.isfinite(temperature) or not (0.0 <= temperature <= 2.0):
+        raise _BadRequest("temperature must be finite and within [0, 2]")
+    if isinstance(top_p, bool) or not isinstance(top_p, (int, float)):
+        raise _BadRequest("top_p must be a number")
+    top_p = float(top_p)
+    if not math.isfinite(top_p) or not (0.0 < top_p <= 1.0):
+        raise _BadRequest("top_p must be finite and within (0, 1]")
+    if isinstance(max_tokens, bool) or not isinstance(max_tokens, int):
+        raise _BadRequest("max_tokens must be an integer")
+    if not (1 <= max_tokens <= MAX_MAX_TOKENS):
+        raise _BadRequest(f"max_tokens must be within [1, {MAX_MAX_TOKENS}]")
+    return temperature, top_p, max_tokens
+
+
+def _scheduling_fields(
+    request: web.Request, body: Mapping[str, Any]
+) -> tuple[str, str, Optional[float]]:
+    """Priority class, fair-share tenant and deadline: body fields win,
+    ``X-Priority`` / ``X-Tenant`` / ``X-Deadline-S`` headers are the fallback
+    (so proxies can tag traffic without rewriting bodies)."""
+    priority = body.get("priority", request.headers.get("X-Priority", "interactive"))
+    if priority not in PRIORITIES:
+        raise _BadRequest(f"priority must be one of {list(PRIORITIES)}")
+    tenant = body.get("tenant", request.headers.get("X-Tenant", "default"))
+    if not isinstance(tenant, str) or not tenant.strip() or len(tenant) > 128:
+        raise _BadRequest("tenant must be a non-empty string of <= 128 chars")
+    deadline_s = body.get("deadline_s", request.headers.get("X-Deadline-S"))
+    if deadline_s is not None:
+        try:
+            deadline_s = float(deadline_s)
+        except (TypeError, ValueError):
+            raise _BadRequest("deadline_s must be a number") from None
+        if not math.isfinite(deadline_s) or not (0.0 < deadline_s <= 3600.0):
+            raise _BadRequest("deadline_s must be finite and within (0, 3600]")
+    return priority, tenant.strip(), deadline_s
+
+
+def _shed_response(e: SchedulerRejected) -> web.Response:
+    """Load shed -> 429 with a Retry-After back-off hint."""
+    retry = max(1, math.ceil(e.retry_after_s))
+    return web.json_response(
+        {"detail": str(e), "reason": e.reason, "retry_after_s": e.retry_after_s},
+        status=429,
+        headers={"Retry-After": str(retry)},
+    )
 
 
 def create_app(registry: ModelRegistry) -> web.Application:
@@ -46,6 +112,8 @@ def create_app(registry: ModelRegistry) -> web.Application:
         try:
             embs = await eng.embed(texts)
             return web.json_response({"embeddings": embs})
+        except SchedulerRejected as e:
+            return _shed_response(e)
         except Exception as e:
             logger.exception("embeddings failed")
             return web.json_response({"detail": str(e)}, status=500)
@@ -57,10 +125,11 @@ def create_app(registry: ModelRegistry) -> web.Application:
             if not isinstance(model, str):
                 raise ValueError("model must be a string")
             messages = body["messages"]
-            max_tokens = int(body.get("max_tokens", 1024))
             json_format = bool(body.get("json_format", False))
-            temperature = float(body.get("temperature", 0.8))
-            top_p = float(body.get("top_p", 0.95))
+            temperature, top_p, max_tokens = _validate_sampling(body)
+            priority, tenant, deadline_s = _scheduling_fields(request, body)
+        except _BadRequest as e:
+            return web.json_response({"detail": str(e)}, status=422)
         except Exception:
             return web.json_response({"detail": "invalid request"}, status=422)
         eng = registry.get_generator(model)
@@ -78,6 +147,9 @@ def create_app(registry: ModelRegistry) -> web.Application:
                 temperature=temperature,
                 top_p=top_p,
                 json_format=json_format,
+                priority=priority,
+                tenant=tenant,
+                deadline_s=deadline_s,
             )
             usage = {
                 "model": model,
@@ -96,18 +168,41 @@ def create_app(registry: ModelRegistry) -> web.Application:
                     }
                 }
             )
+        except SchedulerRejected as e:
+            return _shed_response(e)
+        except DeadlineExceeded as e:
+            return web.json_response({"detail": str(e)}, status=504)
         except Exception as e:
             logger.exception("dialog failed")
             return web.json_response({"detail": str(e)}, status=500)
 
     async def healthz(request: web.Request) -> web.Response:
+        generators = {}
+        for name, eng in registry.generators.items():
+            g = {
+                "active_slots": eng.num_active,
+                "steps": eng.steps,
+                "reclaimed_slots": getattr(eng, "reclaimed_slots", 0),
+            }
+            sched = getattr(eng, "scheduler", None)
+            if sched is not None:
+                # queue depth, shed counters, per-class wait percentiles —
+                # the operator's overload dashboard
+                g["sched"] = sched.stats()
+            generators[name] = g
         return web.json_response(
             {
                 "status": "ok",
                 "models": sorted(registry.specs),
-                "generators": {
-                    name: {"active_slots": eng.num_active, "steps": eng.steps}
-                    for name, eng in registry.generators.items()
+                "generators": generators,
+                "embedders": {
+                    name: {
+                        "queue_depth": eng._queue.qsize(),
+                        "max_queue": getattr(eng, "max_queue", 0),
+                        "shed": getattr(eng, "shed", 0),
+                        "dropped_cancelled": getattr(eng, "dropped_cancelled", 0),
+                    }
+                    for name, eng in registry.embedders.items()
                 },
             }
         )

@@ -11,7 +11,7 @@ the MXU-resident HNSW replacement), and an adjacency-list tree instead of MPTT.
 from __future__ import annotations
 
 import datetime as _dt
-from typing import List, Optional
+from typing import List
 
 from .orm import (
     BoolField,

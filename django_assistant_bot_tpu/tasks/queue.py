@@ -9,7 +9,6 @@ import functools
 import inspect
 import json
 import logging
-import os
 import threading
 import time
 import traceback

@@ -1,6 +1,5 @@
 """Broadcasting plane: status machine, targeting, batch fan-out, stats, finalize."""
 
-import asyncio
 import datetime as dt
 
 import pytest
@@ -13,7 +12,6 @@ from django_assistant_bot_tpu.broadcasting.services import (
 )
 from django_assistant_bot_tpu.broadcasting.tasks import (
     check_scheduled_broadcasts,
-    send_broadcast_batch,
 )
 from django_assistant_bot_tpu.bot.domain import BotPlatform, UserUnavailableError
 from django_assistant_bot_tpu.conf import settings

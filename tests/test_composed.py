@@ -75,7 +75,7 @@ def test_composed_planes_webhook_to_generation(tmp_db, monkeypatch):
                 codename="composed-bot", telegram_token="1:composed"
             )
             user = models.BotUser.objects.create(user_id="c1", platform="telegram")
-            instance = models.Instance.objects.create(bot=bot, user=user)
+            models.Instance.objects.create(bot=bot, user=user)
 
             # KB embedded by the REAL mesh-sharded TPU encoder
             wiki = models.WikiDocument.objects.create(bot=bot, title="Billing")

@@ -49,7 +49,7 @@ def _send(bot, text, message_id):
 
 
 def test_task_creation_state_machine(bot, monkeypatch):
-    import example.bot as example_bot
+    import example.bot as example_bot  # noqa: F401 — registers the bot
 
     scripted = EchoProvider(script=["#create_task"])
     monkeypatch.setattr(

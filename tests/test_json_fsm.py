@@ -9,7 +9,6 @@ mask emits JSON approximately never.
 
 import json
 
-import numpy as np
 import pytest
 
 import jax
@@ -17,7 +16,6 @@ import jax
 from django_assistant_bot_tpu.models import DecoderConfig, llama
 from django_assistant_bot_tpu.ops.json_fsm import (
     build_char_dfa,
-    build_token_fsm,
     fsm_for_tokenizer,
 )
 from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine

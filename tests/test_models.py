@@ -14,7 +14,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from django_assistant_bot_tpu.models import DecoderConfig, EncoderConfig, encoder, llama
+from django_assistant_bot_tpu.models import DecoderConfig, encoder, llama
 from django_assistant_bot_tpu.models.hf_loader import load_decoder, load_encoder
 
 
@@ -409,7 +409,8 @@ def test_windowed_prefill_chunk_decode_matches_forward(tmp_path):
 
 
 def test_unsupported_rope_scaling_rejected(tiny_llama_dir, tmp_path):
-    import json, shutil
+    import json
+    import shutil
 
     d, _ = tiny_llama_dir
     bad = tmp_path / "badrope"
@@ -563,7 +564,8 @@ def test_phi3_matches_hf(tmp_path):
 
 def test_unsupported_decoder_family_rejected(tiny_gemma_dir, tmp_path):
     """gemma-2 etc. would load without error but mis-compute; reject up front."""
-    import json, shutil
+    import json
+    import shutil
 
     d, _ = tiny_gemma_dir
     bad = tmp_path / "fake_gemma2"

@@ -2,7 +2,7 @@
 
 import pytest
 
-from django_assistant_bot_tpu.native import NativeWordPieceTokenizer, native_available
+from django_assistant_bot_tpu.native import NativeWordPieceTokenizer
 from django_assistant_bot_tpu.native.build import build_library
 
 VOCAB = [

@@ -14,7 +14,6 @@ from django_assistant_bot_tpu.conf import settings
 from django_assistant_bot_tpu.loading import CSVLoader
 from django_assistant_bot_tpu.processing import signals  # noqa: F401 — activates post_save
 from django_assistant_bot_tpu.processing.tasks import (
-    document_processing_task,
     finalize_document_processing_task,
     wiki_processing_task,
 )
@@ -46,7 +45,9 @@ def _scripted(monkeypatch, script):
     from django_assistant_bot_tpu.ai import dialog as dialog_mod
 
     provider = EchoProvider(script=list(script))
-    monkeypatch.setattr(dialog_mod, "get_ai_provider", lambda model: provider)
+    monkeypatch.setattr(
+        dialog_mod, "get_ai_provider", lambda model, **kwargs: provider
+    )
     return provider
 
 

@@ -118,7 +118,6 @@ def test_verify_step_rejects_garbage_draft_and_matches_plain_step(tiny):
 def test_build_prompt_lookup_draft_bigram_and_fallbacks():
     """The draft is the span after the LAST bigram match; unigram fallback;
     no-match rows draft from the (rejectable) tail."""
-    S = 16
     hist = jnp.asarray(
         [
             # ... 7 8 50 ... 7 8 | pending=8, prev=7 -> expect draft [50, 60, 61]
