@@ -1344,6 +1344,178 @@ print(json.dumps(bench.bench_overload()))
 """
 
 
+def bench_stream() -> dict:
+    """stream_* section (serving/streaming.py evidence): perceived latency —
+    client-observed TTFT on the SAME concurrent trace, streaming (first delta
+    of generate_stream) vs non-streaming (the full-response wait the reference
+    contract imposes) — plus proof the token event queues don't throttle the
+    engine: decode tok/s with N streaming consumers attached vs detached
+    (futures only), interleaved A/B/A so drift on a shared chip can't fake a
+    regression.  Also asserts the streamed text is byte-identical to the
+    non-streaming greedy result (the detokenizer holdback contract).
+
+    Caveat recorded with the numbers: at SMALL/toy geometry the engine tick
+    is host-bound and shares the GIL with the consumer loop, so the
+    attached-vs-detached ratio there measures Python thread scheduling
+    (observed ±25% trial-to-trial on a shared host), not the event queues;
+    the per-arm rates ship in the record so variance is visible.  The
+    criterion binds on the real-geometry run, where ticks block in XLA with
+    the GIL released."""
+    import numpy as np
+
+    eng, _ = _build_gen_engine(max_slots=4, buckets=(32,))
+    # 4 admission waves of 4 slots, ~1s+ of wall per arm: short arms measure
+    # host-scheduler noise, not the event queues (observed ±25% trial-to-trial
+    # on a shared host at 8x48)
+    n_req, n_new, plen = 16, 64, 24
+    rng = np.random.default_rng(11)
+    prompts = [
+        "".join(chr(97 + int(c)) for c in rng.integers(0, 26, plen))
+        for _ in range(n_req)
+    ]
+    try:
+        eng.submit([1, 2, 3], max_tokens=4, temperature=0.0).result(timeout=600)
+
+        async def detached_arm():
+            # request/response path: the client sees NOTHING until the full
+            # result lands, so its "time to first content" IS full latency
+            t0 = time.perf_counter()
+            futs = [
+                eng.submit(
+                    eng.tokenizer.encode(p), max_tokens=n_new, temperature=0.8
+                )
+                for p in prompts
+            ]
+            results = [await asyncio.wrap_future(f) for f in futs]
+            wall = time.perf_counter() - t0
+            first_content = sorted(r.latency_s for r in results)
+            toks = sum(r.completion_tokens for r in results)
+            return first_content, toks / wall
+
+        async def attached_arm():
+            # the SAME submit-based trace and the SAME completion measurement
+            # (future resolution) as the detached arm — the ONLY difference
+            # is a live TokenStream per request, drained concurrently by this
+            # loop.  That isolates the question the acceptance criterion
+            # asks: do the event queues throttle the ENGINE?  (Consumer-side
+            # iteration wall time is a client cost, not an engine cost.)
+            from django_assistant_bot_tpu.serving import TokenStream
+
+            loop = asyncio.get_running_loop()
+            streams = [
+                TokenStream().bind(loop, capacity=n_new + 2) for _ in prompts
+            ]
+
+            async def drain(st, t_submit):
+                first, n = None, 0
+                async for kind, _payload in st:
+                    if kind == "token":
+                        if first is None:
+                            first = time.perf_counter() - t_submit
+                        n += 1
+                return first, n
+
+            t0 = time.perf_counter()
+            futs, drains = [], []
+            for p, st in zip(prompts, streams):
+                futs.append(
+                    eng.submit(
+                        eng.tokenizer.encode(p),
+                        max_tokens=n_new,
+                        temperature=0.8,
+                        stream=st,
+                    )
+                )
+                drains.append(
+                    asyncio.ensure_future(drain(st, time.perf_counter()))
+                )
+            results = [await asyncio.wrap_future(f) for f in futs]
+            wall = time.perf_counter() - t0
+            dr = await asyncio.gather(*drains)
+            firsts = sorted(d[0] for d in dr if d[0] is not None)
+            toks = sum(r.completion_tokens for r in results)
+            # streams skip EOS and results strip it: counts must agree exactly
+            assert sum(d[1] for d in dr) == toks, "streamed token count drifted"
+            return firsts, toks / wall
+
+        # interleaved A/B/A/B/A/B, best arm each: single-trial arm-to-arm
+        # drift on a shared chip is the same order as the effect under test,
+        # so one pair would report noise as throttling (or hide real
+        # throttling); best-of-3 per arm bounds both directions
+        nonstream_first: list = []
+        att_first: list = []
+        det_rates, att_rates = [], []
+        for _ in range(3):
+            f, r = asyncio.run(detached_arm())
+            nonstream_first += f
+            det_rates.append(r)
+            f, r = asyncio.run(attached_arm())
+            att_first += f
+            att_rates.append(r)
+        detached_tok_s = max(det_rates)
+        att_tok_s = max(att_rates)
+        att_first.sort()
+        nonstream_first.sort()
+
+        # byte identity: greedy (temperature 0) same prompt through both paths
+        ref = eng.submit(
+            eng.tokenizer.encode(prompts[0]), max_tokens=24, temperature=0.0
+        ).result(timeout=600)
+
+        async def collect():
+            parts, final = [], None
+            async for c in eng.generate_stream(
+                prompts[0], max_tokens=24, temperature=0.0
+            ):
+                parts.append(c.text)
+                if c.done:
+                    final = c.result
+            return "".join(parts), final
+
+        streamed_text, streamed_final = asyncio.run(collect())
+        stats = eng.tick_stats()
+    finally:
+        eng.stop()
+
+    def pctl(vals, frac):
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, max(0, math.ceil(frac * len(vals)) - 1))]
+
+    return {
+        "stream_ttft_p50_s": round(pctl(att_first, 0.50), 4),
+        "stream_ttft_p95_s": round(pctl(att_first, 0.95), 4),
+        "stream_nonstream_ttft_p50_s": round(pctl(nonstream_first, 0.50), 4),
+        "stream_nonstream_ttft_p95_s": round(pctl(nonstream_first, 0.95), 4),
+        "stream_ttft_speedup_p50": round(
+            pctl(nonstream_first, 0.50) / max(1e-9, pctl(att_first, 0.50)), 2
+        ),
+        "stream_attached_tokens_per_s": round(att_tok_s, 2),
+        "stream_detached_tokens_per_s": round(detached_tok_s, 2),
+        # ~1.0 = the event queues cost the engine nothing (acceptance: within
+        # ~2% noise of the detached baseline on real geometry)
+        "stream_attached_vs_detached": round(att_tok_s / max(1e-9, detached_tok_s), 4),
+        # per-arm rates (interleaved run order): trial variance is the error
+        # bar on the ratio above — judge the ratio against it
+        "stream_detached_rates": [round(r, 1) for r in det_rates],
+        "stream_attached_rates": [round(r, 1) for r in att_rates],
+        "stream_final_byte_identical": bool(
+            streamed_text == ref.text and streamed_final.text == ref.text
+        ),
+        "stream_concurrency": n_req,
+        "stream_new_tokens": n_new,
+        "stream_engine_ttft_p50_ms": stats.get("ttft_p50_ms"),
+        "stream_engine_itl_p50_ms": stats.get("itl_p50_ms"),
+    }
+
+
+_STREAM_SNIPPET = """
+import json
+import bench
+print(json.dumps(bench.bench_stream()))
+"""
+
+
 def baseline_embedding_torch_cpu() -> float:
     """Reference serving path: per-text torch forward loop (unbatched), CPU."""
     import torch
@@ -1855,6 +2027,12 @@ _COMPACT_KEYS = (
     "overload_sched_interactive_p95_wait_s",
     "overload_shed",
     "overload_deadline_reclaim_s",
+    "stream_ttft_p50_s",
+    "stream_ttft_p95_s",
+    "stream_nonstream_ttft_p50_s",
+    "stream_ttft_speedup_p50",
+    "stream_attached_vs_detached",
+    "stream_final_byte_identical",
     "rag_turn2_p50_ttft_s",
     "bench_elapsed_s",
 )
@@ -1947,6 +2125,7 @@ def main() -> None:
             moe_eng.stop()
         extras.update(bench_ingestion())
         extras.update(bench_overload())
+        extras.update(bench_stream())
         baseline_thread.join(timeout=600)
         emit()
         return
@@ -1993,6 +2172,10 @@ def main() -> None:
     #     above-capacity mixed trace (interactive p50/p95 wait, shed + 429
     #     contract, deadline slot reclaim — serving/scheduler.py evidence)
     run("overload", _OVERLOAD_SNIPPET, cap_s=400)
+    # 3d) streaming: client TTFT streaming-vs-nonstreaming on the same trace
+    #     + attached/detached decode throughput (the token event queues must
+    #     not throttle the engine — serving/streaming.py evidence)
+    run("stream", _STREAM_SNIPPET, cap_s=400)
     # 4) config 4b: KNN at 1M-corpus scale (build/append/query latency)
     ecfg = _encoder_cfg()
     run(

@@ -38,3 +38,12 @@ class AIDialog(AIProvider):
         self, messages: List[Message], max_tokens: int = 1024, json_format: bool = False
     ) -> AIResponse:
         return await self._provider.get_response(messages, max_tokens, json_format)
+
+    def stream_response(
+        self, messages: List[Message], max_tokens: int = 1024, json_format: bool = False
+    ):
+        # returns the provider's async iterator directly (native streams keep
+        # streaming; others get the buffered default adapter)
+        return self._provider.stream_response(
+            messages, max_tokens=max_tokens, json_format=json_format
+        )

@@ -8,13 +8,26 @@ it is one code path.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import re
 from abc import ABC, abstractmethod
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 from ...utils.debug import TimeDebugger
 from ..domain import AIResponse, Message
+
+
+@dataclasses.dataclass
+class AIStreamChunk:
+    """One provider-level streaming event: a text ``delta``, or the terminal
+    chunk (``done=True``) carrying the full :class:`AIResponse` — whose
+    ``result`` equals the concatenation of every delta for natively-streaming
+    providers, and is the authoritative value either way."""
+
+    delta: str = ""
+    done: bool = False
+    response: Optional[AIResponse] = None
 
 
 class AIProvider(ABC):
@@ -34,6 +47,32 @@ class AIProvider(ABC):
         max_tokens: int = 1024,
         json_format: bool = False,
     ) -> AIResponse: ...
+
+    async def stream_response(
+        self,
+        messages: List[Message],
+        max_tokens: int = 1024,
+        json_format: bool = False,
+    ) -> AsyncIterator[AIStreamChunk]:
+        """Async iterator of :class:`AIStreamChunk`: text deltas, then one
+        terminal chunk with the full :class:`AIResponse`.
+
+        Default adapter: buffer the whole :meth:`get_response` result and
+        yield it as a single delta — every existing provider (OpenAI, Ollama,
+        Groq, Echo scripts) streams correctly with zero changes, just without
+        progressive output.  Providers with a native token stream (TPU
+        in-process, gpu_service SSE) override this."""
+        resp = await self.get_response(
+            messages, max_tokens=max_tokens, json_format=json_format
+        )
+        text = (
+            resp.result
+            if isinstance(resp.result, str)
+            else json.dumps(resp.result, ensure_ascii=False)
+        )
+        if text:
+            yield AIStreamChunk(delta=text)
+        yield AIStreamChunk(done=True, response=resp)
 
 
 class AIEmbedder(ABC):

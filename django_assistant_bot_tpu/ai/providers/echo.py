@@ -10,12 +10,13 @@ from __future__ import annotations
 
 import hashlib
 import json
+import re
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from ..domain import AIResponse, Message
-from .base import AIEmbedder, AIProvider
+from .base import AIEmbedder, AIProvider, AIStreamChunk
 
 
 class EchoProvider(AIProvider):
@@ -62,6 +63,29 @@ class EchoProvider(AIProvider):
         if json_format:
             return AIResponse(result={"echo": last_user}, usage=usage)
         return AIResponse(result=f"echo: {last_user}", usage=usage)
+
+    async def stream_response(
+        self,
+        messages: List[Message],
+        max_tokens: int = 1024,
+        json_format: bool = False,
+    ):
+        """Deterministic word-by-word stream for tests: the scripted/echoed
+        text split into word+whitespace pieces whose concatenation is
+        byte-identical to the ``get_response`` result."""
+        resp = await self.get_response(
+            messages, max_tokens=max_tokens, json_format=json_format
+        )
+        text = (
+            resp.result
+            if isinstance(resp.result, str)
+            else json.dumps(resp.result, ensure_ascii=False)
+        )
+        # lossless partition: non-space runs keep their trailing whitespace;
+        # a leading whitespace run is its own piece
+        for piece in re.findall(r"\S+\s*|\s+", text):
+            yield AIStreamChunk(delta=piece)
+        yield AIStreamChunk(done=True, response=resp)
 
 
 class HashEmbedder(AIEmbedder):

@@ -9,19 +9,40 @@ reference's torch microservice and this framework's own TPU server
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 from typing import List, Optional
 
 import aiohttp
 
 from ..domain import AIResponse, Message
-from .base import AIEmbedder, AIProvider, approx_tokens, parse_json_response
+from .base import (
+    AIEmbedder,
+    AIProvider,
+    AIStreamChunk,
+    approx_tokens,
+    parse_json_response,
+)
 
 logger = logging.getLogger(__name__)
 
 # load-shed (429) retry policy: bounded attempts, Retry-After-honoring sleeps
 SHED_RETRIES = 3
 SHED_MAX_SLEEP_S = 10.0
+
+
+async def _iter_sse_lines(content):
+    """Split an SSE body into lines WITHOUT aiohttp's readline (its 64 KiB
+    line cap would reject the terminal event, which carries the whole result
+    text in one ``data:`` line on long generations)."""
+    buf = b""
+    async for chunk in content.iter_any():
+        buf += chunk
+        while b"\n" in buf:
+            raw, buf = buf.split(b"\n", 1)
+            yield raw.decode("utf-8", errors="replace").strip()
+    if buf:
+        yield buf.decode("utf-8", errors="replace").strip()
 
 
 async def _post_with_shed_retry(session, url: str, payload: dict):
@@ -103,6 +124,74 @@ class GPUServiceProvider(AIProvider):
             result=result,
             usage=body.get("usage"),
             length_limited=body.get("length_limited", False),
+        )
+
+    async def stream_response(
+        self,
+        messages: List[Message],
+        max_tokens: int = 1024,
+        json_format: bool = False,
+    ):
+        """Consume the server's ``text/event-stream`` wire format
+        (docs/STREAMING.md): per-delta ``data:`` events, a terminal event with
+        usage + the authoritative full text, then ``[DONE]``.  The server
+        rejects ``stream`` + ``json_format`` (422), so JSON requests buffer
+        through the base adapter here."""
+        if json_format:
+            async for chunk in AIProvider.stream_response(
+                self, messages, max_tokens=max_tokens, json_format=True
+            ):
+                yield chunk
+            return
+        self.calls_attempts.append(1)
+        payload = {
+            "model": self._model,
+            "messages": list(messages),
+            "max_tokens": max_tokens,
+            "json_format": False,
+            "stream": True,
+            "priority": self._priority,
+            "tenant": self._tenant,
+        }
+        if self._deadline_s is not None:
+            payload["deadline_s"] = self._deadline_s
+        acc: List[str] = []
+        async with aiohttp.ClientSession(timeout=self._timeout) as session:
+            async with await _post_with_shed_retry(
+                session, f"{self._base}/dialog/", payload
+            ) as resp:
+                async for line in _iter_sse_lines(resp.content):
+                    if not line.startswith("data:"):
+                        continue
+                    data = line[len("data:"):].strip()
+                    if data == "[DONE]":
+                        break
+                    event = json.loads(data)
+                    if event.get("done"):
+                        if event.get("finish_reason") == "error":
+                            raise RuntimeError(
+                                f"stream failed mid-generation: "
+                                f"{event.get('error', 'unknown error')}"
+                            )
+                        result = event.get("result")
+                        yield AIStreamChunk(
+                            done=True,
+                            response=AIResponse(
+                                result="".join(acc) if result is None else result,
+                                usage=event.get("usage"),
+                                length_limited=event.get("length_limited", False),
+                            ),
+                        )
+                        return
+                    delta = event.get("delta", "")
+                    if delta:
+                        acc.append(delta)
+                        yield AIStreamChunk(delta=delta)
+        # stream closed without a terminal event (server died mid-stream):
+        # surface what arrived rather than silently dropping the turn
+        yield AIStreamChunk(
+            done=True,
+            response=AIResponse(result="".join(acc), usage=None, length_limited=False),
         )
 
 

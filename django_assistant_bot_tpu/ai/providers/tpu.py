@@ -19,7 +19,7 @@ from typing import List, Optional
 from ...conf import settings
 from ...utils.repeat_until import RepeatUntilError, repeat_until
 from ..domain import AIResponse, Message
-from .base import AIEmbedder, AIProvider, parse_json_response
+from .base import AIEmbedder, AIProvider, AIStreamChunk, parse_json_response
 
 _registry = None
 _registry_lock = threading.Lock()
@@ -134,16 +134,10 @@ class TPUProvider(AIProvider):
                 tenant=self._tenant,
                 deadline_s=self._deadline_s,
             )
-            usage = {
-                "model": self._model,
-                "prompt_tokens": result.prompt_tokens,
-                "completion_tokens": result.completion_tokens,
-                "total_tokens": result.prompt_tokens + result.completion_tokens,
-                "ttft_s": result.ttft_s,
-                "latency_s": result.latency_s,
-            }
             return AIResponse(
-                result=result.text, usage=usage, length_limited=result.length_limited
+                result=result.text,
+                usage=result.usage_dict(self._model),
+                length_limited=result.length_limited,
             )
 
         if not json_format:
@@ -166,6 +160,48 @@ class TPUProvider(AIProvider):
             resp.result = parsed if parsed is not None else {}
         self.calls_attempts.append(attempts)
         return resp
+
+    async def stream_response(
+        self,
+        messages: List[Message],
+        max_tokens: int = 1024,
+        json_format: bool = False,
+    ):
+        """Native in-process stream: tokens flow straight from the decode tick
+        (engine.generate_stream) with no HTTP hop.  ``json_format`` output is
+        only valid as a whole document (the repair/repeat loop may rewrite
+        it), so it buffers through the base adapter instead."""
+        if json_format:
+            async for chunk in AIProvider.stream_response(
+                self, messages, max_tokens=max_tokens, json_format=True
+            ):
+                yield chunk
+            return
+        self.calls_attempts.append(1)
+        agen = self._engine.generate_stream(
+            list(messages),
+            max_tokens=max_tokens,
+            temperature=0.8,
+            priority=self._priority,
+            tenant=self._tenant,
+            deadline_s=self._deadline_s,
+        )
+        async for c in agen:
+            if c.done:
+                r = c.result
+                if c.text:
+                    yield AIStreamChunk(delta=c.text)
+                yield AIStreamChunk(
+                    done=True,
+                    response=AIResponse(
+                        result=r.text,
+                        usage=r.usage_dict(self._model),
+                        length_limited=r.length_limited,
+                    ),
+                )
+                return
+            if c.text:
+                yield AIStreamChunk(delta=c.text)
 
 
 class TPUEmbedder(AIEmbedder):

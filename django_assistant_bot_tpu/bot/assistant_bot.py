@@ -60,6 +60,9 @@ class AssistantBot(Bot):
         self.messages: List[GPTMessage] = []
         self.debug_info: Dict = {}
         self.resource_manager: Optional[ResourceManager] = None
+        # the chat being answered this turn — progressive streamed delivery
+        # needs it while the generation is still running
+        self._chat_id: Optional[str] = None
 
     def __init_subclass__(cls, **kwargs):
         # Each subclass gets its own command table (the reference shares one
@@ -119,6 +122,7 @@ class AssistantBot(Bot):
 
         logger.info("instance %s text: %s", self.instance.id, update.text)
 
+        self._chat_id = update.chat_id
         answer_task = asyncio.create_task(self._get_answer(self.dialog, update))
         typing_task = asyncio.create_task(self.delayed_typing(update.chat_id, answer_task))
         try:
@@ -264,6 +268,32 @@ class AssistantBot(Bot):
             strong_ai_model=self._get_strong_ai_model(),
             resource_manager=self.resource_manager,
         )
+        if (
+            settings.STREAM_BOT_ANSWERS
+            and getattr(self.platform, "supports_partial", False)
+            and self._chat_id
+        ):
+            # progressive delivery: the first streamed chunk posts early and
+            # edit-updates ride the token cadence (throttled); the returned
+            # answer is marked already_delivered so the task plane only
+            # stores it.  Any pre-stream failure falls through to the plain
+            # request/response path below — never a lost turn.
+            from .services.dialog_service import deliver_streamed_answer
+
+            try:
+                stream = chat_completion.generate_answer_stream(
+                    messages, debug_info=debug_info, do_interrupt=do_interrupt
+                )
+                return await deliver_streamed_answer(
+                    self.platform,
+                    self._chat_id,
+                    stream,
+                    answer_builder=self._ai_response_to_answer,
+                )
+            except Exception:
+                logger.exception(
+                    "progressive delivery failed; falling back to whole-message"
+                )
         ai_answer = await chat_completion.generate_answer(
             messages, debug_info=debug_info, do_interrupt=do_interrupt
         )

@@ -52,3 +52,36 @@ class ChatCompletion:
         strong_ai = get_ai_provider(self.strong_ai_model)
         with AIDebugger(strong_ai, debug_info, "final"):
             return await strong_ai.get_response(enriched_messages)
+
+    async def generate_answer_stream(
+        self,
+        messages: List[Message],
+        debug_info: Optional[Dict] = None,
+        do_interrupt: Optional[Callable[[], Awaitable[bool]]] = None,
+    ):
+        """Streaming variant of :meth:`generate_answer`: identical enrichment
+        pipeline, then the strong model's ``stream_response`` — an async
+        iterator of :class:`~..ai.providers.base.AIStreamChunk` ending with
+        the terminal chunk's full :class:`AIResponse`.  Providers without a
+        native stream yield one buffered chunk (the base adapter), so every
+        configured model works; only the delivery granularity differs."""
+        debug_info = debug_info if debug_info is not None else {}
+        if messages:
+            debug_info["query"] = messages[-1]["content"]
+
+        context_service = ContextService(
+            bot=self.bot,
+            fast_ai_model=self.fast_ai_model,
+            strong_ai_model=self.strong_ai_model,
+            messages=messages,
+            debug_info=debug_info,
+            do_interrupt=do_interrupt,
+        )
+        enriched_messages = await context_service.enrich()
+
+        strong_ai = get_ai_provider(self.strong_ai_model)
+        # the debugger brackets the whole consumption: entered before the
+        # first token, exited when the terminal chunk (or an abort) lands
+        with AIDebugger(strong_ai, debug_info, "final"):
+            async for chunk in strong_ai.stream_response(enriched_messages):
+                yield chunk

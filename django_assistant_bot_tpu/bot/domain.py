@@ -170,6 +170,7 @@ class SingleAnswer:
         no_store: bool = False,
         audio: Optional[Audio] = None,
         disable_web_page_preview: Optional[bool] = None,
+        already_delivered: bool = False,
     ):
         self.text = text
         self.thinking = thinking
@@ -183,6 +184,10 @@ class SingleAnswer:
         self.no_store = no_store
         self.audio = audio
         self.disable_web_page_preview = disable_web_page_preview
+        # progressive streaming delivery already posted/edited this answer in
+        # place; the task plane must not post it a second time (it still flows
+        # through on_answer_sent for history storage)
+        self.already_delivered = already_delivered
         self._raw_text = raw_text
 
     @property
@@ -216,6 +221,7 @@ class SingleAnswer:
             "raw_text": self._raw_text,
             "audio": self.audio.to_dict() if self.audio else None,
             "disable_web_page_preview": self.disable_web_page_preview,
+            "already_delivered": self.already_delivered,
         }
 
     @classmethod
@@ -285,7 +291,16 @@ def answer_from_dict(data: Dict) -> Answer:
 
 class BotPlatform(ABC):
     """Adapter between a messaging platform and the engine
-    (reference: assistant/bot/domain.py:281-300)."""
+    (reference: assistant/bot/domain.py:281-300).
+
+    Platforms with message editing (Telegram) additionally implement the
+    partial-delivery trio below and flip ``supports_partial``; the default is
+    False, so progressive streaming falls back to whole-message delivery on
+    every other platform with zero adapter changes."""
+
+    # progressive delivery capability: post_partial/edit_partial/
+    # finalize_partial are implemented and safe to call
+    supports_partial: bool = False
 
     @property
     @abstractmethod
@@ -299,6 +314,23 @@ class BotPlatform(ABC):
 
     @abstractmethod
     async def action_typing(self, chat_id: str) -> None: ...
+
+    async def post_partial(self, chat_id: str, text: str) -> Optional[Any]:
+        """Post the first streamed chunk; returns a platform message handle
+        for later edits, or None when posting failed (caller falls back to
+        whole-message delivery)."""
+        raise NotImplementedError(f"{self.codename} does not support partial posts")
+
+    async def edit_partial(self, chat_id: str, message_id: Any, text: str) -> bool:
+        """Replace a partial message's text with the longer accumulation."""
+        raise NotImplementedError(f"{self.codename} does not support edits")
+
+    async def finalize_partial(
+        self, chat_id: str, message_id: Any, answer: SingleAnswer
+    ) -> bool:
+        """The final edit: formatted text + keyboards.  Always attempted once
+        the stream completes, regardless of the edit throttle."""
+        raise NotImplementedError(f"{self.codename} does not support edits")
 
 
 class Bot(ABC):

@@ -96,6 +96,26 @@ class TelegramAPI:
             raise TelegramAPIError(code, desc)
         return data["result"]
 
+    async def edit_message_text(
+        self,
+        chat_id: str,
+        message_id: Any,
+        text: str,
+        *,
+        parse_mode: Optional[str] = None,
+        reply_markup: Optional[Dict] = None,
+    ) -> Dict:
+        """editMessageText — progressive answer delivery updates one message
+        in place instead of posting a new one per chunk."""
+        return await self.call(
+            "editMessageText",
+            chat_id=chat_id,
+            message_id=message_id,
+            text=text,
+            parse_mode=parse_mode,
+            reply_markup=reply_markup,
+        )
+
     async def send_chat_action(self, chat_id: str, action: str = "typing") -> Any:
         return await self.call("sendChatAction", chat_id=chat_id, action=action)
 

@@ -29,6 +29,11 @@ _PERMANENT_FORBIDDEN = ("bot was kicked", "group chat was deleted", "user is dea
 
 
 class TelegramBotPlatform(BotPlatform):
+    # editMessageText exists -> progressive streamed answers deliver as one
+    # message updated in place (bot/services/dialog_service.py
+    # deliver_streamed_answer throttles the edit cadence)
+    supports_partial = True
+
     def __init__(self, token: str, api: Optional[TelegramAPI] = None):
         self.api = api or TelegramAPI(token)
 
@@ -184,6 +189,73 @@ class TelegramBotPlatform(BotPlatform):
             except TelegramForbidden as e:
                 self._check_forbidden(e, chat_id)
                 return
+
+    # ------------------------------------------------------ partial delivery
+    async def post_partial(self, chat_id: str, text: str):
+        """First streamed chunk: plain text (the accumulating raw stream is
+        not guaranteed to be parseable MarkdownV2 at arbitrary cut points),
+        no keyboard yet.  Returns the message_id for the edit loop, or None
+        on failure — the caller then falls back to whole-message delivery."""
+        try:
+            msg = await self.api.send_message(chat_id, text)
+            return msg.get("message_id")
+        except TelegramForbidden as e:
+            self._check_forbidden(e, chat_id)
+            return None
+        except TelegramBadRequest as e:
+            logger.warning("partial post failed to %s: %s", chat_id, e)
+            return None
+
+    async def edit_partial(self, chat_id: str, message_id, text: str) -> bool:
+        try:
+            await self.api.edit_message_text(chat_id, message_id, text)
+            return True
+        except TelegramBadRequest as e:
+            if "message is not modified" in e.description.lower():
+                return True  # same text: counts as an applied edit
+            logger.warning("partial edit failed to %s: %s", chat_id, e)
+            return False
+        except TelegramForbidden as e:
+            self._check_forbidden(e, chat_id)
+            return False
+
+    async def finalize_partial(self, chat_id: str, message_id, answer: SingleAnswer) -> bool:
+        """Final edit: MarkdownV2 with plain-text retry (same fallback ladder
+        as post_answer) plus the answer's keyboard.  Text past Telegram's
+        4096-char message cap cannot be edited in: return False so the task
+        plane posts the full answer whole (the path long answers always
+        took)."""
+        if answer.text and len(answer.text) > 4096:
+            logger.warning(
+                "final text exceeds Telegram's message cap (%d chars); "
+                "falling back to whole-message delivery", len(answer.text),
+            )
+            return False
+        reply_markup = self._reply_markup(answer)
+        rendered = format_markdown_v2(answer.text)
+        for parse_mode, text in (("MarkdownV2", rendered), (None, answer.text)):
+            try:
+                await self.api.edit_message_text(
+                    chat_id,
+                    message_id,
+                    text,
+                    parse_mode=parse_mode,
+                    reply_markup=reply_markup,
+                )
+                return True
+            except TelegramBadRequest as e:
+                desc = e.description.lower()
+                if "can't parse" in desc and parse_mode == "MarkdownV2":
+                    logger.warning("MarkdownV2 parse failed on final edit; retrying plain: %s", e)
+                    continue
+                if "message is not modified" in desc:
+                    return True
+                logger.error("final edit failed to %s: %s", chat_id, e)
+                return False
+            except TelegramForbidden as e:
+                self._check_forbidden(e, chat_id)
+                return False
+        return False
 
     async def action_typing(self, chat_id: str) -> None:
         try:

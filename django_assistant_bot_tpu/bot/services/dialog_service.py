@@ -14,13 +14,15 @@ import datetime as _dt
 import hashlib
 import logging
 import os
-from typing import List, Optional
+import re
+import time
+from typing import Any, AsyncIterator, Callable, List, Optional
 
-from ...ai.domain import Message as GPTMessage
+from ...ai.domain import AIResponse, Message as GPTMessage
 from ...ai.services.ai_service import calculate_ai_cost
 from ...conf import settings
 from ...storage.models import Dialog, Instance, Message, Role
-from ..domain import Photo, SingleAnswer
+from ..domain import BotPlatform, Photo, SingleAnswer
 
 logger = logging.getLogger(__name__)
 
@@ -71,6 +73,125 @@ def get_last_message(dialog: Dialog) -> Optional[Message]:
     return Message.objects.filter(dialog=dialog).order_by("-timestamp", "-id").first()
 
 
+# Telegram caps message text at 4096 chars; partials stay safely under it so
+# the edit loop can't start failing mid-answer (the overflow tail rides the
+# final whole-message fallback, the same path long answers always took)
+PARTIAL_TEXT_CAP = 3900
+
+_THINK_OPEN = "<think>"
+_THINK_CLOSE = "</think>"
+
+
+def _displayable_partial(text: str) -> str:
+    """What a PARTIAL message may show of the raw accumulation: an open
+    ``<think>`` block is internal reasoning mid-flight — hide it (show only
+    what precedes it) until it closes, then strip it the same way the final
+    answer's tag extraction will.  Capped at :data:`PARTIAL_TEXT_CAP`."""
+    if _THINK_CLOSE in text:
+        text = re.sub(r".*?</think>", "", text, flags=re.DOTALL)
+    elif _THINK_OPEN in text:
+        text = text.split(_THINK_OPEN, 1)[0]
+    if len(text) > PARTIAL_TEXT_CAP:
+        text = text[:PARTIAL_TEXT_CAP] + "…"
+    return text
+
+
+async def deliver_streamed_answer(
+    platform: BotPlatform,
+    chat_id: str,
+    stream: AsyncIterator,
+    *,
+    answer_builder: Callable[[AIResponse], Optional[SingleAnswer]],
+    min_edit_interval_s: Optional[float] = None,
+    min_first_chars: int = 8,
+    clock: Optional[Callable[[], float]] = None,
+) -> Optional[SingleAnswer]:
+    """Progressive answer delivery: post the first streamed chunk early, then
+    edit the same message with the accumulation, throttled to
+    ``min_edit_interval_s`` between edits (Telegram's edit rate limit; default
+    ``settings.STREAM_EDIT_INTERVAL_S``), with the FINAL edit always sent.
+
+    ``stream`` yields provider-level :class:`~....ai.providers.base.
+    AIStreamChunk` events; ``answer_builder`` turns the terminal
+    :class:`AIResponse` into the outgoing :class:`SingleAnswer` (tag
+    extraction, buttons — the bot's ``_ai_response_to_answer``).  Partials
+    never show an open ``<think>`` block (:func:`_displayable_partial`) and
+    stay under Telegram's message-length cap.
+
+    Fallback ladder (each step degrades to today's whole-message behavior):
+    a platform without ``supports_partial``, a failed or raising first post,
+    or a stream whose only content is the terminal chunk all return an
+    UNdelivered answer for the task plane to post whole.  Platform errors
+    during edits/finalize are swallowed here — only STREAM (provider) errors
+    propagate, so the caller's regeneration fallback never double-generates
+    because of a flaky edit.  When partial delivery succeeded, the returned
+    answer carries ``already_delivered=True`` so the task plane only stores
+    it.
+
+    Throttling never sleeps: an edit inside the quiet window is simply
+    skipped, and the next chunk past the window carries the whole
+    accumulation — token cadence drives the loop, so a fake ``clock`` makes
+    the cadence unit-testable."""
+    if min_edit_interval_s is None:
+        min_edit_interval_s = settings.STREAM_EDIT_INTERVAL_S
+    clock = clock or time.monotonic
+    supports = bool(getattr(platform, "supports_partial", False))
+    acc: List[str] = []
+    message_id: Any = None
+    last_edit = 0.0
+    final: Optional[AIResponse] = None
+    async for chunk in stream:
+        if chunk.done:
+            final = chunk.response
+            break
+        if not chunk.delta:
+            continue
+        acc.append(chunk.delta)
+        if not supports:
+            continue
+        text = _displayable_partial("".join(acc))
+        if message_id is None:
+            # wait for a minimally-presentable first chunk so the user does
+            # not see a single stray word flash up
+            if len(text.strip()) < min_first_chars:
+                continue
+            try:
+                message_id = await platform.post_partial(chat_id, text)
+            except Exception:
+                logger.exception("partial post raised; whole-message fallback")
+                message_id = None
+            if message_id is None:
+                supports = False  # partial post failed; deliver whole at the end
+                continue
+            last_edit = clock()
+        elif clock() - last_edit >= min_edit_interval_s:
+            try:
+                if await platform.edit_partial(chat_id, message_id, text):
+                    last_edit = clock()
+            except Exception:
+                # a flaky edit (rate limit, network blip) must not abort the
+                # stream consumption — the next window retries with more text
+                logger.warning("partial edit raised; will retry", exc_info=True)
+    if final is None:
+        raise RuntimeError("answer stream ended without a terminal chunk")
+    answer = answer_builder(final)
+    if answer is None:
+        # nothing deliverable (e.g. the whole output was a thinking block —
+        # which partials never showed); history stores nothing
+        return None
+    if message_id is not None:
+        # the final edit is always attempted: it swaps the raw accumulation
+        # for the cleaned/formatted text + keyboard even when nothing changed
+        # since the last throttled edit.  A raising finalize degrades to the
+        # whole-message fallback rather than failing the turn.
+        try:
+            if await platform.finalize_partial(chat_id, message_id, answer):
+                answer.already_delivered = True
+        except Exception:
+            logger.exception("finalize edit raised; whole-message fallback")
+    return answer
+
+
 def _media_secret(media_root: str) -> bytes:
     """Per-install random secret mixed into media filenames.
 
@@ -84,9 +205,17 @@ def _media_secret(media_root: str) -> bytes:
     The secret lives as a SIBLING of the SERVED media root
     (``<root>.secret``), never inside it: everything under MEDIA_ROOT is
     statically served auth-exempt (api/app.py), so a secret stored within
-    would itself be downloadable.  Creation is write-tmp + atomic replace —
-    a crashed or racing creator can never leave a partial/empty file that
-    wedges every later save."""
+    would itself be downloadable.
+
+    First write is EXCLUSIVE (create-then-read-winner): the fresh secret is
+    written+fsynced to a tmp file, then hard-linked into place — ``os.link``
+    fails with EEXIST when another process already created the file, and the
+    loser READS THE WINNER instead of replacing it.  The previous
+    write-tmp + ``os.replace`` pattern let two concurrent first-savers each
+    install a different secret, so photos HMAC'd in flight by the loser got
+    paths the winner's secret can never re-derive (orphaned duplicates on
+    webhook redelivery).  Linking only after fsync means a reader can never
+    observe a partial file."""
     path = os.path.normpath(media_root) + ".secret"
     try:
         with open(path, "rb") as f:
@@ -97,17 +226,31 @@ def _media_secret(media_root: str) -> bytes:
         pass
     fresh = os.urandom(32)
     tmp = f"{path}.{os.getpid()}.tmp"
-    # O_TRUNC, not O_EXCL: a stale tmp (crashed earlier run, recycled pid)
-    # must not wedge creation; the pid suffix keeps cross-process tmps apart
+    # O_TRUNC, not O_EXCL, for the TMP file: a stale tmp (crashed earlier
+    # run, recycled pid) must not wedge creation; the pid suffix keeps
+    # cross-process tmps apart.  Exclusivity is enforced at the link below.
     fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
     try:
         os.write(fd, fresh)
         os.fsync(fd)
     finally:
         os.close(fd)
-    os.replace(tmp, path)
-    # a racing creator may have replaced after us — re-read so concurrent
-    # processes converge on whichever complete file won
+    try:
+        os.link(tmp, path)  # atomic create-exclusive of a COMPLETE file
+    except FileExistsError:
+        pass  # raced: another process won; read its secret below
+    except OSError:
+        # filesystem without hard links: degrade to replace-if-still-absent
+        # (the exclusivity window narrows to this branch only)
+        if not os.path.exists(path):
+            os.replace(tmp, path)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    # converge on the winner — whoever created `path` first; every process
+    # (winner included) reads the same installed bytes
     with open(path, "rb") as f:
         return f.read() or fresh
 

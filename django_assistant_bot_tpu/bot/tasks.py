@@ -82,6 +82,10 @@ async def _answer_task(
 async def _post_answer(platform: BotPlatform, chat_id: str, answer: Answer) -> None:
     parts = answer.parts if isinstance(answer, MultiPartAnswer) else [answer]
     for part in parts:
+        if getattr(part, "already_delivered", False):
+            # progressive streaming already posted + final-edited this part
+            # in place; re-posting would duplicate the message
+            continue
         await platform.post_answer(chat_id, part)
 
 

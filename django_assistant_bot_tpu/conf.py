@@ -71,6 +71,15 @@ class Settings:
         self.TASK_ALWAYS_EAGER: bool = str(_env("TASK_ALWAYS_EAGER", "0")) in ("1", "true", "True")
         # dialog lifecycle
         self.DIALOG_TTL_S: int = int(_env("DIALOG_TTL_S", 24 * 3600))
+        # progressive answer delivery: post the first streamed chunk early and
+        # edit-update it (platforms with edit support only; Telegram edits are
+        # throttled to >= STREAM_EDIT_INTERVAL_S apart, final edit always
+        # sent).  Off by default: whole-message delivery is the reference
+        # behavior and the non-streaming bench baseline.
+        self.STREAM_BOT_ANSWERS: bool = str(_env("STREAM_BOT_ANSWERS", "0")) in (
+            "1", "true", "True",
+        )
+        self.STREAM_EDIT_INTERVAL_S: float = float(_env("STREAM_EDIT_INTERVAL_S", 1.0))
         # vector schema (reference fixes 768 for ruBert — assistant/storage/models.py:13;
         # configurable here so tiny dev models and other embedders fit the same schema)
         self.EMBEDDING_DIM: int = int(_env("EMBEDDING_DIM", 768))

@@ -9,15 +9,22 @@ deficiencies).  This plane is one process driving the whole TPU slice:
 - :mod:`.tokenizer` — HF tokenizer wrapper + byte-level fallback, chat templating;
 - :mod:`.engine`    — continuous-batching generation engine (slot-based KV cache,
   bucketed prefill, jit decode tick) and a coalescing batched embedding engine;
+- :mod:`.streaming` — per-request token streams + UTF-8-safe incremental
+  detokenization (``GenerationEngine.generate_stream`` and the SSE wire);
 - :mod:`.scheduler` — admission-controlled request scheduler (priority classes,
   weighted per-tenant fair share, deadlines, bounded queue + load shedding);
 - :mod:`.registry`  — model registry loading checkpoints onto the mesh;
 - :mod:`.server`    — aiohttp app exposing the reference's exact HTTP contract
-  (``POST /embeddings/``, ``POST /dialog/``).
+  (``POST /embeddings/``, ``POST /dialog/``) plus SSE streaming.
 """
 
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer  # noqa: F401
 from .engine import EmbeddingEngine, GenerationEngine, GenerationResult  # noqa: F401
+from .streaming import (  # noqa: F401
+    IncrementalDetokenizer,
+    StreamChunk,
+    TokenStream,
+)
 from .scheduler import (  # noqa: F401
     DeadlineExceeded,
     RequestScheduler,

@@ -83,6 +83,18 @@ class GenerationResult:
     ttft_s: float = 0.0
     latency_s: float = 0.0
 
+    def usage_dict(self, model: str) -> dict:
+        """The wire-format usage object (HTTP responses, provider AIResponse
+        usage, SSE terminal events) — one construction for every consumer."""
+        return {
+            "model": model,
+            "prompt_tokens": self.prompt_tokens,
+            "completion_tokens": self.completion_tokens,
+            "total_tokens": self.prompt_tokens + self.completion_tokens,
+            "ttft_s": self.ttft_s,
+            "latency_s": self.latency_s,
+        }
+
 
 @dataclasses.dataclass
 class _Request:
@@ -106,6 +118,10 @@ class _Request:
     # slot-residency start (prefill begins): the service-time sample the
     # scheduler's estimated-wait model is fed on finish
     started_at: Optional[float] = None
+    # per-request token event sink (serving/streaming.py TokenStream): fed a
+    # deque-append per sampled id from _process_tick — already host-resident
+    # data, so streaming adds zero device syncs.  None = request/response.
+    stream: Any = None
 
 
 # slot-cache precision knob -> concrete dtype (None = the model's cfg.dtype);
@@ -136,6 +152,8 @@ class _Prefix:
 class _Slot:
     request: _Request
     generated: List[int] = dataclasses.field(default_factory=list)
+    # host arrival time of the previous token (inter-token-latency samples)
+    last_token_at: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -311,6 +329,19 @@ class GenerationEngine:
         # live slots reclaimed before finishing (expired deadline / client
         # cancel) — each one freed mid-decode instead of burning ticks
         self.reclaimed_slots = 0
+        # the client-cancel subset of the above: a streaming consumer that
+        # disconnected mid-generation (its iterator cancelled the future) —
+        # the disconnect-reaping evidence /healthz and tick_stats expose
+        self.cancelled_slots = 0
+        # perceived-latency samples, host-side: TTFT (submit -> first token on
+        # host) and inter-token gaps as _process_tick consumes device results.
+        # Bounded windows; read via latency_stats()/tick_stats()/healthz.
+        self._ttft_s: "collections.deque[float]" = collections.deque(maxlen=1024)
+        self._itl_s: "collections.deque[float]" = collections.deque(maxlen=4096)
+        # streams owed a wakeup, flushed at the end of each _process_tick:
+        # one cross-thread notify per stream per tick, delivered just before
+        # the engine thread returns to device work (engine-thread-only state)
+        self._stream_notify: set = set()
         self.mesh = mesh
         self._cache_shardings = (
             llama.cache_shardings(cfg, mesh, max_slots) if mesh is not None else None
@@ -737,6 +768,7 @@ class GenerationEngine:
         priority: str = "interactive",
         tenant: str = "default",
         deadline_s: Optional[float] = None,
+        stream: Any = None,
     ) -> Future:
         """Thread-safe submission; returns a concurrent Future[GenerationResult].
 
@@ -749,7 +781,12 @@ class GenerationEngine:
         serving/scheduler.py).  With a scheduler attached, submission may
         raise :class:`SchedulerRejected` synchronously (load shed — the
         request was never queued); an expired deadline fails the future with
-        :class:`DeadlineExceeded` and frees its decode slot."""
+        :class:`DeadlineExceeded` and frees its decode slot.
+
+        ``stream``: a :class:`~.streaming.TokenStream` to receive per-token
+        events as device results resolve (EOS is not emitted) plus a terminal
+        event wired through the future's done-callback — every resolution
+        path (finish, deadline, failure, cancel) closes the stream."""
         prompt_ids = list(prompt_ids)
         if json_format and self.speculative:
             raise ValueError(
@@ -775,6 +812,10 @@ class GenerationEngine:
         prefix_len = max(0, min(int(prefix_len), len(prompt_ids) - 1))
         now = time.monotonic()
         fut: Future = Future()
+        if stream is not None:
+            # attach BEFORE the queue put: if the engine resolves (or drains)
+            # the future immediately, the callback still fires post-hoc
+            fut.add_done_callback(stream.finish)
         self._queue.put(
             _Request(
                 prompt_ids=prompt_ids,
@@ -789,6 +830,7 @@ class GenerationEngine:
                 tenant=tenant,
                 deadline_at=(now + deadline_s) if deadline_s is not None else None,
                 admitted=admitted,
+                stream=stream,
             )
         )
         # A stop() racing (or preceding) the put above would leave the request
@@ -835,6 +877,86 @@ class GenerationEngine:
             deadline_s=deadline_s,
         )
         return await asyncio.wrap_future(fut)
+
+    async def generate_stream(
+        self,
+        prompt: str | Sequence[dict],
+        *,
+        max_tokens: int = 1024,
+        temperature: float = 0.8,
+        top_p: float = 0.95,
+        json_format: bool = False,
+        priority: str = "interactive",
+        tenant: str = "default",
+        deadline_s: Optional[float] = None,
+    ):
+        """Async iterator of :class:`~.streaming.StreamChunk`: per-token
+        UTF-8-safe text deltas as device results resolve, then one terminal
+        chunk with the finish reason and the full :class:`GenerationResult`.
+
+        The concatenation of every chunk's ``text`` is byte-identical to the
+        non-streaming ``generate()`` result for the same request + seed —
+        incomplete multi-byte fragments are held back, never replaced.
+
+        Abandoning the iterator (``aclose``/GC on client disconnect) cancels
+        the request; the engine's per-iteration reap frees its decode slot
+        within one tick via the deadline epoch mechanism, so an abandoned
+        generation stops burning device capacity immediately.
+
+        ``json_format`` streams the grammar-constrained tokens as ordinary
+        text deltas (each prefix is a prefix of one valid JSON document); the
+        HTTP layer rejects ``stream`` + ``json_format`` instead — see
+        docs/STREAMING.md."""
+        import asyncio
+
+        from .streaming import IncrementalDetokenizer, StreamChunk, TokenStream
+        from .tokenizer import encode_chat_split
+
+        if isinstance(prompt, str):
+            ids, plen = self.tokenizer.encode(prompt), 0
+        else:
+            ids, plen = encode_chat_split(self.tokenizer, prompt)
+        stream = TokenStream().bind(
+            asyncio.get_running_loop(), capacity=int(max_tokens) + 2
+        )
+        fut = self.submit(
+            ids,
+            max_tokens=max_tokens,
+            temperature=temperature,
+            top_p=top_p,
+            json_format=json_format,
+            prefix_len=plen,
+            priority=priority,
+            tenant=tenant,
+            deadline_s=deadline_s,
+            stream=stream,
+        )
+        detok = IncrementalDetokenizer(self.tokenizer)
+        idx = 0
+        try:
+            async for kind, payload in stream:
+                if kind == "token":
+                    text = detok.push(payload)
+                    yield StreamChunk(index=idx, token_id=payload, text=text)
+                    idx += 1
+                    continue
+                if isinstance(payload, BaseException):
+                    raise payload
+                result: GenerationResult = payload
+                yield StreamChunk(
+                    index=idx,
+                    token_id=None,
+                    text=detok.flush(),
+                    done=True,
+                    finish_reason="length" if result.length_limited else "stop",
+                    result=result,
+                )
+                return
+        finally:
+            # consumer gone (disconnect / break / error): cancel so the
+            # per-iteration reap frees the slot within one decode tick
+            if not fut.done():
+                fut.cancel()
 
     @property
     def num_active(self) -> int:
@@ -936,6 +1058,10 @@ class GenerationEngine:
             self._json[i] = False
             self._sampling_dirty = True
             self.reclaimed_slots += 1
+            if not expired:
+                # future.cancelled(): a streaming consumer disconnected (or a
+                # client dropped its future) — same reap, separate counter
+                self.cancelled_slots += 1
             if expired:
                 _safe_resolve(
                     req.future,
@@ -1435,6 +1561,9 @@ class GenerationEngine:
             )
         st.step += 1
         if st.request.future.cancelled():
+            # the consumer vanished mid-prefill: abandon the remaining chunks
+            self.reclaimed_slots += 1
+            self.cancelled_slots += 1
             self._chunking = None
             return
         dl = st.request.deadline_at
@@ -1558,10 +1687,39 @@ class GenerationEngine:
                 self.spec_accepted / max(1, self.spec_drafted), 4
             )
         out["reclaimed_slots"] = self.reclaimed_slots
+        out.update(self.latency_stats())
         if self.scheduler is not None:
             # queue-pressure snapshot: depth/pressure/shed/wait percentiles
             out["sched"] = self.scheduler.stats()
         return out
+
+    @staticmethod
+    def _pctl_ms(samples, frac: float) -> float:
+        vals = sorted(samples)
+        if not vals:
+            return 0.0
+        idx = min(len(vals) - 1, max(0, round(frac * (len(vals) - 1))))
+        return round(vals[idx] * 1e3, 3)
+
+    def latency_stats(self) -> dict:
+        """Perceived-latency percentiles over the recent sample windows:
+        TTFT (submit -> first token on host) and inter-token latency, plus
+        the disconnect counter — the streaming plane's operator dashboard
+        (also exposed per-generator in /healthz).  ITL samples are host
+        BATCH-arrival gaps: burst/speculative ticks deliver several tokens
+        at once, so per-token cadence is roughly the gap divided by the
+        tokens-per-tick."""
+        ttft = list(self._ttft_s)
+        itl = list(self._itl_s)
+        return {
+            "ttft_p50_ms": self._pctl_ms(ttft, 0.50),
+            "ttft_p95_ms": self._pctl_ms(ttft, 0.95),
+            "ttft_n": len(ttft),
+            "itl_p50_ms": self._pctl_ms(itl, 0.50),
+            "itl_p95_ms": self._pctl_ms(itl, 0.95),
+            "itl_n": len(itl),
+            "cancelled_slots": self.cancelled_slots,
+        }
 
     def probe_decode(self, iters: int = 16, fill_len: Optional[int] = None) -> float:
         """Pure device decode rate: `iters` burst ticks issued back-to-back with
@@ -1762,19 +1920,32 @@ class GenerationEngine:
 
     def _process_tick(self):
         """Consume the oldest in-flight result (blocks until it arrives)."""
+        try:
+            self._process_tick_inner()
+        finally:
+            # deferred stream wakeups: one notify per touched stream per tick
+            # (see TokenStream.push_token), flushed even on a mid-tick error
+            # so no consumer is left waiting on already-appended events
+            if self._stream_notify:
+                for st in self._stream_notify:
+                    st.notify_now()
+                self._stream_notify.clear()
+
+    def _process_tick_inner(self):
         ref = self._inflight.popleft()
         t0 = time.monotonic()
         vals = np.asarray(ref.nxt)
         self._tick_block_s += time.monotonic() - t0
         self._ticks_processed += 1
+        now = time.monotonic()
         if ref.first:
             for j, (slot, epoch) in enumerate(ref.slots):
                 s = self._slots[slot]
                 if s is None or self._slot_epoch[slot] != epoch:
                     continue
                 tok = int(vals[ref.offset + j])
-                s.request.first_token_at = time.monotonic()
                 s.generated.append(tok)
+                self._note_token(s, tok, now)
                 if self._should_finish(slot, tok):
                     self._finish(slot)
             return
@@ -1790,11 +1961,10 @@ class GenerationEngine:
                 if s.request.temperature <= 0:
                     self.spec_drafted += K
                     self.spec_accepted += max(0, n - 1)
-                if s.request.first_token_at is None and n > 0:
-                    s.request.first_token_at = time.monotonic()
                 for k in range(n):
                     tok = int(vals[k, slot])
                     s.generated.append(tok)
+                    self._note_token(s, tok, now)
                     if self._should_finish(slot, tok):
                         self._finish(slot)
                         break  # remaining accepted tokens are post-EOS garbage
@@ -1806,10 +1976,30 @@ class GenerationEngine:
                     continue  # finished by an earlier token; speculation dropped
                 tok = int(vals[k, slot])
                 s.generated.append(tok)
-                if s.request.first_token_at is None:
-                    s.request.first_token_at = time.monotonic()
+                self._note_token(s, tok, now)
                 if self._should_finish(slot, tok):
                     self._finish(slot)
+
+    def _note_token(self, s: _Slot, tok: int, now: float) -> None:
+        """Per-token host bookkeeping where device results land: TTFT and
+        inter-token-latency samples, plus fan-out to the request's token
+        stream (a deque append — the id is already host-resident from the
+        inflight pipeline, so streaming adds no device sync).  EOS is not
+        emitted: ``_finish`` strips it from the result text too."""
+        req = s.request
+        if req.first_token_at is None:
+            req.first_token_at = now
+            self._ttft_s.append(now - req.submitted_at)
+        elif s.last_token_at is not None and now > s.last_token_at:
+            # tokens of one tick batch share `now` — a zero "gap" between
+            # burst/speculative batch-mates would collapse the percentiles to
+            # 0; sampling only across batches measures the real host-arrival
+            # cadence (per-token ITL ~ gap / tokens-per-tick)
+            self._itl_s.append(now - s.last_token_at)
+        s.last_token_at = now
+        if req.stream is not None and tok != self.tokenizer.eos_id:
+            if req.stream.push_token(tok, notify=False):
+                self._stream_notify.add(req.stream)
 
     def _should_finish(self, slot: int, tok: int) -> bool:
         s = self._slots[slot]

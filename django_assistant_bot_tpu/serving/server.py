@@ -7,13 +7,20 @@ Endpoint parity (reference: gpu_service/main.py:75-107):
   ``{"response": {"result": str, "usage": {...}, "length_limited": bool}}``
 - 400 "Model is not supported" for unknown models; 500 with detail on failure.
 
-Extras the reference lacks: ``GET /healthz`` (engine/slot stats) and ``GET /models``.
+Extras the reference lacks: ``GET /healthz`` (engine/slot stats) and ``GET /models``,
+plus ``"stream": true`` on ``/dialog/`` — a ``text/event-stream`` response with
+per-token delta events and a terminal usage event (wire format in
+docs/STREAMING.md).  A mid-stream client disconnect cancels the engine request,
+which frees its decode slot within one tick.  The non-streaming path is
+byte-identical to before (the bench baseline).
 One process, one mesh, engines shared across all requests — the continuous batcher
 gives cross-request batching instead of gunicorn worker replicas.
 """
 
 from __future__ import annotations
 
+import asyncio
+import json
 import logging
 import math
 from typing import Any, Mapping, Optional
@@ -92,6 +99,105 @@ def _shed_response(e: SchedulerRejected) -> web.Response:
     )
 
 
+def _usage(model: str, result) -> dict:
+    return result.usage_dict(model)
+
+
+def _sse(payload) -> bytes:
+    data = payload if isinstance(payload, str) else json.dumps(payload)
+    return f"data: {data}\n\n".encode("utf-8")
+
+
+async def _stream_dialog(
+    request: web.Request, eng, model: str, messages, **gen_kwargs
+) -> web.StreamResponse:
+    """``"stream": true`` -> ``text/event-stream`` (wire format in
+    docs/STREAMING.md): one ``data:`` event per emitted text delta, a terminal
+    event carrying finish reason + usage + the full result text, then a
+    literal ``[DONE]``.
+
+    The FIRST chunk is awaited before the response is prepared so synchronous
+    failures (load shed, infeasible deadline, bad request) still map to their
+    proper HTTP statuses; later failures surface as an ``error`` event on the
+    open stream.  A client disconnect mid-stream abandons the generator, whose
+    cleanup cancels the engine request — the per-iteration reap then frees the
+    decode slot within one tick (the deadline epoch mechanism)."""
+    agen = eng.generate_stream(messages, **gen_kwargs)
+    try:
+        first = await agen.__anext__()
+    except StopAsyncIteration:
+        first = None
+    except SchedulerRejected as e:
+        return _shed_response(e)
+    except DeadlineExceeded as e:
+        return web.json_response({"detail": str(e)}, status=504)
+    except Exception as e:
+        logger.exception("stream dialog failed before first token")
+        return web.json_response({"detail": str(e)}, status=500)
+
+    resp = web.StreamResponse(
+        status=200,
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "X-Accel-Buffering": "no",
+        },
+    )
+    await resp.prepare(request)
+    try:
+        chunk = first
+        while chunk is not None:
+            if chunk.done:
+                if chunk.text:  # flushed hold-back tail rides its own event
+                    await resp.write(
+                        _sse({"delta": chunk.text, "index": chunk.index})
+                    )
+                r = chunk.result
+                await resp.write(
+                    _sse(
+                        {
+                            "done": True,
+                            "finish_reason": chunk.finish_reason,
+                            "result": r.text,
+                            "usage": _usage(model, r),
+                            "length_limited": r.length_limited,
+                        }
+                    )
+                )
+                break
+            if chunk.text:
+                await resp.write(_sse({"delta": chunk.text, "index": chunk.index}))
+            try:
+                chunk = await agen.__anext__()
+            except StopAsyncIteration:
+                break
+        await resp.write(_sse("[DONE]"))
+        await resp.write_eof()
+    except (
+        asyncio.CancelledError,
+        ConnectionResetError,
+        ConnectionError,
+    ):
+        # client went away mid-stream; the finally's aclose() cancels the
+        # engine request so its slot frees within one decode tick
+        logger.info("stream client disconnected mid-generation")
+        raise
+    except Exception as e:
+        # already committed to 200: surface the failure as an error event
+        logger.exception("stream dialog failed mid-stream")
+        try:
+            await resp.write(
+                _sse({"done": True, "finish_reason": "error", "error": str(e)})
+            )
+            await resp.write(_sse("[DONE]"))
+            await resp.write_eof()
+        except (ConnectionResetError, ConnectionError):
+            pass
+    finally:
+        await agen.aclose()
+    return resp
+
+
 def create_app(registry: ModelRegistry) -> web.Application:
     app = web.Application()
     app[REGISTRY_KEY] = registry
@@ -126,6 +232,18 @@ def create_app(registry: ModelRegistry) -> web.Application:
                 raise ValueError("model must be a string")
             messages = body["messages"]
             json_format = bool(body.get("json_format", False))
+            stream = body.get("stream", False)
+            if not isinstance(stream, bool):
+                raise _BadRequest("stream must be a boolean")
+            if stream and json_format:
+                # documented choice (docs/STREAMING.md): constrained-JSON
+                # output is only validated as a whole document, and partial
+                # JSON is not independently consumable — reject rather than
+                # pretend chunks are usable
+                raise _BadRequest(
+                    "stream is not supported with json_format; "
+                    "request one or the other"
+                )
             temperature, top_p, max_tokens = _validate_sampling(body)
             priority, tenant, deadline_s = _scheduling_fields(request, body)
         except _BadRequest as e:
@@ -135,6 +253,19 @@ def create_app(registry: ModelRegistry) -> web.Application:
         eng = registry.get_generator(model)
         if eng is None:
             return web.json_response({"detail": "Model is not supported"}, status=400)
+        if stream:
+            return await _stream_dialog(
+                request,
+                eng,
+                model,
+                messages,
+                max_tokens=max_tokens,
+                temperature=temperature,
+                top_p=top_p,
+                priority=priority,
+                tenant=tenant,
+                deadline_s=deadline_s,
+            )
         try:
             # json_format enables grammar-constrained decoding: a JSON token-FSM
             # masks sampling inside the decode tick (ops/json_fsm.py), so the
@@ -151,19 +282,11 @@ def create_app(registry: ModelRegistry) -> web.Application:
                 tenant=tenant,
                 deadline_s=deadline_s,
             )
-            usage = {
-                "model": model,
-                "prompt_tokens": result.prompt_tokens,
-                "completion_tokens": result.completion_tokens,
-                "total_tokens": result.prompt_tokens + result.completion_tokens,
-                "ttft_s": result.ttft_s,
-                "latency_s": result.latency_s,
-            }
             return web.json_response(
                 {
                     "response": {
                         "result": result.text,
-                        "usage": usage,
+                        "usage": _usage(model, result),
                         "length_limited": result.length_limited,
                     }
                 }
@@ -184,6 +307,11 @@ def create_app(registry: ModelRegistry) -> web.Application:
                 "steps": eng.steps,
                 "reclaimed_slots": getattr(eng, "reclaimed_slots", 0),
             }
+            latency = getattr(eng, "latency_stats", None)
+            if callable(latency):
+                # TTFT / inter-token-latency percentiles + disconnect count —
+                # the streaming plane's perceived-latency dashboard
+                g["stream"] = latency()
             sched = getattr(eng, "scheduler", None)
             if sched is not None:
                 # queue depth, shed counters, per-class wait percentiles —

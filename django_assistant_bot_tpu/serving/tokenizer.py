@@ -29,6 +29,9 @@ class ByteTokenizer:
     pad_id = 256
     bos_id = 257
     eos_id = 258
+    # decode == UTF-8 of the concatenated token_bytes(): the streaming
+    # detokenizer may use its O(1)-per-token incremental-codec fast path
+    byte_level = True
 
     def encode(self, text: str) -> List[int]:
         return [self.bos_id] + list(text.encode("utf-8"))

@@ -169,16 +169,29 @@ def test_csv_loader_builds_tree(tmp_path):
 
 
 def test_language_matches_only_known_jitter_pairs(monkeypatch):
-    """Equivalence is limited to detector-jitter pairs (ru<->uk; short-latin
-    'en') — a German answer to an English document must FAIL (r4 advisor:
-    whole-script-group equivalence was too broad)."""
+    """Equivalence is limited to detector-jitter pairs (ru<->uk; latin 'en'
+    default; symmetric latin pairs on SHORT chunks only) — a full-length
+    German answer to an English document must FAIL (r4 advisor: whole-script
+    equivalence was too broad; r5: one-way en acceptance spun repeat_until)."""
     from django_assistant_bot_tpu.processing import utils as pu
 
-    monkeypatch.setattr(pu, "get_language", lambda t: t)  # text IS the code
+    # detected code = first token of the text, so length is controllable
+    monkeypatch.setattr(pu, "get_language", lambda t: t.split()[0])
+    pu.language_jitter_counts.clear()
+    long_pad = " x" * pu.LATIN_JITTER_MAX_CHARS  # pushes past the threshold
     assert pu.language_matches("ru", "uk") and pu.language_matches("uk", "ru")
     assert pu.language_matches("fr", "en")  # short latin chunks read as en
+    assert pu.language_matches("fr", "en" + long_pad)  # en default: any length
     assert pu.language_matches(None, "anything")
-    assert not pu.language_matches("en", "de")
-    assert not pu.language_matches("en", "es")
+    # the r5 asymmetry fix: expected en + detected fr/nl on a SHORT chunk is
+    # detector jitter, not a wrong-language answer
+    assert pu.language_matches("en", "fr")
+    assert pu.language_matches("en", "nl")
+    # ...but a long answer in the wrong language still fails
+    assert not pu.language_matches("en", "de" + long_pad)
+    assert not pu.language_matches("en", "es" + long_pad)
     assert not pu.language_matches("ru", "en")
     assert not pu.language_matches("en", "ru")
+    # jitter direction is observable
+    assert pu.language_jitter_counts["en->fr"] == 1
+    assert pu.language_jitter_counts["fr->en"] == 2

@@ -1,0 +1,739 @@
+"""End-to-end token streaming: engine iterator, SSE wire, providers, delivery.
+
+Covers the docs/STREAMING.md contracts:
+
+- byte identity: the concatenation of streamed deltas equals the non-streaming
+  decode of the same ids (engine iterator AND the SSE path);
+- UTF-8 safety: incremental detokenization over random multi-byte (emoji/CJK)
+  token splits never emits a replacement character for an incomplete fragment;
+- cancellation: abandoning a stream (client disconnect) cancels the request
+  and frees its decode slot within one tick, counted in ``tick_stats``;
+- provider adapters: EchoProvider word-by-word, the buffered default adapter,
+  GPUServiceProvider consuming the SSE wire;
+- progressive bot delivery: first-chunk post + throttled edits + final edit,
+  exercised with a fake clock.
+"""
+
+import asyncio
+import json
+import random
+import time
+
+import pytest
+
+import jax
+
+from django_assistant_bot_tpu.ai.domain import AIResponse
+from django_assistant_bot_tpu.ai.providers.base import AIProvider, AIStreamChunk
+from django_assistant_bot_tpu.ai.providers.echo import EchoProvider
+from django_assistant_bot_tpu.models import DecoderConfig, llama
+from django_assistant_bot_tpu.serving import (
+    ByteTokenizer,
+    GenerationEngine,
+    IncrementalDetokenizer,
+    ModelRegistry,
+)
+from django_assistant_bot_tpu.serving.server import create_app
+
+
+# ------------------------------------------------------- incremental detok
+MULTIBYTE_CORPUS = (
+    "hello world! "
+    "héllo café naïve "
+    "👋🌍🤖🔥💡🧪 "
+    "日本語のテキストです "
+    "한국어 텍스트 "
+    "привет мир "
+    "🇺🇦🇯🇵 👩‍👩‍👧‍👦 "  # flags + ZWJ family: 4-byte clusters
+)
+
+
+class _NonByteTokenizer(ByteTokenizer):
+    """Forces the general (full re-decode) path of the detokenizer."""
+
+    byte_level = False
+
+
+@pytest.mark.parametrize("tok_cls", [ByteTokenizer, _NonByteTokenizer])
+def test_incremental_detok_property_random_splits(tok_cls):
+    """Property: for random multi-byte strings fed ONE TOKEN AT A TIME (the
+    worst-case split — every UTF-8 continuation byte lands in its own push),
+    the concatenated deltas are byte-identical to the one-shot decode and no
+    replacement character is ever fabricated."""
+    tok = tok_cls()
+    rng = random.Random(7)
+    chars = MULTIBYTE_CORPUS
+    for _ in range(40):
+        s = "".join(rng.choice(chars) for _ in range(rng.randint(0, 30)))
+        ids = tok.encode(s)  # includes BOS (renders to nothing)
+        detok = IncrementalDetokenizer(tok)
+        parts = [detok.push(i) for i in ids]
+        parts.append(detok.flush())
+        out = "".join(parts)
+        assert out == tok.decode(ids) == s
+        assert "�" not in out
+        # every multi-byte character arrived whole in exactly one delta
+        for p in parts:
+            assert "�" not in p
+
+
+def test_incremental_detok_flushes_truncated_tail():
+    """A generation cut mid-character (length limit) still matches the
+    one-shot decode: the replacement chars appear only at flush, exactly as
+    the non-streaming decode would produce them."""
+    tok = ByteTokenizer()
+    ids = list("né".encode("utf-8"))[:-1]  # drop the é's continuation byte
+    detok = IncrementalDetokenizer(tok)
+    mid = "".join(detok.push(i) for i in ids)
+    assert "�" not in mid  # never mid-stream
+    assert mid + detok.flush() == tok.decode(ids)
+
+
+# ----------------------------------------------------------- engine stream
+@pytest.fixture(scope="module")
+def stream_engine():
+    import dataclasses as _dc
+
+    # a LONG context so the disconnect test's abandoned generation would run
+    # for thousands of ticks if the cancel didn't reap it
+    cfg = _dc.replace(DecoderConfig.tiny(), max_seq_len=2048)
+    params = llama.init(cfg, jax.random.key(0))
+    eng = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=2048
+    ).start()
+    yield eng
+    eng.stop()
+
+
+def _collect_stream(eng, prompt, **kw):
+    async def go():
+        parts, chunks, final = [], [], None
+        async for c in eng.generate_stream(prompt, **kw):
+            chunks.append(c)
+            parts.append(c.text)
+            if c.done:
+                final = c
+        return "".join(parts), chunks, final
+
+    return asyncio.run(go())
+
+
+def test_engine_stream_byte_identical_to_generate(stream_engine):
+    """Greedy stream == greedy non-stream for the same request: same token
+    ids, and the delta concatenation equals the non-streaming text byte for
+    byte (acceptance criterion #1)."""
+    eng = stream_engine
+    prompt = "hello streaming world"
+    ref = eng.submit(
+        eng.tokenizer.encode(prompt), max_tokens=12, temperature=0.0
+    ).result(timeout=300)
+    text, chunks, final = _collect_stream(
+        eng, prompt, max_tokens=12, temperature=0.0
+    )
+    assert final is not None and final.done
+    assert final.result.token_ids == ref.token_ids
+    assert text == ref.text == final.result.text
+    token_chunks = [c for c in chunks if not c.done]
+    assert [c.index for c in token_chunks] == list(range(len(token_chunks)))
+    assert len(token_chunks) == len(ref.token_ids)
+    assert final.finish_reason in ("stop", "length")
+
+
+def test_engine_stream_disconnect_frees_slot_within_tick(stream_engine):
+    """Abandoning the iterator mid-generation cancels the request; the
+    per-iteration reap frees the slot almost immediately (one decode tick,
+    not the ~2000 remaining tokens) and counts it in tick_stats."""
+    eng = stream_engine
+    before = eng.cancelled_slots
+
+    async def go():
+        agen = eng.generate_stream("x" * 16, max_tokens=2000, temperature=0.8)
+        got = 0
+        async for c in agen:
+            got += 1
+            if got >= 2:
+                break  # client gone; generator cleanup cancels the future
+        await agen.aclose()
+        return got
+
+    assert asyncio.run(go()) >= 2
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if eng.num_active == 0 and eng.cancelled_slots > before:
+            break
+        time.sleep(0.005)
+    assert eng.num_active == 0, "slot not reclaimed after stream abandonment"
+    stats = eng.tick_stats()
+    assert stats["cancelled_slots"] > before
+    assert stats["reclaimed_slots"] >= stats["cancelled_slots"]
+
+
+def test_engine_stream_latency_stats(stream_engine):
+    """TTFT/ITL percentiles accumulate from streamed traffic."""
+    eng = stream_engine
+    _collect_stream(eng, "stats please", max_tokens=8, temperature=0.0)
+    stats = eng.tick_stats()
+    assert stats["ttft_n"] >= 1 and stats["ttft_p50_ms"] > 0
+    assert stats["itl_n"] >= 1
+    assert stats["itl_p95_ms"] >= stats["itl_p50_ms"] >= 0
+
+
+# ---------------------------------------------------------------- SSE wire
+@pytest.fixture(scope="module")
+def sse_client():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    loop = asyncio.new_event_loop()
+    registry = ModelRegistry.from_config(
+        {
+            "tiny-chat": {
+                "kind": "decoder", "tiny": True, "max_slots": 2,
+                "max_seq_len": 1024,
+            },
+        }
+    )
+    client = TestClient(TestServer(create_app(registry)), loop=loop)
+    loop.run_until_complete(client.start_server())
+    yield loop, client, registry
+    loop.run_until_complete(client.close())
+    loop.close()
+
+
+async def _read_sse(resp, limit=None):
+    events = []
+    async for raw in resp.content:
+        line = raw.decode("utf-8").strip()
+        if not line.startswith("data:"):
+            continue
+        data = line[len("data:"):].strip()
+        if data == "[DONE]":
+            break
+        events.append(json.loads(data))
+        if limit is not None and len(events) >= limit:
+            break
+    return events
+
+
+def test_sse_dialog_happy_path(sse_client):
+    """stream:true responds text/event-stream; delta concatenation equals the
+    terminal event's full result (byte identity over the wire), usage rides
+    the terminal event, and the non-streaming path is untouched."""
+    loop, client, _ = sse_client
+    body = {
+        "model": "tiny-chat",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 6,
+        "temperature": 0.0,
+        "stream": True,
+    }
+
+    async def go():
+        resp = await client.post("/dialog/", json=body)
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        events = await _read_sse(resp)
+        terminal = events[-1]
+        assert terminal["done"] is True
+        assert terminal["finish_reason"] in ("stop", "length")
+        usage = terminal["usage"]
+        assert usage["completion_tokens"] <= 6
+        assert usage["total_tokens"] == (
+            usage["prompt_tokens"] + usage["completion_tokens"]
+        )
+        deltas = "".join(e["delta"] for e in events if "delta" in e)
+        assert deltas == terminal["result"]
+
+        # same request non-streaming (greedy -> identical result text)
+        plain = dict(body)
+        del plain["stream"]
+        resp2 = await client.post("/dialog/", json=plain)
+        assert resp2.status == 200
+        data = await resp2.json()
+        assert data["response"]["result"] == terminal["result"]
+
+    loop.run_until_complete(go())
+
+
+def test_sse_rejects_json_format(sse_client):
+    """Documented choice: stream + json_format is a 422, not buffered SSE."""
+    loop, client, _ = sse_client
+
+    async def go():
+        resp = await client.post(
+            "/dialog/",
+            json={
+                "model": "tiny-chat",
+                "messages": [{"role": "user", "content": "hi"}],
+                "json_format": True,
+                "stream": True,
+            },
+        )
+        assert resp.status == 422
+        assert "json_format" in (await resp.json())["detail"]
+        # non-bool stream flag is a 422 too, not a silent cast
+        resp = await client.post(
+            "/dialog/",
+            json={
+                "model": "tiny-chat",
+                "messages": [{"role": "user", "content": "hi"}],
+                "stream": "yes",
+            },
+        )
+        assert resp.status == 422
+
+    loop.run_until_complete(go())
+
+
+def test_sse_unknown_model_is_400(sse_client):
+    loop, client, _ = sse_client
+
+    async def go():
+        resp = await client.post(
+            "/dialog/",
+            json={"model": "nope", "messages": [], "stream": True},
+        )
+        assert resp.status == 400
+
+    loop.run_until_complete(go())
+
+
+def test_sse_disconnect_frees_slot(sse_client):
+    """Closing the HTTP connection mid-stream cancels the engine request: the
+    slot frees within ~a tick (not after the remaining ~900 tokens) and the
+    disconnect lands in the cancelled counter /healthz exposes."""
+    loop, client, registry = sse_client
+    eng = registry.get_generator("tiny-chat")
+    before = eng.cancelled_slots
+
+    async def go():
+        resp = await client.post(
+            "/dialog/",
+            json={
+                "model": "tiny-chat",
+                "messages": [{"role": "user", "content": "stream then vanish"}],
+                "max_tokens": 900,
+                "stream": True,
+            },
+        )
+        assert resp.status == 200
+        got = await _read_sse(resp, limit=2)
+        assert got  # generation is live
+        resp.close()  # client disconnects mid-stream
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if eng.num_active == 0 and eng.cancelled_slots > before:
+                break
+            await asyncio.sleep(0.01)
+        assert eng.num_active == 0
+        assert eng.cancelled_slots > before
+
+        # the counter surfaces on /healthz
+        health = await (await client.get("/healthz")).json()
+        g = health["generators"]["tiny-chat"]
+        assert g["stream"]["cancelled_slots"] >= eng.cancelled_slots - 1
+        assert "ttft_p50_ms" in g["stream"]
+
+    loop.run_until_complete(go())
+
+
+# ------------------------------------------------------- provider adapters
+def test_echo_provider_streams_word_by_word():
+    prov = EchoProvider(script=["alpha  beta\ngamma 🤖 done"])
+
+    async def go():
+        return [
+            c
+            async for c in prov.stream_response(
+                [{"role": "user", "content": "q"}]
+            )
+        ]
+
+    chunks = asyncio.run(go())
+    assert chunks[-1].done and chunks[-1].response is not None
+    deltas = [c.delta for c in chunks if not c.done]
+    assert len(deltas) >= 4  # genuinely word-by-word, not one blob
+    assert "".join(deltas) == "alpha  beta\ngamma 🤖 done"
+    assert chunks[-1].response.result == "alpha  beta\ngamma 🤖 done"
+
+
+def test_default_stream_adapter_buffers_whole_response():
+    """A provider that never heard of streaming still streams: the base
+    adapter yields its whole get_response result once, then the terminal."""
+
+    class Plain(AIProvider):
+        calls_attempts = []
+
+        @property
+        def context_size(self):
+            return 100
+
+        def calculate_tokens(self, text):
+            return 1
+
+        async def get_response(self, messages, max_tokens=1024, json_format=False):
+            if json_format:
+                return AIResponse(result={"k": "v"}, usage=None)
+            return AIResponse(result="whole thing", usage=None)
+
+    async def go(json_format):
+        return [
+            c
+            async for c in Plain().stream_response(
+                [{"role": "user", "content": "q"}], json_format=json_format
+            )
+        ]
+
+    chunks = asyncio.run(go(False))
+    assert [c.delta for c in chunks if not c.done] == ["whole thing"]
+    assert chunks[-1].done and chunks[-1].response.result == "whole thing"
+    # dict results stream as their JSON text; the terminal keeps the dict
+    jchunks = asyncio.run(go(True))
+    assert json.loads(jchunks[0].delta) == {"k": "v"}
+    assert jchunks[-1].response.result == {"k": "v"}
+
+
+def test_gpu_service_provider_consumes_sse(sse_client):
+    """GPUServiceProvider speaks the SSE wire format end-to-end against the
+    real server: deltas arrive progressively and the terminal response carries
+    the authoritative text + usage."""
+    from django_assistant_bot_tpu.ai.providers.http_service import GPUServiceProvider
+
+    loop, client, _ = sse_client
+    base = str(client.server.make_url("")).rstrip("/")
+    prov = GPUServiceProvider(base, "tiny-chat")
+
+    async def go():
+        return [
+            c
+            async for c in prov.stream_response(
+                [{"role": "user", "content": "over the wire"}], max_tokens=5
+            )
+        ]
+
+    chunks = loop.run_until_complete(go())
+    assert chunks[-1].done
+    resp = chunks[-1].response
+    assert "".join(c.delta for c in chunks if not c.done) == resp.result
+    assert resp.usage["completion_tokens"] <= 5
+
+
+@pytest.mark.slow
+def test_tpu_provider_stream_response():
+    """tpu: provider streams in-process from the engine; json_format buffers
+    through the base adapter (whole validated document, single delta)."""
+    from django_assistant_bot_tpu.ai.providers.tpu import (
+        TPUProvider,
+        reset_shared_registry,
+    )
+
+    reset_shared_registry()
+    try:
+        prov = TPUProvider("stream-tiny")
+
+        async def go():
+            return [
+                c
+                async for c in prov.stream_response(
+                    [{"role": "user", "content": "hello"}], max_tokens=6
+                )
+            ]
+
+        chunks = asyncio.run(go())
+        assert chunks[-1].done
+        resp = chunks[-1].response
+        assert "".join(c.delta for c in chunks if not c.done) == resp.result
+        assert resp.usage["completion_tokens"] >= 1
+    finally:
+        reset_shared_registry()
+
+
+# ---------------------------------------------------- progressive delivery
+class FakePlatform:
+    supports_partial = True
+
+    def __init__(self, fail_post=False):
+        self.posted = []
+        self.edits = []
+        self.finals = []
+        self.fail_post = fail_post
+
+    async def post_partial(self, chat_id, text):
+        if self.fail_post:
+            return None
+        self.posted.append(text)
+        return 42
+
+    async def edit_partial(self, chat_id, message_id, text):
+        assert message_id == 42
+        self.edits.append(text)
+        return True
+
+    async def finalize_partial(self, chat_id, message_id, answer):
+        assert message_id == 42
+        self.finals.append(answer.text)
+        return True
+
+
+def _mk_stream(pieces, clk):
+    """pieces: list of (time, delta) then a terminal AIResponse."""
+
+    async def gen():
+        full = []
+        for t, delta in pieces:
+            clk["t"] = t
+            full.append(delta)
+            yield AIStreamChunk(delta=delta)
+        yield AIStreamChunk(
+            done=True, response=AIResponse(result="".join(full), usage=None)
+        )
+
+    return gen()
+
+
+def _builder(resp):
+    from django_assistant_bot_tpu.bot.domain import SingleAnswer
+
+    return SingleAnswer(text=resp.result, raw_text=resp.result)
+
+
+def test_deliver_streamed_answer_throttles_edits():
+    """Fake-clock cadence: first chunk posts immediately, edits inside the
+    1 s window are coalesced (skipped, next edit carries the accumulation),
+    and the final edit ALWAYS goes out even right after a throttled edit."""
+    from django_assistant_bot_tpu.bot.services.dialog_service import (
+        deliver_streamed_answer,
+    )
+
+    clk = {"t": 0.0}
+    pieces = [
+        (0.0, "Hello strea"),   # >= min_first_chars -> first post
+        (0.3, "ming wor"),      # 0.3s since post -> throttled (no edit)
+        (0.6, "ld, more "),     # still inside the window -> throttled
+        (1.2, "text here "),    # window passed -> ONE edit with everything
+        (1.4, "and the end."),  # throttled again
+    ]
+    p = FakePlatform()
+    answer = asyncio.run(
+        deliver_streamed_answer(
+            p,
+            "chat1",
+            _mk_stream(pieces, clk),
+            answer_builder=_builder,
+            min_edit_interval_s=1.0,
+            clock=lambda: clk["t"],
+        )
+    )
+    full = "".join(d for _, d in pieces)
+    assert p.posted == ["Hello strea"]
+    # exactly one throttled edit, carrying the coalesced accumulation
+    assert p.edits == ["Hello streaming world, more text here "]
+    # final edit always sent, with the complete text
+    assert p.finals == [full]
+    assert answer.already_delivered is True
+    assert answer.text == full
+
+
+def test_deliver_streamed_answer_falls_back_without_edit_support():
+    """No supports_partial (every non-Telegram platform today): nothing posts
+    during the stream; the whole answer returns UNdelivered for the task
+    plane's normal post_answer path."""
+    from django_assistant_bot_tpu.bot.services.dialog_service import (
+        deliver_streamed_answer,
+    )
+
+    class NoEdit:
+        supports_partial = False
+
+    clk = {"t": 0.0}
+    answer = asyncio.run(
+        deliver_streamed_answer(
+            NoEdit(),
+            "chat1",
+            _mk_stream([(0.0, "hello "), (2.0, "world")], clk),
+            answer_builder=_builder,
+            min_edit_interval_s=1.0,
+            clock=lambda: clk["t"],
+        )
+    )
+    assert answer.text == "hello world"
+    assert answer.already_delivered is False
+
+
+def test_deliver_streamed_answer_failed_first_post_degrades():
+    """post_partial returning None (send failure) degrades to whole-message
+    delivery instead of losing the turn."""
+    from django_assistant_bot_tpu.bot.services.dialog_service import (
+        deliver_streamed_answer,
+    )
+
+    clk = {"t": 0.0}
+    p = FakePlatform(fail_post=True)
+    answer = asyncio.run(
+        deliver_streamed_answer(
+            p,
+            "chat1",
+            _mk_stream([(0.0, "long enough first"), (2.0, " tail")], clk),
+            answer_builder=_builder,
+            min_edit_interval_s=1.0,
+            clock=lambda: clk["t"],
+        )
+    )
+    assert p.edits == [] and p.finals == []
+    assert answer.already_delivered is False
+    assert answer.text == "long enough first tail"
+
+
+def test_displayable_partial_hides_thinking_and_caps():
+    """Partials never leak an open <think> block (internal reasoning) and
+    stay under Telegram's message cap; a closed block strips exactly like the
+    final answer's tag extraction."""
+    from django_assistant_bot_tpu.bot.services.dialog_service import (
+        PARTIAL_TEXT_CAP,
+        _displayable_partial,
+    )
+
+    assert _displayable_partial("Hi <think>secret plan") == "Hi "
+    assert _displayable_partial("<think>only reasoning so far") == ""
+    assert _displayable_partial("<think>done</think>The answer") == "The answer"
+    capped = _displayable_partial("x" * (PARTIAL_TEXT_CAP + 500))
+    assert len(capped) == PARTIAL_TEXT_CAP + 1 and capped.endswith("…")
+
+
+def test_deliver_streamed_answer_survives_raising_edits():
+    """A platform edit raising (rate limit, network blip) must not abort the
+    stream — the caller's fallback would re-generate and double-post.  The
+    final answer still arrives, finalized if finalize works."""
+    from django_assistant_bot_tpu.bot.services.dialog_service import (
+        deliver_streamed_answer,
+    )
+
+    class FlakyPlatform(FakePlatform):
+        async def edit_partial(self, chat_id, message_id, text):
+            raise RuntimeError("telegram 429")
+
+    clk = {"t": 0.0}
+    p = FlakyPlatform()
+    answer = asyncio.run(
+        deliver_streamed_answer(
+            p,
+            "chat1",
+            _mk_stream([(0.0, "first chunk long"), (2.0, " more"), (4.0, " end")], clk),
+            answer_builder=_builder,
+            min_edit_interval_s=1.0,
+            clock=lambda: clk["t"],
+        )
+    )
+    assert p.posted == ["first chunk long"]
+    assert answer.text == "first chunk long more end"
+    assert answer.already_delivered is True  # finalize still landed
+
+
+def test_telegram_finalize_rejects_overlong_text():
+    """Final text past Telegram's 4096-char cap can't be edited in: finalize
+    returns False so the task plane posts the full answer whole."""
+    from django_assistant_bot_tpu.bot.domain import SingleAnswer
+    from django_assistant_bot_tpu.bot.platforms.telegram.platform import (
+        TelegramBotPlatform,
+    )
+
+    api = _StubTelegramAPI()
+    platform = TelegramBotPlatform("token", api=api)
+    ok = asyncio.run(
+        platform.finalize_partial("c", 7, SingleAnswer(text="y" * 5000))
+    )
+    assert ok is False and api.edited == []
+
+
+class _StubTelegramAPI:
+    def __init__(self):
+        self.sent = []
+        self.edited = []
+        self.fail_parse_once = False
+
+    async def send_message(self, chat_id, text, **kw):
+        self.sent.append((text, kw.get("parse_mode")))
+        return {"message_id": 7}
+
+    async def edit_message_text(self, chat_id, message_id, text, *, parse_mode=None, reply_markup=None):
+        from django_assistant_bot_tpu.bot.platforms.telegram.api import (
+            TelegramBadRequest,
+        )
+
+        if self.fail_parse_once and parse_mode == "MarkdownV2":
+            self.fail_parse_once = False
+            raise TelegramBadRequest(400, "Bad Request: can't parse entities")
+        self.edited.append((message_id, text, parse_mode))
+        return {"message_id": message_id}
+
+
+def test_telegram_partial_delivery_methods():
+    """post_partial/edit_partial/finalize_partial against a stub API: plain
+    partials, MarkdownV2 final edit with plain fallback, not-modified
+    tolerated."""
+    from django_assistant_bot_tpu.bot.domain import SingleAnswer
+    from django_assistant_bot_tpu.bot.platforms.telegram.platform import (
+        TelegramBotPlatform,
+    )
+
+    api = _StubTelegramAPI()
+    platform = TelegramBotPlatform("token", api=api)
+    assert platform.supports_partial
+
+    async def go():
+        mid = await platform.post_partial("c", "partial text")
+        assert mid == 7
+        assert api.sent == [("partial text", None)]  # plain, no parse mode
+        assert await platform.edit_partial("c", mid, "partial text more")
+        # final edit: MarkdownV2 parse failure falls back to plain text
+        api.fail_parse_once = True
+        ok = await platform.finalize_partial(
+            "c", mid, SingleAnswer(text="final *text*")
+        )
+        assert ok
+
+    asyncio.run(go())
+    assert api.edited[0] == (7, "partial text more", None)
+    assert api.edited[-1] == (7, "final *text*", None)  # plain fallback won
+
+
+# ----------------------------------------------------- media secret (race)
+def test_media_secret_loser_reads_winner(tmp_path, monkeypatch):
+    """Two concurrent first-writers must converge on ONE secret: the loser of
+    the exclusive create reads the winner's bytes instead of installing its
+    own (the old replace pattern let both install different secrets)."""
+    import os
+
+    from django_assistant_bot_tpu.bot.services import dialog_service as ds
+
+    root = tmp_path / "media"
+    root.mkdir()
+    path = str(root) + ".secret"
+    winner = b"w" * 32
+    real_link = os.link
+
+    def racing_link(src, dst):
+        if dst == path:
+            # another process wins the race just before our link lands
+            with open(path, "wb") as f:
+                f.write(winner)
+            raise FileExistsError(dst)
+        return real_link(src, dst)
+
+    monkeypatch.setattr(os, "link", racing_link)
+    got = ds._media_secret(str(root))
+    assert got == winner  # the loser adopted the winner's secret
+    # no stale tmp files left behind
+    assert not [p for p in root.parent.iterdir() if ".tmp" in p.name]
+
+
+def test_media_secret_create_and_reuse(tmp_path):
+    from django_assistant_bot_tpu.bot.services import dialog_service as ds
+
+    root = tmp_path / "media"
+    root.mkdir()
+    s1 = ds._media_secret(str(root))
+    s2 = ds._media_secret(str(root))
+    assert s1 == s2 and len(s1) == 32
+    import os
+
+    assert (os.stat(str(root) + ".secret").st_mode & 0o777) == 0o600
