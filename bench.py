@@ -1344,6 +1344,99 @@ print(json.dumps(bench.bench_overload()))
 """
 
 
+def bench_chaos() -> dict:
+    """chaos_* section (serving/faults.py + engine supervision evidence):
+    goodput and recovery-time-to-first-success under an injected engine-fatal
+    fault vs the no-fault baseline on the SAME trace.
+
+    The trace runs greedy requests through a small engine twice.  Baseline
+    arm: no injector.  Chaos arm: ``tick_raise`` armed ONCE mid-trace (exact,
+    not probabilistic) — the crash-only restart must complete the whole trace
+    anyway (queued work preserved, token-less in-flight work re-submitted),
+    and the time from the fault firing to the next successful completion is
+    the recovery number."""
+    import numpy as np
+
+    from django_assistant_bot_tpu.serving.faults import FaultInjector
+
+    n_req, n_new = 10, 24
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 255, 16).tolist() for _ in range(n_req)]
+
+    def drive(eng, injector=None):
+        # warm the loop (shapes are compiled by engine.warmup())
+        eng.submit([1, 2, 3], max_tokens=4, temperature=0.0).result(timeout=600)
+        done_ok: list = []  # time.monotonic() of each successful completion
+
+        def note_done(f):
+            if not f.cancelled() and f.exception() is None:
+                done_ok.append(time.monotonic())
+
+        t0 = time.perf_counter()
+        futs = []
+        for i, p in enumerate(prompts):
+            if injector is not None and i == n_req // 2:
+                # armed after half the trace is submitted: some requests are
+                # in flight, some queued — the restart must preserve both
+                injector.arm("tick_raise")
+            f = eng.submit(p, max_tokens=n_new, temperature=0.0)
+            f.add_done_callback(note_done)
+            futs.append(f)
+        ok = failed = 0
+        for f in futs:
+            try:
+                f.result(timeout=1200)
+                ok += 1
+            except Exception:
+                failed += 1
+        wall = time.perf_counter() - t0
+        recovery = None
+        if injector is not None:
+            fault_at = injector.last_fire_at("tick_raise")
+            if fault_at is not None:
+                after = [t for t in done_ok if t >= fault_at]
+                if after:
+                    recovery = min(after) - fault_at
+        return ok, failed, wall, recovery
+
+    out: dict = {}
+    eng, _ = _build_gen_engine(max_slots=4, buckets=(32,))
+    try:
+        ok, failed, wall, _ = drive(eng)
+        out["chaos_baseline_goodput_frac"] = round(ok / n_req, 4)
+        out["chaos_baseline_wall_s"] = round(wall, 4)
+    finally:
+        eng.stop()
+    inj = FaultInjector({})
+    eng, _ = _build_gen_engine(max_slots=4, buckets=(32,))
+    eng._faults = inj  # engine built fault-free; the injector rides along
+    try:
+        ok, failed, wall, recovery = drive(eng, injector=inj)
+        sup = eng.supervision_stats()
+        out.update(
+            {
+                "chaos_goodput_frac": round(ok / n_req, 4),
+                "chaos_failed": failed,
+                "chaos_wall_s": round(wall, 4),
+                "chaos_recovery_s": round(recovery, 4) if recovery is not None else None,
+                "chaos_restarts": sup["engine_restarts"],
+                "chaos_resubmitted": sup["restarted_requests_resubmitted"],
+                "chaos_poisoned": sup["poisoned_requests"],
+                "chaos_injector_fires": inj.stats().get("tick_raise", {}).get("fires", 0),
+            }
+        )
+    finally:
+        eng.stop()
+    return out
+
+
+_CHAOS_SNIPPET = """
+import json
+import bench
+print(json.dumps(bench.bench_chaos()))
+"""
+
+
 def bench_stream() -> dict:
     """stream_* section (serving/streaming.py evidence): perceived latency —
     client-observed TTFT on the SAME concurrent trace, streaming (first delta
@@ -2027,6 +2120,10 @@ _COMPACT_KEYS = (
     "overload_sched_interactive_p95_wait_s",
     "overload_shed",
     "overload_deadline_reclaim_s",
+    "chaos_goodput_frac",
+    "chaos_recovery_s",
+    "chaos_restarts",
+    "chaos_baseline_goodput_frac",
     "stream_ttft_p50_s",
     "stream_ttft_p95_s",
     "stream_nonstream_ttft_p50_s",
@@ -2125,6 +2222,7 @@ def main() -> None:
             moe_eng.stop()
         extras.update(bench_ingestion())
         extras.update(bench_overload())
+        extras.update(bench_chaos())
         extras.update(bench_stream())
         baseline_thread.join(timeout=600)
         emit()
@@ -2172,6 +2270,10 @@ def main() -> None:
     #     above-capacity mixed trace (interactive p50/p95 wait, shed + 429
     #     contract, deadline slot reclaim — serving/scheduler.py evidence)
     run("overload", _OVERLOAD_SNIPPET, cap_s=400)
+    # 3c') chaos: goodput + recovery-time-to-first-success with tick_raise
+    #      fired once mid-trace vs the no-fault baseline on the same trace
+    #      (serving/faults.py + crash-only restart evidence)
+    run("chaos", _CHAOS_SNIPPET, cap_s=400)
     # 3d) streaming: client TTFT streaming-vs-nonstreaming on the same trace
     #     + attached/detached decode throughput (the token event queues must
     #     not throttle the engine — serving/streaming.py evidence)

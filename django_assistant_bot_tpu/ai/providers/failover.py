@@ -1,0 +1,270 @@
+"""Provider failover chain with per-backend circuit breakers.
+
+The reference (and this repo, until now) pins each bot to exactly ONE provider:
+when that backend is down — the TPU engine degraded (503), the gpu_service
+unreachable, an API quota blown — every dialog turn fails until a human edits
+config.  ``FailoverProvider`` wraps an *ordered* chain (e.g. ``tpu:chat`` →
+``gpu_service:chat`` → ``test``) and serves each request from the first
+healthy backend:
+
+- **Per-backend circuit breaker** (closed → open → half-open).  A backend that
+  keeps failing is skipped for ``reset_timeout_s`` instead of eating its
+  timeout on every request; after the cooldown exactly one probe request is
+  let through (half-open) — success closes the circuit, failure re-opens it.
+- **Per-attempt timeout.**  A hung backend costs at most ``attempt_timeout_s``
+  before the chain moves on (None = the backend's own timeout discipline).
+- **Jittered backoff between backends** bounds the thundering retry a mass
+  failure would otherwise produce.
+- **Streaming-aware.**  ``stream_response`` fails over only while nothing has
+  been emitted: once the first delta reaches the consumer the response is
+  committed, and a mid-stream error surfaces to the client (replaying from a
+  different backend would emit divergent text after the prefix).
+
+Construction is routed from model strings of the form
+``failover:<model>|<model>|...`` (ai/services/ai_service.py), so a bot config
+opts in without code changes.  Deterministic tests inject a fake clock/sleep.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from typing import Callable, List, Optional, Sequence
+
+from ..domain import AIResponse, Message
+from .base import AIProvider
+
+logger = logging.getLogger(__name__)
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class AllBackendsFailed(RuntimeError):
+    """Every backend in the chain failed (or had its circuit open)."""
+
+    def __init__(self, errors: Sequence[tuple]):
+        detail = "; ".join(f"{name}: {type(e).__name__}: {e}" for name, e in errors)
+        super().__init__(f"all {len(errors)} failover backends failed ({detail})")
+        self.errors = list(errors)
+
+
+class CircuitBreaker:
+    """Minimal closed/open/half-open breaker, deterministic under a fake clock.
+
+    ``failure_threshold`` consecutive failures open the circuit; after
+    ``reset_timeout_s`` ONE caller is admitted as a half-open probe (further
+    callers stay blocked until it resolves); the probe's success closes the
+    circuit, its failure re-opens the full timeout.
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        reset_timeout_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_timeout_s = float(reset_timeout_s)
+        self._clock = clock
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        if self._opened_at is None:
+            return CLOSED
+        if self._probing or self._clock() - self._opened_at >= self.reset_timeout_s:
+            return HALF_OPEN
+        return OPEN
+
+    def allow(self) -> bool:
+        """May a request try this backend right now?  (Half-open admits one.)"""
+        if self._opened_at is None:
+            return True
+        if self._probing:
+            return False  # one probe at a time
+        if self._clock() - self._opened_at >= self.reset_timeout_s:
+            self._probing = True
+            return True
+        return False
+
+    def record_success(self) -> None:
+        self._failures = 0
+        self._opened_at = None
+        self._probing = False
+
+    def record_failure(self) -> None:
+        self._failures += 1
+        if self._probing or self._failures >= self.failure_threshold:
+            self._opened_at = self._clock()  # (re-)open the full timeout
+            self._probing = False
+
+    def release_probe(self) -> None:
+        """The admitted half-open probe resolved neither way (the caller was
+        cancelled mid-flight): free the probe slot so the NEXT request can
+        probe — without this the breaker would stay half-open-and-blocking
+        forever.  No-op unless a probe is outstanding."""
+        self._probing = False
+
+
+class FailoverProvider(AIProvider):
+    """Ordered provider chain behind one :class:`AIProvider` face."""
+
+    def __init__(
+        self,
+        providers: Sequence[AIProvider],
+        *,
+        names: Optional[Sequence[str]] = None,
+        attempt_timeout_s: Optional[float] = None,
+        backoff_s: float = 0.1,
+        breaker_threshold: int = 3,
+        breaker_reset_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        sleep=asyncio.sleep,
+    ):
+        if not providers:
+            raise ValueError("failover chain needs at least one provider")
+        self._providers = list(providers)
+        self._names = list(names) if names else [
+            type(p).__name__ for p in self._providers
+        ]
+        self._attempt_timeout_s = attempt_timeout_s
+        self._backoff_s = max(0.0, float(backoff_s))
+        self._breakers = [
+            CircuitBreaker(breaker_threshold, breaker_reset_s, clock=clock)
+            for _ in self._providers
+        ]
+        self._sleep = sleep
+        self.calls_attempts: List[int] = []
+
+    # ------------------------------------------------------------------ stats
+    def breaker_states(self) -> dict:
+        return {n: b.state for n, b in zip(self._names, self._breakers)}
+
+    @property
+    def context_size(self) -> int:
+        # the chain's contract is the primary's; a fallback with a smaller
+        # window truncates exactly as it would when addressed directly
+        return self._providers[0].context_size
+
+    def calculate_tokens(self, text: str) -> int:
+        return self._providers[0].calculate_tokens(text)
+
+    async def _backoff(self) -> None:
+        if self._backoff_s:
+            await self._sleep(self._backoff_s * (0.5 + 0.5 * random.random()))
+
+    async def get_response(
+        self,
+        messages: List[Message],
+        max_tokens: int = 1024,
+        json_format: bool = False,
+    ) -> AIResponse:
+        errors: List[tuple] = []
+        attempts = 0
+        for i, (name, prov, br) in enumerate(
+            zip(self._names, self._providers, self._breakers)
+        ):
+            if not br.allow():
+                continue
+            attempts += 1
+            try:
+                coro = prov.get_response(
+                    messages, max_tokens=max_tokens, json_format=json_format
+                )
+                if self._attempt_timeout_s is not None:
+                    resp = await asyncio.wait_for(coro, self._attempt_timeout_s)
+                else:
+                    resp = await coro
+            except asyncio.CancelledError:
+                # the CALLER went away — neither a success nor a failure of
+                # this backend; free the half-open probe slot if we held it
+                br.release_probe()
+                raise
+            except Exception as e:
+                br.record_failure()
+                errors.append((name, e))
+                logger.warning(
+                    "failover: backend %s failed (%s: %s); breaker %s",
+                    name, type(e).__name__, e, br.state,
+                )
+                if i < len(self._providers) - 1:
+                    await self._backoff()
+                continue
+            br.record_success()
+            self.calls_attempts.append(attempts)
+            return resp
+        self.calls_attempts.append(attempts)
+        if not errors:
+            raise AllBackendsFailed(
+                [(n, RuntimeError("circuit open")) for n in self._names]
+            )
+        raise AllBackendsFailed(errors)
+
+    async def stream_response(
+        self,
+        messages: List[Message],
+        max_tokens: int = 1024,
+        json_format: bool = False,
+    ):
+        """Stream from the first backend that produces a chunk.  Failover
+        happens only BEFORE anything is yielded; once a delta is out, the
+        response is committed to that backend and a later error propagates."""
+        errors: List[tuple] = []
+        attempts = 0
+        for i, (name, prov, br) in enumerate(
+            zip(self._names, self._providers, self._breakers)
+        ):
+            if not br.allow():
+                continue
+            attempts += 1
+            agen = prov.stream_response(
+                messages, max_tokens=max_tokens, json_format=json_format
+            )
+            try:
+                if self._attempt_timeout_s is not None:
+                    first = await asyncio.wait_for(
+                        agen.__anext__(), self._attempt_timeout_s
+                    )
+                else:
+                    first = await agen.__anext__()
+            except asyncio.CancelledError:
+                br.release_probe()  # caller cancelled: free the probe slot
+                raise
+            except StopAsyncIteration:
+                # an empty stream is a broken backend, not a committed answer
+                br.record_failure()
+                errors.append((name, RuntimeError("empty stream")))
+                continue
+            except Exception as e:
+                br.record_failure()
+                errors.append((name, e))
+                logger.warning(
+                    "failover: backend %s failed before first delta (%s: %s)",
+                    name, type(e).__name__, e,
+                )
+                await agen.aclose()
+                if i < len(self._providers) - 1:
+                    await self._backoff()
+                continue
+            # committed: the consumer sees this backend's stream to the end
+            br.record_success()
+            self.calls_attempts.append(attempts)
+            try:
+                yield first
+                async for chunk in agen:
+                    yield chunk
+            finally:
+                await agen.aclose()
+            return
+        self.calls_attempts.append(attempts)
+        if not errors:
+            raise AllBackendsFailed(
+                [(n, RuntimeError("circuit open")) for n in self._names]
+            )
+        raise AllBackendsFailed(errors)

@@ -9,8 +9,11 @@ reference's torch microservice and this framework's own TPU server
 from __future__ import annotations
 
 import asyncio
+import datetime
+import email.utils
 import json
 import logging
+import random
 from typing import List, Optional
 
 import aiohttp
@@ -26,9 +29,68 @@ from .base import (
 
 logger = logging.getLogger(__name__)
 
-# load-shed (429) retry policy: bounded attempts, Retry-After-honoring sleeps
+# retry policy: bounded attempts; 429/503 honor Retry-After (float seconds or
+# RFC 9110 HTTP-date), connection errors/timeouts use capped jittered backoff.
+# 503 and connection errors retry only for idempotent requests — every call in
+# this module is (generation/embedding is stateless server-side), but callers
+# composing non-idempotent endpoints must pass idempotent=False.
 SHED_RETRIES = 3
 SHED_MAX_SLEEP_S = 10.0
+RETRY_BACKOFF_BASE_S = 0.25
+
+# what counts as "the connection failed before/without a response" (safe to
+# retry an idempotent request): aiohttp's client connection errors, bare OS
+# connection resets (also what the fault injector raises), and timeouts
+CONNECTION_ERRORS = (
+    aiohttp.ClientConnectionError,
+    ConnectionError,
+    asyncio.TimeoutError,
+    TimeoutError,
+)
+
+
+def parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """``Retry-After`` per RFC 9110 §10.2.3: delay-seconds OR an HTTP-date.
+    Returns seconds from now (>= 0), or None when absent/unparseable."""
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    try:
+        dt = email.utils.parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if dt is None:
+        return None
+    if dt.tzinfo is None:  # RFC 9110 dates are GMT; be lenient about parsers
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return max(0.0, (dt - datetime.datetime.now(datetime.timezone.utc)).total_seconds())
+
+
+def _backoff_s(attempt: int) -> float:
+    """Capped jittered exponential backoff (full jitter: 50-100% of the cap
+    for this attempt) — retries from many clients must not synchronize."""
+    cap = min(SHED_MAX_SLEEP_S, RETRY_BACKOFF_BASE_S * (2**attempt))
+    return cap * (0.5 + 0.5 * random.random())
+
+
+def _fault_injector():
+    """The chaos-plane injector, WITHOUT importing the (jax-heavy) serving
+    package into processes that only speak HTTP: consult it when the faults
+    module is already loaded (a chaos test set one) or the env gate is set."""
+    import os
+    import sys
+
+    mod = sys.modules.get("django_assistant_bot_tpu.serving.faults")
+    if mod is not None:
+        return mod.global_injector()
+    if os.environ.get("DABT_FAULTS", "").strip():
+        from ...serving.faults import global_injector
+
+        return global_injector()
+    return None
 
 
 async def _iter_sse_lines(content):
@@ -45,25 +107,73 @@ async def _iter_sse_lines(content):
         yield buf.decode("utf-8", errors="replace").strip()
 
 
-async def _post_with_shed_retry(session, url: str, payload: dict):
-    """POST, honoring 429 + ``Retry-After`` from the scheduler's load shedding:
-    sleep the hinted back-off (capped) and retry a bounded number of times;
-    a still-shedding server surfaces the final 429 to the caller."""
+async def _post_with_shed_retry(session, url: str, payload: dict, *, idempotent: bool = True):
+    """POST with the bounded retry policy.
+
+    - **429** (scheduler load shed) always retries, honoring ``Retry-After``
+      (delay-seconds or HTTP-date per RFC 9110), capped.
+    - **503** (engine degraded — the restart circuit) and **connection
+      errors/timeouts** retry only when ``idempotent`` (a connection error
+      leaves "did it execute?" unknown), with capped jittered backoff; a 503's
+      ``Retry-After`` wins over the computed backoff.
+    - Everything else raises immediately; a still-failing server surfaces its
+      final error to the caller after ``SHED_RETRIES`` retries.
+    """
+    inj = _fault_injector()
     for attempt in range(SHED_RETRIES + 1):
-        resp = await session.post(url, json=payload)
-        if resp.status != 429 or attempt == SHED_RETRIES:
+        last = attempt == SHED_RETRIES
+        try:
+            if inj is not None:
+                # chaos plane: injected timeout/conn_reset/http_5xx exercise
+                # this very retry policy without a misbehaving server
+                inj.raise_http_fault(url)
+            resp = await session.post(url, json=payload)
+        except aiohttp.ClientResponseError as e:
+            # a response-shaped failure (incl. the injector's http_5xx);
+            # the server's Retry-After still wins over the computed backoff
+            if e.status not in (429, 503) or (e.status == 503 and not idempotent) or last:
+                raise
+            retry_after = parse_retry_after(
+                e.headers.get("Retry-After") if e.headers else None
+            )
+            delay = min(
+                SHED_MAX_SLEEP_S,
+                retry_after if retry_after is not None else _backoff_s(attempt),
+            )
+            logger.info(
+                "%s failed with %d; retrying in %.1fs (%d/%d)",
+                url, e.status, delay, attempt + 1, SHED_RETRIES,
+            )
+            await asyncio.sleep(delay)
+            continue
+        except CONNECTION_ERRORS as e:
+            if not idempotent or last:
+                raise
+            delay = _backoff_s(attempt)
+            logger.info(
+                "%s connection failed (%s: %s); retrying in %.1fs (%d/%d)",
+                url, type(e).__name__, e, delay, attempt + 1, SHED_RETRIES,
+            )
+            await asyncio.sleep(delay)
+            continue
+        retriable = resp.status == 429 or (resp.status == 503 and idempotent)
+        if not retriable or last:
             resp.raise_for_status()
             return resp
-        try:
-            retry_after = float(resp.headers.get("Retry-After", "1"))
-        except ValueError:
-            retry_after = 1.0
+        retry_after = parse_retry_after(resp.headers.get("Retry-After"))
+        delay = min(
+            SHED_MAX_SLEEP_S,
+            retry_after if retry_after is not None else _backoff_s(attempt),
+        )
         resp.release()
         logger.info(
-            "%s shed the request (429); retrying in %.1fs (%d/%d)",
-            url, retry_after, attempt + 1, SHED_RETRIES,
+            "%s %s the request (%d); retrying in %.1fs (%d/%d)",
+            url,
+            "shed" if resp.status == 429 else "is degraded",
+            resp.status,
+            delay, attempt + 1, SHED_RETRIES,
         )
-        await asyncio.sleep(min(SHED_MAX_SLEEP_S, max(0.0, retry_after)))
+        await asyncio.sleep(delay)
     raise RuntimeError("unreachable")  # pragma: no cover
 
 

@@ -3,7 +3,8 @@
 Routing parity with the reference (assistant/ai/services/ai_service.py:14-74) plus
 the new ``tpu:`` prefix and a ``test`` model for deterministic tests:
 
-providers: ``tpu:`` | ``groq:`` | ``gpu_service:`` | ``ollama:``/``llama*`` |
+providers: ``failover:<m>|<m>|...`` (ordered chain with per-backend circuit
+breakers) | ``tpu:`` | ``groq:`` | ``gpu_service:`` | ``ollama:``/``llama*`` |
 ``test`` | else OpenAI.
 embedders: ``tpu:`` | ``text-embedding-3*`` -> OpenAI | ``gpu_service:`` |
 ``test`` | else Ollama.
@@ -33,6 +34,25 @@ def get_ai_provider(
     background ingestion.  Providers without a scheduling plane (OpenAI,
     Ollama, ...) simply ignore the tags."""
     logger.debug("getting AI provider for model %s", model)
+    if model.startswith("failover:"):
+        # ordered chain: "failover:tpu:chat|gpu_service:chat|test" — each leg
+        # is routed by this same factory; a per-backend circuit breaker skips
+        # legs that keep failing (ai/providers/failover.py, docs/RESILIENCE.md)
+        from ..providers.failover import FailoverProvider
+
+        chain = [m.strip() for m in model[len("failover:"):].split("|") if m.strip()]
+        if not chain:
+            raise ValueError("failover: model needs at least one backend, "
+                             "e.g. failover:tpu:chat|test")
+        return FailoverProvider(
+            [
+                get_ai_provider(
+                    m, priority=priority, tenant=tenant, deadline_s=deadline_s
+                )
+                for m in chain
+            ],
+            names=chain,
+        )
     if model.startswith("tpu:"):
         from ..providers.tpu import TPUProvider
 

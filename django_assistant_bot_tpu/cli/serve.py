@@ -43,6 +43,43 @@ def add_parser(sub):
         help="default per-request deadline in seconds applied when the client "
         "sends none (expired requests free their decode slot immediately)",
     )
+    p.add_argument(
+        "--faults",
+        default=None,
+        metavar="JSON",
+        help="chaos session: fault-injection spec for every decoder, e.g. "
+        '\'{"tick_raise": {"every": 50}}\' (sites/schedules in '
+        "docs/RESILIENCE.md; equivalent to the DABT_FAULTS env var)",
+    )
+    p.add_argument(
+        "--fault-seed",
+        type=int,
+        default=0,
+        metavar="N",
+        help="seed for probabilistic fault sites (same seed -> same pattern)",
+    )
+    p.add_argument(
+        "--max-restarts",
+        type=int,
+        default=None,
+        metavar="N",
+        help="restart circuit: crash-only restarts tolerated per window "
+        "before the engine goes degraded (503 + Retry-After)",
+    )
+    p.add_argument(
+        "--restart-window-s",
+        type=float,
+        default=None,
+        metavar="S",
+        help="sliding window for the restart circuit",
+    )
+    p.add_argument(
+        "--degraded-cooldown-s",
+        type=float,
+        default=None,
+        metavar="S",
+        help="how long a tripped engine fast-fails submits before resuming",
+    )
     return p
 
 
@@ -71,8 +108,9 @@ def run(args) -> int:
         config = {
             name: {**spec, "warmup": True} for name, spec in config.items()
         }
-    # scheduler overrides apply to decoder entries only (encoders have no
-    # admission scheduler; their coalescer bound is the max_queue spec knob)
+    # scheduler/resilience overrides apply to decoder entries only (encoders
+    # have no admission scheduler or decode loop; their coalescer bound is the
+    # max_queue spec knob)
     sched_overrides = {}
     if getattr(args, "no_scheduler", False):
         sched_overrides["scheduler"] = False
@@ -80,6 +118,17 @@ def run(args) -> int:
         sched_overrides["sched_max_queue"] = args.sched_max_queue
     if getattr(args, "sched_deadline_s", None) is not None:
         sched_overrides["sched_default_deadline_s"] = args.sched_deadline_s
+    if getattr(args, "faults", None) is not None:
+        import json as _json
+
+        sched_overrides["faults"] = _json.loads(args.faults)
+        sched_overrides["fault_seed"] = getattr(args, "fault_seed", 0)
+    if getattr(args, "max_restarts", None) is not None:
+        sched_overrides["max_restarts"] = args.max_restarts
+    if getattr(args, "restart_window_s", None) is not None:
+        sched_overrides["restart_window_s"] = args.restart_window_s
+    if getattr(args, "degraded_cooldown_s", None) is not None:
+        sched_overrides["degraded_cooldown_s"] = args.degraded_cooldown_s
     if sched_overrides:
         config = {
             name: {**spec, **(sched_overrides if spec.get("kind") == "decoder" else {})}

@@ -13,13 +13,22 @@ deficiencies).  This plane is one process driving the whole TPU slice:
   detokenization (``GenerationEngine.generate_stream`` and the SSE wire);
 - :mod:`.scheduler` — admission-controlled request scheduler (priority classes,
   weighted per-tenant fair share, deadlines, bounded queue + load shedding);
+- :mod:`.faults`    — deterministic seeded fault injection (the chaos plane
+  that exercises the engine's quarantine/restart/circuit recovery paths);
 - :mod:`.registry`  — model registry loading checkpoints onto the mesh;
 - :mod:`.server`    — aiohttp app exposing the reference's exact HTTP contract
   (``POST /embeddings/``, ``POST /dialog/``) plus SSE streaming.
 """
 
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer  # noqa: F401
-from .engine import EmbeddingEngine, GenerationEngine, GenerationResult  # noqa: F401
+from .engine import (  # noqa: F401
+    EmbeddingEngine,
+    EngineUnavailable,
+    GenerationEngine,
+    GenerationResult,
+    RequestPoisoned,
+)
+from .faults import FaultInjected, FaultInjector  # noqa: F401
 from .streaming import (  # noqa: F401
     IncrementalDetokenizer,
     StreamChunk,

@@ -49,6 +49,27 @@ logger = logging.getLogger(__name__)
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
 
+class RequestPoisoned(RuntimeError):
+    """A failure attributable to ONE request (garbage sampled ids from a NaN'd
+    logits row, a detokenization crash): that request's future fails with this
+    and its slot is quarantined — batch-mates keep decoding."""
+
+    def __init__(self, detail: str, slot: Optional[int] = None):
+        super().__init__(detail)
+        self.slot = slot
+
+
+class EngineUnavailable(RuntimeError):
+    """The engine's restart circuit is open (too many crash-only restarts in
+    the window): ``submit()`` fast-fails with this instead of queueing work
+    the engine cannot serve.  The HTTP layer maps it to 503 + ``Retry-After``
+    (``retry_after_s`` is the remaining cooldown)."""
+
+    def __init__(self, detail: str, retry_after_s: float):
+        super().__init__(f"{detail} (retry after {retry_after_s:.1f}s)")
+        self.retry_after_s = float(retry_after_s)
+
+
 def _replicated(mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -118,6 +139,10 @@ class _Request:
     # slot-residency start (prefill begins): the service-time sample the
     # scheduler's estimated-wait model is fed on finish
     started_at: Optional[float] = None
+    # crash-only restarts this request survived (re-submitted with no tokens
+    # emitted); bounded by the engine's max_request_restarts so one poisoned
+    # prompt that deterministically kills the device cannot retry forever
+    restarts: int = 0
     # per-request token event sink (serving/streaming.py TokenStream): fed a
     # deque-append per sampled id from _process_tick — already host-resident
     # data, so streaming adds zero device syncs.  None = request/response.
@@ -218,6 +243,14 @@ class GenerationEngine:
         speculative: int = 0,
         decode_kv_chunk: Optional[int] = 0,
         scheduler: Optional[RequestScheduler] = None,
+        faults=None,
+        max_restarts: int = 5,
+        restart_window_s: float = 60.0,
+        restart_backoff_s: float = 0.05,
+        restart_backoff_max_s: float = 2.0,
+        degraded_cooldown_s: float = 30.0,
+        heartbeat_degraded_s: float = 30.0,
+        max_request_restarts: int = 2,
         mesh=None,
     ):
         self.cfg = cfg
@@ -326,6 +359,36 @@ class GenerationEngine:
         self.scheduler = scheduler
         if scheduler is not None:
             scheduler.bind_slots(max_slots)
+        # --- supervision (docs/RESILIENCE.md) ---------------------------------
+        # Deterministic fault injection (serving/faults.py).  None = off: the
+        # hot path pays one `is None` check per tick, nothing else.
+        self._faults = faults
+        # Loop errors are classified request-poison (quarantine one slot) vs
+        # engine-fatal (crash-only restart: rebuild device state, salvage
+        # work).  Restarts back off exponentially, and max_restarts inside
+        # restart_window_s opens a circuit: submit() fast-fails
+        # EngineUnavailable until degraded_cooldown_s elapses (half-open).
+        self.max_restarts = max(1, int(max_restarts))
+        self.restart_window_s = float(restart_window_s)
+        self.restart_backoff_s = max(0.0, float(restart_backoff_s))
+        self.restart_backoff_max_s = max(
+            self.restart_backoff_s, float(restart_backoff_max_s)
+        )
+        self.degraded_cooldown_s = max(0.0, float(degraded_cooldown_s))
+        self.heartbeat_degraded_s = max(0.1, float(heartbeat_degraded_s))
+        self.max_request_restarts = max(0, int(max_request_restarts))
+        self.engine_restarts = 0
+        self.poisoned_requests = 0
+        self.circuit_trips = 0
+        self.restarted_resubmitted = 0
+        self.restarted_failed = 0
+        self._restart_times: "collections.deque[float]" = collections.deque(maxlen=64)
+        self._consecutive_failures = 0
+        self._degraded_until: Optional[float] = None
+        # loop heartbeat: stamped at the top of every loop iteration so a
+        # wedged engine thread (stuck XLA call) is visible as a growing
+        # loop_heartbeat_age_s in /healthz instead of stale-but-green stats
+        self._beat = time.monotonic()
         # live slots reclaimed before finishing (expired deadline / client
         # cancel) — each one freed mid-decode instead of burning ticks
         self.reclaimed_slots = 0
@@ -688,6 +751,7 @@ class GenerationEngine:
                 "previous engine thread is still draining; cannot restart yet"
             )
         self._running = True
+        self._beat = time.monotonic()
         self._thread = threading.Thread(target=self._loop, daemon=True, name="gen-engine")
         self._thread.start()
         return self
@@ -736,8 +800,8 @@ class GenerationEngine:
 
     def _drain_queue(self, err: BaseException):
         """Fail everything not yet started.  Only called from the engine thread
-        itself (_fail_all, end-of-loop _shutdown) — ``_pending``/``_chunking``
-        are engine-thread-private state."""
+        itself (end-of-loop _shutdown) — ``_pending``/``_chunking`` are
+        engine-thread-private state."""
         if self._chunking is not None:
             _safe_resolve(self._chunking.request.future, exc=err)
             self._chunking = None
@@ -787,6 +851,13 @@ class GenerationEngine:
         events as device results resolve (EOS is not emitted) plus a terminal
         event wired through the future's done-callback — every resolution
         path (finish, deadline, failure, cancel) closes the stream."""
+        if self.degraded():
+            # restart circuit open: fail fast (503 at the server) instead of
+            # queueing work behind a device that keeps killing the loop
+            remaining = max(0.1, (self._degraded_until or 0.0) - time.monotonic())
+            raise EngineUnavailable(
+                "engine degraded after repeated restarts", retry_after_s=remaining
+            )
         prompt_ids = list(prompt_ids)
         if json_format and self.speculative:
             raise ValueError(
@@ -970,6 +1041,9 @@ class GenerationEngine:
     def _loop(self):
         try:
             while self._running:
+                self._beat = time.monotonic()
+                if self._degraded_until is not None and not self._degraded_wait():
+                    continue
                 try:
                     with self._iter_lock:  # excludes probe_decode (see there)
                         self._reap_dead_slots()
@@ -987,14 +1061,57 @@ class GenerationEngine:
                             or self.num_active == 0
                         ):
                             self._process_tick()
+                    # a clean iteration closes any failure streak (the restart
+                    # backoff escalates over CONSECUTIVE failures only)
+                    self._consecutive_failures = 0
                     if not admitted and self.num_active == 0 and not self._inflight:
                         time.sleep(self.idle_poll_s)
-                except Exception:
-                    logger.exception("engine loop error; failing active requests")
+                except Exception as e:
+                    logger.exception(
+                        "engine-fatal loop error; attempting crash-only restart"
+                    )
                     with self._iter_lock:
-                        self._fail_all()
+                        self._restart(e)
+                    # bounded exponential backoff between restarts: a
+                    # persistent device fault must not spin the loop hot
+                    self._backoff_after_failure()
         finally:
             self._shutdown()
+
+    def _degraded_wait(self) -> bool:
+        """One degraded-mode loop beat.  Returns True when the cooldown has
+        elapsed (half-open: restart history clears and the loop resumes —
+        the next fault inside the window re-trips immediately)."""
+        now = time.monotonic()
+        if self._degraded_until is not None and now >= self._degraded_until:
+            logger.warning(
+                "engine circuit half-open: resuming after %.1fs degraded cooldown",
+                self.degraded_cooldown_s,
+            )
+            # restart HISTORY is kept: a still-broken device re-trips on its
+            # first post-cooldown crash (while prior restarts remain inside
+            # restart_window_s) instead of burning max_restarts fresh crash/
+            # rebuild cycles; a healthy resume ages the history out naturally
+            self._degraded_until = None
+            self._consecutive_failures = 0
+            return True
+        # new work fast-fails in submit(); anything already queued keeps
+        # honoring deadlines/cancels while the engine cools down
+        with self._iter_lock:
+            self._reap_dead_slots()
+        time.sleep(min(0.05, max(0.0, (self._degraded_until or now) - now)))
+        return False
+
+    def _backoff_after_failure(self) -> None:
+        self._consecutive_failures += 1
+        if not self._running or self.degraded():
+            return  # the degraded wait (or shutdown) is the backoff
+        delay = min(
+            self.restart_backoff_max_s,
+            self.restart_backoff_s * (2 ** (self._consecutive_failures - 1)),
+        )
+        if delay > 0:
+            time.sleep(delay)
 
     def _shutdown(self):
         """End-of-loop drain, run BY the engine thread: fail live slots and
@@ -1179,7 +1296,7 @@ class GenerationEngine:
                     full_groups.setdefault(b, []).append((slot, req))
             # every not-yet-slotted request of the wave stays in
             # _starting_batch until its group succeeds — if an earlier group's
-            # prefill raises, _fail_all resolves the rest instead of orphaning
+            # prefill raises, _restart salvages the rest instead of orphaning
             remaining = [pair for group in full_groups.values() for pair in group]
             remaining += [(s, r) for group in suffix_groups.values() for s, r, _ in group]
             self._starting_batch = remaining
@@ -1687,6 +1804,8 @@ class GenerationEngine:
                 self.spec_accepted / max(1, self.spec_drafted), 4
             )
         out["reclaimed_slots"] = self.reclaimed_slots
+        # restart/quarantine/circuit counters + loop heartbeat (supervision)
+        out["supervision"] = self.supervision_stats()
         out.update(self.latency_stats())
         if self.scheduler is not None:
             # queue-pressure snapshot: depth/pressure/shed/wait percentiles
@@ -1831,6 +1950,14 @@ class GenerationEngine:
         too); the sampled ids stream back asynchronously and are consumed by
         :meth:`_process_tick`."""
         t0 = time.monotonic()
+        if self._faults is not None:
+            # deterministic chaos (serving/faults.py): a thrown device
+            # dispatch (engine-fatal -> crash-only restart) or injected
+            # latency (heartbeat-age evidence); inert when no injector is set
+            self._faults.maybe_raise("tick_raise", "device step")
+            delay = self._faults.sleep_s("slow_tick")
+            if delay:
+                time.sleep(delay)
         self._refresh_sampling()
         if self.speculative and not (
             # graceful degradation: under queue pressure the (K+1)-position
@@ -1938,16 +2065,25 @@ class GenerationEngine:
         self._tick_block_s += time.monotonic() - t0
         self._ticks_processed += 1
         now = time.monotonic()
+        if (
+            self._faults is not None
+            and ref.slots
+            and self._faults.should_fire("nan_logits")
+        ):
+            # simulate what a NaN'd logits row yields downstream of on-device
+            # sampling: garbage ids for ONE slot.  The id validation in
+            # _consume_token quarantines that slot; batch-mates keep decoding.
+            vals = np.array(vals, copy=True)
+            if ref.first:
+                vals[ref.offset] = -1
+            else:
+                vals[..., ref.slots[0][0]] = -1
         if ref.first:
             for j, (slot, epoch) in enumerate(ref.slots):
                 s = self._slots[slot]
                 if s is None or self._slot_epoch[slot] != epoch:
                     continue
-                tok = int(vals[ref.offset + j])
-                s.generated.append(tok)
-                self._note_token(s, tok, now)
-                if self._should_finish(slot, tok):
-                    self._finish(slot)
+                self._consume_token(slot, s, int(vals[ref.offset + j]), now)
             return
         if ref.n_new is not None:  # speculative tick: variable tokens/slot
             counts = np.asarray(ref.n_new)
@@ -1962,11 +2098,7 @@ class GenerationEngine:
                     self.spec_drafted += K
                     self.spec_accepted += max(0, n - 1)
                 for k in range(n):
-                    tok = int(vals[k, slot])
-                    s.generated.append(tok)
-                    self._note_token(s, tok, now)
-                    if self._should_finish(slot, tok):
-                        self._finish(slot)
+                    if self._consume_token(slot, s, int(vals[k, slot]), now):
                         break  # remaining accepted tokens are post-EOS garbage
             return
         for k in range(vals.shape[0]):  # burst steps, oldest first
@@ -1974,11 +2106,29 @@ class GenerationEngine:
                 s = self._slots[slot]
                 if s is None or self._slot_epoch[slot] != epoch:
                     continue  # finished by an earlier token; speculation dropped
-                tok = int(vals[k, slot])
-                s.generated.append(tok)
-                self._note_token(s, tok, now)
-                if self._should_finish(slot, tok):
-                    self._finish(slot)
+                self._consume_token(slot, s, int(vals[k, slot]), now)
+
+    def _consume_token(self, slot: int, s: _Slot, tok: int, now: float) -> bool:
+        """Append one host-resident sampled id to its slot; returns True when
+        the slot is no longer live (finished or quarantined).  Out-of-vocab
+        ids — what a NaN'd logits row degenerates to after on-device top-k —
+        are request-poison: quarantine this slot, keep the batch alive."""
+        if not 0 <= tok < self.cfg.vocab_size:
+            self._quarantine(
+                slot,
+                RequestPoisoned(
+                    f"sampled id {tok} outside vocab [0, {self.cfg.vocab_size})"
+                    " — NaN/corrupt logits suspected; request quarantined",
+                    slot=slot,
+                ),
+            )
+            return True
+        s.generated.append(tok)
+        self._note_token(s, tok, now)
+        if self._should_finish(slot, tok):
+            self._finish(slot)
+            return True
+        return False
 
     def _note_token(self, s: _Slot, tok: int, now: float) -> None:
         """Per-token host bookkeeping where device results land: TTFT and
@@ -2033,9 +2183,20 @@ class GenerationEngine:
         if hit_eos:
             ids = ids[:-1]
         now = time.monotonic()
+        try:
+            if self._faults is not None:
+                self._faults.maybe_raise("detok_raise", "detokenize")
+            text = self.tokenizer.decode(ids)
+        except Exception as e:
+            # request-poison: only THIS request's result text is unrecoverable
+            # — fail it and keep serving (the slot is already freed above)
+            logger.warning("detokenization failed; quarantining request: %s", e)
+            self.poisoned_requests += 1
+            _safe_resolve(req.future, exc=e)
+            return
         result = GenerationResult(
             token_ids=ids,
-            text=self.tokenizer.decode(ids),
+            text=text,
             prompt_tokens=len(req.prompt_ids),
             completion_tokens=len(ids),
             length_limited=not hit_eos,
@@ -2052,27 +2213,118 @@ class GenerationEngine:
             )
         _safe_resolve(req.future, result=result)
 
-    def _fail_all(self):
-        err = RuntimeError("generation engine failure")
+    def _quarantine(self, slot: int, err: BaseException) -> None:
+        """Fail ONE slot's request and free the slot — the epoch bump drops
+        its in-flight speculative tokens, and batch-mates keep decoding.  The
+        slot's stale cache row is overwritten by the next admission (the same
+        discipline ``_finish`` relies on)."""
+        s = self._slots[slot]
+        if s is None:
+            return
+        self._slots[slot] = None
+        self._slot_epoch[slot] += 1
+        self._json[slot] = False
+        self._sampling_dirty = True
+        self.poisoned_requests += 1
+        _safe_resolve(s.request.future, exc=err)
+
+    def degraded(self) -> bool:
+        """True while the restart circuit is open (submit() fast-fails)."""
+        dl = self._degraded_until
+        return dl is not None and time.monotonic() < dl
+
+    def supervision_stats(self) -> dict:
+        """Restart/quarantine/circuit counters + the loop heartbeat — the
+        /healthz evidence that distinguishes a live engine from a wedged or
+        degraded one (stale-but-green stats were the old failure mode)."""
+        now = time.monotonic()
+        age = now - self._beat
+        degraded = self.degraded()
+        healthy = (
+            self._running and not degraded and age < self.heartbeat_degraded_s
+        )
+        return {
+            "running": self._running,
+            "healthy": healthy,
+            "degraded": degraded,
+            "loop_heartbeat_age_s": round(age, 3),
+            "heartbeat_degraded_s": self.heartbeat_degraded_s,
+            "engine_restarts": self.engine_restarts,
+            "poisoned_requests": self.poisoned_requests,
+            "circuit_trips": self.circuit_trips,
+            "restarted_requests_resubmitted": self.restarted_resubmitted,
+            "restarted_requests_failed": self.restarted_failed,
+        }
+
+    def _restart(self, err: BaseException):
+        """Crash-only restart after an engine-fatal error: rebuild every piece
+        of device state from scratch, salvage what is safely retryable, fail
+        the rest.
+
+        Salvage rules: queued work is untouched (it never reached the device);
+        in-flight requests that have emitted NO tokens yet (mid-prefill,
+        awaiting activation — including streams before their first delta) are
+        re-submitted at the head of their (class, tenant) queue with their
+        original futures, so the client never sees the crash; requests past
+        their first token fail cleanly with the error (a non-stream replay
+        would double-bill latency, a streamed one would repeat output).  Each
+        request survives at most ``max_request_restarts`` restarts.  After
+        ``max_restarts`` restarts inside ``restart_window_s`` the circuit
+        opens: submit() fast-fails EngineUnavailable until the cooldown."""
+        now = time.monotonic()
+        self.engine_restarts += 1
+        self._restart_times.append(now)
+        salvage: List[_Request] = []
         if self._starting_batch is not None:
-            for _, req in self._starting_batch:
-                _safe_resolve(req.future, exc=err)
+            salvage.extend(req for _, req in self._starting_batch)
             self._starting_batch = None
+        if self._chunking is not None:
+            salvage.append(self._chunking.request)
+            self._chunking = None
         self._inflight.clear()
         for i, s in enumerate(self._slots):
             if s is not None:
-                _safe_resolve(s.request.future, exc=err)
+                if s.generated:
+                    _safe_resolve(s.request.future, exc=err)
+                else:
+                    salvage.append(s.request)
             self._slots[i] = None
             self._slot_epoch[i] += 1
-        if self._chunking is not None:
-            _safe_resolve(self._chunking.request.future, exc=err)
-            self._chunking = None
         self._json[:] = False
         self._sampling_dirty = True
         # cached prefixes were sliced out of the (possibly poisoned) cache
         # lineage — drop them with the rest of the device state
         self._prefix_lru.clear()
         self._prefix_bytes = 0
+        # a failure inside _activate_batch can leave a request both slotted
+        # AND in _starting_batch — salvage each request once
+        seen: set = set()
+        requeue: List[_Request] = []
+        for req in salvage:
+            if id(req) in seen:
+                continue
+            seen.add(id(req))
+            if req.future.cancelled():
+                continue
+            if req.restarts >= self.max_request_restarts:
+                self.restarted_failed += 1
+                _safe_resolve(req.future, exc=err)
+                continue
+            req.restarts += 1
+            req.started_at = None
+            req.first_token_at = None
+            self.restarted_resubmitted += 1
+            requeue.append(req)
+        # head of the queue, class/tenant tags riding on the request —
+        # salvaged work must not requeue behind later arrivals.  Head inserts
+        # reverse, so insert newest-submitted first: each (class, tenant)
+        # queue ends up with its salvaged requests back in FIFO order.
+        requeue.sort(key=lambda r: r.submitted_at, reverse=True)
+        for req in requeue:
+            if self.scheduler is not None:
+                self.scheduler.enqueue(req, front=True)
+            else:
+                self._pending.appendleft(req)
         try:
             # the cache may have been donated into a failed call — rebuild it
             self._cache = self._fresh_cache()
@@ -2096,6 +2348,18 @@ class GenerationEngine:
                 "engine recovery failed; declaring the engine dead"
             )
             self._running = False
+            return
+        recent = [t for t in self._restart_times if t >= now - self.restart_window_s]
+        if len(recent) >= self.max_restarts:
+            self.circuit_trips += 1
+            self._degraded_until = now + self.degraded_cooldown_s
+            logger.error(
+                "engine circuit OPEN: %d restarts in %.0fs; degraded for %.1fs "
+                "(submit fast-fails EngineUnavailable)",
+                len(recent),
+                self.restart_window_s,
+                self.degraded_cooldown_s,
+            )
 
 
 class EmbeddingEngine:

@@ -1,0 +1,271 @@
+"""Deterministic, seeded fault injection for the serving plane.
+
+Crash-only design (Candea & Fox) and chaos-engineering practice agree on one
+point: recovery paths that are never exercised don't work.  This module is the
+exerciser — a zero-overhead-when-off injection plane with *named sites* wired
+into the engine loop and the HTTP provider client, driven either by exact
+fire-on-Nth-call schedules (tests are exact, not flaky) or by a seeded
+probability stream (same seed → same fire pattern, across processes).
+
+Sites (the full set — unknown names are a config error, not a silent no-op):
+
+================  ============================================================
+``tick_raise``    the device decode/prefill dispatch raises (XLA error, TPU
+                  preemption, OOM) — exercised at the top of the engine's
+                  ``_issue_tick``; classified *engine-fatal* → crash-only
+                  restart (see ``GenerationEngine._restart``)
+``nan_logits``    a tick's sampled ids come back garbage (what a NaN'd logits
+                  row yields after top-k/softmax) — the engine's host-side id
+                  validation catches it and *quarantines* only the poisoned
+                  slot, keeping its batch-mates alive
+``detok_raise``   final detokenization raises — request-poison: fail that one
+                  request, the engine keeps serving
+``slow_tick``     latency injection: the engine loop sleeps ``delay_s`` before
+                  a tick (heartbeat-age / wedged-loop detection evidence)
+``timeout``       HTTP client: the request times out before a response
+``conn_reset``    HTTP client: the connection drops mid-request
+``http_5xx``      HTTP client: the server answers 503
+================  ============================================================
+
+Each site's spec is either a bare float (fire probability) or a mapping with
+any of: ``p`` (probability), ``fire_on`` (exact 1-based call indices),
+``every`` (fire every Nth call), ``max_fires`` (stop after N fires),
+``delay_s`` (sleep length for latency sites).  Schedules compose: a call fires
+if it matches ``fire_on`` OR ``every`` OR the probability draw, until
+``max_fires`` is exhausted.
+
+Gating: engines take an injector from ``ModelSpec.faults`` (explicit) or from
+the ``DABT_FAULTS`` env var (JSON, with ``DABT_FAULT_SEED``); the HTTP client
+uses the process-global env-gated injector.  With neither set, everything that
+would consult an injector holds ``None`` and the hot path pays a single
+``is None`` check — the inertness unit test in tests/test_faults.py asserts no
+injector method is ever entered on a fault-free engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, Mapping, Optional
+
+ENGINE_SITES = ("tick_raise", "nan_logits", "detok_raise", "slow_tick")
+HTTP_SITES = ("timeout", "conn_reset", "http_5xx")
+ALL_SITES = ENGINE_SITES + HTTP_SITES
+
+ENV_FAULTS = "DABT_FAULTS"
+ENV_SEED = "DABT_FAULT_SEED"
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault fired.  ``site`` names the injection point."""
+
+    def __init__(self, site: str, detail: str = ""):
+        super().__init__(f"injected fault: {site}" + (f" ({detail})" if detail else ""))
+        self.site = site
+
+
+@dataclasses.dataclass
+class _Site:
+    name: str
+    probability: float = 0.0
+    fire_on: frozenset = frozenset()
+    every: int = 0
+    max_fires: int = 0  # 0 = unlimited
+    delay_s: float = 0.05
+    calls: int = 0
+    fires: int = 0
+    armed: int = 0  # fire unconditionally on the next N calls (tests)
+    last_fire_monotonic: Optional[float] = None
+
+
+def _parse_site(name: str, spec: Any) -> _Site:
+    if isinstance(spec, bool):
+        raise ValueError(f"fault site {name!r}: spec must be a probability or mapping")
+    if isinstance(spec, (int, float)):
+        spec = {"p": float(spec)}
+    if not isinstance(spec, Mapping):
+        raise ValueError(f"fault site {name!r}: unparseable spec {spec!r}")
+    unknown = set(spec) - {"p", "probability", "fire_on", "every", "max_fires", "delay_s"}
+    if unknown:
+        raise ValueError(f"fault site {name!r}: unknown keys {sorted(unknown)}")
+    p = float(spec.get("p", spec.get("probability", 0.0)))
+    if not (0.0 <= p <= 1.0):
+        raise ValueError(f"fault site {name!r}: probability {p} outside [0, 1]")
+    fire_on = frozenset(int(n) for n in spec.get("fire_on", ()))
+    if any(n < 1 for n in fire_on):
+        raise ValueError(f"fault site {name!r}: fire_on indices are 1-based")
+    return _Site(
+        name=name,
+        probability=p,
+        fire_on=fire_on,
+        every=max(0, int(spec.get("every", 0))),
+        max_fires=max(0, int(spec.get("max_fires", 0))),
+        delay_s=max(0.0, float(spec.get("delay_s", 0.05))),
+    )
+
+
+class FaultInjector:
+    """Deterministic fire-pattern generator over named sites.
+
+    Thread-safe: sites are consulted from the engine thread and asyncio
+    threads concurrently.  Each site draws from its own ``random.Random``
+    seeded by ``(seed, site name)`` so one site's call pattern can never
+    perturb another's — and the same seed reproduces the same pattern
+    regardless of how sites interleave.
+    """
+
+    def __init__(self, spec: Mapping[str, Any], *, seed: int = 0):
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._sites: Dict[str, _Site] = {}
+        self._rngs: Dict[str, random.Random] = {}
+        for name, site_spec in (spec or {}).items():
+            if name not in ALL_SITES:
+                raise ValueError(
+                    f"unknown fault site {name!r}; expected one of {list(ALL_SITES)}"
+                )
+            self._sites[name] = _parse_site(name, site_spec)
+            # str seeding is stable across processes (hashed via sha512, not
+            # the per-process-salted hash()) — determinism is the contract
+            self._rngs[name] = random.Random(f"{self.seed}:{name}")
+
+    @classmethod
+    def from_spec(
+        cls, spec: Optional[Mapping[str, Any]], *, seed: int = 0
+    ) -> Optional["FaultInjector"]:
+        """None/empty spec → None: callers hold no injector at all, so the
+        disabled path is a bare ``is None`` check."""
+        if not spec:
+            return None
+        return cls(spec, seed=seed)
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultInjector"]:
+        raw = os.environ.get(ENV_FAULTS, "").strip()
+        if not raw:
+            return None
+        return cls(json.loads(raw), seed=int(os.environ.get(ENV_SEED, "0") or "0"))
+
+    # ------------------------------------------------------------------ sites
+    def enabled(self, site: str) -> bool:
+        return site in self._sites
+
+    def arm(self, site: str, n: int = 1) -> None:
+        """Fire unconditionally on the next ``n`` calls of ``site`` (tests:
+        exact one-shot faults without counting call indices).  Arming a site
+        absent from the spec registers it."""
+        with self._lock:
+            s = self._sites.get(site)
+            if s is None:
+                if site not in ALL_SITES:
+                    raise ValueError(f"unknown fault site {site!r}")
+                s = self._sites[site] = _Site(name=site)
+                self._rngs[site] = random.Random(f"{self.seed}:{site}")
+            s.armed += int(n)
+
+    def should_fire(self, site: str) -> bool:
+        """Consult (and advance) a site's schedule.  Unconfigured sites never
+        fire and keep no state."""
+        with self._lock:
+            s = self._sites.get(site)
+            if s is None:
+                return False
+            s.calls += 1
+            if s.max_fires and s.fires >= s.max_fires:
+                return False
+            fire = False
+            if s.armed > 0:
+                s.armed -= 1
+                fire = True
+            elif s.calls in s.fire_on:
+                fire = True
+            elif s.every and s.calls % s.every == 0:
+                fire = True
+            elif s.probability and self._rngs[site].random() < s.probability:
+                fire = True
+            if fire:
+                s.fires += 1
+                s.last_fire_monotonic = time.monotonic()
+            return fire
+
+    def maybe_raise(self, site: str, detail: str = "") -> None:
+        if self.should_fire(site):
+            raise FaultInjected(site, detail)
+
+    def sleep_s(self, site: str) -> float:
+        """Latency sites: the injected delay for this call (0.0 = no fire)."""
+        if self.should_fire(site):
+            with self._lock:
+                return self._sites[site].delay_s
+        return 0.0
+
+    def raise_http_fault(self, url: str = "") -> None:
+        """Consult the HTTP sites in a fixed order and raise the mapped client
+        exception for the first that fires — called by the provider client
+        before each attempt, so retry/failover paths are exercised without a
+        misbehaving server."""
+        if self.should_fire("timeout"):
+            raise TimeoutError(f"injected fault: timeout ({url})")
+        if self.should_fire("conn_reset"):
+            raise ConnectionResetError(f"injected fault: conn_reset ({url})")
+        if self.should_fire("http_5xx"):
+            import aiohttp
+
+            raise aiohttp.ClientResponseError(
+                request_info=None,
+                history=(),
+                status=503,
+                message=f"injected fault: http_5xx ({url})",
+            )
+
+    def last_fire_at(self, site: str) -> Optional[float]:
+        """time.monotonic() of the site's most recent fire (bench: recovery
+        time is measured from here to the next successful completion)."""
+        with self._lock:
+            s = self._sites.get(site)
+            return s.last_fire_monotonic if s is not None else None
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {
+                name: {"calls": s.calls, "fires": s.fires}
+                for name, s in self._sites.items()
+            }
+
+
+# Process-global injector for call sites without a per-engine spec (the HTTP
+# provider client).  Loaded once from the environment; tests override via
+# set_global_injector and MUST reset in teardown.
+_global: Optional[FaultInjector] = None
+_global_loaded = False
+_global_lock = threading.Lock()
+
+
+def global_injector() -> Optional[FaultInjector]:
+    global _global, _global_loaded
+    if _global_loaded:
+        return _global
+    with _global_lock:
+        if not _global_loaded:
+            _global = FaultInjector.from_env()
+            _global_loaded = True
+    return _global
+
+
+def set_global_injector(inj: Optional[FaultInjector]) -> None:
+    global _global, _global_loaded
+    with _global_lock:
+        _global = inj
+        _global_loaded = True
+
+
+def reset_global_injector() -> None:
+    """Forget the cached global injector (re-reads the env on next use)."""
+    global _global, _global_loaded
+    with _global_lock:
+        _global = None
+        _global_loaded = False

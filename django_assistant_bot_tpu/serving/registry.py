@@ -93,6 +93,27 @@ class ModelSpec:
     # embedding coalescer queue bound (encoder entries): past it /embeddings/
     # sheds with 429 instead of queueing unboundedly
     max_queue: int = 1024
+    # --- resilience (serving/faults.py + engine supervision; docs/RESILIENCE.md)
+    # deterministic fault injection: site name -> probability or schedule dict
+    # (None = also honor the DABT_FAULTS env var; {} = force-off for this model)
+    faults: Optional[Mapping[str, Any]] = None
+    fault_seed: int = 0
+    # crash-only restart circuit: after max_restarts restarts inside
+    # restart_window_s the engine goes degraded (submit fast-fails
+    # EngineUnavailable -> HTTP 503 + Retry-After) for degraded_cooldown_s
+    max_restarts: int = 5
+    restart_window_s: float = 60.0
+    # bounded exponential backoff between restarts (the hot-spin fix)
+    restart_backoff_s: float = 0.05
+    restart_backoff_max_s: float = 2.0
+    degraded_cooldown_s: float = 30.0
+    # /healthz flips to degraded when the engine loop's heartbeat is older
+    # than this (a wedged thread no longer reports stale-but-green stats)
+    heartbeat_degraded_s: float = 30.0
+    # how many restarts one request may ride through via re-submission before
+    # it fails (bounds retries of a prompt that deterministically kills the
+    # device)
+    max_request_restarts: int = 2
 
     @classmethod
     def from_dict(cls, name: str, d: Mapping[str, Any]) -> "ModelSpec":
@@ -243,6 +264,15 @@ class ModelRegistry:
                 # so they bypass the None-dropping from_knobs filter
                 sched.cfg.admit_max_wait_s = spec.sched_admit_max_wait_s
                 sched.cfg.default_deadline_s = spec.sched_default_deadline_s
+            from .faults import FaultInjector
+
+            # explicit spec wins ({} forces off); otherwise the env gate
+            # (DABT_FAULTS / DABT_FAULT_SEED) applies — a chaos session can
+            # target a running config without editing it
+            if spec.faults is not None:
+                faults = FaultInjector.from_spec(spec.faults, seed=spec.fault_seed)
+            else:
+                faults = FaultInjector.from_env()
             eng = GenerationEngine(
                 cfg,
                 params,
@@ -262,6 +292,14 @@ class ModelRegistry:
                     else int(spec.decode_kv_chunk)
                 ),
                 scheduler=sched,
+                faults=faults,
+                max_restarts=spec.max_restarts,
+                restart_window_s=spec.restart_window_s,
+                restart_backoff_s=spec.restart_backoff_s,
+                restart_backoff_max_s=spec.restart_backoff_max_s,
+                degraded_cooldown_s=spec.degraded_cooldown_s,
+                heartbeat_degraded_s=spec.heartbeat_degraded_s,
+                max_request_restarts=spec.max_request_restarts,
                 mesh=self.mesh,
             )
             if spec.warmup or spec.warmup_json:

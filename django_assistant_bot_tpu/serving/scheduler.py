@@ -204,16 +204,25 @@ class RequestScheduler:
         ten_w = float(self.cfg.tenant_weights.get(key[1], 1.0))
         return max(1e-6, cls_w * ten_w)
 
-    def enqueue(self, req) -> None:
+    def enqueue(self, req, *, front: bool = False) -> None:
         """Insert an (already admitted) request.  Requests that bypassed
         :meth:`try_admit` (internal/test paths writing the engine queue
-        directly) are counted here so depth accounting stays true."""
+        directly) are counted here so depth accounting stays true.
+
+        ``front=True`` re-inserts at the HEAD of the request's (class, tenant)
+        queue — the crash-only restart path (engine ``_restart``) uses it to
+        re-submit salvaged in-flight work ahead of later arrivals.  The
+        request keeps its class/tenant tags, so fair-share ordering across
+        queues is untouched; within its own queue it simply resumes the place
+        it already earned.  Depth was already released when the request was
+        popped, so a ``front`` re-insert charges depth again (admitted flag
+        notwithstanding) to keep the bound true."""
         key = (
             getattr(req, "priority", INTERACTIVE) or INTERACTIVE,
             getattr(req, "tenant", "default") or "default",
         )
         with self._lock:
-            if not getattr(req, "admitted", False):
+            if front or not getattr(req, "admitted", False):
                 self._depth += 1
             q = self._queues.get(key)
             if q is None:
@@ -221,7 +230,10 @@ class RequestScheduler:
             if not q:
                 # an idle queue must not bank credit: restart at current vtime
                 self._pass[key] = max(self._pass.get(key, 0.0), self._vtime)
-            q.append(req)
+            if front:
+                q.appendleft(req)
+            else:
+                q.append(req)
 
     def _best_key_locked(self) -> Optional[Tuple[str, str]]:
         best = None

@@ -27,6 +27,7 @@ from typing import Any, Mapping, Optional
 
 from aiohttp import web
 
+from .engine import EngineUnavailable
 from .registry import ModelRegistry
 from .scheduler import DeadlineExceeded, SchedulerRejected
 
@@ -99,6 +100,17 @@ def _shed_response(e: SchedulerRejected) -> web.Response:
     )
 
 
+def _unavailable_response(e: EngineUnavailable) -> web.Response:
+    """Engine restart circuit open -> 503 with a Retry-After covering the
+    remaining degraded cooldown (docs/RESILIENCE.md)."""
+    retry = max(1, math.ceil(e.retry_after_s))
+    return web.json_response(
+        {"detail": str(e), "retry_after_s": e.retry_after_s},
+        status=503,
+        headers={"Retry-After": str(retry)},
+    )
+
+
 def _usage(model: str, result) -> dict:
     return result.usage_dict(model)
 
@@ -129,6 +141,8 @@ async def _stream_dialog(
         first = None
     except SchedulerRejected as e:
         return _shed_response(e)
+    except EngineUnavailable as e:
+        return _unavailable_response(e)
     except DeadlineExceeded as e:
         return web.json_response({"detail": str(e)}, status=504)
     except Exception as e:
@@ -293,6 +307,8 @@ def create_app(registry: ModelRegistry) -> web.Application:
             )
         except SchedulerRejected as e:
             return _shed_response(e)
+        except EngineUnavailable as e:
+            return _unavailable_response(e)
         except DeadlineExceeded as e:
             return web.json_response({"detail": str(e)}, status=504)
         except Exception as e:
@@ -300,6 +316,10 @@ def create_app(registry: ModelRegistry) -> web.Application:
             return web.json_response({"detail": str(e)}, status=500)
 
     async def healthz(request: web.Request) -> web.Response:
+        # status degrades when ANY generator is unhealthy: restart circuit
+        # open, engine thread dead, or a loop heartbeat older than the
+        # threshold (a wedged XLA call used to keep reporting green here)
+        status = "ok"
         generators = {}
         for name, eng in registry.generators.items():
             g = {
@@ -317,10 +337,16 @@ def create_app(registry: ModelRegistry) -> web.Application:
                 # queue depth, shed counters, per-class wait percentiles —
                 # the operator's overload dashboard
                 g["sched"] = sched.stats()
+            sup = getattr(eng, "supervision_stats", None)
+            if callable(sup):
+                # restart/quarantine/circuit counters + loop_heartbeat_age_s
+                g["supervision"] = sv = sup()
+                if not sv.get("healthy", True):
+                    status = "degraded"
             generators[name] = g
         return web.json_response(
             {
-                "status": "ok",
+                "status": status,
                 "models": sorted(registry.specs),
                 "generators": generators,
                 "embedders": {

@@ -307,14 +307,26 @@ def test_engine_queued_deadline_expires_while_slots_saturated(sched_engine):
     # feasibility test (the point here is queue-side expiry, not admission)
     for _ in range(100):
         eng.scheduler.note_service(0.001)
-    blocker = eng.submit([1, 2, 3], max_tokens=220, temperature=0.0)
-    queued = eng.submit([4, 5, 6], max_tokens=10, temperature=0.0, deadline_s=0.05)
-    t0 = time.monotonic()
-    with pytest.raises(DeadlineExceeded):
-        queued.result(timeout=30)
-    # failed promptly (well before the blocker's full decode), not on dequeue
-    assert time.monotonic() - t0 < 5.0
-    blocker.result(timeout=120)  # the running request is unaffected
+    # a warm jit cache can finish the 220-token blocker inside the 50ms
+    # deadline, racing the expiry this test exists to observe — injected
+    # per-tick latency (serving/faults.py slow_tick) pins the blocker's
+    # residency deterministically past the queued request's deadline
+    from django_assistant_bot_tpu.serving.faults import FaultInjector
+
+    eng._faults = FaultInjector({"slow_tick": {"every": 1, "delay_s": 0.01}})
+    try:
+        blocker = eng.submit([1, 2, 3], max_tokens=220, temperature=0.0)
+        queued = eng.submit(
+            [4, 5, 6], max_tokens=10, temperature=0.0, deadline_s=0.05
+        )
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            queued.result(timeout=30)
+        # failed promptly (well before the blocker's full decode), not on dequeue
+        assert time.monotonic() - t0 < 5.0
+        blocker.result(timeout=120)  # the running request is unaffected
+    finally:
+        eng._faults = None
 
 
 def test_engine_submit_sheds_past_bound():

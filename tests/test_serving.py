@@ -93,9 +93,10 @@ def test_engine_long_prompt_truncated(tiny_gen_engine):
 
 
 def test_engine_fails_active_requests_and_recovers():
-    """A device-step exception must fail in-flight futures (not hang them) and
-    leave the engine serviceable: the cache is rebuilt and the next request
-    completes normally (the failure-detection obligation, SURVEY.md §5.3)."""
+    """A device-step exception triggers a crash-only restart, and a request
+    that had emitted NO tokens yet is transparently re-submitted: its future
+    completes normally after the restart (docs/RESILIENCE.md).  The engine
+    stays serviceable with a rebuilt cache."""
     cfg = DecoderConfig.tiny()
     params = llama.init(cfg, jax.random.key(1))
     eng = GenerationEngine(
@@ -111,19 +112,26 @@ def test_engine_fails_active_requests_and_recovers():
             return orig(*args, **kwargs)
 
         eng._decode_tick = boom
+        # the fault fires on the FIRST decode tick — before any token reached
+        # the host — so the request is salvageable and must survive the crash
         fut = eng.submit([1, 2, 3], max_tokens=5, temperature=0.0)
-        with pytest.raises(RuntimeError):
-            fut.result(timeout=120)
+        res = fut.result(timeout=120)
+        assert len(res.token_ids) == 5
+        assert eng.engine_restarts == 1
+        assert eng.supervision_stats()["restarted_requests_resubmitted"] == 1
         # engine healed itself (fresh cache, cleared slots): next request works
         res = eng.submit([1, 2, 3], max_tokens=5, temperature=0.0).result(timeout=120)
         assert len(res.token_ids) == 5
+        assert eng.engine_restarts == 1  # no further restarts
     finally:
         eng.stop()
 
 
-def test_wave_prefill_failure_fails_every_unstarted_group():
+def test_wave_prefill_failure_salvages_every_unstarted_group():
     """A wave split into seq-bucket groups: if an early group's prefill raises,
-    the later groups' futures must fail too (not hang unresolved)."""
+    the later groups' requests must not hang unresolved — the crash-only
+    restart re-submits every not-yet-slotted request (no tokens were emitted),
+    so BOTH futures complete normally after one restart."""
     cfg = DecoderConfig.tiny()
     params = llama.init(cfg, jax.random.key(2))
     eng = GenerationEngine(
@@ -161,10 +169,9 @@ def test_wave_prefill_failure_fails_every_unstarted_group():
     eng._prefill = boom
     eng.start()
     try:
-        with pytest.raises(RuntimeError):
-            fut_short.result(timeout=120)
-        with pytest.raises(RuntimeError):
-            fut_long.result(timeout=120)
+        assert len(fut_short.result(timeout=120).token_ids) == 4
+        assert len(fut_long.result(timeout=120).token_ids) == 4
+        assert eng.engine_restarts == 1
         # engine recovered; new requests serve normally
         res = eng.submit([1, 2, 3], max_tokens=4, temperature=0.0).result(timeout=120)
         assert len(res.token_ids) == 4
