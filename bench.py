@@ -1049,7 +1049,19 @@ def decode_byte_ledger(eng, fill_len=None) -> dict:
         * 2  # K and V
         * kv_itemsize
     )
-    kv_alloc_b = row_b * eng.max_seq_len
+    if getattr(eng, "paged", False):
+        # paged layout: the allocation is the page pool, not slots x max_seq
+        kv_alloc_b = (
+            eng._kv_pool.n_pages
+            * cfg.num_layers
+            * cfg.num_kv_heads
+            * cfg.head_dim
+            * eng.kv_page_size
+            * 2
+            * kv_itemsize
+        )
+    else:
+        kv_alloc_b = row_b * eng.max_seq_len
     c = eng.decode_kv_chunk
     if c and fill_len is not None:
         covered = min(eng.max_seq_len, (min(fill_len, eng.max_seq_len - 1) // c + 1) * c)
@@ -1188,6 +1200,146 @@ def bench_slots_ab(trials: int = 3) -> dict:
     }
 
 
+def bench_paged() -> dict:
+    """paged_* section (docs/KV_PAGING.md): the paged KV plane's two claims.
+
+    (a) Slots at fixed HBM: a legacy engine and a paged engine over the SAME
+    KV byte ledger (the paged pool holds exactly the legacy arm's
+    slots x max_seq_len pages).  Legacy concurrency is pinned at its slot
+    count; paged admits by demand (ceil((prompt + max_tokens) / page) pages),
+    so the same bytes serve more concurrent requests at bench prompt shapes —
+    the capacity ratio is recorded alongside a measured burst (peak live
+    slots + wall-clock tok/s) so the arithmetic is backed by a run.
+
+    (b) Prefix-hit TTFT on a shared-system-prompt trace (the reference's
+    per-bot prompt shape): page-sharing (COW boundary clone, zero prefix
+    recompute) vs the r4 whole-prefix pinned LRU, p50/p95 client TTFT.
+    """
+    import jax
+    import numpy as np
+
+    from django_assistant_bot_tpu.models import llama
+    from django_assistant_bot_tpu.parallel import get_mesh, shard_pytree
+    from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
+
+    cfg = _decoder_cfg()
+    params = llama.init_int8(cfg, jax.random.PRNGKey(0))
+    mesh = get_mesh()
+    with mesh:
+        params = shard_pytree(params, llama.logical_axes(cfg), mesh)
+    max_seq = min(1024, cfg.max_seq_len)
+    bucket = _decode_bucket()
+    new_tokens = 64
+    legacy_slots = max(2, SLOTS // 2)
+
+    def build(layout, slots, kv_pages=0, prefix_cache=0):
+        eng = GenerationEngine(
+            cfg, params, ByteTokenizer(),
+            max_slots=slots, max_seq_len=max_seq,
+            prefill_buckets=(bucket,), chunk_size=bucket, mesh=mesh,
+            prefix_cache_size=prefix_cache, prefix_min_tokens=16,
+            kv_layout=layout, kv_pages=kv_pages,
+        )
+        eng.warmup()
+        eng.start()
+        return eng
+
+    rng = np.random.default_rng(5)
+    out: dict = {}
+
+    # ---- (a) slots at fixed HBM -----------------------------------------
+    legacy = build("legacy", legacy_slots)
+    page = legacy._resolve_kv_chunk(0) or 512
+    pool_pages = legacy_slots * (max_seq // page)  # the legacy arm's exact bytes
+    paged = build("paged", SLOTS, kv_pages=pool_pages)
+    try:
+        pages_per_req = -(-(DECODE_PROMPT_LEN + new_tokens) // paged.kv_page_size)
+        paged_capacity = min(SLOTS, pool_pages // pages_per_req)
+        n_req = min(2 * legacy_slots, paged_capacity)
+        prompts = [
+            rng.integers(1, 255, DECODE_PROMPT_LEN).tolist() for _ in range(n_req)
+        ]
+
+        def burst(eng):
+            futs = [
+                eng.submit(p, max_tokens=new_tokens, temperature=0.8)
+                for p in prompts
+            ]
+            peak, t0 = 0, time.perf_counter()
+            while not all(f.done() for f in futs):
+                peak = max(peak, eng.num_active)
+                time.sleep(0.002)
+            wall = time.perf_counter() - t0
+            toks = sum(f.result().completion_tokens for f in futs)
+            return peak, toks / wall
+
+        burst(legacy)  # warm both loops before the timed pass
+        burst(paged)
+        legacy_peak, legacy_tok_s = burst(legacy)
+        paged_peak, paged_tok_s = burst(paged)
+        out.update({
+            "paged_page_size": paged.kv_page_size,
+            "paged_pool_pages": pool_pages,
+            "paged_pages_per_req": pages_per_req,
+            # capacity at the SAME byte ledger: demand-based reservation vs
+            # one max_seq_len row per slot
+            "paged_slots_at_fixed_hbm": paged_capacity,
+            "legacy_slots_at_fixed_hbm": legacy_slots,
+            "paged_vs_legacy_slots": round(paged_capacity / legacy_slots, 2),
+            "paged_kv_bytes_per_slot_frac": round(
+                pages_per_req * page / max_seq, 4
+            ),
+            "paged_burst_peak_active": paged_peak,
+            "legacy_burst_peak_active": legacy_peak,
+            "paged_tokens_per_s": round(paged_tok_s, 2),
+            "paged_legacy_tokens_per_s": round(legacy_tok_s, 2),
+        })
+    finally:
+        legacy.stop()
+        paged.stop()
+
+    # ---- (b) prefix-hit TTFT on a shared-system-prompt trace -------------
+    prefix = rng.integers(1, 255, min(300, bucket - 8)).tolist()
+    turns = [
+        prefix + rng.integers(1, 255, 40).tolist() for _ in range(12)
+    ]
+
+    def ttft_arm(layout):
+        eng = build(layout, 4, prefix_cache=8)
+        try:
+            # first turn registers the prefix; it is excluded from the stats
+            eng.submit(
+                turns[0], max_tokens=8, temperature=0.0, prefix_len=len(prefix)
+            ).result(timeout=1200)
+            ttfts = []
+            for t in turns[1:]:
+                r = eng.submit(
+                    t, max_tokens=8, temperature=0.0, prefix_len=len(prefix)
+                ).result(timeout=1200)
+                ttfts.append(r.ttft_s)
+            hits = eng.prefix_hits
+            ttfts.sort()
+            return ttfts, hits
+        finally:
+            eng.stop()
+
+    ttft_l, hits_l = ttft_arm("legacy")
+    ttft_p, hits_p = ttft_arm("paged")
+
+    def pctl(vals, frac):
+        return vals[min(len(vals) - 1, max(0, round(frac * (len(vals) - 1))))]
+
+    out.update({
+        "paged_prefix_ttft_p50_s": round(pctl(ttft_p, 0.5), 4),
+        "paged_prefix_ttft_p95_s": round(pctl(ttft_p, 0.95), 4),
+        "legacy_prefix_ttft_p50_s": round(pctl(ttft_l, 0.5), 4),
+        "legacy_prefix_ttft_p95_s": round(pctl(ttft_l, 0.95), 4),
+        "paged_prefix_hits": hits_p,
+        "legacy_prefix_hits": hits_l,
+    })
+    return out
+
+
 # Each device-using config section runs in its OWN subprocess: the chip is
 # shared across every live process on this host, so a parent that keeps model
 # params resident starves the next section (r3's 8B bench failed exactly this
@@ -1211,6 +1363,13 @@ import json
 import bench
 
 print(json.dumps(bench.bench_ingest_only()))
+"""
+
+_PAGED_SNIPPET = """
+import json
+import bench
+
+print(json.dumps(bench.bench_paged()))
 """
 
 
@@ -2101,6 +2260,12 @@ _COMPACT_KEYS = (
     "decode_int8_slots_b_steady_tokens_per_s",
     "decode_int8_slots_b",
     "slots_ab_winner",
+    "paged_vs_legacy_slots",
+    "paged_slots_at_fixed_hbm",
+    "paged_tokens_per_s",
+    "paged_prefix_ttft_p50_s",
+    "paged_prefix_ttft_p95_s",
+    "legacy_prefix_ttft_p50_s",
     "decode_8b_int8_tokens_per_s_per_chip",
     "decode_8b_int8_fp8kv_tokens_per_s_per_chip",
     "longctx_decode_bucketed_tokens_per_s",
@@ -2212,6 +2377,7 @@ def main() -> None:
         baseline_thread.start()
         extras.update(bench_core())
         extras.update(bench_int8())
+        extras.update(bench_paged())
         extras.update(bench_longctx_decode(slots=4))
         moe_eng, _ = _build_gen_engine(_moe_cfg(), buckets=(_decode_bucket(),))
         try:
@@ -2263,6 +2429,9 @@ def main() -> None:
     # 3) config 2b: int8 weight-only decode at 1B (halves decode HBM reads)
     #    + the interleaved 16-vs-32 slot A/B/A trials
     run("int8", _INT8_SNIPPET, cap_s=900)
+    # 3a') paged KV plane: slots-at-fixed-HBM A/B (legacy vs paged on the
+    #      same byte ledger) + prefix-hit TTFT vs the r4 prefix cache
+    run("paged", _PAGED_SNIPPET, cap_s=600)
     # 3b) long-context DECODE: 16k-allocated cache at 8 slots, bucketed KV
     #     read vs full-cache read (the tentpole's canonical evidence)
     run("longctx_decode", _LONGCTX_DECODE_SNIPPET, cap_s=700)

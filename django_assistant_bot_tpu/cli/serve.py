@@ -22,6 +22,38 @@ def add_parser(sub):
         "warmup_json is set per model in the config file)",
     )
     p.add_argument(
+        "--kv-layout",
+        choices=("paged", "legacy"),
+        default=None,
+        help="KV cache layout for every decoder: 'paged' (block-table page "
+        "pool with prefix sharing — the default) or 'legacy' (contiguous "
+        "per-slot rows; the one-flag rollback — docs/KV_PAGING.md)",
+    )
+    p.add_argument(
+        "--kv-pages",
+        type=int,
+        default=None,
+        metavar="N",
+        help="page-pool size in pages for every decoder (0 = byte parity "
+        "with the legacy layout: max_slots * max_seq_len / page_size)",
+    )
+    p.add_argument(
+        "--kv-page-size",
+        type=int,
+        default=None,
+        metavar="TOKENS",
+        help="KV page size in tokens (0 = align with decode_kv_chunk)",
+    )
+    # deprecated r4 prefix-LRU flags: kept working, mapped onto the page-pool
+    # prefix registry (run() logs a one-line warning when used)
+    p.add_argument("--prefix-cache-size", type=int, default=None, help=(
+        "DEPRECATED: max shareable-prefix entries (now the page-pool prefix "
+        "registry bound; still honored)"))
+    p.add_argument("--prefix-min-tokens", type=int, default=None, help=(
+        "DEPRECATED: min prefix tokens to register for sharing (still honored)"))
+    p.add_argument("--prefix-cache-max-bytes", type=int, default=None, help=(
+        "DEPRECATED: byte budget for shared prefix pages (still honored)"))
+    p.add_argument(
         "--no-scheduler",
         action="store_true",
         help="disable the admission-controlled scheduler on every decoder "
@@ -112,6 +144,27 @@ def run(args) -> int:
     # have no admission scheduler or decode loop; their coalescer bound is the
     # max_queue spec knob)
     sched_overrides = {}
+    if getattr(args, "kv_layout", None) is not None:
+        sched_overrides["kv_layout"] = args.kv_layout
+    if getattr(args, "kv_pages", None) is not None:
+        sched_overrides["kv_pages"] = args.kv_pages
+    if getattr(args, "kv_page_size", None) is not None:
+        sched_overrides["kv_page_size"] = args.kv_page_size
+    # deprecated prefix-LRU flags: one-line warning, then mapped onto the
+    # page-pool prefix registry (identical semantics under the paged layout)
+    _dep = {
+        "prefix_cache_size": "prefix_cache",
+        "prefix_min_tokens": "prefix_min_tokens",
+        "prefix_cache_max_bytes": "prefix_cache_max_bytes",
+    }
+    for flag, knob in _dep.items():
+        val = getattr(args, flag, None)
+        if val is not None:
+            print(
+                f"warning: --{flag.replace('_', '-')} is deprecated; mapped "
+                f"onto the paged KV prefix registry ({knob})"
+            )
+            sched_overrides[knob] = val
     if getattr(args, "no_scheduler", False):
         sched_overrides["scheduler"] = False
     if getattr(args, "sched_max_queue", None) is not None:

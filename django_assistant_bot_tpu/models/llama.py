@@ -32,6 +32,7 @@ from ..ops.attention import (
     chunked_gqa_decode_attention,
     dot_product_attention,
     gqa_dot_product_attention,
+    paged_gqa_decode_attention,
 )
 from ..ops.norms import rms_norm
 from ..ops.quant import QTensor, qeinsum
@@ -858,6 +859,372 @@ def extract_prefix(cache: KVCache, slot: jnp.ndarray, pb: int) -> tuple[jnp.ndar
     pk = jnp.take(cache.k, slot, axis=1)[:, :, :pb]
     pv = jnp.take(cache.v, slot, axis=1)[:, :, :pb]
     return pk, pv
+
+
+# ---------------------------------------------------------------------------
+# Paged KV memory plane (vLLM-style block tables) — docs/KV_PAGING.md
+# ---------------------------------------------------------------------------
+
+
+class PagedKVCache(NamedTuple):
+    """Page-pool KV cache.  k/v: [L, P, KH, page, D] — a flat pool of P
+    fixed-size pages shared by every slot; lengths: [B] tokens present per
+    slot.  Which physical page holds a slot's logical block lives in a
+    separate ``[B, NB]`` block table (host-owned, passed per call — NOT part
+    of the donated device chain), where entries >= P mean "unallocated"."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    lengths: jnp.ndarray  # int32 [B]
+
+    @property
+    def n_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+
+def init_paged_cache(
+    cfg: DecoderConfig, batch: int, n_pages: int, page_size: int, dtype=None
+) -> PagedKVCache:
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, n_pages, cfg.num_kv_heads, page_size, cfg.head_dim)
+    return PagedKVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def paged_cache_shardings(cfg: DecoderConfig, mesh, batch: int) -> PagedKVCache:
+    """NamedShardings for the page pool: KV heads over the TP (``model``) axis
+    like the slot cache; the page axis stays replicated across ``data`` — the
+    block-table gather is global, so sharding pages would need collectives
+    (multi-chip serving promotes to per-replica pools instead, ROADMAP 3)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import MODEL_AXIS
+
+    if cfg.num_kv_heads % mesh.shape[MODEL_AXIS] == 0 and mesh.shape[MODEL_AXIS] > 1:
+        kv = NamedSharding(mesh, P(None, None, MODEL_AXIS, None, None))
+    else:
+        kv = NamedSharding(mesh, P())
+    return PagedKVCache(k=kv, v=kv, lengths=NamedSharding(mesh, P()))
+
+
+def copy_pages(
+    cache: PagedKVCache,
+    src: jnp.ndarray,  # [n] int32 physical page ids
+    dst: jnp.ndarray,  # [n] int32
+) -> PagedKVCache:
+    """Clone whole pages inside the pool (the allocator's copy-on-write
+    primitive: a prefix sharer clones the boundary page its own suffix will
+    write into).  Pure HBM copy; dst entries >= P drop."""
+    P = cache.n_pages
+    k = cache.k.at[:, jnp.minimum(dst, P)].set(
+        jnp.take(cache.k, jnp.clip(src, 0, P - 1), axis=1), mode="drop"
+    )
+    v = cache.v.at[:, jnp.minimum(dst, P)].set(
+        jnp.take(cache.v, jnp.clip(src, 0, P - 1), axis=1), mode="drop"
+    )
+    return PagedKVCache(k=k, v=v, lengths=cache.lengths)
+
+
+def _gather_paged_rows(cache: PagedKVCache, block_tables: jnp.ndarray):
+    """Materialise each row's logical KV view from its pages:
+    ([L, B, KH, NB*page, D]) x2.  Unallocated blocks gather a clamped page —
+    garbage the caller masks, exactly like the contiguous rows' invalid
+    positions."""
+    L, P, KH, page, D = cache.k.shape
+    B, NB = block_tables.shape
+    phys = jnp.clip(block_tables, 0, P - 1).reshape(-1)
+
+    def gather(pool):
+        rows = jnp.take(pool, phys, axis=1)  # [L, B*NB, KH, page, D]
+        rows = rows.reshape(L, B, NB, KH, page, D)
+        return rows.transpose(0, 1, 3, 2, 4, 5).reshape(L, B, KH, NB * page, D)
+
+    return gather(cache.k), gather(cache.v)
+
+
+def _scatter_paged_rows(
+    pool: jnp.ndarray,  # [L, P, KH, page, D]
+    rows: jnp.ndarray,  # [L, B, KH, S, D] updated logical rows
+    block_tables: jnp.ndarray,  # [B, NB]
+    write_mask,  # [B, NB] bool (np or jnp) — blocks this call actually wrote
+) -> jnp.ndarray:
+    """Write back only the blocks ``write_mask`` marks (per-row private pages
+    — shared prefix pages must never be re-written, even with identical
+    values, so the mask is part of the sharing contract).  Masked/pad blocks
+    scatter to the P sentinel and drop."""
+    L, P, KH, page, D = pool.shape
+    B, NB = block_tables.shape
+    for j in range(NB):
+        blk = jax.lax.slice_in_dim(rows, j * page, (j + 1) * page, axis=3)
+        tgt = jnp.where(write_mask[:, j], block_tables[:, j], P)
+        pool = pool.at[:, jnp.minimum(tgt, P)].set(
+            blk.astype(pool.dtype), mode="drop"
+        )
+    return pool
+
+
+def insert_sequences_paged(
+    cache: PagedKVCache,
+    ks: jnp.ndarray,  # [L, B, KH, Sb, D] from prefill
+    vs: jnp.ndarray,
+    lengths: jnp.ndarray,  # [B]
+    slots: jnp.ndarray,  # [B] int32 — target slot (max_slots sentinel = pad row)
+    block_tables: jnp.ndarray,  # [B, NB] — pad rows carry the P sentinel
+) -> PagedKVCache:
+    """Paged analog of :func:`insert_sequences`: write prefilled K/V rows into
+    their slots' pages (positions [0, Sb)).  Blocks past a row's allocation
+    (bucket padding beyond the reserved demand) and pad rows drop via the
+    sentinel — no aliasing trick needed, unlike the contiguous scan."""
+    L, P, KH, page, D = cache.k.shape
+    B, Sb = ks.shape[1], ks.shape[3]
+    NB = block_tables.shape[1]
+    nbw = min(NB, -(-Sb // page))
+    pad_s = nbw * page - Sb
+    if pad_s:
+        ks = jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad_s), (0, 0)))
+        vs = jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad_s), (0, 0)))
+    k, v = cache.k, cache.v
+    for j in range(nbw):
+        blk_k = jax.lax.slice_in_dim(ks, j * page, (j + 1) * page, axis=3)
+        blk_v = jax.lax.slice_in_dim(vs, j * page, (j + 1) * page, axis=3)
+        tgt = jnp.minimum(block_tables[:, j], P)
+        k = k.at[:, tgt].set(blk_k.astype(k.dtype), mode="drop")
+        v = v.at[:, tgt].set(blk_v.astype(v.dtype), mode="drop")
+    new_lengths = cache.lengths.at[slots].set(
+        lengths.astype(cache.lengths.dtype), mode="drop"
+    )
+    return PagedKVCache(k=k, v=v, lengths=new_lengths)
+
+
+def prefill_suffix_paged(
+    params: Params,
+    cfg: DecoderConfig,
+    input_ids: jnp.ndarray,  # [B, C] right-padded suffix tokens (C static bucket)
+    cache: PagedKVCache,
+    block_tables: jnp.ndarray,  # [B, NB] — each row's full logical page chain
+    slots: jnp.ndarray,  # [B] int32 (max_slots sentinel = pad row)
+    starts: jnp.ndarray,  # [B] int32 — tokens already present (the prefix length)
+    valids: jnp.ndarray,  # [B] int32 — real (non-pad) tokens per row
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Paged :func:`prefill_suffix`: gather each row's logical view from its
+    pages, run the identical suffix forward (same masks, same RoPE positions
+    — the compute is byte-for-byte the contiguous path's), then scatter back
+    ONLY the blocks overlapping the written window ``[start, start+C)``.
+    Blocks below it are the shared prefix pages — physically shared with
+    other requests, so they must not be touched (their gathered values are
+    unchanged, but a duplicate-index scatter's winner is undefined)."""
+    B, C = input_ids.shape
+    L, P, KH, page, D = cache.k.shape
+    NB = block_tables.shape[1]
+    S = NB * page
+    pos = starts[:, None] + jnp.arange(C)[None, :]
+    cos_t, sin_t = _rope_tables(cfg, S)
+    cos, sin = cos_t[pos], sin_t[pos]
+    x = _embed(params, cfg, input_ids)
+    kpos = jnp.arange(S)[None, None, None, :]
+    causal_keep = kpos <= pos[:, None, :, None]
+
+    k_rows, v_rows = _gather_paged_rows(cache, block_tables)
+
+    def make_body(window):
+        attn_mask = causal_keep
+        if window is not None:
+            attn_mask = attn_mask & (kpos > pos[:, None, :, None] - window)
+
+        def body(x, inputs):
+            p, k_row, v_row = inputs
+            h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _attn_proj(cfg, p, h, cos, sin)
+            k_row = _write_cache(k_row, k, starts)
+            v_row = _write_cache(v_row, v, starts)
+            o = gqa_dot_product_attention(q, k_row, v_row, mask=attn_mask)
+            o = o.transpose(0, 2, 1, 3).reshape(B, C, -1)
+            x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
+            h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(cfg, p, h)
+            return x, (k_row, v_row)
+
+        return body
+
+    x, (k_rows, v_rows) = _scan_window_split(
+        cfg, make_body, x, (params["layers"], k_rows, v_rows)
+    )
+    blk = jnp.arange(NB)
+    write_mask = ((blk[None, :] + 1) * page > starts[:, None]) & (
+        blk[None, :] * page < (starts + valids)[:, None]
+    )
+    k = _scatter_paged_rows(cache.k, k_rows, block_tables, write_mask)
+    v = _scatter_paged_rows(cache.v, v_rows, block_tables, write_mask)
+    lengths = cache.lengths.at[slots].set(
+        (starts + valids).astype(cache.lengths.dtype), mode="drop"
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(valids - 1, 0)[:, None, None], axis=1
+    )[:, 0]
+    logits = _head_logits(params, cfg, last)
+    return logits.astype(jnp.float32), PagedKVCache(k=k, v=v, lengths=lengths)
+
+
+def prefill_chunk_paged(
+    params: Params,
+    cfg: DecoderConfig,
+    input_ids: jnp.ndarray,  # [1, C] one chunk of one prompt
+    cache: PagedKVCache,
+    block_table: jnp.ndarray,  # [NB] int32 — the target slot's page chain
+    slot: jnp.ndarray,  # scalar int32
+    start: jnp.ndarray,  # scalar int32 — tokens already written for this slot
+    valid: jnp.ndarray,  # scalar int32 — real (non-pad) tokens in this chunk
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Paged :func:`prefill_chunk`: one chunk of one long prompt extends the
+    slot's page chain.  Same forward as the contiguous path over the gathered
+    logical row; write-back covers only the blocks overlapping
+    ``[start, start+C)`` (earlier blocks may be shared prefix pages)."""
+    B, C = input_ids.shape
+    L, P, KH, page, D = cache.k.shape
+    NB = block_table.shape[0]
+    S = NB * page
+    pos = start + jnp.arange(C)
+    cos_t, sin_t = _rope_tables(cfg, S)
+    cos, sin = cos_t[pos], sin_t[pos]
+    x = _embed(params, cfg, input_ids)
+    kpos = jnp.arange(S)[None, None, None, :]
+    causal_keep = kpos <= pos[None, None, :, None]
+
+    k_rows, v_rows = _gather_paged_rows(cache, block_table[None, :])
+
+    def make_body(window):
+        attn_mask = causal_keep
+        if window is not None:
+            attn_mask = attn_mask & (kpos > pos[None, None, :, None] - window)
+
+        def body(x, inputs):
+            p, k_row, v_row = inputs
+            h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _attn_proj(cfg, p, h, cos, sin)
+            k_row = jax.lax.dynamic_update_slice(
+                k_row, k.astype(k_row.dtype), (0, 0, start, 0)
+            )
+            v_row = jax.lax.dynamic_update_slice(
+                v_row, v.astype(v_row.dtype), (0, 0, start, 0)
+            )
+            o = gqa_dot_product_attention(q, k_row, v_row, mask=attn_mask)
+            o = o.transpose(0, 2, 1, 3).reshape(B, C, -1)
+            x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
+            h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(cfg, p, h)
+            return x, (k_row, v_row)
+
+        return body
+
+    x, (k_rows, v_rows) = _scan_window_split(
+        cfg, make_body, x, (params["layers"], k_rows, v_rows)
+    )
+    blk = jnp.arange(NB)
+    write_mask = ((blk + 1) * page > start) & (blk * page < start + valid)
+    k = _scatter_paged_rows(cache.k, k_rows, block_table[None, :], write_mask[None, :])
+    v = _scatter_paged_rows(cache.v, v_rows, block_table[None, :], write_mask[None, :])
+    lengths = jax.lax.dynamic_update_index_in_dim(
+        cache.lengths, (start + valid).astype(cache.lengths.dtype), slot, 0
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jax.lax.dynamic_index_in_dim(x[0], jnp.maximum(valid - 1, 0), 0, keepdims=False)
+    logits = _head_logits(params, cfg, last)[None]
+    return logits.astype(jnp.float32), PagedKVCache(k=k, v=v, lengths=lengths)
+
+
+def decode_step_paged(
+    params: Params,
+    cfg: DecoderConfig,
+    tokens: jnp.ndarray,  # [B] int32 — last sampled token per slot
+    cache: PagedKVCache,
+    block_tables: jnp.ndarray,  # [B, NB] int32
+    *,
+    active: Optional[jnp.ndarray] = None,  # [B] bool; inactive slots are frozen
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Paged :func:`decode_step`: one autoregressive step for every active
+    slot against the page pool -> (logits [B,V] f32, cache).
+
+    The attention read is :func:`~..ops.attention.paged_gqa_decode_attention`
+    — inherently chunked at page granularity, with the same loop bounds and
+    online-softmax discipline as the contiguous ``kv_chunk`` path (chunk ==
+    page), so outputs are bit-identical to the legacy layout for mirrored
+    pool contents.  The K/V write is a per-row scatter into
+    ``block_table[b, pos // page]`` at offset ``pos % page``; inactive rows
+    and rows whose position has run past their allocation scatter to the P
+    sentinel and DROP — unlike the contiguous path's harmless garbage writes,
+    a paged garbage write could land in a page since re-assigned to another
+    request, so masking is part of the correctness contract."""
+    B = tokens.shape[0]
+    L, P, KH, page, D = cache.k.shape
+    NB = block_tables.shape[1]
+    S = NB * page
+    H = cfg.num_heads
+    if active is None:
+        active = jnp.ones((B,), bool)
+    active = active & (cache.lengths < S)
+    positions = jnp.minimum(cache.lengths, S - 1)
+    cos_t, sin_t = _rope_tables(cfg, S)
+    cos = cos_t[positions][:, None, :]
+    sin = sin_t[positions][:, None, :]
+
+    x = _embed(params, cfg, tokens)[:, None, :]  # [B,1,E]
+    blk = positions // page
+    off = positions % page
+    phys = jnp.take_along_axis(block_tables, blk[:, None], axis=1)[:, 0]
+    phys_w = jnp.where(active, jnp.minimum(phys, P), P)
+
+    def make_body(window):
+        def body(x, inputs):
+            p, k_pool, v_pool = inputs  # [P, KH, page, D] per layer
+            h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+            q = qeinsum("bse,eo->bso", h, p["wq"], cfg.dtype)
+            k = qeinsum("bse,eo->bso", h, p["wk"], cfg.dtype)
+            v = qeinsum("bse,eo->bso", h, p["wv"], cfg.dtype)
+            if cfg.attn_bias:
+                q = q + p["bq"]
+                k = k + p["bk"]
+                v = v + p["bv"]
+            q = q.reshape(B, 1, H, D)
+            k = k.reshape(B, 1, KH, D)
+            v = v.reshape(B, 1, KH, D)
+            q = apply_rope(q, cos, sin).transpose(0, 2, 1, 3)
+            k = apply_rope(k, cos, sin).transpose(0, 2, 1, 3)
+            v = v.transpose(0, 2, 1, 3)
+            k_pool = k_pool.at[phys_w, :, off, :].set(
+                k[:, :, 0, :].astype(k_pool.dtype), mode="drop"
+            )
+            v_pool = v_pool.at[phys_w, :, off, :].set(
+                v[:, :, 0, :].astype(v_pool.dtype), mode="drop"
+            )
+            o = paged_gqa_decode_attention(
+                q, k_pool, v_pool, block_tables, positions,
+                active=active, window=window,
+            )  # [B,H,1,D]
+            o = o.transpose(0, 2, 1, 3).reshape(B, 1, -1)
+            x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
+            h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(cfg, p, h)
+            return x, (k_pool, v_pool)
+
+        return body
+
+    x, (ks, vs) = _scan_window_split(cfg, make_body, x, (params["layers"], cache.k, cache.v))
+    new_cache = PagedKVCache(
+        k=ks,
+        v=vs,
+        lengths=jnp.where(active, cache.lengths + 1, cache.lengths),
+    )
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps)
+    logits = _head_logits(params, cfg, x)
+    return logits.astype(jnp.float32), new_cache
 
 
 def verify_step(

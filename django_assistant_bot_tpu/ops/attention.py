@@ -189,6 +189,88 @@ def chunked_gqa_decode_attention(
     return out.reshape(B, H, 1, D)
 
 
+def paged_gqa_decode_attention(
+    q: jnp.ndarray,  # [B, H, 1, D]
+    k_pool: jnp.ndarray,  # [P, KH, page, D] page pool, storage dtype (bf16 / fp8)
+    v_pool: jnp.ndarray,  # [P, KH, page, D]
+    block_tables: jnp.ndarray,  # [B, NB] int32 — physical page per logical block;
+    #                             entries >= P mean "unallocated" (read masked)
+    positions: jnp.ndarray,  # [B] int32 — absolute position of each slot's query
+    *,
+    active: Optional[jnp.ndarray] = None,  # [B] bool; inactive rows don't widen the read
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Block-table variant of :func:`chunked_gqa_decode_attention`: the KV
+    "row" of a slot is a chain of fixed-size pages scattered through a shared
+    pool, resolved one gather per logical block.
+
+    Chunk == page: the loop structure, masking, and online-softmax state are
+    EXACTLY :func:`chunked_gqa_decode_attention`'s with ``chunk = page`` — so
+    for pools whose pages mirror a contiguous cache's chunks the result is
+    bit-identical (the byte-identity contract tests/test_kv_paging.py pins).
+    Logical blocks past a row's allocation gather a clamped page whose keys
+    are masked out (scores pinned to ``NEG_INF`` -> exact zero contribution,
+    the same discipline the contiguous path applies to garbage positions).
+
+    Reduced-precision pools dequantize PER PAGE: the ``astype`` sits on the
+    gathered operand, so the pool streams from HBM at its own width — same
+    placement as the contiguous path's per-chunk dequant.
+    """
+    B, H, Sq, D = q.shape
+    if Sq != 1:
+        raise ValueError(f"decode attention expects Sq=1 queries, got {Sq}")
+    P, KH, page, _ = k_pool.shape
+    NB = block_tables.shape[1]
+    S = NB * page
+    G = H // KH
+    scale = D ** -0.5
+    if active is None:
+        active = jnp.ones((B,), bool)
+    qg = q.reshape(B, KH, G, D)
+
+    act_pos = jnp.where(active, positions, 0)
+    hi = jnp.minimum(jnp.max(act_pos) // page + 1, NB)
+    if window is not None:
+        min_pos = jnp.min(jnp.where(active, positions, S))
+        lo = jnp.minimum(jnp.maximum(min_pos - window + 1, 0) // page, hi)
+    else:
+        lo = jnp.zeros((), hi.dtype)
+
+    def body(ci, carry):
+        m, l, acc = carry
+        phys = jax.lax.dynamic_slice_in_dim(block_tables, ci, 1, axis=1)[:, 0]
+        phys = jnp.clip(phys, 0, P - 1)  # sentinel rows read a live page, masked below
+        k_blk = jnp.take(k_pool, phys, axis=0)  # [B, KH, page, D]
+        v_blk = jnp.take(v_pool, phys, axis=0)
+        if k_blk.dtype != q.dtype:
+            k_blk = k_blk.astype(q.dtype)
+            v_blk = v_blk.astype(q.dtype)
+        s = jnp.einsum(
+            "bkgd,bksd->bkgs", qg, k_blk, preferred_element_type=jnp.float32
+        ) * scale  # [B, KH, G, page]
+        kpos = ci * page + jnp.arange(page)
+        keep = kpos[None, :] <= positions[:, None]  # [B, page]
+        if window is not None:
+            keep &= kpos[None, :] > positions[:, None] - window
+        s = jnp.where(keep[:, None, None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jnp.einsum(
+            "bkgs,bksd->bkgd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((B, KH, G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, KH, G, 1), jnp.float32)
+    a0 = jnp.zeros((B, KH, G, D), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(lo, hi, body, (m0, l0, a0))
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    return out.reshape(B, H, 1, D)
+
+
 # ---------------------------------------------------------------------------
 # Pallas flash attention
 # ---------------------------------------------------------------------------

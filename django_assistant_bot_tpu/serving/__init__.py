@@ -7,8 +7,11 @@ assistant/ai/embedders/transformers.py:15-29 — SURVEY.md §3.3 calls out both
 deficiencies).  This plane is one process driving the whole TPU slice:
 
 - :mod:`.tokenizer` — HF tokenizer wrapper + byte-level fallback, chat templating;
-- :mod:`.engine`    — continuous-batching generation engine (slot-based KV cache,
-  bucketed prefill, jit decode tick) and a coalescing batched embedding engine;
+- :mod:`.engine`    — continuous-batching generation engine (paged block-table
+  KV cache by default, bucketed prefill, jit decode tick) and a coalescing
+  batched embedding engine;
+- :mod:`.kv_pool`   — host-side page allocator for the paged KV plane
+  (refcounted prefix sharing, COW, LRU byte budget — docs/KV_PAGING.md);
 - :mod:`.streaming` — per-request token streams + UTF-8-safe incremental
   detokenization (``GenerationEngine.generate_stream`` and the SSE wire);
 - :mod:`.scheduler` — admission-controlled request scheduler (priority classes,

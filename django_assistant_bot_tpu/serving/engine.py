@@ -147,6 +147,9 @@ class _Request:
     # deque-append per sampled id from _process_tick — already host-resident
     # data, so streaming adds zero device syncs.  None = request/response.
     stream: Any = None
+    # paged KV plane: worst-case page reservation (ceil((prompt + max_tokens)
+    # / page_size)) — the scheduler's KV-pressure admission charge
+    kv_pages: int = 0
 
 
 # slot-cache precision knob -> concrete dtype (None = the model's cfg.dtype);
@@ -242,6 +245,9 @@ class GenerationEngine:
         kv_cache_dtype: Optional[str] = None,
         speculative: int = 0,
         decode_kv_chunk: Optional[int] = 0,
+        kv_layout: str = "paged",
+        kv_page_size: int = 0,
+        kv_pages: int = 0,
         scheduler: Optional[RequestScheduler] = None,
         faults=None,
         max_restarts: int = 5,
@@ -351,6 +357,88 @@ class GenerationEngine:
         # host-side and reported as ``kv_read_frac`` in :meth:`tick_stats`.
         self.decode_kv_chunk = self._resolve_kv_chunk(decode_kv_chunk)
         self._kv_frac_sum = 0.0
+        # --- paged KV memory plane (docs/KV_PAGING.md) ------------------------
+        # "paged" (default): the KV cache is a fixed pool of fixed-size pages
+        # plus per-slot block tables — requests reserve only
+        # ceil((prompt + max_tokens) / page) pages, common prompt prefixes
+        # share pages refcounted (copy-on-write at the boundary page), and
+        # admission sheds on KV pressure.  "legacy" keeps the contiguous
+        # [max_slots, max_seq_len] layout — the rollback / bench-A/B flag.
+        # Paged decode is bit-identical to legacy-with-chunked-read (the page
+        # IS the chunk), asserted in tests/test_kv_paging.py.
+        if kv_layout not in ("paged", "legacy"):
+            raise ValueError(
+                f"unknown kv_layout {kv_layout!r}; expected 'paged' or 'legacy'"
+            )
+        self.paged = kv_layout == "paged"
+        if self.paged and self.speculative:
+            # verify_step writes K+1 contiguous positions against the slot
+            # cache — the paged write path doesn't carry it yet (ROADMAP 2
+            # replaces the draft anyway); keep speculative entries on the
+            # legacy layout instead of failing the load
+            logger.warning(
+                "kv_layout='paged' is incompatible with speculative decoding; "
+                "falling back to the legacy slot cache for this engine"
+            )
+            self.paged = False
+        self.kv_page_size = 0
+        self._kv_blocks = 0
+        self._kv_pool = None
+        if self.paged:
+            page = int(kv_page_size) or self.decode_kv_chunk or 0
+            if not page:
+                # decode_kv_chunk disabled: pick the largest page that still
+                # divides the context into >= 2 pages (the paged read is
+                # inherently page-chunked — there is no "full read" layout)
+                for c in (512, 256, 128, 64, 32, 16, 8):
+                    if self.max_seq_len % c == 0 and self.max_seq_len // c >= 2:
+                        page = c
+                        break
+            if not page or self.max_seq_len % page or self.max_seq_len // page < 2:
+                logger.warning(
+                    "kv_layout='paged' needs a page size dividing "
+                    "max_seq_len=%d into >= 2 pages (got %s); falling back to "
+                    "the legacy slot cache",
+                    self.max_seq_len,
+                    page or None,
+                )
+                self.paged = False
+            else:
+                self.kv_page_size = page
+                self._kv_blocks = self.max_seq_len // page
+                n_pages = int(kv_pages) or self.max_slots * self._kv_blocks
+                if n_pages < self._kv_blocks:
+                    raise ValueError(
+                        f"kv_pages={n_pages} cannot hold even one max-length "
+                        f"request ({self._kv_blocks} pages of {page})"
+                    )
+                import jax.numpy as _jnp
+
+                from .kv_pool import PageAllocator
+
+                kv_itemsize = _jnp.dtype(
+                    self.kv_cache_dtype or cfg.dtype
+                ).itemsize
+                page_bytes = (
+                    cfg.num_layers
+                    * cfg.num_kv_heads
+                    * page
+                    * cfg.head_dim
+                    * 2  # K and V
+                    * kv_itemsize
+                )
+                # the r4 prefix-LRU knobs map straight onto the page pool:
+                # entry count -> registry entries, byte budget -> shared-page
+                # budget, min tokens -> registration threshold
+                self._kv_pool = PageAllocator(
+                    n_pages,
+                    page,
+                    page_bytes=page_bytes,
+                    max_shared_bytes=self.prefix_cache_max_bytes,
+                    max_shared_entries=self.prefix_cache_size,
+                    min_prefix_tokens=self.prefix_min_tokens,
+                )
+                self._kv_sentinel = n_pages  # block-table "unallocated" marker
         # Admission-controlled scheduling (serving/scheduler.py): when present,
         # submit() runs its admission test (bounded queue, estimated wait) and
         # _admit pulls requests in weighted-fair-share order instead of FIFO.
@@ -359,6 +447,15 @@ class GenerationEngine:
         self.scheduler = scheduler
         if scheduler is not None:
             scheduler.bind_slots(max_slots)
+            if self._kv_pool is not None:
+                # KV-pressure admission: the scheduler compares a request's
+                # projected page demand against the pool's obtainable pages
+                # (free + evictable cached prefixes) minus what the queue has
+                # already reserved — shedding with its own 429 reason instead
+                # of queueing work the pool cannot place (docs/SCHEDULING.md)
+                scheduler.bind_kv(
+                    self._kv_pool.available, self._kv_pool.n_pages
+                )
         # --- supervision (docs/RESILIENCE.md) ---------------------------------
         # Deterministic fault injection (serving/faults.py).  None = off: the
         # hot path pays one `is None` check per tick, nothing else.
@@ -406,9 +503,14 @@ class GenerationEngine:
         # the engine thread returns to device work (engine-thread-only state)
         self._stream_notify: set = set()
         self.mesh = mesh
-        self._cache_shardings = (
-            llama.cache_shardings(cfg, mesh, max_slots) if mesh is not None else None
-        )
+        if mesh is not None:
+            self._cache_shardings = (
+                llama.paged_cache_shardings(cfg, mesh, max_slots)
+                if self.paged
+                else llama.cache_shardings(cfg, mesh, max_slots)
+            )
+        else:
+            self._cache_shardings = None
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._pending: "collections.deque[_Request]" = collections.deque()
@@ -420,6 +522,24 @@ class GenerationEngine:
         self._slot_epoch = [0] * max_slots
         self._inflight: "collections.deque[_TickRef]" = collections.deque()
         self._cache = self._fresh_cache()
+        # per-slot block tables (host-owned, paged layout): logical block ->
+        # physical page, with n_pages as the "unallocated" sentinel.  Uploaded
+        # lazily like the sampling arrays (committed replicated array, re-sent
+        # only when admissions/frees change it) — NOT part of the donated
+        # cache chain, so host edits never race a device step.
+        if self.paged:
+            self._slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
+            self._block_tables = np.full(
+                (max_slots, self._kv_blocks), self._kv_sentinel, np.int32
+            )
+        else:
+            self._slot_pages = []
+            self._block_tables = np.zeros((1, 1), np.int32)  # inert legacy stub
+        self._bt_dev = jax.device_put(
+            jnp.asarray(self._block_tables),
+            _replicated(mesh) if mesh is not None else None,
+        )
+        self._bt_dirty = False
         self._tokens_dev = self._fresh_tokens()
         self._temps = np.zeros((max_slots,), np.float32)
         self._top_ps = np.ones((max_slots,), np.float32)
@@ -483,35 +603,72 @@ class GenerationEngine:
 
         self._prefill = jax.jit(_prefill)
         # donate the cache here too: slot insertion is a scatter into HBM, not a copy
-        self._insert = jax.jit(
-            llama.insert_sequences, donate_argnums=(0,), out_shardings=insert_out
-        )
+        if self.paged:
+            self._insert = jax.jit(
+                llama.insert_sequences_paged,
+                donate_argnums=(0,),
+                out_shardings=insert_out,
+            )
 
-        def _prefill_chunk(params, ids, cache, slot, start, valid):
-            return llama.prefill_chunk(params, cfg_c, ids, cache, slot, start, valid)
+            def _prefill_chunk_paged(params, ids, cache, bt_row, slot, start, valid):
+                return llama.prefill_chunk_paged(
+                    params, cfg_c, ids, cache, bt_row, slot, start, valid
+                )
 
-        self._prefill_chunk = jax.jit(
-            _prefill_chunk, donate_argnums=(2,), out_shardings=chunk_out
-        )
+            self._prefill_chunk = jax.jit(
+                _prefill_chunk_paged, donate_argnums=(2,), out_shardings=chunk_out
+            )
 
-        def _prefill_suffix(params, ids, cache, slots, starts, valids):
-            return llama.prefill_suffix(params, cfg_c, ids, cache, slots, starts, valids)
+            def _prefill_suffix_paged(params, ids, cache, bt, slots, starts, valids):
+                return llama.prefill_suffix_paged(
+                    params, cfg_c, ids, cache, bt, slots, starts, valids
+                )
 
-        if mesh is not None:
-            pfx = llama.prefix_shardings(cfg, mesh)
-            suffix_out = (_replicated(mesh), self._cache_shardings)
-            extract_out = (pfx, pfx)
+            suffix_out = (
+                (_replicated(mesh), self._cache_shardings)
+                if mesh is not None
+                else None
+            )
+            self._prefill_suffix = jax.jit(
+                _prefill_suffix_paged, donate_argnums=(2,), out_shardings=suffix_out
+            )
+            # the allocator's COW primitive: clone the boundary page a prefix
+            # sharer will write its own suffix into
+            self._copy_pages = jax.jit(
+                llama.copy_pages, donate_argnums=(0,), out_shardings=insert_out
+            )
+            self._insert_prefix = self._extract_prefix = None
         else:
-            suffix_out = extract_out = None
-        self._prefill_suffix = jax.jit(
-            _prefill_suffix, donate_argnums=(2,), out_shardings=suffix_out
-        )
-        self._insert_prefix = jax.jit(
-            llama.insert_prefix, donate_argnums=(0,), out_shardings=insert_out
-        )
-        self._extract_prefix = jax.jit(
-            llama.extract_prefix, static_argnums=(2,), out_shardings=extract_out
-        )
+            self._insert = jax.jit(
+                llama.insert_sequences, donate_argnums=(0,), out_shardings=insert_out
+            )
+
+            def _prefill_chunk(params, ids, cache, slot, start, valid):
+                return llama.prefill_chunk(params, cfg_c, ids, cache, slot, start, valid)
+
+            self._prefill_chunk = jax.jit(
+                _prefill_chunk, donate_argnums=(2,), out_shardings=chunk_out
+            )
+
+            def _prefill_suffix(params, ids, cache, slots, starts, valids):
+                return llama.prefill_suffix(params, cfg_c, ids, cache, slots, starts, valids)
+
+            if mesh is not None:
+                pfx = llama.prefix_shardings(cfg, mesh)
+                suffix_out = (_replicated(mesh), self._cache_shardings)
+                extract_out = (pfx, pfx)
+            else:
+                suffix_out = extract_out = None
+            self._prefill_suffix = jax.jit(
+                _prefill_suffix, donate_argnums=(2,), out_shardings=suffix_out
+            )
+            self._insert_prefix = jax.jit(
+                llama.insert_prefix, donate_argnums=(0,), out_shardings=insert_out
+            )
+            self._extract_prefix = jax.jit(
+                llama.extract_prefix, static_argnums=(2,), out_shardings=extract_out
+            )
+            self._copy_pages = None
 
     def _make_activate(self, json_mode: bool):
         """Build the jitted activation: mask (JSON), sample the first token per
@@ -563,8 +720,9 @@ class GenerationEngine:
 
         cfg_c, top_k_c, burst_c = self.cfg, self.top_k, self.burst
         kv_chunk_c = self.decode_kv_chunk
+        paged_c = self.paged
 
-        def tick(params, tokens, cache, active, temps, top_ps, rng,
+        def tick(params, tokens, cache, active, bt, temps, top_ps, rng,
                  fsm_s=None, jmask=None, next_tab=None, allowed_tab=None):
             def body(carry, _):
                 tokens, cache, rng, fsm_s = carry
@@ -580,9 +738,14 @@ class GenerationEngine:
                 # skip it.
                 p = jax.lax.optimization_barrier(params) if burst_c > 1 else params
                 rng, sub = jax.random.split(rng)
-                logits, cache = llama.decode_step(
-                    p, cfg_c, tokens, cache, active=active, kv_chunk=kv_chunk_c
-                )
+                if paged_c:
+                    logits, cache = llama.decode_step_paged(
+                        p, cfg_c, tokens, cache, bt, active=active
+                    )
+                else:
+                    logits, cache = llama.decode_step(
+                        p, cfg_c, tokens, cache, active=active, kv_chunk=kv_chunk_c
+                    )
                 if json_mode:
                     ok = allowed_tab[fsm_s]  # [B, V]
                     logits = jnp.where(jmask[:, None] & ~ok, NEG_INF, logits)
@@ -723,18 +886,26 @@ class GenerationEngine:
 
     def _fresh_cache(self):
         dt = self.kv_cache_dtype
+        if self.paged:
+            n_pages, page = self._kv_pool.n_pages, self.kv_page_size
+
+            def make():
+                return llama.init_paged_cache(
+                    self.cfg, self.max_slots, n_pages, page, dtype=dt
+                )
+        else:
+            def make():
+                return llama.init_cache(
+                    self.cfg, self.max_slots, self.max_seq_len, dtype=dt
+                )
+
         if self._cache_shardings is not None:
             # Allocate *sharded*: an eager init_cache would materialise the whole
             # cache on device 0 first — at slice-sized caches that alone overflows
             # one chip's HBM.
             with self.mesh:
-                return jax.jit(
-                    lambda: llama.init_cache(
-                        self.cfg, self.max_slots, self.max_seq_len, dtype=dt
-                    ),
-                    out_shardings=self._cache_shardings,
-                )()
-        return llama.init_cache(self.cfg, self.max_slots, self.max_seq_len, dtype=dt)
+                return jax.jit(make, out_shardings=self._cache_shardings)()
+        return make()
 
     def _mesh_scope(self):
         """Trace/run device steps inside the mesh so sharding constraints bind."""
@@ -865,22 +1036,42 @@ class GenerationEngine:
                 "(the JSON token-FSM advances one sequential state per token); "
                 "serve JSON traffic from a non-speculative model entry"
             )
-        admitted = False
-        if self.scheduler is not None:
-            if deadline_s is None:
-                deadline_s = self.scheduler.cfg.default_deadline_s
-            adm = self.scheduler.try_admit(priority, deadline_s)
-            if not adm.ok:
-                raise SchedulerRejected(adm.reason, adm.retry_after_s)
-            if adm.clamp_max_tokens is not None:
-                max_tokens = min(max_tokens, adm.clamp_max_tokens)
-            admitted = True
-        # keep room for at least one generated token
+        # keep room for at least one generated token (truncate BEFORE the
+        # admission test: the KV demand below is computed from what will
+        # actually occupy pages)
         limit = self.max_seq_len - 1
         if len(prompt_ids) > limit:
             prompt_ids = prompt_ids[-limit:]
             prefix_len = 0  # truncation drops leading tokens — prefix gone
         prefix_len = max(0, min(int(prefix_len), len(prompt_ids) - 1))
+        kv_pages = 0
+        if self.paged:
+            # worst-case page reservation: the whole prompt plus every token
+            # the request may generate, capped at the context.  Reserving up
+            # front means decode can never run out of pages mid-stream — the
+            # pool pressure surfaces at ADMISSION (429), not as a mid-decode
+            # stall.  Prefix sharing only reduces the pages actually taken.
+            demand_tokens = min(len(prompt_ids) + int(max_tokens), self.max_seq_len)
+            kv_pages = -(-demand_tokens // self.kv_page_size)
+        admitted = False
+        if self.scheduler is not None:
+            if deadline_s is None:
+                deadline_s = self.scheduler.cfg.default_deadline_s
+            adm = self.scheduler.try_admit(priority, deadline_s, kv_pages=kv_pages)
+            if not adm.ok:
+                raise SchedulerRejected(adm.reason, adm.retry_after_s)
+            if adm.clamp_max_tokens is not None:
+                max_tokens = min(max_tokens, adm.clamp_max_tokens)
+                if self.paged:
+                    # the clamp shrinks the worst case; release the difference
+                    demand_tokens = min(
+                        len(prompt_ids) + int(max_tokens), self.max_seq_len
+                    )
+                    new_pages = -(-demand_tokens // self.kv_page_size)
+                    if new_pages < kv_pages:
+                        self.scheduler.release_kv(kv_pages - new_pages)
+                        kv_pages = new_pages
+            admitted = True
         now = time.monotonic()
         fut: Future = Future()
         if stream is not None:
@@ -902,6 +1093,7 @@ class GenerationEngine:
                 deadline_at=(now + deadline_s) if deadline_s is not None else None,
                 admitted=admitted,
                 stream=stream,
+                kv_pages=kv_pages,
             )
         )
         # A stop() racing (or preceding) the put above would leave the request
@@ -1127,6 +1319,8 @@ class GenerationEngine:
                 _safe_resolve(s.request.future, exc=err)
                 self._slots[i] = None
                 self._slot_epoch[i] += 1
+            if self.paged:
+                self._free_slot_pages(i)
         self._drain_queue(err)
 
     def _reap_dead_slots(self) -> None:
@@ -1174,6 +1368,7 @@ class GenerationEngine:
             self._slot_epoch[i] += 1
             self._json[i] = False
             self._sampling_dirty = True
+            self._free_slot_pages(i)
             self.reclaimed_slots += 1
             if not expired:
                 # future.cancelled(): a streaming consumer disconnected (or a
@@ -1190,15 +1385,22 @@ class GenerationEngine:
                 if self.scheduler is not None:
                     self.scheduler.note_expired_running(req.priority)
 
-    def _prefix_lookup(self, req: _Request) -> Optional[_Prefix]:
+    def _prefix_lookup(self, req: _Request):
         """LONGEST cached prefix this prompt starts with, or None.
 
         Longest-match (not exact-key) is what makes multi-turn dialogs hit:
         turn N's prompt extends turn N-1's [system, ...history] block, so the
         previous turn's registered prefix is a proper prefix of the new prompt
-        even though the declared split point moved.  LRU-touches the winner."""
+        even though the declared split point moved.  LRU-touches the winner.
+
+        Paged layout: the allocator's registry answers (a
+        :class:`~.kv_pool.SharedPrefix` of physical pages); legacy: the
+        pinned-K/V LRU (:class:`_Prefix`).  Both carry ``.length``."""
         if self.prefix_cache_size <= 0 or req.prefix_len < self.prefix_min_tokens:
             return None
+        if self.paged:
+            hit = self._kv_pool.lookup(req.prompt_ids, req.prefix_len)
+            return self._paged_usable_hit(req, hit)
         n = len(req.prompt_ids)
         best_key = None
         best: Optional[_Prefix] = None
@@ -1209,6 +1411,84 @@ class GenerationEngine:
         if best_key is not None:
             self._prefix_lru.move_to_end(best_key)
         return best
+
+    def _paged_usable_hit(self, req: _Request, hit):
+        """Reject a registry hit whose bucketed suffix prefill would have to
+        slide left past the prefix boundary (prefix within one bucket of the
+        context end): the slid window would re-WRITE physically shared pages,
+        and a duplicate-index scatter with near-identical recomputed values is
+        undefined.  The chunked path never slides into the prefix
+        (remainder > chunk_size guarantees the final chunk starts past it)."""
+        if hit is None:
+            return None
+        n_eff = len(req.prompt_ids) - hit.length
+        if n_eff > self.chunk_size:
+            return hit
+        b = pick_bucket(n_eff, self.prefill_buckets, self.chunk_size)
+        if hit.length + b > self.max_seq_len:
+            return None
+        return hit
+
+    def _paged_admit_slot(self, slot: int, req: _Request, hit) -> bool:
+        """Reserve and wire pages for ``req`` in ``slot``: shared full prefix
+        pages by reference (incref), the boundary page by copy-on-write clone,
+        everything else fresh from the pool.  False = the pool cannot place
+        the request right now (it stays queued; pages free as slots finish)."""
+        page = self.kv_page_size
+        demand_tokens = min(
+            len(req.prompt_ids) + req.max_tokens, self.max_seq_len
+        )
+        total = -(-demand_tokens // page)
+        shared: List[int] = []
+        pinned: List[int] = []
+        cow_src = None
+        if hit is not None:
+            # pin EVERY hit page (incl. the COW source) BEFORE alloc: alloc's
+            # on-demand LRU eviction could otherwise evict this very entry and
+            # hand its just-freed pages back as "fresh" pages of the same
+            # request — aliasing prefix and suffix blocks to one physical page
+            pinned = list(hit.pages)
+            self._kv_pool.incref(pinned)
+            shared = pinned[: hit.full_pages]
+            if len(pinned) > hit.full_pages:
+                cow_src = pinned[hit.full_pages]
+        fresh = self._kv_pool.alloc(total - len(shared))
+        if fresh is None:
+            if pinned:
+                self._kv_pool.decref(pinned)
+            return False
+        if cow_src is not None:
+            # the sharer's own suffix K/V lands in the boundary page — clone
+            # it (positions below the prefix length carry the owner's valid
+            # prefix K/V; at/above it the clone holds garbage the sharer's
+            # suffix prefill overwrites before it is ever unmasked)
+            with self._mesh_scope():
+                self._cache = self._copy_pages(
+                    self._cache,
+                    jnp.asarray([cow_src], jnp.int32),
+                    jnp.asarray([fresh[0]], jnp.int32),
+                )
+            self._kv_pool.cow_copies += 1
+            # the clone is done — the boundary page only needed the pin
+            self._kv_pool.decref([cow_src])
+        row = shared + fresh
+        self._slot_pages[slot] = row
+        self._block_tables[slot, :] = self._kv_sentinel
+        self._block_tables[slot, : len(row)] = row
+        self._bt_dirty = True
+        return True
+
+    def _free_slot_pages(self, slot: int) -> None:
+        """Release a slot's page references (request finished / reclaimed /
+        quarantined).  Registered prefix entries keep their own refs, so
+        shared pages survive the owner; everything refcount-0 returns to the
+        free list for the next admission."""
+        if not self.paged or not self._slot_pages[slot]:
+            return
+        self._kv_pool.decref(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self._block_tables[slot, :] = self._kv_sentinel
+        self._bt_dirty = True
 
     def _peek_next(self, now: float) -> Optional[_Request]:
         """Head-of-queue inspection without removal.  Scheduler path: the
@@ -1238,6 +1518,15 @@ class GenerationEngine:
             return self.scheduler.pop(now)
         return self._pending.popleft() if self._pending else None
 
+    def _requeue_front(self, req: _Request) -> None:
+        """Put a just-popped request back at the head of its queue (admission
+        could not start it this iteration: pool out of pages, or a chunked
+        prefill is already in flight)."""
+        if self.scheduler is not None:
+            self.scheduler.enqueue(req, front=True)
+        else:
+            self._pending.appendleft(req)
+
     def _admit(self) -> bool:
         admitted = False
         # stage queued requests: into the scheduler (which orders them by
@@ -1254,7 +1543,7 @@ class GenerationEngine:
                 self._pending.append(req)
         now = time.monotonic()
         free = self._free_slots()
-        batch: List[tuple[int, _Request, Optional[_Prefix]]] = []
+        batch: List[tuple[int, _Request, Any]] = []
         while free:
             req = self._peek_next(now)
             if req is None:
@@ -1263,17 +1552,53 @@ class GenerationEngine:
             # with a cached prefix only the suffix runs through the model, so
             # the chunked path is needed only when the REMAINDER exceeds a chunk
             n_eff = len(req.prompt_ids) - (hit.length if hit else 0)
+            if n_eff > self.chunk_size and (self._chunking is not None or batch):
+                break  # one chunked prefill at a time; scheduling order preserved
+            slot = free[0]
+            if self.paged and not self._paged_admit_slot(slot, req, hit):
+                if hit is not None:
+                    # the pinned hit itself may be what eviction needed — drop
+                    # it and retry as a full prefill (the entry becomes
+                    # evictable), so a registry-heavy pool cannot wedge the
+                    # queue head
+                    hit = None
+                    n_eff = len(req.prompt_ids)
+                    if n_eff > self.chunk_size and (
+                        self._chunking is not None or batch
+                    ):
+                        break
+                    if not self._paged_admit_slot(slot, req, None):
+                        break
+                else:
+                    break  # out of pages: the head waits for a slot to free
+            taken = self._take_next(now)
+            if taken is None:
+                self._free_slot_pages(slot)
+                break
+            if taken is not req:
+                # the head moved between peek and pop (a client cancelled the
+                # peeked request, or a concurrent enqueue re-ordered the fair
+                # share) — the POPPED request is the one that must be served;
+                # dropping it would leave its future unresolved forever
+                self._free_slot_pages(slot)
+                req = taken
+                hit = self._prefix_lookup(req)
+                n_eff = len(req.prompt_ids) - (hit.length if hit else 0)
+                if n_eff > self.chunk_size and (
+                    self._chunking is not None or batch
+                ):
+                    self._requeue_front(req)
+                    break
+                if self.paged and not self._paged_admit_slot(slot, req, hit):
+                    self._requeue_front(req)
+                    break
+            free.pop(0)
+            self._count_prefix(req, hit)
             if n_eff > self.chunk_size:
-                if self._chunking is not None or batch:
-                    break  # one chunked prefill at a time; scheduling order preserved
-                self._take_next(now)
-                self._count_prefix(req, hit)
-                self._begin_chunked(free.pop(0), req, prefix=hit)
+                self._begin_chunked(slot, req, prefix=hit)
                 admitted = True
             else:
-                self._take_next(now)
-                self._count_prefix(req, hit)
-                batch.append((free.pop(0), req, hit))
+                batch.append((slot, req, hit))
         if batch:
             # group the wave by seq bucket: short prompts must not pay the
             # longest prompt's O(S^2) attention; one dispatch per bucket group.
@@ -1350,9 +1675,23 @@ class GenerationEngine:
                     ids = jnp.zeros((bp, bucket), jnp.int32)
                     lengths = jnp.zeros((bp,), jnp.int32)
                     logits, ks, vs = self._prefill(self.params, ids, lengths)
-                    self._cache = self._insert(
-                        self._cache, ks, vs, lengths, jnp.zeros((bp,), jnp.int32)
-                    )
+                    if self.paged:
+                        # sentinel slots + block tables: the compiled scatter
+                        # shapes are exercised, every write drops on device
+                        self._cache = self._insert(
+                            self._cache,
+                            ks,
+                            vs,
+                            lengths,
+                            jnp.full((bp,), self.max_slots, jnp.int32),
+                            jnp.full(
+                                (bp, self._kv_blocks), self._kv_sentinel, jnp.int32
+                            ),
+                        )
+                    else:
+                        self._cache = self._insert(
+                            self._cache, ks, vs, lengths, jnp.zeros((bp,), jnp.int32)
+                        )
                     # the fused activation program keys on the batch bucket too
                     # — compile it here, discarding results (all rows OOB-drop)
                     self._activate_fn(
@@ -1381,15 +1720,48 @@ class GenerationEngine:
                 # chunked prefill (prompts > chunk_size) has one fixed shape;
                 # unreachable (and not worth compiling) when prompts are
                 # truncated to max_seq_len - 1 <= chunk_size
-                _, self._cache = self._prefill_chunk(
-                    self.params,
-                    jnp.zeros((1, self.chunk_size), jnp.int32),
+                if self.paged:
+                    _, self._cache = self._prefill_chunk(
+                        self.params,
+                        jnp.zeros((1, self.chunk_size), jnp.int32),
+                        self._cache,
+                        jnp.full((self._kv_blocks,), self._kv_sentinel, jnp.int32),
+                        jnp.asarray(0, jnp.int32),
+                        jnp.asarray(0, jnp.int32),
+                        jnp.asarray(0, jnp.int32),
+                    )
+                else:
+                    _, self._cache = self._prefill_chunk(
+                        self.params,
+                        jnp.zeros((1, self.chunk_size), jnp.int32),
+                        self._cache,
+                        jnp.asarray(0, jnp.int32),
+                        jnp.asarray(0, jnp.int32),
+                        jnp.asarray(0, jnp.int32),
+                    )
+            if self.prefix_cache_size > 0 and self.paged:
+                # paged prefix path: the batched suffix prefill per (batch,
+                # seq) bucket plus the COW page clone — sentinel targets, so
+                # every warmup write drops
+                for bucket in buckets:
+                    for bp in self._batch_buckets():
+                        logits, self._cache = self._prefill_suffix(
+                            self.params,
+                            jnp.zeros((bp, bucket), jnp.int32),
+                            self._cache,
+                            jnp.full(
+                                (bp, self._kv_blocks), self._kv_sentinel, jnp.int32
+                            ),
+                            jnp.full((bp,), self.max_slots, jnp.int32),
+                            jnp.zeros((bp,), jnp.int32),
+                            jnp.zeros((bp,), jnp.int32),
+                        )
+                self._cache = self._copy_pages(
                     self._cache,
-                    jnp.asarray(0, jnp.int32),
-                    jnp.asarray(0, jnp.int32),
-                    jnp.asarray(0, jnp.int32),
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.full((1,), self._kv_sentinel, jnp.int32),
                 )
-            if self.prefix_cache_size > 0:
+            elif self.prefix_cache_size > 0:
                 # prefix-cache path: suffix prefill per (batch, seq) bucket +
                 # the extract/insert copies per prefix bucket.  All warmup
                 # writes land in slot 0 with length 0 — same discipline as the
@@ -1425,6 +1797,7 @@ class GenerationEngine:
                 self._tokens_dev,
                 self._cache,
                 jnp.zeros((self.max_slots,), bool),
+                self._bt_dev,
                 jnp.asarray(self._temps),
                 jnp.asarray(self._top_ps),
                 self._rng,
@@ -1454,6 +1827,7 @@ class GenerationEngine:
                     last,
                     self._cache,
                     jnp.zeros((self.max_slots,), bool),
+                    self._bt_dev,
                     jnp.asarray(self._temps),
                     jnp.asarray(self._top_ps),
                     self._rng,
@@ -1509,6 +1883,17 @@ class GenerationEngine:
         admission pays no padding."""
         return tuple(sorted({1, min(4, self.max_slots), self.max_slots}))
 
+    def _wave_block_tables(self, slots: List[int], pad: int) -> np.ndarray:
+        """Block-table rows for a prefill wave ([Bp, n_blocks]); the first
+        ``pad`` rows are batch-bucket padding and carry the page sentinel
+        everywhere — their writes drop on device."""
+        bt = np.full(
+            (pad + len(slots), self._kv_blocks), self._kv_sentinel, np.int32
+        )
+        for j, slot in enumerate(slots):
+            bt[pad + j] = self._block_tables[slot]
+        return bt
+
     def _start_batch(self, batch: List[tuple[int, _Request]]):
         """One prefill dispatch for every request admitted this wave.
 
@@ -1525,7 +1910,12 @@ class GenerationEngine:
         pad = Bp - B
         ids = np.full((Bp, bucket), self.tokenizer.pad_id, np.int32)
         lengths = np.zeros((Bp,), np.int32)
-        slot_arr = np.full((Bp,), slots[0], np.int32)
+        # pad rows: legacy aliases the first real slot (the insert scan's row
+        # order makes the real row win); paged scatters with drop semantics,
+        # so pads carry the max_slots / page sentinels instead
+        slot_arr = np.full(
+            (Bp,), self.max_slots if self.paged else slots[0], np.int32
+        )
         for j, req in enumerate(reqs):
             n = len(req.prompt_ids)
             ids[pad + j, :n] = req.prompt_ids
@@ -1535,9 +1925,19 @@ class GenerationEngine:
             logits, ks, vs = self._prefill(
                 self.params, jnp.asarray(ids), jnp.asarray(lengths)
             )
-            self._cache = self._insert(
-                self._cache, ks, vs, jnp.asarray(lengths), jnp.asarray(slot_arr)
-            )
+            if self.paged:
+                self._cache = self._insert(
+                    self._cache,
+                    ks,
+                    vs,
+                    jnp.asarray(lengths),
+                    jnp.asarray(slot_arr),
+                    jnp.asarray(self._wave_block_tables(slots, pad)),
+                )
+            else:
+                self._cache = self._insert(
+                    self._cache, ks, vs, jnp.asarray(lengths), jnp.asarray(slot_arr)
+                )
         # a miss with a declared prefix: capture its K/V for future requests
         # (pure device slice, async — admission never blocks on it)
         for slot, req in batch:
@@ -1547,11 +1947,13 @@ class GenerationEngine:
         # otherwise every distinct wave size would trigger fresh compiles
         self._activate_batch(slots, reqs, logits, pad=pad)
 
-    def _start_suffix_batch(self, group: List[tuple[int, _Request, _Prefix]]):
-        """Admit a wave of prefix-cache hits: copy each cached prefix into its
-        slot (HBM copy, no compute), then ONE batched suffix prefill continues
-        all rows from their prefix lengths — the skipped work is exactly the
-        prefix recompute the reference pays every turn."""
+    def _start_suffix_batch(self, group: List[tuple[int, _Request, Any]]):
+        """Admit a wave of prefix-cache hits: make each slot's cache row carry
+        the prefix K/V — legacy copies the pinned prefix into the slot row,
+        paged already wired the shared pages into the block table at admission
+        — then ONE batched suffix prefill continues all rows from their
+        prefix lengths; the skipped work is exactly the prefix recompute the
+        reference pays every turn."""
         slots = [s for s, _, _ in group]
         reqs = [r for _, r, _ in group]
         hits = [h for _, _, h in group]
@@ -1566,13 +1968,17 @@ class GenerationEngine:
         ids = np.full((Bp, bucket), self.tokenizer.pad_id, np.int32)
         starts = np.zeros((Bp,), np.int32)
         valids = np.zeros((Bp,), np.int32)
-        slot_arr = np.full((Bp,), slots[0], np.int32)
+        slot_arr = np.full(
+            (Bp,), self.max_slots if self.paged else slots[0], np.int32
+        )
         for j, (req, hit) in enumerate(zip(reqs, hits)):
             # the bucketed write window [start, start+bucket) must not cross
             # max_seq_len — dynamic_update_slice would CLAMP the start and
             # smear the window over the prefix.  Slide the window left instead
             # (prefill_chunk's final-chunk discipline): the re-fed prefix
             # tokens recompute to identical K/V at identical positions.
+            # (Paged hits never need the slide: _paged_usable_hit rejects
+            # them, because a slid window would re-write SHARED pages.)
             start = min(hit.length, self.max_seq_len - bucket)
             chunk = req.prompt_ids[start : start + bucket]
             ids[pad + j, : len(chunk)] = chunk
@@ -1580,18 +1986,29 @@ class GenerationEngine:
             valids[pad + j] = len(chunk)
             slot_arr[pad + j] = slots[j]
         with self._mesh_scope():
-            for slot, hit in zip(slots, hits):
-                self._cache = self._insert_prefix(
-                    self._cache, hit.pk, hit.pv, jnp.asarray(slot, jnp.int32)
+            if self.paged:
+                logits, self._cache = self._prefill_suffix(
+                    self.params,
+                    jnp.asarray(ids),
+                    self._cache,
+                    jnp.asarray(self._wave_block_tables(slots, pad)),
+                    jnp.asarray(slot_arr),
+                    jnp.asarray(starts),
+                    jnp.asarray(valids),
                 )
-            logits, self._cache = self._prefill_suffix(
-                self.params,
-                jnp.asarray(ids),
-                self._cache,
-                jnp.asarray(slot_arr),
-                jnp.asarray(starts),
-                jnp.asarray(valids),
-            )
+            else:
+                for slot, hit in zip(slots, hits):
+                    self._cache = self._insert_prefix(
+                        self._cache, hit.pk, hit.pv, jnp.asarray(slot, jnp.int32)
+                    )
+                logits, self._cache = self._prefill_suffix(
+                    self.params,
+                    jnp.asarray(ids),
+                    self._cache,
+                    jnp.asarray(slot_arr),
+                    jnp.asarray(starts),
+                    jnp.asarray(valids),
+                )
         # a hit whose DECLARED split extends past the matched prefix (multi-turn:
         # the history grew) registers the longer prefix for the next turn
         for slot, req in zip(slots, reqs):
@@ -1617,9 +2034,19 @@ class GenerationEngine:
             return 0
 
     def _maybe_register_prefix(self, slot: int, req: _Request) -> None:
-        """After a full prefill of ``slot``, slice the request's declared prefix
-        K/V out of the slot row into the LRU (post-RoPE, positions [0, P))."""
+        """After a full prefill of ``slot``, make the request's declared prefix
+        shareable.  Paged: register the pages covering it with the allocator
+        (pure refcounting — no copy, no extra HBM beyond what the request
+        already holds).  Legacy: slice the prefix K/V out of the slot row into
+        the pinned LRU (post-RoPE, positions [0, P))."""
         if self.prefix_cache_size <= 0 or req.prefix_len < self.prefix_min_tokens:
+            return
+        if self.paged:
+            nbp = -(-req.prefix_len // self.kv_page_size)
+            pages = [int(p) for p in self._block_tables[slot, :nbp]]
+            if any(p >= self._kv_sentinel for p in pages):
+                return  # allocation didn't cover the prefix (shouldn't happen)
+            self._kv_pool.register(req.prompt_ids, req.prefix_len, pages)
             return
         key = tuple(req.prompt_ids[: req.prefix_len])
         if key in self._prefix_lru:
@@ -1653,7 +2080,9 @@ class GenerationEngine:
         flat = np.asarray(req.prompt_ids, np.int32)
         starts = list(range(base, n - c, c)) + [n - c]
         ids = np.stack([flat[s : s + c] for s in starts])
-        if prefix is not None:
+        if prefix is not None and not self.paged:
+            # paged: the shared pages are already wired into the block table
+            # (and the boundary page COW-cloned) by _paged_admit_slot
             with self._mesh_scope():
                 self._cache = self._insert_prefix(
                     self._cache, prefix.pk, prefix.pv, jnp.asarray(slot, jnp.int32)
@@ -1668,19 +2097,31 @@ class GenerationEngine:
         assert st is not None
         j = st.step
         with self._mesh_scope():
-            logits, self._cache = self._prefill_chunk(
-                self.params,
-                jnp.asarray(st.ids[j : j + 1]),
-                self._cache,
-                jnp.asarray(st.slot, jnp.int32),
-                jnp.asarray(st.starts[j], jnp.int32),
-                jnp.asarray(self.chunk_size, jnp.int32),
-            )
+            if self.paged:
+                logits, self._cache = self._prefill_chunk(
+                    self.params,
+                    jnp.asarray(st.ids[j : j + 1]),
+                    self._cache,
+                    jnp.asarray(self._block_tables[st.slot]),
+                    jnp.asarray(st.slot, jnp.int32),
+                    jnp.asarray(st.starts[j], jnp.int32),
+                    jnp.asarray(self.chunk_size, jnp.int32),
+                )
+            else:
+                logits, self._cache = self._prefill_chunk(
+                    self.params,
+                    jnp.asarray(st.ids[j : j + 1]),
+                    self._cache,
+                    jnp.asarray(st.slot, jnp.int32),
+                    jnp.asarray(st.starts[j], jnp.int32),
+                    jnp.asarray(self.chunk_size, jnp.int32),
+                )
         st.step += 1
         if st.request.future.cancelled():
             # the consumer vanished mid-prefill: abandon the remaining chunks
             self.reclaimed_slots += 1
             self.cancelled_slots += 1
+            self._free_slot_pages(st.slot)
             self._chunking = None
             return
         dl = st.request.deadline_at
@@ -1693,6 +2134,7 @@ class GenerationEngine:
                 st.request.future,
                 exc=DeadlineExceeded("deadline expired during chunked prefill"),
             )
+            self._free_slot_pages(st.slot)
             self._chunking = None
             return
         if st.step >= len(st.starts):
@@ -1779,6 +2221,14 @@ class GenerationEngine:
             self._top_ps_dev = jnp.asarray(self._top_ps)
             self._json_dev = jnp.asarray(self._json)
             self._sampling_dirty = False
+        if self._bt_dirty:
+            # [max_slots, n_blocks] int32 — a few KB, re-sent only when an
+            # admission or free actually changed a block table
+            self._bt_dev = jax.device_put(
+                jnp.asarray(self._block_tables),
+                _replicated(self.mesh) if self.mesh is not None else None,
+            )
+            self._bt_dirty = False
 
     def tick_stats(self) -> dict:
         """Aggregate per-tick wall breakdown (ms/tick).  `block` near zero means
@@ -1803,6 +2253,9 @@ class GenerationEngine:
             out["spec_accept_rate"] = round(
                 self.spec_accepted / max(1, self.spec_drafted), 4
             )
+        # KV memory plane gauges: pool occupancy, sharing fraction, allocator
+        # eviction/COW counters (paged), or the pinned-prefix footprint (legacy)
+        out["kv"] = self.kv_stats()
         out["reclaimed_slots"] = self.reclaimed_slots
         # restart/quarantine/circuit counters + loop heartbeat (supervision)
         out["supervision"] = self.supervision_stats()
@@ -1810,6 +2263,22 @@ class GenerationEngine:
         if self.scheduler is not None:
             # queue-pressure snapshot: depth/pressure/shed/wait percentiles
             out["sched"] = self.scheduler.stats()
+        return out
+
+    def kv_stats(self) -> dict:
+        """KV memory plane snapshot for tick_stats / healthz: layout, pool
+        gauges (``kv_pages_used`` / ``kv_pages_free`` / ``kv_shared_page_frac``
+        and the allocator's eviction/COW counters) when paged; the pinned
+        prefix-LRU footprint when legacy.  Prefix hit/miss counters ride along
+        in both layouts."""
+        out: dict = {"kv_layout": "paged" if self.paged else "legacy"}
+        if self.paged:
+            out.update(self._kv_pool.stats())
+        else:
+            out["prefix_entries"] = len(self._prefix_lru)
+            out["prefix_bytes"] = self._prefix_bytes
+        out["prefix_hits"] = self.prefix_hits
+        out["prefix_misses"] = self.prefix_misses
         return out
 
     @staticmethod
@@ -1882,6 +2351,22 @@ class GenerationEngine:
         self._cache = self._cache._replace(lengths=lens)
 
     def _probe_decode_locked(self, iters: int, fill_len: Optional[int]) -> float:
+        if fill_len is not None and self.paged:
+            # give every slot a DISTINCT round-robin page chain so the probe's
+            # block-table gathers stream the same page spread real traffic at
+            # this fill would (sentinel rows would collapse every gather onto
+            # one clamped page — cache-resident, overstating the rate).
+            # Registry-shared pages hold VALID prefix K/V a live cache may
+            # serve later — the probe's garbage writes must not touch them.
+            avoid = self._kv_pool.shared_page_ids()
+            scratch = [p for p in range(self._kv_pool.n_pages) if p not in avoid]
+            if scratch:
+                for b in range(self.max_slots):
+                    for j in range(self._kv_blocks):
+                        self._block_tables[b, j] = scratch[
+                            (b * self._kv_blocks + j) % len(scratch)
+                        ]
+                self._bt_dirty = True
         self._refresh_sampling()
         active = self._active_dev
         if fill_len is not None:
@@ -1905,6 +2390,10 @@ class GenerationEngine:
                 # mid-probe dispatch error can't leave phantom fill lengths
                 # widening every later batch's read window.
                 self._set_cache_lengths(np.zeros((self.max_slots,), np.int32))
+                if self.paged:
+                    self._block_tables[:] = self._kv_sentinel
+                    self._bt_dirty = True
+                    self._refresh_sampling()
 
     def _probe_decode_timed(self, iters: int, active) -> float:
         import numpy as _np
@@ -1913,7 +2402,7 @@ class GenerationEngine:
             # one warm call (jit cache is hot after warmup(); cheap regardless)
             toks, last, self._cache, self._rng = self._decode_tick(
                 self.params, self._tokens_dev, self._cache, active,
-                self._temps_dev, self._top_ps_dev, self._rng,
+                self._bt_dev, self._temps_dev, self._top_ps_dev, self._rng,
             )
             self._tokens_dev = last
             _np.asarray(toks)  # fetch: the only barrier this backend honors
@@ -1937,7 +2426,7 @@ class GenerationEngine:
             for _ in range(iters):
                 toks, last, self._cache, self._rng = self._decode_tick(
                     self.params, self._tokens_dev, self._cache, active,
-                    self._temps_dev, self._top_ps_dev, self._rng,
+                    self._bt_dev, self._temps_dev, self._top_ps_dev, self._rng,
                 )
                 self._tokens_dev = last
             _np.asarray(toks)
@@ -1980,6 +2469,7 @@ class GenerationEngine:
                         self._tokens_dev,
                         self._cache,
                         self._active_dev,
+                        self._bt_dev,
                         self._temps_dev,
                         self._top_ps_dev,
                         self._rng,
@@ -1995,6 +2485,7 @@ class GenerationEngine:
                     self._tokens_dev,
                     self._cache,
                     self._active_dev,
+                    self._bt_dev,
                     self._temps_dev,
                     self._top_ps_dev,
                     self._rng,
@@ -2177,6 +2668,7 @@ class GenerationEngine:
         self._slot_epoch[slot] += 1  # invalidate this slot's in-flight ticks
         self._json[slot] = False
         self._sampling_dirty = True
+        self._free_slot_pages(slot)
         req = s.request
         ids = s.generated
         hit_eos = bool(ids) and ids[-1] == self.tokenizer.eos_id
@@ -2225,6 +2717,7 @@ class GenerationEngine:
         self._slot_epoch[slot] += 1
         self._json[slot] = False
         self._sampling_dirty = True
+        self._free_slot_pages(slot)
         self.poisoned_requests += 1
         _safe_resolve(s.request.future, exc=err)
 
@@ -2296,6 +2789,15 @@ class GenerationEngine:
         # lineage — drop them with the rest of the device state
         self._prefix_lru.clear()
         self._prefix_bytes = 0
+        if self.paged:
+            # crash-only discipline for the page plane too: every page back on
+            # the free list, every block table unallocated, the registry
+            # emptied (its pages were part of the poisoned lineage).  The
+            # device pool itself is rebuilt below with the rest.
+            self._kv_pool.reset()
+            self._slot_pages = [[] for _ in range(self.max_slots)]
+            self._block_tables[:] = self._kv_sentinel
+            self._bt_dirty = True
         # a failure inside _activate_batch can leave a request both slotted
         # AND in _starting_batch — salvage each request once
         seen: set = set()

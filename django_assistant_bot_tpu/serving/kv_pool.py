@@ -1,0 +1,277 @@
+"""Host-side page allocator for the paged KV memory plane.
+
+The engine's legacy layout reserves one contiguous ``[max_seq_len]`` KV region
+per decode slot, so HBM *capacity* — not bandwidth — caps concurrency at long
+context: a slot serving a 200-token dialog turn pins the same multi-MB cache
+row as one serving a 16k-token RAG prompt.  The paged plane (vLLM-style block
+tables) carves the same byte budget into fixed-size pages and reserves only
+``ceil((prompt_len + max_tokens) / page_size)`` pages per request, so short
+traffic packs many more concurrent slots into the same HBM.
+
+This module is the *host* half: pure-Python page bookkeeping (free list,
+refcounts, the shareable-prefix registry), unit-testable without a device.
+The device half — the ``[L, P, KH, page, D]`` pool tensors, block-table gather
+attention, page-granular prefill writes — lives in ``models/llama.py`` and
+``ops/attention.py``; the engine (``serving/engine.py``) wires the two
+together.  See docs/KV_PAGING.md for the full layout contract.
+
+Prefix sharing (subsumes the r4 whole-prefix LRU):
+
+- After a request with a declared shared prefix (system prompt + packed RAG
+  context — the reference re-sends that block every turn) finishes its
+  prefill, the engine *registers* the pages covering the prefix here.  The
+  registry holds one refcount per page, so the pages stay alive after the
+  owning request frees its slot.
+- A later request whose prompt starts with a registered prefix *shares* the
+  fully-covered pages read-only (one incref each, zero copies, zero model
+  compute) and takes a **copy-on-write** clone of the boundary page the
+  prefix only partially fills — its own suffix K/V lands there, so the page
+  cannot be shared physically.  Positions below the prefix length in the
+  clone are the owner's prefix K/V (valid for every consumer — RoPE is
+  absolute-position), positions at/above it are overwritten by the sharer's
+  own suffix prefill before they are ever unmasked.
+- Entries LRU-evict past ``max_shared_bytes`` (or ``max_entries``), and
+  :meth:`alloc` evicts on demand when the free list alone cannot satisfy a
+  request — cached prefixes are a *scavengeable* use of free HBM, never a
+  reason to shed traffic.
+
+Thread contract: all methods are engine-thread-only except :meth:`stats` and
+:meth:`available`, which only read counters and take the internal lock (the
+scheduler's KV-pressure admission test calls them from client threads).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class SharedPrefix:
+    """One registered shareable prefix.
+
+    ``pages`` are the physical pages covering prompt positions
+    ``[0, length)`` in logical order; all but possibly the last are full
+    (``page_size`` tokens).  ``full_pages`` of them are safe to share
+    physically; a partial tail page must be COW-cloned by consumers."""
+
+    pages: Tuple[int, ...]
+    length: int  # true token count of the prefix
+    full_pages: int  # pages fully covered by the prefix (shareable in place)
+
+
+class PageAllocator:
+    """Refcounted fixed-size page pool with a shareable-prefix LRU.
+
+    Invariants (property-tested in tests/test_kv_paging.py):
+
+    - every page is either on the free list or has refcount >= 1, never both;
+    - ``pages_free + pages_used == n_pages`` at all times;
+    - a page referenced by k live holders and m registry entries has
+      refcount k + m.
+    """
+
+    def __init__(
+        self,
+        n_pages: int,
+        page_size: int,
+        *,
+        page_bytes: int = 0,
+        max_shared_bytes: int = 1 << 30,
+        max_shared_entries: int = 8,
+        min_prefix_tokens: int = 32,
+    ):
+        if n_pages <= 0 or page_size <= 0:
+            raise ValueError(
+                f"PageAllocator needs n_pages > 0 and page_size > 0, got "
+                f"({n_pages}, {page_size})"
+            )
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.page_bytes = max(0, int(page_bytes))
+        self.max_shared_bytes = int(max_shared_bytes)
+        self.max_shared_entries = max(0, int(max_shared_entries))
+        self.min_prefix_tokens = max(1, int(min_prefix_tokens))
+        self._lock = threading.Lock()
+        # LIFO free list: the most recently freed pages are re-used first, so
+        # a steady workload keeps touching a warm working set of HBM
+        self._free: List[int] = list(range(self.n_pages - 1, -1, -1))
+        self._refs: Dict[int, int] = {}
+        self._shared: "collections.OrderedDict[tuple, SharedPrefix]" = (
+            collections.OrderedDict()
+        )
+        self._shared_bytes = 0
+        # counters (read by tick_stats / healthz); prefix hit/miss counting
+        # lives with the ENGINE (once per admitted request — lookup() runs on
+        # every admission peek and would overcount while a head waits)
+        self.evictions = 0  # shared entries dropped (LRU or on-demand)
+        self.cow_copies = 0  # boundary pages cloned for a sharer
+
+    # ------------------------------------------------------------ core alloc
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` free pages (refcount 1 each), evicting LRU shared
+        prefixes on demand.  Returns None — allocating nothing — when the
+        pool cannot satisfy the request even after evicting every entry."""
+        if n <= 0:
+            return []
+        with self._lock:
+            while len(self._free) < n and self._shared:
+                self._evict_lru_locked()
+            if len(self._free) < n:
+                return None
+            pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._refs[p] = 1
+            return pages
+
+    def incref(self, pages: Sequence[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p not in self._refs:
+                    raise ValueError(f"incref on free page {p}")
+                self._refs[p] += 1
+
+    def decref(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; pages reaching zero return to the
+        free list (LIFO)."""
+        with self._lock:
+            self._decref_locked(pages)
+
+    def _decref_locked(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            r = self._refs.get(p)
+            if r is None:
+                raise ValueError(f"decref on free page {p}")
+            if r <= 1:
+                del self._refs[p]
+                self._free.append(p)
+            else:
+                self._refs[p] = r - 1
+
+    # ------------------------------------------------------- prefix registry
+    def lookup(self, prompt_ids: Sequence[int], prefix_len: int) -> Optional[SharedPrefix]:
+        """LONGEST registered prefix this prompt starts with, or None.
+
+        Longest-match (not exact-key) keeps multi-turn dialogs hitting: turn
+        N's prompt extends turn N-1's ``[system, ...history]`` block, so the
+        previous turn's entry is a proper prefix of the new prompt even though
+        the declared split point moved.  LRU-touches the winner."""
+        if prefix_len < self.min_prefix_tokens:
+            return None
+        n = len(prompt_ids)
+        with self._lock:
+            best_key, best = None, None
+            for key, ent in self._shared.items():
+                if ent.length < n and (best is None or ent.length > best.length):
+                    if tuple(prompt_ids[: ent.length]) == key:
+                        best, best_key = ent, key
+            if best_key is not None:
+                self._shared.move_to_end(best_key)
+            return best
+
+    def register(
+        self, prompt_ids: Sequence[int], prefix_len: int, pages: Sequence[int]
+    ) -> bool:
+        """Register the pages covering ``prompt_ids[:prefix_len]`` as a
+        shareable prefix (increfs each — the registry is a holder like any
+        live request).  ``pages`` must cover positions ``[0, prefix_len)`` in
+        logical order: ``ceil(prefix_len / page_size)`` entries.  Returns
+        False (no-op) for too-short prefixes, duplicates, or a disabled
+        registry."""
+        if (
+            self.max_shared_entries <= 0
+            or prefix_len < self.min_prefix_tokens
+            or not pages
+        ):
+            return False
+        need = -(-prefix_len // self.page_size)
+        if len(pages) != need:
+            raise ValueError(
+                f"register: prefix of {prefix_len} tokens needs {need} pages, "
+                f"got {len(pages)}"
+            )
+        key = tuple(prompt_ids[:prefix_len])
+        with self._lock:
+            if key in self._shared:
+                return False
+            for p in pages:
+                if p not in self._refs:
+                    raise ValueError(f"register with free page {p}")
+            ent = SharedPrefix(
+                pages=tuple(pages),
+                length=int(prefix_len),
+                full_pages=int(prefix_len // self.page_size),
+            )
+            for p in ent.pages:
+                self._refs[p] += 1
+            self._shared[key] = ent
+            self._shared_bytes += len(ent.pages) * self.page_bytes
+            while self._shared and (
+                len(self._shared) > self.max_shared_entries
+                or (self.page_bytes and self._shared_bytes > self.max_shared_bytes)
+            ):
+                self._evict_lru_locked()
+            return True
+
+    def _evict_lru_locked(self) -> None:
+        _, ent = self._shared.popitem(last=False)
+        self._shared_bytes -= len(ent.pages) * self.page_bytes
+        self._decref_locked(ent.pages)
+        self.evictions += 1
+
+    def reset(self) -> None:
+        """Forget everything (crash-only engine restart: the device pool is
+        rebuilt from scratch, so every page is free again)."""
+        with self._lock:
+            self._free = list(range(self.n_pages - 1, -1, -1))
+            self._refs.clear()
+            self._shared.clear()
+            self._shared_bytes = 0
+
+    # ------------------------------------------------------------- telemetry
+    @property
+    def pages_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def available(self) -> int:
+        """Pages a new request could obtain right now: the free list plus
+        every cached-prefix page whose ONLY holder is the registry (evicting
+        the entry would free it).  The scheduler's KV-pressure admission test
+        compares projected demand against this."""
+        with self._lock:
+            evictable = sum(
+                1
+                for ent in self._shared.values()
+                for p in ent.pages
+                if self._refs.get(p) == 1
+            )
+            return len(self._free) + evictable
+
+    def shared_page_ids(self) -> set:
+        """Pages any registry entry references — holders of VALID prefix K/V
+        that scratch writes (e.g. the decode probe's synthetic fill) must
+        never touch."""
+        with self._lock:
+            return {p for ent in self._shared.values() for p in ent.pages}
+
+    def stats(self) -> dict:
+        with self._lock:
+            used = self.n_pages - len(self._free)
+            shared_pages = {p for ent in self._shared.values() for p in ent.pages}
+            return {
+                "kv_pages_total": self.n_pages,
+                "kv_page_size": self.page_size,
+                "kv_pages_used": used,
+                "kv_pages_free": len(self._free),
+                "kv_shared_pages": len(shared_pages),
+                "kv_shared_page_frac": round(len(shared_pages) / max(1, used), 4)
+                if used
+                else 0.0,
+                "kv_shared_entries": len(self._shared),
+                "kv_shared_bytes": self._shared_bytes,
+                "kv_evictions": self.evictions,
+                "kv_cow_copies": self.cow_copies,
+            }

@@ -62,6 +62,19 @@ class ModelSpec:
     # every decode step then reads the whole allocated max_slots x max_seq_len
     # cache regardless of live lengths.
     decode_kv_chunk: Optional[int] = 0
+    # --- paged KV memory plane (docs/KV_PAGING.md) ---
+    # "paged" (default): a fixed pool of KV pages + per-request block tables
+    # with refcounted copy-on-write prefix sharing and KV-pressure admission;
+    # requests reserve ceil((prompt + max_tokens) / page) pages instead of a
+    # whole max_seq_len row.  "legacy": the contiguous slot cache — the
+    # one-flag rollback and the bench A/B arm.
+    kv_layout: str = "paged"
+    # page size in tokens; 0 = align with decode_kv_chunk (or its auto pick)
+    kv_page_size: int = 0
+    # pool size in pages; 0 = byte parity with the legacy layout
+    # (max_slots * max_seq_len / page_size) — raise max_slots past the legacy
+    # count to actually bank the freed capacity as extra concurrency
+    kv_pages: int = 0
     # compile every (batch, seq) prefill/activation shape + decode ticks at
     # load time instead of on first traffic (GenerationEngine.warmup) — slower
     # boot, no multi-second serve-time compile stalls.  warmup_json also
@@ -117,6 +130,19 @@ class ModelSpec:
 
     @classmethod
     def from_dict(cls, name: str, d: Mapping[str, Any]) -> "ModelSpec":
+        d = dict(d)
+        # deprecation shim: the r4 prefix-LRU knob name keeps working, mapped
+        # onto the page-pool prefix registry (same budget semantics)
+        if "prefix_cache_size" in d:
+            val = d.pop("prefix_cache_size")
+            if "prefix_cache" not in d:
+                logger.warning(
+                    "model %s: 'prefix_cache_size' is deprecated — mapped onto "
+                    "the paged prefix registry ('prefix_cache'); the byte "
+                    "budget knob is 'prefix_cache_max_bytes' as before",
+                    name,
+                )
+                d["prefix_cache"] = val
         return cls(name=name, **{k: v for k, v in d.items() if k != "name"})
 
 
@@ -291,6 +317,9 @@ class ModelRegistry:
                     None if spec.decode_kv_chunk in (None, "off")
                     else int(spec.decode_kv_chunk)
                 ),
+                kv_layout=spec.kv_layout,
+                kv_page_size=spec.kv_page_size,
+                kv_pages=spec.kv_pages,
                 scheduler=sched,
                 faults=faults,
                 max_restarts=spec.max_restarts,

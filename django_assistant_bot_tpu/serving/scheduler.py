@@ -129,6 +129,13 @@ class RequestScheduler:
         self._pass: Dict[Tuple[str, str], float] = {}
         self._vtime = 0.0
         self._depth = 0
+        # KV-pressure admission (paged KV plane, docs/KV_PAGING.md): the
+        # engine binds a callable reporting the pool's obtainable pages; the
+        # scheduler tracks pages already promised to queued requests so a
+        # burst cannot over-commit the pool between admissions
+        self._kv_available = None
+        self._kv_total = 0
+        self._queued_kv_pages = 0
         self._service_ema_s = float(self.cfg.service_time_init)
         # per-class counters (created lazily so new classes just appear)
         self.submitted: Dict[str, int] = collections.defaultdict(int)
@@ -148,6 +155,24 @@ class RequestScheduler:
         self._slots = max(1, int(slots))
         return self
 
+    def bind_kv(self, available_fn, total_pages: int) -> "RequestScheduler":
+        """Wire the paged-KV pool into admission: ``available_fn`` reports
+        obtainable pages (free + evictable cached prefixes), ``total_pages``
+        the pool size.  A request that cannot start now — and whose projected
+        KV wait (queued-KV backlog in pool drains x the service-time EMA)
+        exceeds ``admit_max_wait_s`` — sheds with the distinct ``kv_pressure``
+        reason instead of queueing behind memory that frees no faster than
+        running requests finish."""
+        self._kv_available = available_fn
+        self._kv_total = max(0, int(total_pages))
+        return self
+
+    def release_kv(self, pages: int) -> None:
+        """Return reserved-but-unneeded pages to the admission ledger (e.g.
+        the degradation band clamped max_tokens after the reservation)."""
+        with self._lock:
+            self._queued_kv_pages = max(0, self._queued_kv_pages - max(0, pages))
+
     def _est_wait_s_locked(self, extra: int = 0) -> float:
         return (self._depth + extra) * self._service_ema_s / self._slots
 
@@ -155,12 +180,14 @@ class RequestScheduler:
         self,
         priority: str = INTERACTIVE,
         deadline_s: Optional[float] = None,
+        kv_pages: int = 0,
         *,
         now: Optional[float] = None,
     ) -> Admission:
         """The synchronous admission test (any thread).  On ``ok`` the caller
-        MUST follow through with :meth:`enqueue` (depth is reserved here so a
-        racing burst cannot overshoot the bound)."""
+        MUST follow through with :meth:`enqueue` (depth — and the ``kv_pages``
+        reservation — are charged here so a racing burst cannot overshoot
+        either bound)."""
         cfg = self.cfg
         with self._lock:
             self.submitted[priority] += 1
@@ -172,6 +199,31 @@ class RequestScheduler:
             if self._depth >= cfg.max_queue:
                 self.shed["queue_full"] += 1
                 return Admission(False, "queue_full", retry)
+            if (
+                kv_pages
+                and self._kv_available is not None
+                and self._kv_total
+                and cfg.admit_max_wait_s is not None
+            ):
+                # projected KV pressure: queue depth alone cannot see a pool
+                # exhausted by a few long-context admissions.  Shed only when
+                # BOTH hold: the request could not start now (its worst-case
+                # page demand exceeds the obtainable pages minus what the
+                # queue already reserved), and its projected wait for pages —
+                # the queued-KV backlog measured in full pool drains, each
+                # costing ~one service time — exceeds the same estimated-wait
+                # ceiling the depth test uses.  Same philosophy, distinct
+                # reason (and counter) so operators can tell memory pressure
+                # from compute backlog.
+                avail = int(self._kv_available()) - self._queued_kv_pages
+                kv_wait = (
+                    (self._queued_kv_pages + kv_pages)
+                    / self._kv_total
+                    * self._service_ema_s
+                )
+                if kv_pages > avail and kv_wait > cfg.admit_max_wait_s:
+                    self.shed["kv_pressure"] += 1
+                    return Admission(False, "kv_pressure", retry)
             if cfg.admit_max_wait_s is not None and est > cfg.admit_max_wait_s:
                 self.shed["est_wait"] += 1
                 return Admission(False, "estimated_wait", retry)
@@ -181,6 +233,7 @@ class RequestScheduler:
                 self.shed["deadline_infeasible"] += 1
                 return Admission(False, "deadline_infeasible", retry)
             self._depth += 1
+            self._queued_kv_pages += max(0, int(kv_pages))
             clamp = None
             if (
                 cfg.degrade_at < 1.0
@@ -224,6 +277,7 @@ class RequestScheduler:
         with self._lock:
             if front or not getattr(req, "admitted", False):
                 self._depth += 1
+                self._queued_kv_pages += max(0, getattr(req, "kv_pages", 0))
             q = self._queues.get(key)
             if q is None:
                 q = self._queues[key] = collections.deque()
@@ -260,12 +314,14 @@ class RequestScheduler:
             if req.future.cancelled():
                 q.popleft()
                 self._depth = max(0, self._depth - 1)
+                self._release_kv_locked(req)
                 self.cancelled_queued[key[0]] += 1
                 continue
             dl = getattr(req, "deadline_at", None)
             if dl is not None and now >= dl:
                 q.popleft()
                 self._depth = max(0, self._depth - 1)
+                self._release_kv_locked(req)
                 self.expired_queued[key[0]] += 1
                 _safe_resolve(
                     req.future,
@@ -294,6 +350,7 @@ class RequestScheduler:
             key, req = head
             self._queues[key].popleft()
             self._depth = max(0, self._depth - 1)
+            self._release_kv_locked(req)
             self._vtime = self._pass[key]
             self._pass[key] += 1.0 / self._weight(key)
             self.admitted[key[0]] += 1
@@ -320,12 +377,14 @@ class RequestScheduler:
                     req = q.popleft()
                     if req.future.cancelled():
                         self._depth = max(0, self._depth - 1)
+                        self._release_kv_locked(req)
                         self.cancelled_queued[key[0]] += 1
                         dropped += 1
                         continue
                     dl = getattr(req, "deadline_at", None)
                     if dl is not None and now >= dl:
                         self._depth = max(0, self._depth - 1)
+                        self._release_kv_locked(req)
                         self.expired_queued[key[0]] += 1
                         dropped += 1
                         _safe_resolve(
@@ -350,6 +409,12 @@ class RequestScheduler:
                     _safe_resolve(q.popleft().future, exc=err)
                     self._depth = max(0, self._depth - 1)
             self._depth = max(0, self._depth)
+            self._queued_kv_pages = 0
+
+    def _release_kv_locked(self, req) -> None:
+        self._queued_kv_pages = max(
+            0, self._queued_kv_pages - max(0, getattr(req, "kv_pages", 0))
+        )
 
     # ------------------------------------------------------------- telemetry
     def note_service(self, seconds: float) -> None:
@@ -404,6 +469,7 @@ class RequestScheduler:
         with self._lock:
             return {
                 "queue_depth": self._depth,
+                "queued_kv_pages": self._queued_kv_pages,
                 "max_queue": self.cfg.max_queue,
                 "pressure": round(self._depth / max(1, self.cfg.max_queue), 4),
                 "est_wait_s": round(self._est_wait_s_locked(), 4),

@@ -332,10 +332,16 @@ def create_app(registry: ModelRegistry) -> web.Application:
                 # TTFT / inter-token-latency percentiles + disconnect count —
                 # the streaming plane's perceived-latency dashboard
                 g["stream"] = latency()
+            kv = getattr(eng, "kv_stats", None)
+            if callable(kv):
+                # KV memory plane gauges: pool occupancy, shared-page
+                # fraction, allocator eviction/COW counters (docs/KV_PAGING.md)
+                g["kv"] = kv()
             sched = getattr(eng, "scheduler", None)
             if sched is not None:
                 # queue depth, shed counters, per-class wait percentiles —
-                # the operator's overload dashboard
+                # the operator's overload dashboard (KV-pressure sheds appear
+                # under sched.shed.kv_pressure, distinct from queue_full)
                 g["sched"] = sched.stats()
             sup = getattr(eng, "supervision_stats", None)
             if callable(sup):

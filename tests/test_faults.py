@@ -155,6 +155,48 @@ def test_tick_raise_mid_trace_recovers_without_failing_queued():
         eng.stop()
 
 
+def test_tick_raise_restart_rebuilds_paged_pool_and_keeps_serving():
+    """Chaos on the paged KV plane (docs/KV_PAGING.md): an engine-fatal fault
+    while pages are allocated AND a prefix is registered — the crash-only
+    restart resets the allocator (every page free, registry empty, block
+    tables unallocated), salvaged work replays onto fresh pages, and prefix
+    sharing works again after recovery."""
+    inj = FaultInjector({})
+    eng = _tiny_engine(
+        faults=inj, max_slots=2, max_seq_len=64,
+        prefix_cache_size=4, prefix_min_tokens=8,
+    ).start()
+    assert eng.paged
+    prefix = list(range(1, 13))  # 12 tokens >= prefix_min_tokens
+    try:
+        eng.submit(
+            prefix + [20], max_tokens=3, temperature=0.0, prefix_len=len(prefix)
+        ).result(timeout=120)
+        assert eng.kv_stats()["kv_shared_pages"] > 0
+        inj.arm("tick_raise")
+        futs = [
+            eng.submit(
+                prefix + [30 + i], max_tokens=4, temperature=0.0,
+                prefix_len=len(prefix),
+            )
+            for i in range(3)
+        ]
+        results = [f.result(timeout=120) for f in futs]
+        assert all(len(r.token_ids) == 4 for r in results)
+        assert eng.engine_restarts == 1
+        # the pool survived the crash in a clean state and re-registered the
+        # prefix from post-restart traffic
+        deadline = time.monotonic() + 10
+        while eng.kv_stats()["kv_pages_used"] > eng.kv_stats()["kv_shared_pages"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        st = eng.kv_stats()
+        assert st["kv_pages_used"] == st["kv_shared_pages"] > 0
+        assert eng.supervision_stats()["healthy"] is True
+    finally:
+        eng.stop()
+
+
 def test_nan_logits_quarantines_one_slot_keeps_batch_alive():
     """Request-poison: garbage sampled ids fail ONE co-batched request; its
     batch-mate keeps decoding to a normal finish.  No engine restart."""

@@ -798,6 +798,7 @@ def test_prefix_cache_byte_cap_and_bucket():
         cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=512,
         prefill_buckets=(32, 64), chunk_size=64,
         prefix_cache_size=8, prefix_min_tokens=8,
+        kv_layout="legacy",  # this test pins the legacy pinned-K/V LRU path
     )
     # bucket: fits a prefill bucket -> that bucket; else multiples of the
     # largest bucket, capped at the engine's (cfg-clamped) max_seq_len —
