@@ -1596,6 +1596,129 @@ print(json.dumps(bench.bench_chaos()))
 """
 
 
+def bench_router() -> dict:
+    """router_* section (serving/router.py evidence): fleet failover — one of
+    two engine replicas is killed mid-trace via the ``replica_dead`` chaos
+    site (armed exactly once, same discipline as ``chaos_*``); token-less
+    requests on the dead replica must re-route to the survivor (goodput 1.0,
+    no client-visible failure), and after an operator restart the recovery
+    time from the kill to the restarted replica's first successful completion
+    is recorded.  A rolling restart under a live trickle rides along as the
+    zero-shed drain evidence.
+
+    Both replicas' loops are stalled (``slow_tick``) through the kill window
+    so in-flight work is still client-token-less when the replica dies — the
+    re-routable regime the acceptance contract names; ``router_failed_past_
+    first_token`` records any request that slipped past that window."""
+    import numpy as np
+
+    from django_assistant_bot_tpu.serving.faults import FaultInjector
+    from django_assistant_bot_tpu.serving.router import EngineRouter
+
+    n_req, n_new = 10, 24
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(1, 255, 16).tolist() for _ in range(n_req)]
+
+    engines = []
+    for _ in range(2):
+        eng, _ = _build_gen_engine(max_slots=4, buckets=(32,))
+        # a probability-0 spec pins the injected stall length; arm() below
+        # makes the schedule exact
+        eng._faults = FaultInjector({"slow_tick": {"p": 0.0, "delay_s": 0.2}})
+        engines.append(eng)
+    router_inj = FaultInjector({})
+    router = EngineRouter(engines, faults=router_inj, breaker_reset_s=0.5)
+    out: dict = {}
+    try:
+        for i in range(2):  # warm both replicas through the router
+            router.submit([1, 2, 3 + i], max_tokens=4, temperature=0.0).result(
+                timeout=600
+            )
+        for eng in engines:
+            eng._faults.arm("slow_tick", 12)
+        t0 = time.perf_counter()
+        futs = []
+        for i, p in enumerate(prompts):
+            if i == n_req // 2:
+                # the NEXT dispatch kills the replica it was about to pick —
+                # its queued + in-flight (token-less) work must re-route
+                router_inj.arm("replica_dead")
+            futs.append(router.submit(p, max_tokens=n_new, temperature=0.0))
+        ok = failed = 0
+        for f in futs:
+            try:
+                f.result(timeout=1200)
+                ok += 1
+            except Exception:
+                failed += 1
+        wall = time.perf_counter() - t0
+        kill_at = router_inj.last_fire_at("replica_dead")
+        dead = [i for i, e in enumerate(engines) if not e._running]
+        recovery = None
+        if dead and kill_at is not None:
+            idx = dead[0]
+            router.restart_replica(idx)
+            # pin one request onto the restarted replica: recovery is the
+            # kill -> first-success-on-restarted-replica interval
+            for j, rep in enumerate(router.replicas):
+                rep.draining = j != idx
+            try:
+                router.submit(
+                    [7, 7, 7], max_tokens=4, temperature=0.0
+                ).result(timeout=600)
+            finally:
+                for rep in router.replicas:
+                    rep.draining = False
+            at = router.replicas[idx].last_success_at
+            if at is not None:
+                recovery = at - kill_at
+        stats = router.router_stats()
+        out.update(
+            {
+                "router_goodput_frac": round(ok / n_req, 4),
+                "router_failed": failed,
+                "router_wall_s": round(wall, 4),
+                "router_reroutes": stats["reroutes"],
+                "router_rerouted_failed": stats["rerouted_failed"],
+                "router_failed_past_first_token": stats[
+                    "failed_past_first_token"
+                ],
+                "router_recovery_s": round(recovery, 4)
+                if recovery is not None
+                else None,
+                "router_replica_killed": bool(dead),
+            }
+        )
+        # rolling restart under a live trickle: the zero-downtime drain path
+        trickle = [
+            router.submit([9, 9, 9 + i], max_tokens=4, temperature=0.0)
+            for i in range(4)
+        ]
+        t0 = time.perf_counter()
+        reports = router.rolling_restart(deadline_s=60.0)
+        shed = sum(r["forced_failures"] for r in reports)
+        ok2 = sum(
+            1 for f in trickle if f.exception(timeout=600) is None
+        )
+        out.update(
+            {
+                "router_rolling_restart_s": round(time.perf_counter() - t0, 4),
+                "router_drain_shed": shed,
+                "router_drain_trickle_ok": ok2,
+            }
+        )
+    finally:
+        router.stop()
+    return out
+
+
+_ROUTER_SNIPPET = """
+import json
+import bench
+print(json.dumps(bench.bench_router()))
+"""
+
+
 def bench_stream() -> dict:
     """stream_* section (serving/streaming.py evidence): perceived latency —
     client-observed TTFT on the SAME concurrent trace, streaming (first delta
@@ -2289,6 +2412,10 @@ _COMPACT_KEYS = (
     "chaos_recovery_s",
     "chaos_restarts",
     "chaos_baseline_goodput_frac",
+    "router_goodput_frac",
+    "router_recovery_s",
+    "router_reroutes",
+    "router_drain_shed",
     "stream_ttft_p50_s",
     "stream_ttft_p95_s",
     "stream_nonstream_ttft_p50_s",
@@ -2389,6 +2516,7 @@ def main() -> None:
         extras.update(bench_ingestion())
         extras.update(bench_overload())
         extras.update(bench_chaos())
+        extras.update(bench_router())
         extras.update(bench_stream())
         baseline_thread.join(timeout=600)
         emit()
@@ -2443,6 +2571,11 @@ def main() -> None:
     #      fired once mid-trace vs the no-fault baseline on the same trace
     #      (serving/faults.py + crash-only restart evidence)
     run("chaos", _CHAOS_SNIPPET, cap_s=400)
+    # 3c'') router: fleet failover — one of 2 replicas killed mid-trace
+    #       (replica_dead armed once); token-less goodput, re-route counts,
+    #       recovery-to-first-success on the restarted replica, and a
+    #       rolling restart under live traffic (serving/router.py evidence)
+    run("router", _ROUTER_SNIPPET, cap_s=400)
     # 3d) streaming: client TTFT streaming-vs-nonstreaming on the same trace
     #     + attached/detached decode throughput (the token event queues must
     #     not throttle the engine — serving/streaming.py evidence)

@@ -28,8 +28,10 @@ opts in without code changes.  Deterministic tests inject a fake clock/sleep.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 import random
+import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
@@ -59,6 +61,11 @@ class CircuitBreaker:
     ``reset_timeout_s`` ONE caller is admitted as a half-open probe (further
     callers stay blocked until it resolves); the probe's success closes the
     circuit, its failure re-opens the full timeout.
+
+    Thread-safe: the provider failover chain runs on one event loop, but the
+    multi-replica engine router mutates breakers from HTTP event-loop threads
+    (dispatch) and engine threads (completion callbacks) concurrently — an
+    unguarded ``allow()`` would admit two half-open probes at once.
     """
 
     def __init__(
@@ -70,46 +77,55 @@ class CircuitBreaker:
         self.failure_threshold = max(1, int(failure_threshold))
         self.reset_timeout_s = float(reset_timeout_s)
         self._clock = clock
+        self._lock = threading.Lock()
         self._failures = 0
         self._opened_at: Optional[float] = None
         self._probing = False
 
     @property
     def state(self) -> str:
-        if self._opened_at is None:
-            return CLOSED
-        if self._probing or self._clock() - self._opened_at >= self.reset_timeout_s:
-            return HALF_OPEN
-        return OPEN
+        with self._lock:
+            if self._opened_at is None:
+                return CLOSED
+            if (
+                self._probing
+                or self._clock() - self._opened_at >= self.reset_timeout_s
+            ):
+                return HALF_OPEN
+            return OPEN
 
     def allow(self) -> bool:
         """May a request try this backend right now?  (Half-open admits one.)"""
-        if self._opened_at is None:
-            return True
-        if self._probing:
-            return False  # one probe at a time
-        if self._clock() - self._opened_at >= self.reset_timeout_s:
-            self._probing = True
-            return True
-        return False
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if self._probing:
+                return False  # one probe at a time
+            if self._clock() - self._opened_at >= self.reset_timeout_s:
+                self._probing = True
+                return True
+            return False
 
     def record_success(self) -> None:
-        self._failures = 0
-        self._opened_at = None
-        self._probing = False
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
 
     def record_failure(self) -> None:
-        self._failures += 1
-        if self._probing or self._failures >= self.failure_threshold:
-            self._opened_at = self._clock()  # (re-)open the full timeout
-            self._probing = False
+        with self._lock:
+            self._failures += 1
+            if self._probing or self._failures >= self.failure_threshold:
+                self._opened_at = self._clock()  # (re-)open the full timeout
+                self._probing = False
 
     def release_probe(self) -> None:
         """The admitted half-open probe resolved neither way (the caller was
         cancelled mid-flight): free the probe slot so the NEXT request can
         probe — without this the breaker would stay half-open-and-blocking
         forever.  No-op unless a probe is outstanding."""
-        self._probing = False
+        with self._lock:
+            self._probing = False
 
 
 class FailoverProvider(AIProvider):
@@ -234,7 +250,21 @@ class FailoverProvider(AIProvider):
                 else:
                     first = await agen.__anext__()
             except asyncio.CancelledError:
-                br.release_probe()  # caller cancelled: free the probe slot
+                # caller cancelled mid-await: free the probe slot and close
+                # the backend stream before propagating
+                br.release_probe()
+                with contextlib.suppress(Exception):
+                    await agen.aclose()
+                raise
+            except GeneratorExit:
+                # finalization of THIS generator while suspended at the
+                # backend await (consumer abandoned it without cancelling):
+                # the probe slot must still free, but awaiting here is
+                # illegal — if the backend's cleanup suspended, this
+                # generator would yield mid-finalization and CPython raises
+                # "async generator ignored GeneratorExit".  The inner
+                # generator is finalized by the loop's asyncgen hooks.
+                br.release_probe()
                 raise
             except StopAsyncIteration:
                 # an empty stream is a broken backend, not a committed answer

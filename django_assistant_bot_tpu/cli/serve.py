@@ -22,6 +22,26 @@ def add_parser(sub):
         "warmup_json is set per model in the config file)",
     )
     p.add_argument(
+        "--replicas",
+        type=int,
+        default=None,
+        metavar="N",
+        help="data-parallel engine replicas per decoder behind a health- and "
+        "prefix-affinity-aware router with per-replica circuit breakers and "
+        "token-less re-route (serving/router.py; docs/RESILIENCE.md).  1 "
+        "(the default) keeps the single-engine path byte-identical to "
+        "before — no router object exists at all",
+    )
+    p.add_argument(
+        "--drain-deadline-s",
+        type=float,
+        default=None,
+        metavar="S",
+        help="graceful-shutdown budget: on SIGTERM the server stops admitting "
+        "(503 + Retry-After), lets in-flight requests finish within this "
+        "deadline, then exits 0 (default 30)",
+    )
+    p.add_argument(
         "--kv-layout",
         choices=("paged", "legacy"),
         default=None,
@@ -144,6 +164,8 @@ def run(args) -> int:
     # have no admission scheduler or decode loop; their coalescer bound is the
     # max_queue spec knob)
     sched_overrides = {}
+    if getattr(args, "replicas", None) is not None:
+        sched_overrides["replicas"] = args.replicas
     if getattr(args, "kv_layout", None) is not None:
         sched_overrides["kv_layout"] = args.kv_layout
     if getattr(args, "kv_pages", None) is not None:
@@ -188,5 +210,18 @@ def run(args) -> int:
             for name, spec in config.items()
         }
     registry = ModelRegistry.from_config(config)
-    run_server(host=args.host, port=args.port, registry=registry)
+    # SIGTERM-triggered graceful drain (whole-router when --replicas > 1):
+    # run_server's shutdown handler stops admission, waits for in-flight
+    # work within the deadline, then returns — and we exit 0, so rolling
+    # restarts under an init system read as clean stops
+    run_server(
+        host=args.host,
+        port=args.port,
+        registry=registry,
+        drain_deadline_s=(
+            args.drain_deadline_s
+            if getattr(args, "drain_deadline_s", None) is not None
+            else 30.0
+        ),
+    )
     return 0

@@ -18,6 +18,9 @@ deficiencies).  This plane is one process driving the whole TPU slice:
   weighted per-tenant fair share, deadlines, bounded queue + load shedding);
 - :mod:`.faults`    — deterministic seeded fault injection (the chaos plane
   that exercises the engine's quarantine/restart/circuit recovery paths);
+- :mod:`.router`    — fault-tolerant multi-replica front door: health- and
+  prefix-affinity-aware dispatch over N supervised engine replicas with
+  per-replica circuit breakers, token-less re-route, and graceful drain;
 - :mod:`.registry`  — model registry loading checkpoints onto the mesh;
 - :mod:`.server`    — aiohttp app exposing the reference's exact HTTP contract
   (``POST /embeddings/``, ``POST /dialog/``) plus SSE streaming.
@@ -43,4 +46,5 @@ from .scheduler import (  # noqa: F401
     SchedulerConfig,
     SchedulerRejected,
 )
+from .router import EngineRouter  # noqa: F401
 from .registry import ModelRegistry, ModelSpec  # noqa: F401

@@ -370,6 +370,11 @@ class GenerationEngine:
             raise ValueError(
                 f"unknown kv_layout {kv_layout!r}; expected 'paged' or 'legacy'"
             )
+        # what the config ASKED for — the fallbacks below may silently demote
+        # paged to legacy (speculative engines, non-dividing contexts), and
+        # kv_stats() reports requested vs effective so operators can see a
+        # replica running the legacy plane without grepping boot logs
+        self.kv_layout_requested = kv_layout
         self.paged = kv_layout == "paged"
         if self.paged and self.speculative:
             # verify_step writes K+1 contiguous positions against the slot
@@ -1224,6 +1229,58 @@ class GenerationEngine:
     @property
     def num_active(self) -> int:
         return sum(s is not None for s in self._slots)
+
+    def queued_depth(self) -> int:
+        """Requests accepted but not yet slotted (any thread; approximate —
+        the router's least-loaded dispatch reads this, and a race of one
+        entry only shifts a tie-break).  With a scheduler its depth ledger is
+        the single source of truth: admission charges it synchronously in
+        ``submit`` (before the request even reaches the staging queue), so
+        adding ``_queue.qsize()`` on top would double-count in-transit work."""
+        if self.scheduler is not None:
+            return self.scheduler.queue_depth
+        return self._queue.qsize() + len(self._pending)
+
+    def idle(self) -> bool:
+        """No work anywhere: no live slot, no in-flight tick, no chunked
+        prefill, nothing queued or mid-admission.  The graceful-drain paths
+        (router ``drain()``, the server's SIGTERM drain) poll this until the
+        replica has finished what it accepted.
+
+        Takes the loop-iteration lock: between a queue pop and the wave's
+        slot activation a request is in NO queue and NO slot (its prefill is
+        running), and an unlocked read in that window would report an idle
+        engine holding live work — the drain would then stop the engine and
+        kill the request it promised to finish."""
+        with self._iter_lock:
+            return (
+                self.num_active == 0
+                and not self._inflight
+                and self._chunking is None
+                and self._starting_batch is None
+                and self.queued_depth() == 0
+                and self._queue.qsize() == 0
+            )
+
+    def holds_prefix(self, prompt_ids: Sequence[int], prefix_len: int) -> bool:
+        """Does this engine's KV plane already hold a usable cached prefix of
+        this prompt?  Read-only, LRU-neutral, safe from any thread — the
+        router's affinity dispatch asks every replica this.  False whenever
+        prefix caching is off or the layout keeps no registry worth routing
+        for (the legacy LRU is engine-thread-owned; a cross-thread scan is
+        best-effort and swallows the resize race)."""
+        if self.prefix_cache_size <= 0 or prefix_len < self.prefix_min_tokens:
+            return False
+        if self.paged:
+            return self._kv_pool.holds_prefix(prompt_ids, prefix_len)
+        n = len(prompt_ids)
+        try:
+            for key, ent in list(self._prefix_lru.items()):
+                if ent.length < n and tuple(prompt_ids[: ent.length]) == key:
+                    return True
+        except RuntimeError:  # dict resized mid-scan (engine thread won)
+            return False
+        return False
 
     # ---------------------------------------------------------------- internal
     def _free_slots(self) -> List[int]:
@@ -2272,6 +2329,11 @@ class GenerationEngine:
         prefix-LRU footprint when legacy.  Prefix hit/miss counters ride along
         in both layouts."""
         out: dict = {"kv_layout": "paged" if self.paged else "legacy"}
+        # requested vs effective: a speculative model entry or a non-dividing
+        # context silently falls back to the legacy plane at load — surfaced
+        # here (tick_stats + /healthz) instead of only as a boot-log warning
+        out["kv_layout_requested"] = self.kv_layout_requested
+        out["kv_layout_effective"] = out["kv_layout"]
         if self.paged:
             out.update(self._kv_pool.stats())
         else:
@@ -2726,6 +2788,19 @@ class GenerationEngine:
         dl = self._degraded_until
         return dl is not None and time.monotonic() < dl
 
+    def healthy(self) -> bool:
+        """The single liveness predicate (any thread): running loop, alive
+        thread (None = a single-threaded test/bench driver, not a death),
+        circuit closed, fresh heartbeat.  /healthz (via supervision_stats)
+        and the multi-replica router's dispatch gate both use THIS — they
+        must never disagree about whether a replica is servable."""
+        if not self._running or self.degraded():
+            return False
+        t = self._thread
+        if t is not None and not t.is_alive():
+            return False
+        return (time.monotonic() - self._beat) < self.heartbeat_degraded_s
+
     def supervision_stats(self) -> dict:
         """Restart/quarantine/circuit counters + the loop heartbeat — the
         /healthz evidence that distinguishes a live engine from a wedged or
@@ -2733,12 +2808,15 @@ class GenerationEngine:
         now = time.monotonic()
         age = now - self._beat
         degraded = self.degraded()
-        healthy = (
-            self._running and not degraded and age < self.heartbeat_degraded_s
-        )
+        # dead-thread detection: a loop thread that died without running its
+        # finally (killed un-pythonically) leaves _running True forever; a
+        # None thread is the single-threaded test/bench driver, not a death
+        t = self._thread
+        thread_alive = t is None or t.is_alive()
         return {
             "running": self._running,
-            "healthy": healthy,
+            "thread_alive": thread_alive,
+            "healthy": self.healthy(),
             "degraded": degraded,
             "loop_heartbeat_age_s": round(age, 3),
             "heartbeat_degraded_s": self.heartbeat_degraded_s,

@@ -25,6 +25,12 @@ Sites (the full set — unknown names are a config error, not a silent no-op):
 ``timeout``       HTTP client: the request times out before a response
 ``conn_reset``    HTTP client: the connection drops mid-request
 ``http_5xx``      HTTP client: the server answers 503
+``replica_dead``  router (serving/router.py): the replica the dispatcher is
+                  about to pick dies abruptly — its engine loop exits and
+                  fails in-flight work, exercising breaker trip + token-less
+                  re-route (the fleet-level analogue of ``tick_raise``)
+``replica_slow``  router: the dispatch hop to a replica stalls ``delay_s``
+                  (slow replica admission / network hop evidence)
 ================  ============================================================
 
 Each site's spec is either a bare float (fire probability) or a mapping with
@@ -54,7 +60,10 @@ from typing import Any, Dict, Mapping, Optional
 
 ENGINE_SITES = ("tick_raise", "nan_logits", "detok_raise", "slow_tick")
 HTTP_SITES = ("timeout", "conn_reset", "http_5xx")
-ALL_SITES = ENGINE_SITES + HTTP_SITES
+# consulted by the multi-replica EngineRouter (serving/router.py), never by an
+# engine: one spec can drive engine-, HTTP- and router-level chaos together
+ROUTER_SITES = ("replica_dead", "replica_slow")
+ALL_SITES = ENGINE_SITES + HTTP_SITES + ROUTER_SITES
 
 ENV_FAULTS = "DABT_FAULTS"
 ENV_SEED = "DABT_FAULT_SEED"
@@ -144,11 +153,16 @@ class FaultInjector:
         return cls(spec, seed=seed)
 
     @classmethod
-    def from_env(cls) -> Optional["FaultInjector"]:
+    def from_env(cls, *, seed_offset: int = 0) -> Optional["FaultInjector"]:
+        """Env-gated injector (DABT_FAULTS / DABT_FAULT_SEED).  ``seed_offset``
+        shifts the seed per consumer — engine replicas use their index so
+        probabilistic sites fire different (still deterministic) patterns per
+        replica instead of N copies of one pattern failing in lockstep."""
         raw = os.environ.get(ENV_FAULTS, "").strip()
         if not raw:
             return None
-        return cls(json.loads(raw), seed=int(os.environ.get(ENV_SEED, "0") or "0"))
+        seed = int(os.environ.get(ENV_SEED, "0") or "0")
+        return cls(json.loads(raw), seed=seed + int(seed_offset))
 
     # ------------------------------------------------------------------ sites
     def enabled(self, site: str) -> bool:

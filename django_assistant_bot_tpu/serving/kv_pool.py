@@ -171,6 +171,20 @@ class PageAllocator:
                 self._shared.move_to_end(best_key)
             return best
 
+    def holds_prefix(self, prompt_ids: Sequence[int], prefix_len: int) -> bool:
+        """Would :meth:`lookup` hit for this prompt?  Read-only peek — no LRU
+        touch, safe from ANY thread (the multi-replica router's affinity
+        dispatch asks every replica's pool this before picking one; a peek
+        that reordered the LRU would let routing probes evict real entries)."""
+        if prefix_len < self.min_prefix_tokens:
+            return False
+        n = len(prompt_ids)
+        with self._lock:
+            for key, ent in self._shared.items():
+                if ent.length < n and tuple(prompt_ids[: ent.length]) == key:
+                    return True
+        return False
+
     def register(
         self, prompt_ids: Sequence[int], prefix_len: int, pages: Sequence[int]
     ) -> bool:

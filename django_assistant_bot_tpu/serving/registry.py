@@ -127,6 +127,18 @@ class ModelSpec:
     # it fails (bounds retries of a prompt that deterministically kills the
     # device)
     max_request_restarts: int = 2
+    # --- multi-replica serving (serving/router.py; docs/RESILIENCE.md) ---
+    # decoder-only: >1 loads N independently supervised engine replicas (each
+    # with its own scheduler, KV page pool, and fault injector — seeds offset
+    # per replica) behind an EngineRouter doing health- and prefix-affinity-
+    # aware dispatch with per-replica circuit breakers and token-less
+    # re-route.  1 = the single-engine path, byte-identical to before (the
+    # bench baseline; no router object exists at all).
+    replicas: int = 1
+    # per-replica router breaker: consecutive replica-shaped failures before
+    # the breaker opens, and how long it stays open before one probe request
+    router_breaker_threshold: int = 3
+    router_breaker_reset_s: float = 10.0
 
     @classmethod
     def from_dict(cls, name: str, d: Mapping[str, Any]) -> "ModelSpec":
@@ -208,6 +220,13 @@ class ModelRegistry:
                 f"model {name}: unknown kv_cache_dtype={spec.kv_cache_dtype!r}; "
                 f"expected one of {sorted(k for k in KV_CACHE_DTYPES if k)}"
             )
+        if spec.replicas < 1:
+            raise ValueError(f"model {name}: replicas must be >= 1")
+        if spec.replicas > 1 and spec.kind == "encoder":
+            raise ValueError(
+                f"model {name}: replicas is decoder-only (the embedding "
+                "coalescer already batches across callers in one engine)"
+            )
         tokenizer_path = spec.path
         logger.info("loading model %r (%s, tiny=%s)", name, spec.kind, spec.tiny)
 
@@ -273,8 +292,11 @@ class ModelRegistry:
                 params = quantize_decoder_params(params)
             with self.mesh:
                 params = shard_pytree(params, llama.logical_axes(cfg), self.mesh)
-            sched = None
-            if spec.scheduler:
+            from .faults import FaultInjector
+
+            def _build_sched():
+                if not spec.scheduler:
+                    return None
                 from .scheduler import RequestScheduler, SchedulerConfig
 
                 sched = RequestScheduler(
@@ -290,51 +312,76 @@ class ModelRegistry:
                 # so they bypass the None-dropping from_knobs filter
                 sched.cfg.admit_max_wait_s = spec.sched_admit_max_wait_s
                 sched.cfg.default_deadline_s = spec.sched_default_deadline_s
-            from .faults import FaultInjector
+                return sched
 
-            # explicit spec wins ({} forces off); otherwise the env gate
-            # (DABT_FAULTS / DABT_FAULT_SEED) applies — a chaos session can
-            # target a running config without editing it
-            if spec.faults is not None:
-                faults = FaultInjector.from_spec(spec.faults, seed=spec.fault_seed)
+            def _build_faults(seed_offset: int = 0):
+                # explicit spec wins ({} forces off); otherwise the env gate
+                # (DABT_FAULTS / DABT_FAULT_SEED) applies — a chaos session
+                # can target a running config without editing it.  Replicas
+                # offset the seed so probabilistic sites fire DIFFERENT
+                # (deterministic) patterns per replica instead of N copies of
+                # one pattern failing in lockstep.
+                if spec.faults is not None:
+                    return FaultInjector.from_spec(
+                        spec.faults, seed=spec.fault_seed + seed_offset
+                    )
+                return FaultInjector.from_env(seed_offset=seed_offset)
+
+            engines = []
+            for i in range(spec.replicas):
+                eng = GenerationEngine(
+                    cfg,
+                    params,  # weights are read-only: every replica shares them
+                    tokenizer,
+                    max_slots=spec.max_slots,
+                    max_seq_len=spec.max_seq_len,
+                    chunk_size=spec.chunk_size,
+                    lookahead=spec.lookahead,
+                    burst=spec.burst,
+                    prefix_cache_size=spec.prefix_cache,
+                    prefix_min_tokens=spec.prefix_min_tokens,
+                    prefix_cache_max_bytes=spec.prefix_cache_max_bytes,
+                    kv_cache_dtype=spec.kv_cache_dtype,
+                    speculative=spec.speculative,
+                    decode_kv_chunk=(
+                        None if spec.decode_kv_chunk in (None, "off")
+                        else int(spec.decode_kv_chunk)
+                    ),
+                    kv_layout=spec.kv_layout,
+                    kv_page_size=spec.kv_page_size,
+                    kv_pages=spec.kv_pages,
+                    scheduler=_build_sched(),
+                    faults=_build_faults(i),
+                    max_restarts=spec.max_restarts,
+                    restart_window_s=spec.restart_window_s,
+                    restart_backoff_s=spec.restart_backoff_s,
+                    restart_backoff_max_s=spec.restart_backoff_max_s,
+                    degraded_cooldown_s=spec.degraded_cooldown_s,
+                    heartbeat_degraded_s=spec.heartbeat_degraded_s,
+                    max_request_restarts=spec.max_request_restarts,
+                    mesh=self.mesh,
+                )
+                if spec.warmup or spec.warmup_json:
+                    # the persistent XLA compile cache makes replica 2..N's
+                    # warmup a cache replay, not a recompile
+                    eng.warmup(json=spec.warmup_json)
+                eng.start()
+                engines.append(eng)
+            if spec.replicas == 1:
+                # single engine, no router object: byte-identical to the
+                # pre-router serving path (the bench baseline)
+                self.generators[name] = engines[0]
             else:
-                faults = FaultInjector.from_env()
-            eng = GenerationEngine(
-                cfg,
-                params,
-                tokenizer,
-                max_slots=spec.max_slots,
-                max_seq_len=spec.max_seq_len,
-                chunk_size=spec.chunk_size,
-                lookahead=spec.lookahead,
-                burst=spec.burst,
-                prefix_cache_size=spec.prefix_cache,
-                prefix_min_tokens=spec.prefix_min_tokens,
-                prefix_cache_max_bytes=spec.prefix_cache_max_bytes,
-                kv_cache_dtype=spec.kv_cache_dtype,
-                speculative=spec.speculative,
-                decode_kv_chunk=(
-                    None if spec.decode_kv_chunk in (None, "off")
-                    else int(spec.decode_kv_chunk)
-                ),
-                kv_layout=spec.kv_layout,
-                kv_page_size=spec.kv_page_size,
-                kv_pages=spec.kv_pages,
-                scheduler=sched,
-                faults=faults,
-                max_restarts=spec.max_restarts,
-                restart_window_s=spec.restart_window_s,
-                restart_backoff_s=spec.restart_backoff_s,
-                restart_backoff_max_s=spec.restart_backoff_max_s,
-                degraded_cooldown_s=spec.degraded_cooldown_s,
-                heartbeat_degraded_s=spec.heartbeat_degraded_s,
-                max_request_restarts=spec.max_request_restarts,
-                mesh=self.mesh,
-            )
-            if spec.warmup or spec.warmup_json:
-                eng.warmup(json=spec.warmup_json)
-            eng.start()
-            self.generators[name] = eng
+                from .router import EngineRouter
+
+                self.generators[name] = EngineRouter(
+                    engines,
+                    names=[f"{name}/r{i}" for i in range(spec.replicas)],
+                    breaker_threshold=spec.router_breaker_threshold,
+                    breaker_reset_s=spec.router_breaker_reset_s,
+                    max_reroutes=spec.max_request_restarts,
+                    faults=_build_faults(len(engines)),
+                )
         else:
             raise ValueError(f"model {name}: unknown kind {spec.kind!r}")
         self.specs[name] = spec
@@ -342,6 +389,19 @@ class ModelRegistry:
     def stop(self):
         for eng in list(self.embedders.values()) + list(self.generators.values()):
             eng.stop()
+
+    def idle(self) -> bool:
+        """No engine holds accepted-but-unfinished work (every generator —
+        or every replica behind a router — idle, every embedder queue empty).
+        The server's SIGTERM graceful drain polls this until the deadline."""
+        for eng in self.generators.values():
+            fn = getattr(eng, "idle", None)
+            if callable(fn) and not fn():
+                return False
+        for eng in self.embedders.values():
+            if not eng._queue.empty():
+                return False
+        return True
 
     def get_embedder(self, model: str):
         return self.embedders.get(model.lower())

@@ -1,0 +1,740 @@
+"""Fault-tolerant multi-replica serving: the front-door engine router.
+
+The reference's only hardware-facing component is a single FastAPI gpu_service
+process — one crash takes down every bot (PAPER.md §7) — and until now this
+repo's serving plane was likewise ONE :class:`~.engine.GenerationEngine`:
+supervised (crash-only restarts, a restart circuit — docs/RESILIENCE.md) but
+with no redundancy.  :class:`EngineRouter` owns N engine replicas — each
+independently supervised, with its own scheduler, KV page pool, and fault
+injector — and fronts them with the engine's own ``submit()`` /
+``generate()`` / ``generate_stream()`` surface, so the HTTP layer and the
+providers cannot tell a fleet from a single engine.
+
+Dispatch policy (docs/RESILIENCE.md "Fleet topology"):
+
+- **Health first.**  A replica is a candidate only when it is not draining,
+  its engine loop is alive (running thread, fresh heartbeat, restart circuit
+  closed), and its per-replica :class:`~...ai.providers.failover.CircuitBreaker`
+  admits it.  The breaker — reused verbatim from the provider failover plane —
+  is fed by :class:`~.engine.EngineUnavailable`, heartbeat staleness, dead
+  threads, and replica-shaped request failures; a half-open breaker admits
+  exactly one probe request, so a recovering replica earns traffic back one
+  request at a time instead of eating a thundering herd.
+- **Prefix affinity, then least-loaded.**  A request carrying a shareable
+  prefix (system prompt + packed RAG context) is routed to the replica whose
+  KV page pool *already holds* that prefix — a read-only, LRU-neutral registry
+  peek (:meth:`~.kv_pool.PageAllocator.holds_prefix`), so multi-turn dialogs
+  keep hitting the prefix cache they warmed instead of re-prefilling on a
+  random replica.  Everything else (and affinity misses) goes least-loaded:
+  ``queued_depth + num_active``, rotation tie-break.  Health and breaker state
+  take precedence over affinity — a cached prefix is never a reason to route
+  into a sick replica.
+- **Token-less re-route.**  When a replica fails a request that has emitted
+  NO tokens (replica died with the request queued or mid-prefill, engine
+  degraded, crash-only restart budget exhausted), the router re-submits it to
+  another healthy replica — bounded by the same ``max_request_restarts``
+  budget the engine's own crash-restart salvage uses, so a request that
+  deterministically kills engines cannot hop forever.  Requests past their
+  first token fail cleanly (a replay would double-bill latency or repeat
+  streamed output) — exactly the single-engine restart contract, lifted to
+  the fleet.
+- **Graceful drain.**  :meth:`drain` stops admitting to one replica, lets its
+  in-flight work finish (deadline-bounded, injectable clock so tests are
+  deterministic), then restarts it while the rest of the fleet absorbs
+  traffic; :meth:`rolling_restart` chains that over every replica for
+  zero-downtime restarts.  ``drain_all`` (no restart) is the SIGTERM path:
+  the server stops admission, the fleet finishes what it accepted, the
+  process exits 0.
+
+Chaos sites ``replica_dead`` / ``replica_slow`` (serving/faults.py) exercise
+all of the above deterministically: ``replica_dead`` kills the replica the
+dispatcher is about to pick — in-flight work fails, the breaker trips, and
+token-less requests re-route — and the ``router_*`` bench section measures
+goodput and recovery the same way ``chaos_*`` does for one engine.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional, Sequence, Set
+
+from ..ai.providers.failover import CircuitBreaker
+from .engine import EngineUnavailable, GenerationEngine, _safe_resolve
+from .scheduler import SchedulerRejected
+
+logger = logging.getLogger(__name__)
+
+
+class _StreamShim:
+    """Router-side token tap between an engine and the client's TokenStream.
+
+    Counts every client-visible token (the re-route eligibility test: ONLY
+    token-less requests may move replica) and forwards to the real stream
+    when one is attached.  The terminal event is NOT forwarded from the inner
+    engine future — the router resolves its OUTER future (which carries the
+    client stream's ``finish`` callback) only once re-routing is settled, so
+    a replica death mid-queue never closes the client stream early."""
+
+    __slots__ = ("inner", "tokens")
+
+    def __init__(self, inner: Any = None):
+        self.inner = inner
+        self.tokens = 0
+
+    def push_token(self, tok: int, *, notify: bool = True) -> bool:
+        self.tokens += 1
+        if self.inner is not None:
+            return self.inner.push_token(tok, notify=notify)
+        return False
+
+    def notify_now(self) -> None:
+        if self.inner is not None:
+            self.inner.notify_now()
+
+    def finish(self, fut: Future) -> None:  # inner future done-callback
+        pass  # terminal rides the router's outer future instead
+
+
+class _Replica:
+    """One engine behind the router: breaker, drain flag, counters."""
+
+    __slots__ = (
+        "engine",
+        "name",
+        "breaker",
+        "draining",
+        "dispatched",
+        "completed_ok",
+        "last_success_at",
+    )
+
+    def __init__(self, engine: GenerationEngine, name: str, breaker: CircuitBreaker):
+        self.engine = engine
+        self.name = name
+        self.breaker = breaker
+        self.draining = False
+        self.dispatched = 0
+        self.completed_ok = 0
+        self.last_success_at: Optional[float] = None
+
+
+class _Routed:
+    """Mutable per-request routing state carried across re-dispatches."""
+
+    __slots__ = (
+        "prompt_ids",
+        "kwargs",
+        "outer",
+        "shim",
+        "reroutes",
+        "replica",
+        "inner",
+        "holders",
+        "deadline_at",
+    )
+
+    def __init__(self, prompt_ids: List[int], kwargs: dict, outer: Future, shim: _StreamShim):
+        self.prompt_ids = prompt_ids
+        self.kwargs = kwargs
+        self.outer = outer
+        self.shim = shim
+        self.reroutes = 0
+        self.replica: Optional[int] = None
+        self.inner: Optional[Future] = None
+        # the client's ABSOLUTE deadline, fixed at first submission: each
+        # engine.submit computes its own deadline_at from deadline_s, so a
+        # re-route must pass the REMAINING budget, not restart the clock —
+        # otherwise every hop silently grants the client a fresh deadline
+        self.deadline_at: Optional[float] = None
+        if kwargs.get("deadline_s") is not None:
+            self.deadline_at = time.monotonic() + float(kwargs["deadline_s"])
+        # replicas whose prefix registry held this prompt's prefix at the
+        # last candidate ordering — a hit is counted only when the replica
+        # ACTUALLY dispatched to is one of them (a skipped holder is a miss)
+        self.holders: Set[int] = set()
+
+
+class EngineRouter:
+    """N supervised :class:`~.engine.GenerationEngine` replicas behind one
+    engine-shaped face (``submit``/``generate``/``generate_stream``/stats).
+
+    ``clock``/``sleep`` are injectable so the drain deadline logic is
+    deterministic under test; the engines themselves keep real time."""
+
+    def __init__(
+        self,
+        engines: Sequence[GenerationEngine],
+        *,
+        names: Optional[Sequence[str]] = None,
+        breaker_threshold: int = 3,
+        breaker_reset_s: float = 10.0,
+        max_reroutes: Optional[int] = None,
+        faults=None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if not engines:
+            raise ValueError("EngineRouter needs at least one engine replica")
+        self._clock = clock
+        self._sleep = sleep
+        self._faults = faults
+        names = list(names) if names else [f"replica{i}" for i in range(len(engines))]
+        if len(names) != len(engines):
+            raise ValueError("names must match engines 1:1")
+        self.replicas: List[_Replica] = [
+            _Replica(
+                eng,
+                name,
+                CircuitBreaker(breaker_threshold, breaker_reset_s, clock=clock),
+            )
+            for eng, name in zip(engines, names)
+        ]
+        # one request survives at most this many replica hops — the same
+        # budget the engines' own crash-restart salvage enforces per replica
+        self.max_reroutes = (
+            int(max_reroutes)
+            if max_reroutes is not None
+            else max(e.max_request_restarts for e in engines)
+        )
+        self.tokenizer = engines[0].tokenizer
+        # the fleet's context contract is the tightest replica's (the
+        # in-process TPUProvider reads this off whatever the registry hands
+        # it for prompt budgeting — replicas are homogeneous today, but min
+        # stays honest if that ever changes)
+        self.max_seq_len = min(e.max_seq_len for e in engines)
+        self.scheduler = None  # per-replica schedulers; see router_stats()
+        self._lock = threading.Lock()
+        self._rr = 0  # rotation counter: load-tie break spreads, not pins
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self.reroutes = 0
+        self.rerouted_failed = 0  # token-less re-routable failures past budget
+        # replica-shaped failures a request could NOT be re-routed away from
+        # (it was past its first client-visible token — the honest cost of a
+        # replica death, distinguished from token-less goodput in the bench)
+        self.failed_past_first_token = 0
+        self.drains = 0
+        self.drain_shed = 0  # requests failed by a deadline-forced drain
+        self.no_replica_available = 0
+
+    # engine.generate / generate_stream only touch self.tokenizer and
+    # self.submit — both present here, so the router reuses them verbatim
+    # (tokenization, prefix split, stream plumbing identical to one engine)
+    generate = GenerationEngine.generate
+    generate_stream = GenerationEngine.generate_stream
+
+    # ------------------------------------------------------------- dispatch
+    def _healthy(self, rep: _Replica) -> bool:
+        """Dispatch-time liveness — the ENGINE's own predicate (the same one
+        /healthz reports), so routing and health reporting can never
+        disagree.  (The breaker is consulted separately — this is the direct
+        evidence that also FEEDS it when stale.)"""
+        return rep.engine.healthy()
+
+    def _load(self, rep: _Replica) -> int:
+        return rep.engine.queued_depth() + rep.engine.num_active
+
+    def _candidate_order(self, state: _Routed, exclude: Optional[Set[int]]) -> List[int]:
+        """Dispatch preference: non-draining replicas, prefix-registry holders
+        first (least-loaded among holders), then everything else least-loaded
+        with a rotating tie-break."""
+        with self._lock:
+            self._rr += 1
+            rr = self._rr
+        n = len(self.replicas)
+        idxs = [
+            i
+            for i, rep in enumerate(self.replicas)
+            if not rep.draining and (not exclude or i not in exclude)
+        ]
+        idxs.sort(key=lambda i: (self._load(self.replicas[i]), (i - rr) % n))
+        prefix_len = state.kwargs.get("prefix_len", 0)
+        state.holders = set()
+        if prefix_len and len(idxs) > 1:
+            holders = [
+                i
+                for i in idxs
+                if self.replicas[i].engine.holds_prefix(state.prompt_ids, prefix_len)
+            ]
+            if holders:
+                state.holders = set(holders)
+                idxs = holders + [i for i in idxs if i not in holders]
+        return idxs
+
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        *,
+        max_tokens: int = 1024,
+        temperature: float = 0.8,
+        top_p: float = 0.95,
+        json_format: bool = False,
+        prefix_len: int = 0,
+        priority: str = "interactive",
+        tenant: str = "default",
+        deadline_s: Optional[float] = None,
+        stream: Any = None,
+    ) -> Future:
+        """Thread-safe fleet submission; returns Future[GenerationResult].
+
+        Raises :class:`SchedulerRejected` when every candidate replica sheds
+        (fleet-wide overload) and :class:`EngineUnavailable` when no healthy
+        replica exists — the same synchronous contract one engine has, so the
+        HTTP layer's 429/503 mapping applies unchanged."""
+        if self._faults is not None:
+            # deterministic fleet chaos: a stalled dispatch hop, or the
+            # picked replica dying under the dispatcher's feet (the injected
+            # sleep, so fake-time harnesses stay deterministic)
+            delay = self._faults.sleep_s("replica_slow")
+            if delay:
+                self._sleep(delay)
+        outer: Future = Future()
+        if stream is not None:
+            outer.add_done_callback(stream.finish)
+        state = _Routed(
+            list(prompt_ids),
+            dict(
+                max_tokens=max_tokens,
+                temperature=temperature,
+                top_p=top_p,
+                json_format=json_format,
+                prefix_len=prefix_len,
+                priority=priority,
+                tenant=tenant,
+                deadline_s=deadline_s,
+            ),
+            outer,
+            _StreamShim(stream),
+        )
+        if self._faults is not None and self._faults.should_fire("replica_dead"):
+            order = self._candidate_order(state, None)
+            if order:
+                self.kill_replica(order[0])
+        self._dispatch(state, exclude=None, sync=True)
+        # outer cancel (client disconnect) must reach whichever inner future
+        # currently carries the request so the engine's reap frees the slot
+        outer.add_done_callback(lambda f: self._propagate_cancel(state, f))
+        return outer
+
+    def _propagate_cancel(self, state: _Routed, outer: Future) -> None:
+        if outer.cancelled():
+            inner = state.inner
+            if inner is not None and not inner.done():
+                inner.cancel()
+
+    def _dispatch(self, state: _Routed, exclude: Optional[Set[int]], *, sync: bool) -> None:
+        """Try candidates in preference order; on ``sync`` (the caller's
+        thread) synchronous rejections raise, on re-route they resolve the
+        outer future instead."""
+        last_unavail: Optional[EngineUnavailable] = None
+        last_shed: Optional[SchedulerRejected] = None
+        for idx in self._candidate_order(state, exclude):
+            rep = self.replicas[idx]
+            br = rep.breaker
+            if not br.allow():
+                continue
+            if not self._healthy(rep):
+                # heartbeat-stale / dead-thread / degraded evidence feeds the
+                # breaker directly (and clears any probe slot allow() claimed)
+                br.record_failure()
+                continue
+            try:
+                inner = rep.engine.submit(state.prompt_ids, **state.kwargs, stream=state.shim)
+            except EngineUnavailable as e:
+                br.record_failure()
+                last_unavail = e
+                continue
+            except SchedulerRejected as e:
+                # load shed is pressure, not a fault: the probe slot frees
+                # and the breaker's failure streak is untouched
+                br.release_probe()
+                last_shed = e
+                continue
+            with self._lock:
+                rep.dispatched += 1
+                if state.kwargs.get("prefix_len", 0) and len(self.replicas) > 1:
+                    # a hit only if THIS replica holds the prefix — a holder
+                    # skipped for health/breaker reasons is a miss (the
+                    # request re-prefills), and the gauge must say so
+                    if idx in state.holders:
+                        self.affinity_hits += 1
+                    else:
+                        self.affinity_misses += 1
+            state.replica = idx
+            state.inner = inner
+            if state.outer.cancelled():
+                inner.cancel()
+            inner.add_done_callback(
+                lambda f, s=state, i=idx: self._on_inner_done(s, i, f)
+            )
+            return
+        # no replica took it
+        with self._lock:
+            self.no_replica_available += 1
+        exc: BaseException
+        if last_shed is not None and last_unavail is None:
+            exc = last_shed
+        elif last_unavail is not None and last_shed is None:
+            exc = last_unavail
+        elif last_shed is not None and last_unavail is not None:
+            # mixed: prefer the shed (429 + honest Retry-After) — part of
+            # the fleet is alive, the client should back off and retry
+            exc = last_shed
+        else:
+            exc = EngineUnavailable(
+                "no healthy replica available", retry_after_s=1.0
+            )
+        if sync:
+            raise exc
+        _safe_resolve(state.outer, exc=exc)
+
+    @staticmethod
+    def _reroutable(exc: BaseException) -> bool:
+        """Replica-shaped failures (the replica died / degraded / kept
+        crashing) re-route; request-shaped outcomes (deadline, shed,
+        poisoned prompt, bad arguments) stick with the request."""
+        from .engine import RequestPoisoned
+        from .scheduler import DeadlineExceeded
+
+        if isinstance(
+            exc, (DeadlineExceeded, SchedulerRejected, RequestPoisoned, ValueError)
+        ):
+            return False
+        return isinstance(exc, Exception)
+
+    def _on_inner_done(self, state: _Routed, idx: int, inner: Future) -> None:
+        rep = self.replicas[idx]
+        br = rep.breaker
+        if state.outer.cancelled():
+            # the client went away; the engine's reap already owns cleanup —
+            # just free any half-open probe slot this request held
+            br.release_probe()
+            return
+        if inner.cancelled():
+            br.release_probe()
+            state.outer.cancel()
+            return
+        exc = inner.exception()
+        if exc is None:
+            now = self._clock()
+            with self._lock:
+                rep.completed_ok += 1
+                rep.last_success_at = now
+            br.record_success()
+            _safe_resolve(state.outer, result=inner.result())
+            return
+        if self._reroutable(exc):
+            br.record_failure()
+            if state.shim.tokens == 0 and state.reroutes < self.max_reroutes:
+                if state.deadline_at is not None:
+                    # the single-engine salvage keeps the original
+                    # _Request.deadline_at; the fleet contract must match —
+                    # pass the REMAINING budget, and a hop with none left is
+                    # a deadline failure, not a fresh attempt
+                    remaining = state.deadline_at - time.monotonic()
+                    if remaining <= 0:
+                        from .scheduler import DeadlineExceeded
+
+                        _safe_resolve(
+                            state.outer,
+                            exc=DeadlineExceeded(
+                                "deadline expired while re-routing off a "
+                                f"failed replica ({rep.name})"
+                            ),
+                        )
+                        return
+                    state.kwargs["deadline_s"] = remaining
+                state.reroutes += 1
+                with self._lock:
+                    self.reroutes += 1
+                logger.warning(
+                    "router: re-routing token-less request off %s (%s: %s); "
+                    "hop %d/%d",
+                    rep.name,
+                    type(exc).__name__,
+                    exc,
+                    state.reroutes,
+                    self.max_reroutes,
+                )
+                try:
+                    self._dispatch(state, exclude={idx}, sync=False)
+                except Exception as redispatch_exc:  # pragma: no cover - belt
+                    # an unexpected submit error here would otherwise be
+                    # swallowed by Future._invoke_callbacks and leave the
+                    # outer future pending FOREVER — resolve it instead
+                    logger.exception("router: re-dispatch failed")
+                    _safe_resolve(state.outer, exc=redispatch_exc)
+                return
+            with self._lock:
+                if state.shim.tokens == 0:
+                    self.rerouted_failed += 1
+                else:
+                    self.failed_past_first_token += 1
+        else:
+            # the replica answered (with a request-level outcome): that
+            # resolves a half-open probe as success and ends any streak
+            br.record_success()
+        _safe_resolve(state.outer, exc=exc)
+
+    # ------------------------------------------------------ chaos / recovery
+    def kill_replica(self, idx: int) -> None:
+        """Abrupt replica death (the ``replica_dead`` chaos site): drop the
+        engine's run flag so its loop exits at the top of the next iteration
+        and its ``_shutdown`` fails everything in flight — exactly what the
+        router must survive.  No drain, no goodbye."""
+        rep = self.replicas[idx]
+        logger.warning("router: chaos killed %s", rep.name)
+        rep.engine._running = False
+
+    def restart_replica(self, idx: int, *, stop_timeout_s: float = 30.0) -> None:
+        """Operator restart of a (dead or drained) replica: bounded stop —
+        failing whatever the dead loop left behind — then a fresh loop
+        thread.  The breaker closes on the explicit restart; the device
+        state (weights, caches, prefix registry) carries over."""
+        rep = self.replicas[idx]
+        rep.engine.stop(drain_timeout_s=stop_timeout_s)
+        rep.engine.start()
+        rep.breaker.record_success()
+
+    # ---------------------------------------------------------------- drain
+    def _replica_idle(self, rep: _Replica) -> bool:
+        return rep.engine.idle()
+
+    def drain(
+        self,
+        idx: int,
+        *,
+        deadline_s: float = 30.0,
+        restart: bool = True,
+        poll_s: float = 0.005,
+    ) -> dict:
+        """Gracefully drain one replica: stop admitting to it (the rest of
+        the fleet absorbs traffic), wait — deadline-bounded — for its
+        in-flight and queued work to finish, then restart it.  Returns a
+        summary dict; ``forced_failures`` counts requests the deadline
+        forced to fail (0 on a clean drain — the zero-shed rolling-restart
+        contract)."""
+        rep = self.replicas[idx]
+        with self._lock:
+            if rep.draining:
+                raise RuntimeError(f"{rep.name} is already draining")
+            rep.draining = True
+            self.drains += 1
+        t0 = self._clock()
+        try:
+            while not self._replica_idle(rep) and self._clock() - t0 < deadline_s:
+                self._sleep(poll_s)
+            drained = self._replica_idle(rep)
+            forced = 0
+            if not drained:
+                forced = rep.engine.num_active + rep.engine.queued_depth()
+                with self._lock:
+                    self.drain_shed += forced
+                logger.warning(
+                    "router: drain of %s hit its %.1fs deadline with %d "
+                    "request(s) still in flight; they fail on restart",
+                    rep.name,
+                    deadline_s,
+                    forced,
+                )
+            if restart:
+                self.restart_replica(idx)
+            return {
+                "replica": rep.name,
+                "drained": drained,
+                "forced_failures": forced,
+                "waited_s": round(self._clock() - t0, 3),
+            }
+        finally:
+            with self._lock:
+                rep.draining = False
+
+    def rolling_restart(self, *, deadline_s: float = 30.0) -> List[dict]:
+        """Drain-and-restart every replica, one at a time, under live
+        traffic — the zero-downtime restart path.  With >= 2 replicas the
+        fleet keeps serving throughout."""
+        return [
+            self.drain(i, deadline_s=deadline_s, restart=True)
+            for i in range(len(self.replicas))
+        ]
+
+    def begin_drain(self) -> None:
+        """Non-blocking fleet-wide admission stop (the SIGTERM path): every
+        replica is marked draining so dispatch fails fast while in-flight
+        work keeps running.  The caller owns the wait (the server's shutdown
+        handler polls ``idle()``); :meth:`drain_all` wraps both."""
+        with self._lock:
+            for rep in self.replicas:
+                rep.draining = True
+
+    def drain_all(self, *, deadline_s: float = 30.0, poll_s: float = 0.01) -> bool:
+        """Whole-router drain (SIGTERM): stop admitting everywhere, wait for
+        the fleet to finish what it accepted.  Returns True when everything
+        drained inside the deadline.  No restart — the process is exiting."""
+        self.begin_drain()
+        t0 = self._clock()
+        while self._clock() - t0 < deadline_s:
+            if all(self._replica_idle(rep) for rep in self.replicas):
+                return True
+            self._sleep(poll_s)
+        return all(self._replica_idle(rep) for rep in self.replicas)
+
+    # ------------------------------------------------------- engine surface
+    @property
+    def num_active(self) -> int:
+        return sum(rep.engine.num_active for rep in self.replicas)
+
+    @property
+    def steps(self) -> int:
+        return sum(rep.engine.steps for rep in self.replicas)
+
+    @property
+    def reclaimed_slots(self) -> int:
+        return sum(rep.engine.reclaimed_slots for rep in self.replicas)
+
+    @property
+    def cancelled_slots(self) -> int:
+        return sum(rep.engine.cancelled_slots for rep in self.replicas)
+
+    def queued_depth(self) -> int:
+        return sum(rep.engine.queued_depth() for rep in self.replicas)
+
+    def idle(self) -> bool:
+        return all(rep.engine.idle() for rep in self.replicas)
+
+    def holds_prefix(self, prompt_ids: Sequence[int], prefix_len: int) -> bool:
+        return any(
+            rep.engine.holds_prefix(prompt_ids, prefix_len) for rep in self.replicas
+        )
+
+    def start(self) -> "EngineRouter":
+        for rep in self.replicas:
+            rep.engine.start()
+        return self
+
+    def stop(self, drain_timeout_s: float = 120.0) -> None:
+        for rep in self.replicas:
+            rep.engine.stop(drain_timeout_s=drain_timeout_s)
+
+    # --------------------------------------------------------------- stats
+    def router_stats(self) -> dict:
+        """Fleet gauges for tick_stats / healthz: per-replica depth and
+        breaker state, affinity hit rate, re-routes, drains.
+
+        The router lock covers ONLY the router-owned counters.  Per-replica
+        depth goes through ``queued_depth()`` → the replica's scheduler lock,
+        and a dying replica's engine thread resolves futures UNDER that
+        scheduler lock whose done-callbacks take the router lock — holding
+        the router lock across the engine call would be the classic ABBA
+        deadlock, wedging /healthz and every submit the moment a probe races
+        a replica death."""
+        with self._lock:
+            hits, misses = self.affinity_hits, self.affinity_misses
+            out = {
+                "n_replicas": len(self.replicas),
+                "affinity_hits": hits,
+                "affinity_misses": misses,
+                "affinity_hit_rate": round(hits / max(1, hits + misses), 4),
+                "reroutes": self.reroutes,
+                "rerouted_failed": self.rerouted_failed,
+                "failed_past_first_token": self.failed_past_first_token,
+                "drains": self.drains,
+                "drain_shed": self.drain_shed,
+                "no_replica_available": self.no_replica_available,
+            }
+        out["replicas"] = [
+            {
+                "name": rep.name,
+                "depth": rep.engine.queued_depth(),
+                "active": rep.engine.num_active,
+                "breaker": rep.breaker.state,
+                "draining": rep.draining,
+                "healthy": self._healthy(rep),
+                "dispatched": rep.dispatched,
+                "completed_ok": rep.completed_ok,
+            }
+            for rep in self.replicas
+        ]
+        return out
+
+    def latency_stats(self) -> dict:
+        """Fleet-wide perceived-latency percentiles: the replicas' raw TTFT /
+        ITL sample windows concatenated (percentiles cannot be merged from
+        per-replica percentiles)."""
+        ttft: List[float] = []
+        itl: List[float] = []
+        for rep in self.replicas:
+            ttft.extend(rep.engine._ttft_s)
+            itl.extend(rep.engine._itl_s)
+        p = GenerationEngine._pctl_ms
+        return {
+            "ttft_p50_ms": p(ttft, 0.50),
+            "ttft_p95_ms": p(ttft, 0.95),
+            "ttft_n": len(ttft),
+            "itl_p50_ms": p(itl, 0.50),
+            "itl_p95_ms": p(itl, 0.95),
+            "itl_n": len(itl),
+            "cancelled_slots": self.cancelled_slots,
+        }
+
+    def kv_stats(self) -> dict:
+        """Aggregated KV gauges + the per-replica blocks (each carries its
+        own kv_layout_requested/effective so one replica silently on the
+        legacy plane is visible)."""
+        per = [rep.engine.kv_stats() for rep in self.replicas]
+        layouts = {p["kv_layout_effective"] for p in per}
+        out: dict = {
+            "kv_layout": per[0]["kv_layout"] if len(layouts) == 1 else "mixed",
+            "kv_layout_requested": per[0]["kv_layout_requested"],
+            "kv_layout_effective": layouts.pop() if len(layouts) == 1 else "mixed",
+            "prefix_hits": sum(p.get("prefix_hits", 0) for p in per),
+            "prefix_misses": sum(p.get("prefix_misses", 0) for p in per),
+            "replicas": per,
+        }
+        if all("kv_pages_total" in p for p in per):
+            for key in ("kv_pages_total", "kv_pages_used", "kv_pages_free"):
+                out[key] = sum(p[key] for p in per)
+        return out
+
+    def supervision_stats(self) -> dict:
+        """Aggregate supervision: healthy only when EVERY replica is (one
+        dead replica of N is exactly what an operator must see as degraded),
+        with the per-replica blocks attached for /healthz."""
+        per = []
+        for rep in self.replicas:
+            s = rep.engine.supervision_stats()
+            s["name"] = rep.name
+            s["breaker"] = rep.breaker.state
+            s["draining"] = rep.draining
+            per.append(s)
+        return {
+            "running": any(p["running"] for p in per),
+            "healthy": all(p["healthy"] for p in per),
+            "degraded": any(p["degraded"] for p in per),
+            "replicas": per,
+            "engine_restarts": sum(p["engine_restarts"] for p in per),
+            "poisoned_requests": sum(p["poisoned_requests"] for p in per),
+            "circuit_trips": sum(p["circuit_trips"] for p in per),
+            "restarted_requests_resubmitted": sum(
+                p["restarted_requests_resubmitted"] for p in per
+            ),
+            "restarted_requests_failed": sum(
+                p["restarted_requests_failed"] for p in per
+            ),
+            "reroutes": self.reroutes,
+        }
+
+    def tick_stats(self) -> dict:
+        """Fleet tick_stats: router gauges + aggregated latency/KV/supervision
+        plus each replica's full engine tick_stats block."""
+        out = {
+            "router": self.router_stats(),
+            "kv": self.kv_stats(),
+            "supervision": self.supervision_stats(),
+            "replicas": [rep.engine.tick_stats() for rep in self.replicas],
+        }
+        out.update(self.latency_stats())
+        return out

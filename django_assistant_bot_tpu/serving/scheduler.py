@@ -368,6 +368,7 @@ class RequestScheduler:
 
         now = now if now is not None else time.monotonic()
         dropped = 0
+        expired = []
         with self._lock:
             for key, q in self._queues.items():
                 if not q:
@@ -387,29 +388,42 @@ class RequestScheduler:
                         self._release_kv_locked(req)
                         self.expired_queued[key[0]] += 1
                         dropped += 1
-                        _safe_resolve(
-                            req.future,
-                            exc=DeadlineExceeded(
-                                f"deadline expired after "
-                                f"{now - req.submitted_at:.2f}s in queue"
-                            ),
-                        )
+                        expired.append(req)
                         continue
                     keep.append(req)
                 q.extend(keep)
+        # resolve OUTSIDE the lock: done-callbacks (the multi-replica
+        # router's re-dispatch) may take other schedulers' locks
+        for req in expired:
+            _safe_resolve(
+                req.future,
+                exc=DeadlineExceeded(
+                    f"deadline expired after "
+                    f"{now - req.submitted_at:.2f}s in queue"
+                ),
+            )
         return dropped
 
     def drain(self, err: BaseException) -> None:
-        """Fail everything still queued (engine shutdown)."""
+        """Fail everything still queued (engine shutdown).
+
+        Futures resolve OUTSIDE the lock: a routed request's done-callback
+        re-dispatches to ANOTHER replica — taking that replica's scheduler
+        lock — and two replicas dying simultaneously would otherwise hold
+        each other's locks in an ABBA deadlock (each engine thread draining
+        its own scheduler while re-dispatching into the other's)."""
         from .engine import _safe_resolve
 
+        victims = []
         with self._lock:
             for q in self._queues.values():
                 while q:
-                    _safe_resolve(q.popleft().future, exc=err)
+                    victims.append(q.popleft())
                     self._depth = max(0, self._depth - 1)
             self._depth = max(0, self._depth)
             self._queued_kv_pages = 0
+        for req in victims:
+            _safe_resolve(req.future, exc=err)
 
     def _release_kv_locked(self, req) -> None:
         self._queued_kv_pages = max(

@@ -34,9 +34,21 @@ from .scheduler import DeadlineExceeded, SchedulerRejected
 logger = logging.getLogger(__name__)
 
 REGISTRY_KEY: web.AppKey[ModelRegistry] = web.AppKey("registry", ModelRegistry)
+DRAIN_KEY: web.AppKey[dict] = web.AppKey("drain_state", dict)
 
 MAX_MAX_TOKENS = 1 << 17  # sanity ceiling; engines clamp to max_seq_len anyway
 PRIORITIES = ("interactive", "background")
+
+
+def _draining_response() -> web.Response:
+    """Graceful shutdown in progress: stop admitting, finish in-flight work.
+    New requests get an honest 503 + Retry-After instead of being accepted
+    and then killed mid-generation by process exit."""
+    return web.json_response(
+        {"detail": "server draining for shutdown"},
+        status=503,
+        headers={"Retry-After": "2"},
+    )
 
 
 class _BadRequest(ValueError):
@@ -212,11 +224,21 @@ async def _stream_dialog(
     return resp
 
 
-def create_app(registry: ModelRegistry) -> web.Application:
+def create_app(
+    registry: ModelRegistry, *, drain_deadline_s: float = 30.0
+) -> web.Application:
     app = web.Application()
     app[REGISTRY_KEY] = registry
+    # graceful-drain state (the SIGTERM path, docs/RESILIENCE.md): once the
+    # flag flips, admission endpoints 503 and on_shutdown waits — bounded by
+    # drain_deadline_s — for every engine to finish what it already accepted
+    # before on_cleanup stops the engines (which fails anything left).
+    drain = {"draining": False, "deadline_s": float(drain_deadline_s)}
+    app[DRAIN_KEY] = drain
 
     async def embeddings(request: web.Request) -> web.Response:
+        if drain["draining"]:
+            return _draining_response()
         try:
             body = await request.json()
             model, texts = body["model"], body["texts"]
@@ -239,6 +261,8 @@ def create_app(registry: ModelRegistry) -> web.Application:
             return web.json_response({"detail": str(e)}, status=500)
 
     async def dialog(request: web.Request) -> web.Response:
+        if drain["draining"]:
+            return _draining_response()
         try:
             body = await request.json()
             model = body["model"]
@@ -319,7 +343,7 @@ def create_app(registry: ModelRegistry) -> web.Application:
         # status degrades when ANY generator is unhealthy: restart circuit
         # open, engine thread dead, or a loop heartbeat older than the
         # threshold (a wedged XLA call used to keep reporting green here)
-        status = "ok"
+        status = "draining" if drain["draining"] else "ok"
         generators = {}
         for name, eng in registry.generators.items():
             g = {
@@ -343,11 +367,18 @@ def create_app(registry: ModelRegistry) -> web.Application:
                 # the operator's overload dashboard (KV-pressure sheds appear
                 # under sched.shed.kv_pressure, distinct from queue_full)
                 g["sched"] = sched.stats()
+            router = getattr(eng, "router_stats", None)
+            if callable(router):
+                # multi-replica fleet gauges: per-replica depth/breaker,
+                # affinity hit rate, re-routes, drains (serving/router.py)
+                g["router"] = router()
             sup = getattr(eng, "supervision_stats", None)
             if callable(sup):
                 # restart/quarantine/circuit counters + loop_heartbeat_age_s
+                # (routers aggregate: one unhealthy replica of N degrades the
+                # fleet status, with per-replica blocks under "replicas")
                 g["supervision"] = sv = sup()
-                if not sv.get("healthy", True):
+                if not sv.get("healthy", True) and status == "ok":
                     status = "degraded"
             generators[name] = g
         return web.json_response(
@@ -382,9 +413,37 @@ def create_app(registry: ModelRegistry) -> web.Application:
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/models", models)
 
+    async def on_shutdown(app):
+        # SIGTERM graceful drain: web.run_app's signal handling triggers
+        # app.shutdown() BEFORE on_cleanup, while in-flight handlers still
+        # run.  Stop admission (the endpoints 503 via the flag), then wait —
+        # deadline-bounded — for every engine to finish what it accepted, so
+        # the on_cleanup stop() below finds nothing to kill.  A single
+        # --replicas 1 engine drains exactly the same way; routers
+        # additionally stop their own dispatch fleet-wide.
+        drain["draining"] = True
+        for eng in registry.generators.values():
+            begin = getattr(eng, "begin_drain", None)
+            if callable(begin):
+                # routers stop their own dispatch too (non-blocking mark;
+                # the poll below is the single wait loop)
+                begin()
+        deadline = asyncio.get_running_loop().time() + drain["deadline_s"]
+        while asyncio.get_running_loop().time() < deadline:
+            if registry.idle():
+                logger.info("graceful drain complete; shutting down")
+                return
+            await asyncio.sleep(0.05)
+        logger.warning(
+            "graceful drain deadline (%.1fs) expired with work in flight; "
+            "remaining requests fail on engine stop",
+            drain["deadline_s"],
+        )
+
     async def on_cleanup(app):
         registry.stop()
 
+    app.on_shutdown.append(on_shutdown)
     app.on_cleanup.append(on_cleanup)
     return app
 
@@ -410,9 +469,18 @@ def run_server(
     host: str = "0.0.0.0",
     port: int = 11435,
     registry: ModelRegistry | None = None,
+    drain_deadline_s: float = 30.0,
 ):
-    """Blocking entry (CLI ``serve``).  Default port matches the reference (11435)."""
+    """Blocking entry (CLI ``serve``).  Default port matches the reference
+    (11435).  SIGTERM/SIGINT trigger a graceful drain: admission stops (503),
+    in-flight work finishes within ``drain_deadline_s``, then the process
+    exits 0 — a rolling restart sheds nothing instead of killing mid-stream
+    generations."""
     if registry is None:
         config = load_config_file(config_path) if config_path else {}
         registry = ModelRegistry.from_config(config)
-    web.run_app(create_app(registry), host=host, port=port)
+    web.run_app(
+        create_app(registry, drain_deadline_s=drain_deadline_s),
+        host=host,
+        port=port,
+    )

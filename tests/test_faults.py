@@ -555,6 +555,118 @@ def test_failover_streaming_before_first_delta_only():
     asyncio.run(go())
 
 
+class _HangingStreamProvider:
+    """First stream call hangs before its first delta (cancellation is the
+    only way out); later calls stream normally.  The half-open-probe shape:
+    a recovering backend that stalls its probe request."""
+
+    def __init__(self):
+        self.calls = 0
+        self.calls_attempts = []
+
+    @property
+    def context_size(self):
+        return 1000
+
+    def calculate_tokens(self, text):
+        return len(text)
+
+    async def get_response(self, messages, max_tokens=1024, json_format=False):
+        raise NotImplementedError
+
+    async def stream_response(self, messages, max_tokens=1024, json_format=False):
+        from django_assistant_bot_tpu.ai.domain import AIResponse
+        from django_assistant_bot_tpu.ai.providers.base import AIStreamChunk
+
+        self.calls += 1
+        if self.calls == 1:
+            await asyncio.Event().wait()  # hang until cancelled
+        yield AIStreamChunk(delta="recovered")
+        yield AIStreamChunk(
+            done=True, response=AIResponse(result="recovered", usage=None)
+        )
+
+
+def test_failover_streaming_cancelled_half_open_probe_releases_slot():
+    """Satellite of the PR 5 review fix, extended to the STREAMING path under
+    concurrent consumers: the one half-open probe stream hangs pre-first-delta
+    and is cancelled — the probe slot must free so the next concurrent stream
+    can probe the backend (without the fix the breaker blocks forever)."""
+    from django_assistant_bot_tpu.ai.providers.failover import AllBackendsFailed
+
+    now = [0.0]
+    prov = _HangingStreamProvider()
+    fp = _chain(prov, clock=lambda: now[0], breaker_threshold=1,
+                breaker_reset_s=10.0)
+
+    async def consume():
+        deltas = []
+        async for c in fp.stream_response([{"role": "user", "content": "q"}]):
+            if not c.done:
+                deltas.append(c.delta)
+        return deltas
+
+    async def go():
+        fp._breakers[0].record_failure()
+        assert fp.breaker_states()["b0"] == "open"
+        now[0] += 11.0  # cooldown elapsed: next caller is THE probe
+        t1 = asyncio.create_task(consume())
+        await asyncio.sleep(0.01)  # t1 claimed the probe and hangs
+        # a concurrent stream cannot enter: the probe slot is held
+        with pytest.raises(AllBackendsFailed, match="circuit open"):
+            await consume()
+        t1.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t1
+        # the cancelled probe released its slot: the next stream probes,
+        # commits, and closes the circuit
+        assert await consume() == ["recovered"]
+        assert fp.breaker_states()["b0"] == "closed"
+
+    asyncio.run(go())
+
+
+class _ParkedAwaitable:
+    """Yields once and parks — lets a test drive an async generator by hand
+    (no event loop) to a suspension point inside a backend await."""
+
+    def __await__(self):
+        yield self
+
+
+def test_failover_streaming_abandoned_probe_releases_slot_on_generator_exit():
+    """aclose() on the failover stream while it is suspended at the backend
+    await delivers GeneratorExit — NOT CancelledError — at the await point;
+    the probe slot must free on that path too (the streaming extension of the
+    cancelled-probe fix: a consumer that abandons the generator, e.g. the SSE
+    handler's finally-aclose after a disconnect, must not wedge the breaker)."""
+    now = [0.0]
+
+    class _Parked(_HangingStreamProvider):
+        async def stream_response(self, messages, max_tokens=1024, json_format=False):
+            self.calls += 1
+            await _ParkedAwaitable()
+            yield None  # pragma: no cover - never reached
+
+    fp = _chain(_Parked(), clock=lambda: now[0], breaker_threshold=1,
+                breaker_reset_s=10.0)
+    br = fp._breakers[0]
+    br.record_failure()
+    now[0] += 11.0
+    agen = fp.stream_response([{"role": "user", "content": "q"}])
+    step = agen.__anext__()
+    step.send(None)  # drive to the backend await: the probe slot is claimed
+    assert br._probing is True
+    # finalizing the abandoned consumer coroutine delivers GeneratorExit AT
+    # the backend await point (what coroutine cleanup does for a consumer
+    # that vanished without cancelling) — the handler must free the slot
+    with pytest.raises(GeneratorExit):
+        step.throw(GeneratorExit)
+    assert br._probing is False  # slot released — the next request may probe
+    assert br.allow() is True
+    br.release_probe()
+
+
 def test_failover_model_routing():
     from django_assistant_bot_tpu.ai.providers.failover import FailoverProvider
     from django_assistant_bot_tpu.ai.services.ai_service import get_ai_provider

@@ -198,7 +198,7 @@ def test_serve_cli_warmup_flag(monkeypatch):
     )
     monkeypatch.setattr(
         "django_assistant_bot_tpu.serving.server.run_server",
-        lambda host, port, registry: None,
+        lambda host, port, registry, drain_deadline_s=30.0: None,
     )
     args = argparse.Namespace(
         config=None, host="0.0.0.0", port=0, tiny=True, warmup=True
