@@ -33,6 +33,7 @@ from ..ops.attention import (
     dot_product_attention,
     gqa_dot_product_attention,
     paged_gqa_decode_attention,
+    paged_tree_attention,
 )
 from ..ops.norms import rms_norm
 from ..ops.quant import QTensor, qeinsum
@@ -1227,68 +1228,274 @@ def decode_step_paged(
     return logits.astype(jnp.float32), new_cache
 
 
-def verify_step(
+def _tree_qkv(cfg: DecoderConfig, p: Params, h: jnp.ndarray, cos, sin):
+    """QKV projections + RoPE for the tree-verify forward, ``h`` [B, T, E].
+
+    Deliberately NOT :func:`_attn_proj`: that helper annotates the position
+    dim with the logical ``length`` axis, and on this jaxlib the SPMD
+    partitioner miscompiles the fused speculative tick whenever the tiny
+    tree dim happens to divide the mesh ``seq`` axis — the "replicated"
+    input tokens come back multiplied by the axis size (observed 2x: token
+    351 -> 702 on a seq=2 mesh; the root cause of the old engine-level
+    greedy-equivalence xfail).  A <= 32-wide dim is not worth sequence-
+    sharding anyway, so the tree forward keeps it unannotated/replicated,
+    exactly like :func:`decode_step`'s Sq=1."""
+    B, T, _ = h.shape
+    H, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    q = qeinsum("bse,eo->bso", h, p["wq"], cfg.dtype)
+    k = qeinsum("bse,eo->bso", h, p["wk"], cfg.dtype)
+    v = qeinsum("bse,eo->bso", h, p["wv"], cfg.dtype)
+    if cfg.attn_bias:
+        q = q + p["bq"]
+        k = k + p["bk"]
+        v = v + p["bv"]
+    q = apply_rope(q.reshape(B, T, H, D), cos, sin).transpose(0, 2, 1, 3)
+    k = apply_rope(k.reshape(B, T, KH, D), cos, sin).transpose(0, 2, 1, 3)
+    v = v.reshape(B, T, KH, D).transpose(0, 2, 1, 3)
+    return q, k, v
+
+
+def _verify_tree_forward(
     params: Params,
     cfg: DecoderConfig,
-    seq: jnp.ndarray,  # [B, C] int32 — input token + C-1 speculative drafts
-    cache: KVCache,
-) -> tuple[jnp.ndarray, KVCache]:
-    """Multi-position decode forward for speculative verification.
+    tree: jnp.ndarray,  # [B, T] flat tree tokens (col 0 = root/input token)
+    lengths: jnp.ndarray,  # [B] valid cache tokens per row
+    k_rows: jnp.ndarray,  # [L, B, KH, S, D] logical cache rows (read-only)
+    v_rows: jnp.ndarray,
+    depths: jnp.ndarray,  # [T] int32 node depth (root = 0)
+    anc_mask: jnp.ndarray,  # [T, T] bool — anc_mask[t, u]: u ancestor-or-self of t
+):
+    """Shared body of the tree-verify step: one forward over every tree node.
 
-    Runs ``C`` contiguous positions per slot starting at ``cache.lengths[b]``
-    (the same position a plain :func:`decode_step` would use), writing K/V for
-    all of them, and returns logits at EVERY position ([B, C, V] f32) so the
-    caller (ops/speculative.accept_drafts) can accept the longest matching
-    draft prefix.  ``cache.lengths`` is NOT advanced here — the caller sets it
-    to ``lengths + n_new`` once acceptance is known; K/V written beyond that
-    point sits past the valid length, is masked out of every future attention,
-    and is overwritten when real tokens land there (the exact discipline
-    decode_step already relies on for freed slots).  Callers must guarantee
-    ``lengths + C <= max_len`` for rows whose acceptance they will take (the
-    engine finishes spec-mode requests ``C-1`` tokens before the cache limit,
-    so live rows always fit); free slots' garbage writes are harmless exactly
-    as in decode_step.
+    Node t takes absolute position ``lengths[b] + depths[t]`` (RoPE matches
+    what sequential decode would use), attends to the VERIFIED prefix
+    (cache positions < lengths — the cache is never written here) plus its
+    own root-path ancestors through the tree's freshly-projected K/V, and
+    returns logits for every node plus the per-layer tree K/V stacks the
+    caller commits for the accepted path only.
 
-    Structurally this is :func:`prefill_suffix` with identity slots (rows ARE
-    slots, so the duplicate-slot scatter scan is unnecessary) plus
-    all-position logits instead of last-token logits."""
-    B, C = seq.shape
-    S = cache.max_len
-    lengths = cache.lengths
-    pos = lengths[:, None] + jnp.arange(C)[None, :]  # [B, C] absolute positions
+    The whole forward traces under ``constraints_disabled()``: any logical
+    ``length`` annotation on the tiny tree dim (e.g. :func:`_mlp`'s hidden
+    constraint) lets this jaxlib's SPMD partitioner sequence-shard it when
+    T happens to divide the mesh ``seq`` axis, and that miscompiles the
+    fused speculative tick (observed: the "replicated" input tokens come
+    back summed across the axis, 351 -> 702 on a seq=2 mesh — the root
+    cause of the old engine-level greedy-equivalence xfail).  A <= 32-wide
+    dim gains nothing from sequence sharding; the heavy dims still shard by
+    propagation from the params and cache operands, exactly like
+    :func:`decode_step`'s Sq=1 forward.
+    """
+    from ..parallel.sharding import constraints_disabled
+
+    B, T = tree.shape
+    S = k_rows.shape[3]
+    pos = lengths[:, None] + depths[None, :]  # [B, T] absolute positions
     pos = jnp.minimum(pos, S - 1)
     cos_t, sin_t = _rope_tables(cfg, S)
-    cos, sin = cos_t[pos], sin_t[pos]
-    x = _embed(params, cfg, seq)  # [B, C, E]
+    cos, sin = cos_t[pos], sin_t[pos]  # [B, T, hd/2]
+    x = _embed(params, cfg, tree)  # [B, T, E]
     kpos = jnp.arange(S)[None, None, None, :]
-    causal_keep = kpos <= pos[:, None, :, None]  # [B, 1, C, S]
+    # cache part: every node sees the verified prefix only (strictly below
+    # lengths — the root's own K/V lives in the tree part, keeping the key
+    # set identical to a plain decode step at the same position)
+    prefix_keep = kpos < lengths[:, None, None, None]  # [B, 1, T, S]
+    prefix_keep = jnp.broadcast_to(prefix_keep, (B, 1, T, S))
 
     def make_body(window):
-        attn_mask = causal_keep
+        cache_mask = prefix_keep
+        tree_keep = anc_mask[None, None]  # [1, 1, T, T]
         if window is not None:
-            attn_mask = attn_mask & (kpos > pos[:, None, :, None] - window)
+            cache_mask = cache_mask & (kpos > pos[:, None, :, None] - window)
+            upos = lengths[:, None, None, None] + depths[None, None, None, :]
+            tree_keep = tree_keep & (upos > pos[:, None, :, None] - window)
+        tree_keep = jnp.broadcast_to(tree_keep, (B, 1, T, T))
+        attn_mask = jnp.concatenate([cache_mask, tree_keep], axis=3)
 
         def body(x, inputs):
-            p, k_cache, v_cache = inputs  # [B, KH, S, D] cache rows
+            p, k_row, v_row = inputs  # [B, KH, S, D] cache rows, read-only
             h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-            q, k, v = _attn_proj(cfg, p, h, cos, sin)
-            k_cache = _write_cache(k_cache, k, lengths)
-            v_cache = _write_cache(v_cache, v, lengths)
-            o = gqa_dot_product_attention(q, k_cache, v_cache, mask=attn_mask)
-            o = o.transpose(0, 2, 1, 3).reshape(B, C, -1)
+            q, k, v = _tree_qkv(cfg, p, h, cos, sin)
+            keys = jnp.concatenate([k_row.astype(k.dtype), k], axis=2)
+            vals = jnp.concatenate([v_row.astype(v.dtype), v], axis=2)
+            o = gqa_dot_product_attention(q, keys, vals, mask=attn_mask)
+            o = o.transpose(0, 2, 1, 3).reshape(B, T, -1)
             x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
             h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
             x = x + _mlp(cfg, p, h)
-            return x, (k_cache, v_cache)
+            return x, (k, v)
 
         return body
 
-    x, (ks, vs) = _scan_window_split(
-        cfg, make_body, x, (params["layers"], cache.k, cache.v)
+    with constraints_disabled():
+        x, (tks, tvs) = _scan_window_split(
+            cfg, make_body, x, (params["layers"], k_rows, v_rows)
+        )
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        logits = _head_logits(params, cfg, x)  # [B, T, V]
+    return logits.astype(jnp.float32), tks, tvs
+
+
+def verify_tree_step(
+    params: Params,
+    cfg: DecoderConfig,
+    tree: jnp.ndarray,  # [B, T] int32 flat speculation tree (col 0 = input)
+    cache: KVCache,
+    depths: jnp.ndarray,  # [T] int32
+    anc_mask: jnp.ndarray,  # [T, T] bool
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Tree-verify forward against the contiguous slot cache.
+
+    READ-ONLY with respect to the cache: unlike the old linear verify step
+    (which wrote K/V for every candidate and relied on the
+    garbage-beyond-length discipline), the tree step returns the candidate
+    K/V stacks ``(logits [B,T,V], tks, tvs [L,B,KH,T,D])`` and the caller
+    commits ONLY the accepted root-to-leaf path via
+    :func:`commit_tree_path` — the shape of write the paged layout can also
+    express (:func:`commit_tree_path_paged`), which is what lets
+    speculative engines keep ``kv_layout="paged"``.
+    """
+    return _verify_tree_forward(
+        params, cfg, tree, cache.lengths, cache.k, cache.v, depths, anc_mask
     )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    logits = _head_logits(params, cfg, x)  # [B, C, V]
-    return logits.astype(jnp.float32), KVCache(k=ks, v=vs, lengths=lengths)
+
+
+def verify_tree_step_paged(
+    params: Params,
+    cfg: DecoderConfig,
+    tree: jnp.ndarray,  # [B, T]
+    cache: PagedKVCache,
+    block_tables: jnp.ndarray,  # [B, NB]
+    depths: jnp.ndarray,
+    anc_mask: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Paged :func:`verify_tree_step`: the same read-only tree forward, with
+    the prefix read IN PLACE from the page pool
+    (:func:`~..ops.attention.paged_tree_attention` — one block-table gather
+    per logical page inside the online-softmax loop, the decode read's
+    structure with tree-wide queries).  The speculative tick is the paged
+    plane's steady-state decode path, so it must not materialise a dense
+    [L, B, KH, S, D] copy of every logical row per tick the way the
+    batched-prefill gathers do.  Traces under ``constraints_disabled()``
+    for the same partitioner reason as :func:`_verify_tree_forward`."""
+    from ..parallel.sharding import constraints_disabled
+
+    B, T = tree.shape
+    L, P, KH, page, D = cache.k.shape
+    NB = block_tables.shape[1]
+    S = NB * page
+    lengths = cache.lengths
+    pos = jnp.minimum(lengths[:, None] + depths[None, :], S - 1)
+    cos_t, sin_t = _rope_tables(cfg, S)
+    cos, sin = cos_t[pos], sin_t[pos]
+    x = _embed(params, cfg, tree)
+
+    def make_body(window):
+        def body(x, inputs):
+            p, k_pool, v_pool = inputs  # [P, KH, page, D] per layer
+            h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _tree_qkv(cfg, p, h, cos, sin)
+            o = paged_tree_attention(
+                q, k_pool, v_pool, block_tables, lengths, k, v,
+                anc_mask, depths, window=window,
+            )
+            o = o.transpose(0, 2, 1, 3).reshape(B, T, -1)
+            x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
+            h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(cfg, p, h)
+            return x, (k, v)
+
+        return body
+
+    with constraints_disabled():
+        x, (tks, tvs) = _scan_window_split(
+            cfg, make_body, x, (params["layers"], cache.k, cache.v)
+        )
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        logits = _head_logits(params, cfg, x)
+    return logits.astype(jnp.float32), tks, tvs
+
+
+def _gather_tree_path(tks: jnp.ndarray, path_idx: jnp.ndarray) -> jnp.ndarray:
+    """[L, B, KH, T, D] tree K/V stack + [B, C] flat node ids -> [L, B, KH, C, D]."""
+    L, B, KH, T, D = tks.shape
+    idx = jnp.broadcast_to(
+        path_idx[None, :, None, :, None], (L, B, KH, path_idx.shape[1], D)
+    )
+    return jnp.take_along_axis(tks, idx, axis=3)
+
+
+def commit_tree_path(
+    cache: KVCache,
+    tks: jnp.ndarray,  # [L, B, KH, T, D] from verify_tree_step
+    tvs: jnp.ndarray,
+    path_idx: jnp.ndarray,  # [B, C] flat tree ids: root + winning branch
+) -> KVCache:
+    """Write the accepted path's K/V at contiguous positions
+    ``[lengths, lengths + C)`` of each slot row.
+
+    Positions beyond the accepted run receive the rejected remainder of the
+    winning branch — garbage past the new valid length, masked out of every
+    future attention and overwritten when real tokens land there: the exact
+    discipline the contiguous layout already relies on, so no masking is
+    needed here.  ``cache.lengths`` is NOT advanced (the caller sets it to
+    ``lengths + n_new`` once acceptance is known).  Callers must guarantee
+    ``lengths + C <= max_len`` for rows whose acceptance they will take (the
+    engine finishes spec-mode requests ``C-1`` tokens before the cache
+    limit, so live rows always fit)."""
+    pk = _gather_tree_path(tks, path_idx)
+    pv = _gather_tree_path(tvs, path_idx)
+
+    def upd(c, n, s):
+        return jax.lax.dynamic_update_slice(c, n.astype(c.dtype), (0, 0, s, 0))
+
+    k = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(cache.k, pk, cache.lengths)
+    v = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(cache.v, pv, cache.lengths)
+    return KVCache(k=k, v=v, lengths=cache.lengths)
+
+
+def commit_tree_path_paged(
+    cache: PagedKVCache,
+    tks: jnp.ndarray,  # [L, B, KH, T, D] from verify_tree_step_paged
+    tvs: jnp.ndarray,
+    path_idx: jnp.ndarray,  # [B, C]
+    block_tables: jnp.ndarray,  # [B, NB]
+    n_commit: jnp.ndarray,  # [B] — tokens of the path to commit (1 + accepted)
+    active: jnp.ndarray,  # [B] bool
+) -> PagedKVCache:
+    """Paged accepted-path commit: a drop-masked ``[B, C]`` scatter through
+    the block table — position ``lengths + j`` lands in page
+    ``block_table[b, (lengths+j) // page]`` at offset ``(lengths+j) % page``.
+
+    Unlike the contiguous commit, the paged layout may NOT write garbage:
+    a rejected-candidate write beyond the accepted run could land in the
+    slot's reservation tail — harmless — but one beyond the reservation
+    would alias a page since handed to another request.  So the scatter
+    drops (page-sentinel discipline, PR 6) everything except the accepted
+    prefix of active rows inside the row's allocation: ``j < n_commit``,
+    ``active``, block table entry < P, and position inside the logical row.
+    """
+    L, P, KH, page, D = cache.k.shape
+    B, C = path_idx.shape
+    NB = block_tables.shape[1]
+    S = NB * page
+    lengths = cache.lengths
+    pk = _gather_tree_path(tks, path_idx)  # [L, B, KH, C, D]
+    pv = _gather_tree_path(tvs, path_idx)
+    k, v = cache.k, cache.v
+    for j in range(C):
+        pos = lengths + j
+        ok = active & (j < n_commit) & (pos < S)
+        blk = jnp.minimum(pos // page, NB - 1)
+        off = jnp.where(ok, pos % page, 0)
+        phys = jnp.take_along_axis(block_tables, blk[:, None], axis=1)[:, 0]
+        phys_w = jnp.where(ok, jnp.minimum(phys, P), P)
+        # advanced indices (dims 1 and 3) are separated by a slice, so the
+        # batch dim moves to the FRONT of the updated view: values [B, L, KH, D]
+        kj = pk[:, :, :, j, :].transpose(1, 0, 2, 3)
+        vj = pv[:, :, :, j, :].transpose(1, 0, 2, 3)
+        k = k.at[:, phys_w, :, off, :].set(kj.astype(k.dtype), mode="drop")
+        v = v.at[:, phys_w, :, off, :].set(vj.astype(v.dtype), mode="drop")
+    return PagedKVCache(k=k, v=v, lengths=lengths)
 
 
 def decode_step(

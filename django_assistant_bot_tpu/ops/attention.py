@@ -271,6 +271,105 @@ def paged_gqa_decode_attention(
     return out.reshape(B, H, 1, D)
 
 
+def paged_tree_attention(
+    q: jnp.ndarray,  # [B, H, T, D] — one query per speculation-tree node
+    k_pool: jnp.ndarray,  # [P, KH, page, D] page pool, storage dtype
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, NB] int32; entries >= P unallocated
+    lengths: jnp.ndarray,  # [B] int32 — verified prefix length per row
+    tree_k: jnp.ndarray,  # [B, KH, T, D] — the tree's freshly-projected keys
+    tree_v: jnp.ndarray,
+    anc_mask: jnp.ndarray,  # [T, T] bool — anc_mask[t, u]: u ancestor-or-self of t
+    depths: jnp.ndarray,  # [T] int32 node depths (root = 0)
+    *,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Tree-query variant of :func:`paged_gqa_decode_attention` for the
+    speculative verify forward: every tree node attends to the row's
+    verified prefix (positions ``< lengths``, read IN PLACE from the page
+    pool one block-table gather per logical block — never a materialised
+    dense copy of the logical row) plus its own root-path ancestors through
+    the tree's fresh K/V, processed as one final masked chunk in the same
+    online-softmax stream.
+
+    The page loop reuses the decode read's structure exactly (same loop
+    bounds, same masking, same f32 running max/sum/acc); the tree chunk is
+    one more update with the ancestor mask in place of the positional one.
+    Reduced-precision pools dequantize per page like the decode path.
+    """
+    B, H, T, D = q.shape
+    P, KH, page, _ = k_pool.shape
+    NB = block_tables.shape[1]
+    G = H // KH
+    scale = D ** -0.5
+    qg = q.reshape(B, KH, G, T, D)
+    # pages [lo, hi) cover every row's verified prefix (lengths == 0 rows
+    # read nothing from the pool; their tree self-attention keeps l > 0)
+    hi = jnp.minimum((jnp.max(lengths) + page - 1) // page, NB)
+    qpos = lengths[:, None] + depths[None, :]  # [B, T] query positions
+    if window is not None:
+        lo = jnp.minimum(
+            jnp.maximum(jnp.min(lengths) - window + 1, 0) // page, hi
+        )
+    else:
+        lo = jnp.zeros((), hi.dtype)
+
+    def body(ci, carry):
+        m, l, acc = carry
+        phys = jax.lax.dynamic_slice_in_dim(block_tables, ci, 1, axis=1)[:, 0]
+        phys = jnp.clip(phys, 0, P - 1)
+        k_blk = jnp.take(k_pool, phys, axis=0)  # [B, KH, page, D]
+        v_blk = jnp.take(v_pool, phys, axis=0)
+        if k_blk.dtype != q.dtype:
+            k_blk = k_blk.astype(q.dtype)
+            v_blk = v_blk.astype(q.dtype)
+        s = jnp.einsum(
+            "bkgtd,bksd->bkgts", qg, k_blk, preferred_element_type=jnp.float32
+        ) * scale  # [B, KH, G, T, page]
+        kpos = ci * page + jnp.arange(page)
+        keep = kpos[None, None, :] < lengths[:, None, None]  # [B, 1, page]
+        if window is not None:
+            keep = keep & (kpos[None, None, :] > qpos[:, :, None] - window)
+        s = jnp.where(keep[:, None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jnp.einsum(
+            "bkgts,bksd->bkgtd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((B, KH, G, T, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, KH, G, T, 1), jnp.float32)
+    a0 = jnp.zeros((B, KH, G, T, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(lo, hi, body, (m0, l0, a0))
+    # the tree itself, as the final online-softmax chunk: ancestor-masked
+    # (root attends to itself, so every live query has l > 0 even at
+    # lengths == 0)
+    tk = tree_k.astype(q.dtype) if tree_k.dtype != q.dtype else tree_k
+    tv = tree_v.astype(q.dtype) if tree_v.dtype != q.dtype else tree_v
+    s = jnp.einsum(
+        "bkgtd,bkud->bkgtu", qg, tk, preferred_element_type=jnp.float32
+    ) * scale  # [B, KH, G, T, T]
+    keep = anc_mask[None, :, :]  # [1, T, T]
+    if window is not None:
+        upos = lengths[:, None] + depths[None, :]  # [B, T] key positions
+        keep = keep & (upos[:, None, :] > qpos[:, :, None] - window)
+    s = jnp.where(keep[:, None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc = alpha * acc + jnp.einsum(
+        "bkgtu,bkud->bkgtd", p.astype(tv.dtype), tv,
+        preferred_element_type=jnp.float32,
+    )
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    return out.reshape(B, H, T, D)
+
+
 # ---------------------------------------------------------------------------
 # Pallas flash attention
 # ---------------------------------------------------------------------------

@@ -1,19 +1,28 @@
-"""Prompt-lookup speculative decoding: on-device n-gram drafts + acceptance.
+"""Tree-verified prompt-lookup speculative decoding: drafts, acceptance, control.
 
 The reference's core workload is "answer from the provided context"
 (assistant/bot/services/context_service/steps/final_prompt.py packs retrieved
 documents into the prompt) — exactly the regime where generated text copies
-long spans of the prompt, and where prompt-lookup decoding (PLD: draft the K
-tokens that followed the last occurrence of the current n-gram in the
-prompt/history, verify all K in ONE forward) multiplies single-stream decode
-throughput without any draft model.
+long spans of the prompt, and where prompt-lookup decoding (draft the tokens
+that followed an occurrence of the current n-gram in the prompt/history,
+verify them in ONE forward) multiplies single-stream decode throughput
+without any draft model.
 
-TPU-native formulation: both the draft construction and the acceptance rule
-are pure static-shape array programs that fuse into the engine's decode tick
-— the draft source is a DEVICE-resident token-history buffer, so the whole
-speculative step (draft -> verify -> accept -> cache/length update) chains
-tick-to-tick on device with zero host round trips.  A host-side draft builder
-would cost one tunnel RTT (~90 ms) per tick — more than the tokens it saves.
+This module is the SpecInfer-style generalisation of the original single-
+candidate draft: instead of one linear K-token guess, the drafter emits the
+top-N DISTINCT continuations (bigram hits ranked by recency, deduplicated on
+their first token, unigram fallback) as a static token TREE — a shared root
+(the pending input token) plus N linear branches of depth K, flattened into
+a fixed ``[B, T]`` layout (T = 1 + N*K) with a precomputed ancestor mask.
+One fused verify forward scores every node (positions share the verified
+prefix and diverge per branch through the mask), and acceptance takes the
+longest root-to-leaf path that matches the model's own argmax.  A single
+wrong guess no longer wastes the whole verify tick: any branch can win.
+
+TPU-native formulation: draft construction, verification and acceptance are
+pure static-shape array programs that fuse into the engine's decode tick —
+the draft source is a DEVICE-resident token-history buffer, so the whole
+speculative step chains tick-to-tick on device with zero host round trips.
 
 Greedy rows (temperature <= 0) accept drafts exactly (verified against the
 model's own argmax); sampled rows simply take the position-0 token
@@ -21,34 +30,108 @@ model's own argmax); sampled rows simply take the position-0 token
 same scope production PLD implementations choose.
 
 Equivalence guarantee, stated precisely: speculative greedy output equals
-non-speculative greedy output in exact arithmetic, and is bit-identical on
-the f32 CPU mesh (tested).  On bf16 MXU hardware the 1-token and
-(K+1)-token forwards accumulate in different orders, so an argmax decided by
-a near-tie (observed delta ~5e-5 at 1B geometry) can break differently —
-the same class of divergence that changing the prefill bucket or slot count
-already produces.  Within one speculative deployment, decoding is
-self-consistent: accepted tokens are exactly what the verify program's
-argmax produces.
+non-speculative greedy output in exact arithmetic, and token-identically on
+the f32 CPU mesh (property-tested in tests/test_speculative.py across ragged
+batches, mixed temperatures and no-match rows).  The bf16 near-tie caveat
+(and the jaxlib sequence-sharding pitfall the verify program must avoid)
+are documented in docs/SPECULATIVE.md.
+
+The :class:`SpecController` at the bottom is the host-side acceptance-EMA
+policy: it shrinks the tree (width, then depth) when measured acceptance
+cannot pay for the verify forward, and disables speculation entirely below
+the measured verify/decode breakeven — so speculation can never be a
+sustained slowdown.  Pure python, unit-testable without a device.
 """
 
 from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
 
 
-def build_prompt_lookup_draft(
+class TreeSpec(NamedTuple):
+    """Static layout of one speculation tree: a root (flat index 0, the
+    pending input token) plus ``width`` linear branches of ``depth`` draft
+    tokens.  Node (n, d) lives at flat index ``1 + n*depth + d``.
+
+    All arrays are host-side numpy constants baked into the jitted tick —
+    the tree SHAPE never changes inside a compiled program (the adaptive
+    controller switches between a small ladder of precompiled shapes).
+    """
+
+    width: int
+    depth: int
+    size: int  # T = 1 + width*depth flattened nodes
+    depths: np.ndarray  # [T] int32 — node depth; root = 0
+    parent: np.ndarray  # [T] int32 — flat parent index; root's parent = 0
+    anc_mask: np.ndarray  # [T, T] bool — anc_mask[t, u]: u is ancestor-of-or t
+    branch_nodes: np.ndarray  # [width, depth] int32 — flat ids, depth order
+
+
+def make_tree_spec(width: int, depth: int) -> TreeSpec:
+    """Precompute the flat layout + ancestor mask for an (N, K) tree."""
+    width = max(1, int(width))
+    depth = max(1, int(depth))
+    T = 1 + width * depth
+    depths = np.zeros((T,), np.int32)
+    parent = np.zeros((T,), np.int32)
+    branch_nodes = np.zeros((width, depth), np.int32)
+    for n in range(width):
+        for d in range(depth):
+            t = 1 + n * depth + d
+            branch_nodes[n, d] = t
+            depths[t] = d + 1
+            parent[t] = 0 if d == 0 else t - 1
+    anc = np.zeros((T, T), bool)
+    for t in range(T):
+        u = t
+        anc[t, t] = True
+        while u != 0:
+            u = parent[u]
+            anc[t, u] = True
+    return TreeSpec(
+        width=width,
+        depth=depth,
+        size=T,
+        depths=depths,
+        parent=parent,
+        anc_mask=anc,
+        branch_nodes=branch_nodes,
+    )
+
+
+def build_tree_draft(
     history: jnp.ndarray,  # [B, S] int32 token history rows
     lengths: jnp.ndarray,  # [B] cache lengths; history[b, :lengths[b]] is valid
     tokens: jnp.ndarray,  # [B] the pending input token (sequence pos lengths[b])
-    k: int,
+    width: int,
+    depth: int,
 ) -> jnp.ndarray:
-    """Draft [B, k]: the tokens that followed the last occurrence of the
-    current tail bigram (fallback: unigram) in each row's history.
+    """Draft [B, width, depth]: the top-``width`` distinct continuations of the
+    current tail bigram in each row's history, most recent first.
 
-    Rows with no match draft from position `n` (garbage/stale tokens) — their
-    drafts are simply rejected by verification; correctness never depends on
-    the draft.  O(B*S) compares — noise next to one decode matmul."""
+    Candidate ranking: every position where the tail bigram
+    ``(history[n-2], tokens)`` occurred is a candidate start; candidates are
+    DEDUPLICATED on their first continuation token (two hits proposing the
+    same next token would waste tree width verifying it twice — the most
+    recent occurrence survives, carrying the freshest continuation), then the
+    ``width`` most recent survivors fill the branches.  The first branch is
+    exactly the old single-candidate prompt-lookup draft, so (width=1) is a
+    strict superset of the previous behavior.  One spare branch falls back to
+    the unigram (last occurrence of ``tokens`` alone) when bigram hits don't
+    fill the tree.  Unfilled branches draft from position ``n``
+    (garbage/stale tokens) — their drafts are simply rejected by
+    verification; correctness never depends on the draft.
+
+    Cost: the dedup is an O(B*S^2) boolean compare — elementwise, fused, and
+    at serving contexts still noise next to one decode matmul; the rest is
+    O(B*S) like the original builder.
+    """
     B, S = history.shape
     n = lengths + 1  # known sequence tokens incl. the pending input
     js = jnp.arange(S - 1)
@@ -58,60 +141,340 @@ def build_prompt_lookup_draft(
     # bigram (prev, tokens) at (j, j+1), ending strictly before the tail bigram
     big = (history[:, :-1] == prev[:, None]) & (history[:, 1:] == tokens[:, None])
     big = big & ((js[None, :] + 1) < (n - 1)[:, None])
-    has2 = big.any(axis=1)
-    j2 = jnp.max(jnp.where(big, js[None, :], -1), axis=1)
+    # first continuation token of candidate j is history[j+2]
+    first_tok = jnp.take_along_axis(
+        history, jnp.clip(js + 2, 0, S - 1)[None, :].repeat(B, axis=0), axis=1
+    )  # [B, S-1]
+    # dedup on the first continuation token: candidate j is dominated when a
+    # LATER candidate proposes the same next token (keep the most recent)
+    same = first_tok[:, :, None] == first_tok[:, None, :]  # [B, j, j']
+    later = js[None, :] > js[:, None]  # [j, j'] — j' more recent than j
+    dominated = jnp.any(same & later[None] & big[:, None, :], axis=2)
+    keep = big & ~dominated
+    # width most recent distinct candidates, by position (desc)
+    ranked = jnp.where(keep, js[None, :], -1)
+    top_pos, _ = jax.lax.top_k(ranked, width)  # [B, width] positions, -1 = none
+    n_big = jnp.sum(top_pos >= 0, axis=1)  # [B] filled bigram branches
     # unigram fallback: last occurrence of `tokens` strictly before pos n-1
     jsf = jnp.arange(S)
     uni = (history == tokens[:, None]) & (jsf[None, :] < (n - 1)[:, None])
     has1 = uni.any(axis=1)
     j1 = jnp.max(jnp.where(uni, jsf[None, :], -1), axis=1)
-    start = jnp.where(has2, j2 + 2, jnp.where(has1, j1 + 1, n))
-    idx = jnp.clip(start[:, None] + jnp.arange(k)[None, :], 0, S - 1)
-    return jnp.take_along_axis(history, idx, axis=1)
+    bidx = jnp.arange(width)[None, :]  # [1, width]
+    starts = jnp.where(
+        top_pos >= 0,
+        top_pos + 2,
+        jnp.where(
+            (bidx == n_big[:, None]) & has1[:, None],
+            (j1 + 1)[:, None],
+            n[:, None],  # unfilled: rejectable garbage from the tail
+        ),
+    )  # [B, width]
+    idx = jnp.clip(
+        starts[:, :, None] + jnp.arange(depth)[None, None, :], 0, S - 1
+    )  # [B, width, depth]
+    return jnp.take_along_axis(history[:, None, :], idx, axis=2)
 
 
-def accept_drafts(
-    logits: jnp.ndarray,  # [B, C, V] f32 — verify logits; C = K+1
-    seq: jnp.ndarray,  # [B, C] int32 — col 0 = input token, cols 1..K = drafts
+def flatten_tree(tokens: jnp.ndarray, draft: jnp.ndarray) -> jnp.ndarray:
+    """[B] input tokens + [B, N, K] branch drafts -> flat tree [B, T]."""
+    B = tokens.shape[0]
+    return jnp.concatenate([tokens[:, None], draft.reshape(B, -1)], axis=1)
+
+
+def accept_tree(
+    logits: jnp.ndarray,  # [B, T, V] f32 — verify logits over the flat tree
+    tree: jnp.ndarray,  # [B, T] int32 flat tree tokens (col 0 = input token)
+    spec: TreeSpec,
     rng: jax.Array,
     *,
     temperature: jnp.ndarray,  # [B]
     top_k: int,
     top_p: jnp.ndarray,  # [B]
 ):
-    """Longest-prefix greedy acceptance + one bonus/corrected token per row.
+    """Longest root-to-leaf acceptance + one bonus/corrected token per row.
 
-    Returns (out [B, C] — out[b, :n_new[b]] are the new sequence tokens,
-    n_new [B] in [1, C], bonus [B] — the next tick's input token, rng).
+    Returns ``(out [B, K+1], n_new [B] in [1, K+1], bonus [B], path_idx
+    [B, K+1], rng)`` where ``out[b, :n_new[b]]`` are the new sequence tokens,
+    ``bonus`` is the next tick's input token and ``path_idx`` are the flat
+    tree indices whose K/V the caller must commit (root first, then the
+    winning branch — garbage beyond the accepted run, exactly like ``out``).
 
-    Greedy rows: draft d_i is accepted iff the model's argmax at the previous
-    position equals it AND every earlier draft was accepted; the token after
-    the accepted run is the model's own argmax there (exactly what
-    non-speculative greedy would have produced — equivalence is testable and
-    tested).  Sampled rows accept nothing and sample position 0 with their own
-    temperature/top-p, so one compiled program serves mixed batches."""
+    Greedy rows: branch node (n, d) is accepted iff the model's argmax at its
+    PARENT equals its token AND every shallower node of the branch was
+    accepted; the winning branch is the one with the longest accepted run
+    (ties: lowest branch index, i.e. the most recent bigram hit), and the
+    token after the run is the model's own argmax there — exactly what
+    non-speculative greedy would have produced at every accepted position,
+    so the equivalence contract of the linear verifier carries over
+    unchanged.  Sampled rows accept nothing and sample position 0 with their
+    own temperature/top-p, so one compiled program serves mixed batches.
+    """
     from .sampling import sample_logits
 
-    B, C, _ = logits.shape
-    greedy_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, C]
+    B = logits.shape[0]
+    N, K = spec.width, spec.depth
+    branch = jnp.asarray(spec.branch_nodes)  # [N, K]
+    greedy_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
     rng, sub = jax.random.split(rng)
     samp0 = sample_logits(
         logits[:, 0], sub, temperature=temperature, top_k=top_k, top_p=top_p
     )
     greedy_row = temperature <= 0.0
-    match = (greedy_next[:, :-1] == seq[:, 1:]) & greedy_row[:, None]  # [B, K]
-    n_acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)  # leading run
-    bonus_greedy = jnp.take_along_axis(greedy_next, n_acc[:, None], axis=1)[:, 0]
+    # parent prediction for node (n, d): argmax at the parent node
+    parent_idx = jnp.asarray(spec.parent)[branch]  # [N, K]
+    pred = greedy_next[:, parent_idx.reshape(-1)].reshape(B, N, K)
+    tok = jnp.take_along_axis(tree[:, None, :].repeat(N, 1), branch[None], axis=2)
+    match = (tok == pred) & greedy_row[:, None, None]  # [B, N, K]
+    acc = jnp.cumprod(match.astype(jnp.int32), axis=2).sum(axis=2)  # [B, N]
+    best = jnp.argmax(acc, axis=1).astype(jnp.int32)  # [B] first max wins
+    n_acc = jnp.take_along_axis(acc, best[:, None], axis=1)[:, 0]  # [B]
+    win_nodes = branch[best]  # [B, K] flat ids of the winning branch
+    # the node whose argmax is the bonus: root when nothing accepted, else
+    # the deepest accepted node of the winning branch
+    last_idx = jnp.where(
+        n_acc > 0,
+        jnp.take_along_axis(
+            win_nodes, jnp.maximum(n_acc - 1, 0)[:, None], axis=1
+        )[:, 0],
+        0,
+    )
+    bonus_greedy = jnp.take_along_axis(greedy_next, last_idx[:, None], axis=1)[:, 0]
     # at temp<=0 sample_logits IS argmax, so samp0 == bonus_greedy when n_acc==0
     bonus = jnp.where(greedy_row, bonus_greedy, samp0)
-    js = jnp.arange(C)[None, :]
-    accepted = jnp.concatenate(
-        [seq[:, 1:], jnp.zeros((B, 1), seq.dtype)], axis=1
-    )  # accepted candidate at output index j is seq[:, j+1]
+    win_toks = jnp.take_along_axis(tree, win_nodes, axis=1)  # [B, K]
+    js = jnp.arange(K + 1)[None, :]
+    accepted = jnp.concatenate([win_toks, jnp.zeros((B, 1), tree.dtype)], axis=1)
     out = jnp.where(
         js < n_acc[:, None],
         accepted,
         jnp.where(js == n_acc[:, None], bonus[:, None], 0),
     ).astype(jnp.int32)
+    # commit gather: root's K/V at output position 0, branch node d at 1 + d
+    path_idx = jnp.concatenate(
+        [jnp.zeros((B, 1), jnp.int32), win_nodes.astype(jnp.int32)], axis=1
+    )  # [B, K+1]
     n_new = n_acc + 1
-    return out, n_new.astype(jnp.int32), bonus.astype(jnp.int32), rng
+    return out, n_new.astype(jnp.int32), bonus.astype(jnp.int32), path_idx, rng
+
+
+# Backwards-compatible linear helpers -------------------------------------
+
+
+def build_prompt_lookup_draft(
+    history: jnp.ndarray,
+    lengths: jnp.ndarray,
+    tokens: jnp.ndarray,
+    k: int,
+) -> jnp.ndarray:
+    """Single-candidate prompt-lookup draft [B, k] — the width-1 tree."""
+    return build_tree_draft(history, lengths, tokens, 1, k)[:, 0]
+
+
+def breakeven_accept_rate(cost_ratio: float, depth: int) -> float:
+    """Per-position accept probability ``p`` at which a (·, depth) verify
+    tick exactly pays for itself against a plain decode tick that costs
+    ``1/cost_ratio`` as much: solves E[tokens/tick] = (1 - p^(K+1))/(1 - p)
+    = cost_ratio by bisection.  cost_ratio <= 1 means speculation is free
+    (breakeven 0); an unreachable ratio (> K+1 tokens/tick) returns 1.0.
+    """
+    K = max(1, int(depth))
+    r = float(cost_ratio)
+    if r <= 1.0:
+        return 0.0
+    if r >= K + 1:
+        return 1.0
+
+    def expected(p: float) -> float:
+        if p >= 1.0:
+            return K + 1.0
+        return (1.0 - p ** (K + 1)) / (1.0 - p)
+
+    lo, hi = 0.0, 1.0
+    for _ in range(60):
+        mid = (lo + hi) / 2.0
+        if expected(mid) < r:
+            lo = mid
+        else:
+            hi = mid
+    return (lo + hi) / 2.0
+
+
+def default_rungs(width: int, depth: int) -> List[Tuple[int, int]]:
+    """The controller's shrink ladder: full tree -> half width -> single
+    branch -> half depth.  Deduplicated, widest first."""
+    rungs: List[Tuple[int, int]] = []
+    for w, k in (
+        (width, depth),
+        (max(1, width // 2), depth),
+        (1, depth),
+        (1, max(1, depth // 2)),
+    ):
+        if (w, k) not in rungs:
+            rungs.append((w, k))
+    return rungs
+
+
+@dataclasses.dataclass
+class SpecController:
+    """Per-rung acceptance-EMA bandit over a ladder of precompiled tree
+    shapes.
+
+    Tree width only pays off by RAISING acceptance (more candidates per
+    depth), so a single shared accept probability can never justify a wider
+    tree over a narrower one — each rung keeps its OWN per-position
+    accept-probability EMA ``p[rung]``, measured only from ticks that rung
+    actually ran, initialised optimistically so every shape gets tried.
+    Per tick the controller compares each rung's expected speedup
+    ``E[tokens/tick] / cost_ratio(rung)`` with ``E = (1 - p^(K+1))/(1 - p)``
+    (cost ratios measured by the engine: verify-tick seconds / plain-tick
+    seconds).  Policy:
+
+    - run the BEST rung by expected speedup, with a periodic exploration
+      tick on the next-wider rung so a stale "width doesn't pay" estimate
+      can be revised when the workload shifts;
+    - when even the best rung's expected speedup is below ``margin``,
+      disable speculation (plain ticks) — but re-probe with one speculative
+      tick every ``probe_every`` ticks so a workload shift (e.g. the model
+      starts quoting its context) can re-enable it;
+    - composes with the scheduler's under-load disable, which is checked by
+      the engine FIRST (an overloaded engine never speculates regardless of
+      acceptance).
+
+    Pure host-side python: deterministic, unit-testable without a device.
+    """
+
+    rungs: List[Tuple[int, int]]  # (width, depth) ladder, widest first
+    alpha: float = 0.15  # acceptance EMA smoothing
+    margin: float = 1.0  # minimum expected speedup to keep speculating
+    probe_every: int = 64  # disabled-state re-probe cadence (ticks)
+    explore_every: int = 32  # enabled-state wider-rung refresh cadence
+    init_accept: float = 0.5  # optimistic prior: start speculating
+    accept_ema: dict = dataclasses.field(default_factory=dict)  # rung -> p
+    cost_ratio: dict = dataclasses.field(default_factory=dict)
+    disabled: bool = False
+    _ticks_since_probe: int = 0
+    _ticks_since_explore: int = 0
+
+    def __post_init__(self):
+        if not self.rungs:
+            raise ValueError("SpecController needs at least one rung")
+        self.rungs = [tuple(r) for r in self.rungs]
+        for rung in self.rungs:
+            self.accept_ema.setdefault(rung, float(self.init_accept))
+        self._rung_idx = 0
+
+    # ---------------------------------------------------------------- inputs
+    def note_cost(self, rung: Tuple[int, int], ratio: float) -> None:
+        """Record a measured verify/plain tick-cost ratio for ``rung``."""
+        self.cost_ratio[tuple(rung)] = max(1.0, float(ratio))
+
+    def note_tick(
+        self,
+        accepted: int,
+        depth: int,
+        rows: int = 1,
+        rung: Optional[Tuple[int, int]] = None,
+    ) -> None:
+        """Fold one speculative tick's greedy-row acceptance into ``rung``'s
+        EMA: ``accepted`` drafts accepted out of ``depth`` offered, over
+        ``rows`` greedy rows (rows==0 ticks carry no signal and are
+        ignored).  ``rung=None`` resolves to the deepest ladder entry of
+        that depth (back-compat for depth-only callers)."""
+        if rows <= 0 or depth <= 0:
+            return
+        if rung is None:
+            rung = next(
+                (r for r in self.rungs if r[1] == depth), self.rungs[0]
+            )
+        rung = tuple(rung)
+        rate = min(1.0, max(0.0, accepted / (rows * depth)))
+        prev = self.accept_ema.get(rung, float(self.init_accept))
+        self.accept_ema[rung] = (1 - self.alpha) * prev + self.alpha * rate
+
+    # ---------------------------------------------------------------- policy
+    def _cost(self, rung: Tuple[int, int]) -> float:
+        got = self.cost_ratio.get(tuple(rung))
+        if got is not None:
+            return got
+        # unmeasured default: each extra verified position costs a fraction
+        # of a plain tick (attention grows, projections amortise) — a
+        # deliberately conservative stand-in until the engine feeds a
+        # measurement
+        w, k = rung
+        return 1.0 + 0.15 * (1 + w * k - 1)
+
+    def expected_tokens(self, rung: Tuple[int, int]) -> float:
+        p = min(self.accept_ema.get(tuple(rung), self.init_accept), 0.999999)
+        k = rung[1]
+        return (1.0 - p ** (k + 1)) / (1.0 - p)
+
+    def expected_speedup(self, rung: Tuple[int, int]) -> float:
+        return self.expected_tokens(rung) / self._cost(rung)
+
+    def best_rung(self) -> Tuple[int, Tuple[int, int], float]:
+        """(index, rung, expected speedup) of the best rung right now."""
+        best_i, best_s = 0, -1.0
+        for i, rung in enumerate(self.rungs):
+            s = self.expected_speedup(rung)
+            if s > best_s:
+                best_i, best_s = i, s
+        return best_i, self.rungs[best_i], best_s
+
+    def rung(self) -> Optional[Tuple[int, int]]:
+        """The tree shape to issue THIS tick, or None for a plain tick.
+
+        Call exactly once per issued tick: while disabled it also advances
+        the probe counter (returning a rung on probe ticks); while enabled
+        it occasionally returns the next-WIDER rung than the current best to
+        refresh that rung's acceptance estimate."""
+        i, rung, speedup = self.best_rung()
+        if not self.disabled:
+            if speedup < self.margin:
+                self.disabled = True
+                self._ticks_since_probe = 0
+                return None
+            self._ticks_since_explore += 1
+            if i > 0 and self._ticks_since_explore >= self.explore_every:
+                # exploration: the wider neighbour's estimate may be stale —
+                # one tick of evidence keeps the ladder climbable
+                self._ticks_since_explore = 0
+                self._rung_idx = i - 1
+                return self.rungs[i - 1]
+            self._rung_idx = i
+            return rung
+        # disabled: mostly plain ticks, with a periodic speculative probe so
+        # acceptance evidence keeps flowing (otherwise disable is forever)
+        self._ticks_since_probe += 1
+        if self._ticks_since_probe >= self.probe_every:
+            self._ticks_since_probe = 0
+            self._rung_idx = i
+            return rung
+        if speedup >= self.margin:
+            self.disabled = False
+            self._rung_idx = i
+            return rung
+        return None
+
+    def current(self) -> Tuple[int, int]:
+        """The rung most recently issued (for stats/gauges)."""
+        return self.rungs[self._rung_idx]
+
+    def stats(self) -> dict:
+        w, k = self.current()
+        i, rung, speedup = self.best_rung()
+        return {
+            "spec_accept_ema": round(
+                self.accept_ema.get((w, k), self.init_accept), 4
+            ),
+            "spec_tree_width": w,
+            "spec_tree_depth": k,
+            "spec_auto_disabled": self.disabled,
+            "spec_expected_speedup": round(speedup, 3),
+            # per-arm acceptance: each tree shape's own measured EMA (rungs
+            # that never ran still show the optimistic prior)
+            "spec_rung_accept_emas": {
+                f"{rw}x{rk}": round(p, 4)
+                for (rw, rk), p in sorted(self.accept_ema.items())
+            },
+        }

@@ -207,6 +207,10 @@ class _TickRef:
     # speculative tick: [max_slots] valid-token counts — entry k of nxt[:, b]
     # is real only for k < n_new[b] (the rest are rejected-draft garbage)
     n_new: Any = None
+    # (width, depth) rung the speculative tick drafted at (the controller may
+    # issue a narrower/shallower rung than the config maximum — acceptance
+    # accounting needs the per-tick value, not the engine knob)
+    spec_rung: Any = None
 
 
 @dataclasses.dataclass
@@ -244,6 +248,9 @@ class GenerationEngine:
         prefix_cache_max_bytes: int = 1 << 30,
         kv_cache_dtype: Optional[str] = None,
         speculative: int = 0,
+        spec_width: int = 4,
+        spec_probe_every: int = 64,
+        spec_explore_every: int = 32,
         decode_kv_chunk: Optional[int] = 0,
         kv_layout: str = "paged",
         kv_page_size: int = 0,
@@ -290,17 +297,24 @@ class GenerationEngine:
         # burst in flight — bounded by burst * per-step time, same order as a
         # prefill chunk.
         self.burst = max(1, int(burst))
-        # Prompt-lookup speculative decoding (ops/speculative.py): K n-gram
-        # draft tokens per tick, drafted ON DEVICE from a token-history buffer
-        # and verified in one fused (K+1)-position forward — greedy rows
-        # advance up to K+1 tokens per tick at bit-identical output.  The
+        # Tree-verified prompt-lookup speculative decoding
+        # (ops/speculative.py): per tick, the on-device n-gram drafter emits
+        # the top-`spec_width` distinct continuations of depth `speculative`
+        # as a static token TREE, one fused forward verifies every node
+        # through a precomputed ancestor mask, and acceptance takes the
+        # longest root-to-leaf path matching the model's argmax — greedy rows
+        # advance up to K+1 tokens per tick at identical output.  The
         # reference's answer-from-context workload is the high-acceptance
-        # regime.  Replaces burst (one tick IS multi-token); incompatible with
-        # JSON-constrained decoding (FSM state is inherently sequential) —
-        # submit() rejects json_format when enabled.
+        # regime.  An acceptance-EMA controller shrinks the tree (then
+        # disables speculation) below the measured verify/decode breakeven,
+        # so speculation can never be a sustained slowdown.  Replaces burst
+        # (one tick IS multi-token); incompatible with JSON-constrained
+        # decoding (FSM state is inherently sequential) — submit() rejects
+        # json_format when enabled.
         self.speculative = max(0, int(speculative))
+        self.spec_width = max(1, int(spec_width)) if self.speculative else 0
         if self.speculative:
-            # the verify tick writes K+1 positions and _should_finish reserves
+            # the commit writes K+1 positions and _should_finish reserves
             # K tokens of headroom — a K near max_seq_len would crash the
             # jitted tick (opaquely) or instantly length-limit every request;
             # fail at load with the same clarity as the other config knobs
@@ -314,6 +328,11 @@ class GenerationEngine:
             self.burst = 1
         self.spec_drafted = 0  # draft tokens proposed (greedy rows only)
         self.spec_accepted = 0  # draft tokens accepted
+        self.spec_ticks_issued = 0  # speculative ticks dispatched
+        self.spec_skipped_load = 0  # plain ticks forced by queue pressure
+        self.spec_skipped_accept = 0  # plain ticks forced by the controller
+        self._spec_probe_every = max(1, int(spec_probe_every))
+        self._spec_explore_every = max(1, int(spec_explore_every))
         # Prefix KV cache: K/V of shared prompt prefixes (system + packed RAG
         # context) are kept on device and re-inserted into slots instead of
         # being re-prefilled — the reference re-sends and recomputes that
@@ -370,22 +389,14 @@ class GenerationEngine:
             raise ValueError(
                 f"unknown kv_layout {kv_layout!r}; expected 'paged' or 'legacy'"
             )
-        # what the config ASKED for — the fallbacks below may silently demote
-        # paged to legacy (speculative engines, non-dividing contexts), and
-        # kv_stats() reports requested vs effective so operators can see a
-        # replica running the legacy plane without grepping boot logs
+        # what the config ASKED for — the non-dividing-context fallback below
+        # may silently demote paged to legacy, and kv_stats() reports
+        # requested vs effective so operators can see a replica running the
+        # legacy plane without grepping boot logs.  (Speculative engines run
+        # paged natively since the tree-verify rewrite: the accepted path
+        # commits through the block table — commit_tree_path_paged.)
         self.kv_layout_requested = kv_layout
         self.paged = kv_layout == "paged"
-        if self.paged and self.speculative:
-            # verify_step writes K+1 contiguous positions against the slot
-            # cache — the paged write path doesn't carry it yet (ROADMAP 2
-            # replaces the draft anyway); keep speculative entries on the
-            # legacy layout instead of failing the load
-            logger.warning(
-                "kv_layout='paged' is incompatible with speculative decoding; "
-                "falling back to the legacy slot cache for this engine"
-            )
-            self.paged = False
         self.kv_page_size = 0
         self._kv_blocks = 0
         self._kv_pool = None
@@ -585,9 +596,27 @@ class GenerationEngine:
         self._decode_tick = self._make_decode_tick(json_mode=False)
         self._activate_fn = self._make_activate(json_mode=False)
         self._activate_fn_json = None  # built in _ensure_fsm
-        self._spec_tick = self._make_spec_tick() if self.speculative else None
+        self._spec_ticks: Dict[tuple, Any] = {}
+        self._spec_ctl = None
         self._history_dev = self._fresh_history() if self.speculative else None
         if self.speculative:
+            from ..ops.speculative import SpecController, default_rungs
+
+            # one compiled program per rung of the controller's shrink
+            # ladder; the controller switches between them per tick (the
+            # tree SHAPE is static inside each program)
+            self._spec_ctl = SpecController(
+                rungs=default_rungs(self.spec_width, self.speculative),
+                probe_every=self._spec_probe_every,
+                explore_every=self._spec_explore_every,
+            )
+            for rung in self._spec_ctl.rungs:
+                self._spec_ticks[rung] = self._make_spec_tick(*rung)
+            if scheduler is not None:
+                # load-disable vs acceptance-disable, side by side in the
+                # scheduler's own stats: operators watching the degradation
+                # band can tell which mechanism turned speculation off
+                scheduler.bind_spec(self._spec_disabled_gauge)
             rep = _replicated(self.mesh) if self.mesh is not None else None
             self._hist_set = jax.jit(
                 lambda h, row, slot: jax.lax.dynamic_update_slice(
@@ -838,30 +867,63 @@ class GenerationEngine:
             return jax.device_put(z, _replicated(self.mesh))
         return jax.device_put(z)
 
-    def _make_spec_tick(self):
-        """Fused prompt-lookup speculative tick: on-device n-gram draft ->
-        (K+1)-position verify forward -> longest-prefix acceptance -> history/
-        cache/length update, all chained device state (lookahead-compatible;
-        zero host round trips per tick).  See ops/speculative.py for the
-        acceptance semantics and models/llama.verify_step for the forward."""
-        from ..ops.speculative import accept_drafts, build_prompt_lookup_draft
+    def _make_spec_tick(self, width: int, depth: int):
+        """Fused tree-speculative tick for one (width, depth) rung: on-device
+        n-gram TREE draft -> one read-only verify forward over every node
+        (ancestor-masked) -> longest root-to-leaf acceptance -> accepted-path
+        K/V commit (contiguous write on the legacy layout; drop-masked
+        block-table scatter on the paged plane) -> history/length update —
+        all chained device state (lookahead-compatible; zero host round trips
+        per tick).  See ops/speculative.py for the acceptance semantics and
+        models/llama.verify_tree_step for the forward."""
+        from ..ops.speculative import (
+            accept_tree,
+            build_tree_draft,
+            flatten_tree,
+            make_tree_spec,
+        )
 
-        cfg_c, top_k_c, K = self.cfg, self.top_k, self.speculative
+        cfg_c, top_k_c, K = self.cfg, self.top_k, int(depth)
+        N = int(width)
         S = self.max_seq_len
+        spec = make_tree_spec(N, K)
+        depths_c = jnp.asarray(spec.depths)
+        anc_c = jnp.asarray(spec.anc_mask)
+        paged_c = self.paged
 
-        def tick(params, tokens, history, cache, active, temps, top_ps, rng):
-            draft = build_prompt_lookup_draft(history, cache.lengths, tokens, K)
-            seq = jnp.concatenate([tokens[:, None], draft], axis=1)  # [B, K+1]
-            logits, cache = llama.verify_step(params, cfg_c, seq, cache)
-            out, n_new, bonus, rng = accept_drafts(
-                logits, seq, rng, temperature=temps, top_k=top_k_c, top_p=top_ps
+        def tick(params, tokens, history, cache, bt, active, temps, top_ps, rng):
+            draft = build_tree_draft(history, cache.lengths, tokens, N, K)
+            tree = flatten_tree(tokens, draft)  # [B, 1 + N*K]
+            if paged_c:
+                logits, tks, tvs = llama.verify_tree_step_paged(
+                    params, cfg_c, tree, cache, bt, depths_c, anc_c
+                )
+            else:
+                logits, tks, tvs = llama.verify_tree_step(
+                    params, cfg_c, tree, cache, depths_c, anc_c
+                )
+            out, n_new, bonus, path_idx, rng = accept_tree(
+                logits, tree, spec, rng,
+                temperature=temps, top_k=top_k_c, top_p=top_ps,
             )
             n_new = jnp.where(active, n_new, 0)
-            # persist this tick's input token + candidates into the history at
-            # sequence positions lengths..lengths+K+1; positions beyond the
-            # accepted run hold garbage that later ticks overwrite (exactly
-            # the KV-cache discipline), and the draft search never reads past
-            # the valid length
+            if paged_c:
+                # accepted-prefix-only commit: everything past the accepted
+                # run (and every inactive row) drops at the page sentinel —
+                # a paged garbage write could land in a page since handed to
+                # another request, so masking is part of the contract
+                cache = llama.commit_tree_path_paged(
+                    cache, tks, tvs, path_idx, bt, n_new, active
+                )
+            else:
+                # contiguous rows tolerate the rejected tail: it sits past
+                # the new valid length, masked/overwritten like all garbage
+                cache = llama.commit_tree_path(cache, tks, tvs, path_idx)
+            # persist this tick's input token + accepted tokens into the
+            # history at sequence positions lengths..lengths+K+1; positions
+            # beyond the accepted run hold garbage that later ticks overwrite
+            # (exactly the KV-cache discipline), and the draft search never
+            # reads past the valid length
             row_tokens = jnp.concatenate([tokens[:, None], out], axis=1)
             # gather+where instead of a vmapped dynamic_update_slice: the
             # per-row scatter that vmap lowers to trips this jaxlib's HLO
@@ -1860,24 +1922,31 @@ class GenerationEngine:
                 self._rng,
             )
             if self.speculative:
-                # the spec tick + the per-admission history write
+                # every rung's spec tick + the per-admission history write,
+                # then a timed micro-probe per rung so the controller's
+                # breakeven test runs on MEASURED verify/decode cost ratios
+                # instead of the conservative default
                 self._history_dev = self._hist_set(
                     self._history_dev,
                     jnp.zeros((self.max_seq_len,), jnp.int32),
                     jnp.int32(0),
                 )
-                _, _, last2, self._history_dev, self._cache, self._rng = (
-                    self._spec_tick(
-                        self.params,
-                        last,
-                        self._history_dev,
-                        self._cache,
-                        jnp.zeros((self.max_slots,), bool),
-                        jnp.asarray(self._temps),
-                        jnp.asarray(self._top_ps),
-                        self._rng,
+                for rung in self._spec_ctl.rungs:
+                    _, _, last2, self._history_dev, self._cache, self._rng = (
+                        self._spec_ticks[rung](
+                            self.params,
+                            last,
+                            self._history_dev,
+                            self._cache,
+                            self._bt_dev,
+                            jnp.zeros((self.max_slots,), bool),
+                            jnp.asarray(self._temps),
+                            jnp.asarray(self._top_ps),
+                            self._rng,
+                        )
                     )
-                )
+                jax.block_until_ready(last2)
+                self._measure_spec_costs(iters=4)
             if json:
                 toks, last, self._cache, self._rng, _ = self._decode_tick_json(
                     self.params,
@@ -2305,11 +2374,7 @@ class GenerationEngine:
             else 1.0,
         }
         if self.speculative:
-            out["spec_drafted"] = self.spec_drafted
-            out["spec_accepted"] = self.spec_accepted
-            out["spec_accept_rate"] = round(
-                self.spec_accepted / max(1, self.spec_drafted), 4
-            )
+            out.update(self.spec_stats())
         # KV memory plane gauges: pool occupancy, sharing fraction, allocator
         # eviction/COW counters (paged), or the pinned-prefix footprint (legacy)
         out["kv"] = self.kv_stats()
@@ -2322,6 +2387,31 @@ class GenerationEngine:
             out["sched"] = self.scheduler.stats()
         return out
 
+    def spec_stats(self) -> Optional[dict]:
+        """Speculation gauges for tick_stats / healthz, or None on a
+        non-speculative engine: cumulative draft/accept counters, the
+        adaptive controller's state (acceptance EMA, per-arm EMAs, the tree
+        shape currently issued) and whether — and WHY — speculation is off:
+        ``spec_auto_disabled`` is the controller's breakeven verdict,
+        ``spec_load_disabled`` the scheduler's degradation band."""
+        if not self.speculative:
+            return None
+        out = {
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
+            "spec_accept_rate": round(
+                self.spec_accepted / max(1, self.spec_drafted), 4
+            ),
+            "spec_load_disabled": bool(
+                self.scheduler is not None and self.scheduler.degraded()
+            ),
+            "spec_ticks": self.spec_ticks_issued,
+            "spec_skipped_load": self.spec_skipped_load,
+            "spec_skipped_accept": self.spec_skipped_accept,
+        }
+        out.update(self._spec_ctl.stats())
+        return out
+
     def kv_stats(self) -> dict:
         """KV memory plane snapshot for tick_stats / healthz: layout, pool
         gauges (``kv_pages_used`` / ``kv_pages_free`` / ``kv_shared_page_frac``
@@ -2329,9 +2419,10 @@ class GenerationEngine:
         prefix-LRU footprint when legacy.  Prefix hit/miss counters ride along
         in both layouts."""
         out: dict = {"kv_layout": "paged" if self.paged else "legacy"}
-        # requested vs effective: a speculative model entry or a non-dividing
-        # context silently falls back to the legacy plane at load — surfaced
-        # here (tick_stats + /healthz) instead of only as a boot-log warning
+        # requested vs effective: a non-dividing context silently falls back
+        # to the legacy plane at load — surfaced here (tick_stats + /healthz)
+        # instead of only as a boot-log warning.  (Speculative engines no
+        # longer fall back: the tree verify commits through the block table.)
         out["kv_layout_requested"] = self.kv_layout_requested
         out["kv_layout_effective"] = out["kv_layout"]
         if self.paged:
@@ -2495,6 +2586,97 @@ class GenerationEngine:
         wall = time.monotonic() - t0
         return max(wall - rtt, wall * 0.5) / (iters * self.burst)
 
+    def _spec_disabled_gauge(self) -> dict:
+        """The spec_disabled gauge bound into the scheduler's stats: which
+        mechanism (if any) is currently holding speculation off, plus the
+        tick counters behind it."""
+        return {
+            "load": bool(self.scheduler is not None and self.scheduler.degraded()),
+            "acceptance": bool(
+                self._spec_ctl is not None and self._spec_ctl.disabled
+            ),
+            "skipped_load_ticks": self.spec_skipped_load,
+            "skipped_accept_ticks": self.spec_skipped_accept,
+        }
+
+    def probe_spec(self, iters: int = 8) -> dict:
+        """Measured verify/decode tick costs per tree rung on an idle engine
+        (same lock discipline as :meth:`probe_decode`): seconds per plain
+        tick, seconds per speculative tick for every (width, depth) rung,
+        the cost ratios, and each rung's breakeven accept rate.  Feeds the
+        controller's cost table as a side effect — the bench's tick-cost
+        sweep and the honest breakeven report both come from here."""
+        if not self.speculative:
+            raise RuntimeError("probe_spec requires a speculative engine")
+        deadline = time.monotonic() + 10.0
+        while True:
+            self._iter_lock.acquire()
+            if self.num_active == 0 and not self._inflight and not self._chunking:
+                break
+            self._iter_lock.release()
+            if time.monotonic() >= deadline:
+                raise RuntimeError("probe_spec requires an idle engine")
+            time.sleep(0.01)
+        try:
+            return self._measure_spec_costs(iters)
+        finally:
+            self._iter_lock.release()
+
+    def _measure_spec_costs(self, iters: int = 4) -> dict:
+        """Time the plain tick and every rung's tree tick back-to-back with
+        chained device state (all slots inactive — the verify forward's cost
+        is fill-independent at a fixed allocation) and feed the measured
+        cost ratios into the controller.  Called from warmup() (pre-start,
+        lock-free) and probe_spec() (idle-locked)."""
+        from ..ops.speculative import breakeven_accept_rate
+
+        self._refresh_sampling()
+        inactive = jnp.zeros((self.max_slots,), bool)
+
+        def time_plain():
+            t0 = time.monotonic()
+            for _ in range(iters):
+                toks, self._tokens_dev, self._cache, self._rng = self._decode_tick(
+                    self.params, self._tokens_dev, self._cache, inactive,
+                    self._bt_dev, self._temps_dev, self._top_ps_dev, self._rng,
+                )
+            np.asarray(toks)
+            return (time.monotonic() - t0) / iters
+
+        def time_rung(rung):
+            t0 = time.monotonic()
+            for _ in range(iters):
+                toks, n_new, self._tokens_dev, self._history_dev, self._cache, \
+                    self._rng = self._spec_ticks[rung](
+                        self.params, self._tokens_dev, self._history_dev,
+                        self._cache, self._bt_dev, inactive,
+                        self._temps_dev, self._top_ps_dev, self._rng,
+                    )
+            np.asarray(toks)
+            return (time.monotonic() - t0) / iters
+
+        with self._mesh_scope():
+            time_plain()  # warm (jit cache is hot after warmup; cheap anyway)
+            plain_s = time_plain()
+            out = {"plain_tick_s": plain_s, "rungs": {}}
+            for rung in self._spec_ctl.rungs:
+                time_rung(rung)  # warm
+                spec_s = time_rung(rung)
+                ratio = spec_s / max(plain_s, 1e-9)
+                self._spec_ctl.note_cost(rung, ratio)
+                # string keys ("WxK", the spec_rung_accept_emas convention):
+                # the result is JSON-able like every other stats surface
+                out["rungs"][f"{rung[0]}x{rung[1]}"] = {
+                    "width": rung[0],
+                    "depth": rung[1],
+                    "tick_s": spec_s,
+                    "cost_ratio": ratio,
+                    "breakeven_accept_rate": breakeven_accept_rate(
+                        ratio, rung[1]
+                    ),
+                }
+        return out
+
     def _issue_tick(self):
         """Dispatch one decode tick without waiting for its result.  The token
         input chains device-to-device from the previous tick (the rng state
@@ -2510,19 +2692,27 @@ class GenerationEngine:
             if delay:
                 time.sleep(delay)
         self._refresh_sampling()
-        if self.speculative and not (
-            # graceful degradation: under queue pressure the (K+1)-position
-            # verify forward is wasted work at low acceptance — fall back to
-            # the plain tick (correctness is tick-kind-independent; only the
-            # draft source quality suffers when speculation resumes)
-            self.scheduler is not None
-            and self.scheduler.degraded()
-        ):
-            self._issue_spec_tick(t0)
-            return
-        # (a degraded speculative engine falls through to the plain tick:
-        # burst is pinned to 1 there, so _decode_tick is the single-step
-        # program and the cache/token chaining is identical)
+        if self.speculative:
+            if self.scheduler is not None and self.scheduler.degraded():
+                # graceful degradation: under queue pressure the tree verify
+                # forward is wasted work at low acceptance — fall back to
+                # the plain tick (correctness is tick-kind-independent; only
+                # the draft source quality suffers when speculation resumes)
+                self.spec_skipped_load += 1
+            else:
+                # acceptance-EMA controller: pick the best rung of the tree
+                # ladder, or None when even the narrowest tree cannot pay
+                # for its verify forward at the measured acceptance (it
+                # keeps probing so a workload shift can re-enable)
+                rung = self._spec_ctl.rung()
+                if rung is None:
+                    self.spec_skipped_accept += 1
+                else:
+                    self._issue_spec_tick(t0, rung)
+                    return
+        # (a load- or acceptance-disabled speculative engine falls through to
+        # the plain tick: burst is pinned to 1 there, so _decode_tick is the
+        # single-step program and the cache/token chaining is identical)
         with self._mesh_scope():
             if self._json.any():
                 toks, last, self._cache, self._rng, self._fsm_states_dev = (
@@ -2566,17 +2756,19 @@ class GenerationEngine:
         ]
         self._inflight.append(_TickRef(nxt=toks, slots=live))
 
-    def _issue_spec_tick(self, t0: float):
-        """Dispatch one fused prompt-lookup speculative tick (draft + verify +
-        accept on device, chained state — same pipelining discipline as the
-        burst tick, but each tick advances a variable 1..K+1 tokens/slot)."""
+    def _issue_spec_tick(self, t0: float, rung: tuple):
+        """Dispatch one fused tree-speculative tick at the controller's
+        current (width, depth) rung (draft + verify + accept + commit on
+        device, chained state — same pipelining discipline as the burst
+        tick, but each tick advances a variable 1..depth+1 tokens/slot)."""
         with self._mesh_scope():
             toks, n_new, last, self._history_dev, self._cache, self._rng = (
-                self._spec_tick(
+                self._spec_ticks[rung](
                     self.params,
                     self._tokens_dev,
                     self._history_dev,
                     self._cache,
+                    self._bt_dev,
                     self._active_dev,
                     self._temps_dev,
                     self._top_ps_dev,
@@ -2590,13 +2782,16 @@ class GenerationEngine:
                 pass
         self._tokens_dev = last
         self.steps += 1
+        self.spec_ticks_issued += 1
         self._tick_issue_s += time.monotonic() - t0
         self._ticks_issued += 1
-        self._kv_frac_sum += 1.0  # verify_step reads the full cache row
+        self._kv_frac_sum += 1.0  # the tree verify reads the full cache row
         live = [
             (i, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None
         ]
-        self._inflight.append(_TickRef(nxt=toks, slots=live, n_new=n_new))
+        self._inflight.append(
+            _TickRef(nxt=toks, slots=live, n_new=n_new, spec_rung=rung)
+        )
 
     def _process_tick(self):
         """Consume the oldest in-flight result (blocks until it arrives)."""
@@ -2640,7 +2835,9 @@ class GenerationEngine:
             return
         if ref.n_new is not None:  # speculative tick: variable tokens/slot
             counts = np.asarray(ref.n_new)
-            K = self.speculative
+            K = ref.spec_rung[1] if ref.spec_rung else self.speculative
+            greedy_rows = 0
+            tick_accepted = 0
             for slot, epoch in ref.slots:
                 s = self._slots[slot]
                 if s is None or self._slot_epoch[slot] != epoch:
@@ -2650,9 +2847,18 @@ class GenerationEngine:
                 if s.request.temperature <= 0:
                     self.spec_drafted += K
                     self.spec_accepted += max(0, n - 1)
+                    greedy_rows += 1
+                    tick_accepted += max(0, n - 1)
                 for k in range(n):
                     if self._consume_token(slot, s, int(vals[k, slot]), now):
                         break  # remaining accepted tokens are post-EOS garbage
+            if self._spec_ctl is not None and greedy_rows:
+                # acceptance evidence for the adaptive controller — greedy
+                # rows only (sampled rows never accept, by design), credited
+                # to the rung that actually drafted this tick
+                self._spec_ctl.note_tick(
+                    tick_accepted, K, greedy_rows, rung=ref.spec_rung
+                )
             return
         for k in range(vals.shape[0]):  # burst steps, oldest first
             for slot, epoch in ref.slots:
@@ -2712,10 +2918,10 @@ class GenerationEngine:
         if len(s.generated) >= s.request.max_tokens:
             return True
         # cache full -> decode_step freezes the slot; finish as length-limited.
-        # Speculative mode leaves K tokens of headroom: a verify tick writes
-        # K+1 positions, so live rows must always fit them (verify_step
-        # docstring) — those last K tokens would have been length_limited a
-        # tick later anyway.
+        # Speculative mode leaves K tokens of headroom: a tick commits up to
+        # K+1 accepted-path positions, so live rows must always fit them
+        # (commit_tree_path docstring) — those last K tokens would have been
+        # length_limited a tick later anyway.
         if (
             len(s.request.prompt_ids) + len(s.generated)
             >= self.max_seq_len - self.speculative

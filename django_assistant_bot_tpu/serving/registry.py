@@ -45,17 +45,19 @@ class ModelSpec:
     # slot-cache precision: None/"bf16" | "fp8" (e4m3) | "fp8_e5m2" — fp8
     # halves KV bytes (lossy; opt-in per model)
     kv_cache_dtype: Optional[str] = None
-    # prompt-lookup speculative decoding: K on-device n-gram draft tokens
-    # verified per tick (greedy rows advance up to K+1 tokens/tick,
-    # bit-identical output; ops/speculative.py).  Excludes json_format
-    # traffic on this model entry.  NOTE on sampled traffic: only greedy
-    # (temperature == 0) rows accept drafts — sampled rows pay the
-    # (K+1)-position verify forward every tick with near-zero acceptance,
-    # i.e. they decode SLOWER than plain ticks (measured 0.24x single-stream
-    # at K=6 / ~5% acceptance, PERF.md).  Enable only on model entries whose
-    # traffic is greedy and copy-from-context shaped; watch `spec_accept_rate`
-    # in tick_stats before keeping it on.
+    # tree-verified prompt-lookup speculative decoding: up to `spec_width`
+    # distinct n-gram continuations of depth `speculative` verified per tick
+    # as one ancestor-masked token tree (greedy rows advance up to K+1
+    # tokens/tick at identical output; ops/speculative.py,
+    # docs/SPECULATIVE.md).  Excludes json_format traffic on this model
+    # entry.  An acceptance-EMA controller shrinks the tree and disables
+    # speculation below the measured verify/decode breakeven, so sampled or
+    # low-acceptance traffic degrades to plain ticks instead of paying the
+    # verify forward forever (the r5 regression: 0.24x single-stream at a
+    # fixed K=6 / ~5% acceptance).  Watch `spec_accept_rate` /
+    # `spec_auto_disabled` in tick_stats.
     speculative: int = 0
+    spec_width: int = 4
     # length-aware decode attention: KV-cache chunk width for the bucketed
     # decode read (serving/engine.py decode_kv_chunk).  0 = auto (512/256/128,
     # whichever divides max_seq_len into >= 2 chunks), None/"off" disables —
@@ -343,6 +345,7 @@ class ModelRegistry:
                     prefix_cache_max_bytes=spec.prefix_cache_max_bytes,
                     kv_cache_dtype=spec.kv_cache_dtype,
                     speculative=spec.speculative,
+                    spec_width=spec.spec_width,
                     decode_kv_chunk=(
                         None if spec.decode_kv_chunk in (None, "off")
                         else int(spec.decode_kv_chunk)

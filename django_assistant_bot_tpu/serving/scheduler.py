@@ -136,6 +136,7 @@ class RequestScheduler:
         self._kv_available = None
         self._kv_total = 0
         self._queued_kv_pages = 0
+        self._spec_gauge_fn = None  # engine's spec_disabled gauge (bind_spec)
         self._service_ema_s = float(self.cfg.service_time_init)
         # per-class counters (created lazily so new classes just appear)
         self.submitted: Dict[str, int] = collections.defaultdict(int)
@@ -153,6 +154,15 @@ class RequestScheduler:
         """Engine capacity for the estimated-wait model (est wait =
         depth * service_ema / slots)."""
         self._slots = max(1, int(slots))
+        return self
+
+    def bind_spec(self, gauge_fn) -> "RequestScheduler":
+        """Wire the engine's speculative-disable gauge into :meth:`stats`:
+        the degradation band already reports ``degraded`` (load-disable);
+        with this bound, operators also see the acceptance-controller's
+        verdict side by side (``spec_disabled``) and can tell load-disable
+        from acceptance-disable without cross-referencing engine stats."""
+        self._spec_gauge_fn = gauge_fn
         return self
 
     def bind_kv(self, available_fn, total_pages: int) -> "RequestScheduler":
@@ -480,6 +490,9 @@ class RequestScheduler:
     def stats(self) -> dict:
         """One JSON-able snapshot for /healthz and tick_stats."""
         waits = self.wait_stats()
+        # the engine-side gauge runs OUTSIDE the lock: it reads engine state
+        # (controller verdict, degradation band) and must not nest locks
+        spec = self._spec_gauge_fn() if self._spec_gauge_fn is not None else None
         with self._lock:
             return {
                 "queue_depth": self._depth,
@@ -497,4 +510,5 @@ class RequestScheduler:
                 "expired_running": dict(self.expired_running),
                 "cancelled_queued": dict(self.cancelled_queued),
                 "wait": waits,
+                **({"spec_disabled": spec} if spec is not None else {}),
             }

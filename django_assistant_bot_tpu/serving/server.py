@@ -361,6 +361,14 @@ def create_app(
                 # KV memory plane gauges: pool occupancy, shared-page
                 # fraction, allocator eviction/COW counters (docs/KV_PAGING.md)
                 g["kv"] = kv()
+            spec = getattr(eng, "spec_stats", None)
+            if callable(spec):
+                # speculative-decoding gauges: accept rate/EMA (per tree
+                # arm), the rung in use, and load- vs acceptance-disable —
+                # None (omitted) on non-speculative engines
+                sv = spec()
+                if sv is not None:
+                    g["spec"] = sv
             sched = getattr(eng, "scheduler", None)
             if sched is not None:
                 # queue depth, shed counters, per-class wait percentiles —

@@ -8,6 +8,12 @@ ICI (data axis), tensor-parallel layers all-reduce over the ``model`` axis, MoE
 experts shard over ``expert``, and long sequences shard over ``seq``.
 """
 
+from .copy_task import (  # noqa: F401
+    copy_task_config,
+    fit_copy_model,
+    make_copy_batch,
+    quote_accuracy,
+)
 from .train import (  # noqa: F401
     TrainState,
     init_train_state,

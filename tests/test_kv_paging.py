@@ -681,11 +681,16 @@ def test_engine_kv_knob_validation_and_fallbacks():
         cfg, params, tok, max_slots=2, max_seq_len=256, decode_kv_chunk=None
     )
     assert eng.paged and eng.kv_page_size == 128
-    # speculative falls back to legacy (documented, warns)
+    # speculative engines run the paged plane natively (the tree verify
+    # commits the accepted path through the block table) — no fallback,
+    # requested == effective
     eng = GenerationEngine(
         cfg, params, tok, max_slots=2, max_seq_len=256, speculative=2
     )
-    assert not eng.paged
+    assert eng.paged
+    ks = eng.kv_stats()
+    assert ks["kv_layout_requested"] == "paged"
+    assert ks["kv_layout_effective"] == "paged"
     with pytest.raises(ValueError, match="kv_pages"):
         GenerationEngine(
             cfg, params, tok, max_slots=2, max_seq_len=256,

@@ -611,10 +611,11 @@ def test_server_graceful_drain_finishes_inflight_then_503s():
 
 
 # ------------------------------------------------- kv_layout_effective gauge
-def test_kv_layout_effective_surfaces_silent_legacy_fallback():
-    """A speculative model entry requests the paged plane but silently runs
-    legacy (the PR 6 fallback logged a warning only) — tick_stats /healthz
-    must say so."""
+def test_kv_layout_effective_surfaces_requested_vs_effective():
+    """The requested-vs-effective gauge still exists for genuinely
+    non-pageable configs (a context no page size divides), and speculative
+    engines — which used to be the silent-legacy case — now report the
+    paged plane as effective."""
     cfg, params = _params()
     eng = GenerationEngine(
         cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=64,
@@ -622,12 +623,14 @@ def test_kv_layout_effective_surfaces_silent_legacy_fallback():
     )
     ks = eng.kv_stats()
     assert ks["kv_layout_requested"] == "paged"
-    assert ks["kv_layout_effective"] == "legacy"
-    assert eng.tick_stats()["kv"]["kv_layout_effective"] == "legacy"
-
-    healthy = GenerationEngine(
-        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=64
-    )
-    ks = healthy.kv_stats()
-    assert ks["kv_layout_requested"] == "paged"
     assert ks["kv_layout_effective"] == "paged"
+    assert eng.tick_stats()["kv"]["kv_layout_effective"] == "paged"
+
+    # a prime-length context: no page size divides it -> legacy fallback,
+    # and the gauge is how operators see it
+    odd = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=61
+    )
+    ks = odd.kv_stats()
+    assert ks["kv_layout_requested"] == "paged"
+    assert ks["kv_layout_effective"] == "legacy"
