@@ -1,0 +1,36 @@
+"""Repo-root pytest bootstrap.
+
+Two jobs:
+
+- put ``tools/`` on ``sys.path`` so the dabtlint package (static analysis +
+  runtime lock-order witness) imports without an install step;
+- under ``DABT_LOCK_WITNESS=1``, register the lock-order witness plugin
+  BEFORE any project module is imported, so every project
+  ``threading.Lock``/``RLock`` creation is wrapped and the whole run's
+  acquisition-order graph is recorded (the session fails on a cycle, on
+  same-class nesting, or on a Future resolved under a non-allowlisted lock
+  — see docs/STATIC_ANALYSIS.md and tools/dabtlint/witness.py).
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+_TOOLS = os.path.join(_ROOT, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+
+def pytest_configure(config):
+    if os.environ.get("DABT_LOCK_WITNESS") == "1":
+        from dabtlint.witness import WitnessPlugin
+
+        if config.pluginmanager.has_plugin("dabt-lock-witness"):
+            return
+        config.pluginmanager.register(
+            WitnessPlugin(
+                os.path.join(_ROOT, "django_assistant_bot_tpu"),
+                baseline_path=os.path.join(_TOOLS, "dabtlint", "baseline.json"),
+            ),
+            "dabt-lock-witness",
+        )

@@ -39,7 +39,8 @@ class ResourceManager:
             with open(file_path, "r", encoding="utf-8") as f:
                 return f.read()
         except FileNotFoundError:
-            raise NoResourceFound(file_path)
+            # the cause is the path itself — chaining the OS error adds noise
+            raise NoResourceFound(file_path) from None
 
     def get_prompt(self, path: str) -> str:
         return self.get_resource(f"prompts/{path}")
@@ -52,7 +53,7 @@ class ResourceManager:
             try:
                 return self.get_resource(f"messages/{self.default_language}/{path}")
             except NoResourceFound as e2:
-                raise NoMessageFound(str(e2))
+                raise NoMessageFound(str(e2)) from e2
 
     def get_phrase(self, phrase: str) -> str:
         for lang in (self.language, self.default_language):

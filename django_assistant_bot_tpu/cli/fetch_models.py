@@ -82,7 +82,7 @@ def fetch_one(repo_id: str, models_dir: str, revision: Optional[str] = None) -> 
             f"{repo_id}: not present at {target} and huggingface_hub is not "
             f"installed ({e}).  Copy the checkpoint directory (config.json + "
             f"*.safetensors + tokenizer files) to that path manually."
-        )
+        ) from None
     print(f"{repo_id}: downloading to {target}")
     try:
         snapshot_download(
@@ -95,7 +95,7 @@ def fetch_one(repo_id: str, models_dir: str, revision: Optional[str] = None) -> 
         raise SystemExit(
             f"{repo_id}: download failed ({type(e).__name__}: {e}).  In an "
             f"air-gapped deployment place the checkpoint at {target} manually."
-        )
+        ) from None
     if not is_complete(target):
         raise SystemExit(
             f"{repo_id}: downloaded, but {target} has no config.json + "
@@ -165,7 +165,7 @@ def _config_repo_ids(config_path: str) -> List[str]:
     with open(config_path) as f:
         cfg = json.load(f)
     out = []
-    for name, spec in cfg.items():
+    for _name, spec in cfg.items():
         path = (spec or {}).get("path")
         if path and looks_like_repo_id(path):
             out.append(path)

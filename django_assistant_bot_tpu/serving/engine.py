@@ -33,7 +33,7 @@ import queue
 import threading
 import time
 from concurrent.futures import Future, InvalidStateError
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -265,7 +265,15 @@ class GenerationEngine:
         heartbeat_degraded_s: float = 30.0,
         max_request_restarts: int = 2,
         mesh=None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
     ):
+        # Injectable time (dabtlint DABT105): every timestamp and backoff in
+        # the engine flows through these two callables, so fake-clock tests
+        # can drive deadlines/backoff/heartbeats deterministically.  Defaults
+        # are the real thing — production behavior is byte-identical.
+        self._clock = clock
+        self._sleep = sleep
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -501,7 +509,7 @@ class GenerationEngine:
         # loop heartbeat: stamped at the top of every loop iteration so a
         # wedged engine thread (stuck XLA call) is visible as a growing
         # loop_heartbeat_age_s in /healthz instead of stale-but-green stats
-        self._beat = time.monotonic()
+        self._beat = self._clock()
         # live slots reclaimed before finishing (expired deadline / client
         # cancel) — each one freed mid-decode instead of burning ticks
         self.reclaimed_slots = 0
@@ -580,6 +588,13 @@ class GenerationEngine:
         # mutates engine-thread-owned device state (_cache/_tokens_dev/_rng),
         # so it must never interleave with an admission/tick.  Uncontended in
         # normal serving (the loop is the only taker).
+        # CALLBACK CONTRACT (dabtlint DABT102 baseline + witness allowlist):
+        # futures resolve INSIDE the iteration, so a Future done-callback runs
+        # with this lock held — callbacks must therefore never acquire any
+        # engine's _iter_lock (router re-dispatch takes router/scheduler
+        # locks and the TARGET engine's submit queue only; idle() is the one
+        # _iter_lock taker outside the loop and resolves nothing).  See
+        # docs/STATIC_ANALYSIS.md.
         self._iter_lock = threading.Lock()
         # Per-tick wall breakdown (engine thread only): where a decode token's
         # time actually goes — `issue_s` is dispatch enqueue (host->device RPC
@@ -989,7 +1004,7 @@ class GenerationEngine:
                 "previous engine thread is still draining; cannot restart yet"
             )
         self._running = True
-        self._beat = time.monotonic()
+        self._beat = self._clock()
         self._thread = threading.Thread(target=self._loop, daemon=True, name="gen-engine")
         self._thread.start()
         return self
@@ -1007,17 +1022,17 @@ class GenerationEngine:
         self._running = False
         t = self._thread
         if t is not None:
-            start = time.monotonic()
+            start = self._clock()
             deadline = start + drain_timeout_s
             t.join(timeout=min(5.0, drain_timeout_s))
-            while t.is_alive() and time.monotonic() < deadline:
+            while t.is_alive() and self._clock() < deadline:
                 logger.warning(
                     "engine thread still draining (device step or compile in "
                     "flight); %.0fs elapsed, waiting up to %.0fs",
-                    time.monotonic() - start,
+                    self._clock() - start,
                     drain_timeout_s,
                 )
-                t.join(timeout=min(15.0, max(0.0, deadline - time.monotonic())))
+                t.join(timeout=min(15.0, max(0.0, deadline - self._clock())))
             if t.is_alive():
                 logger.error(
                     "engine thread did not drain within %.0fs; its requests "
@@ -1092,7 +1107,7 @@ class GenerationEngine:
         if self.degraded():
             # restart circuit open: fail fast (503 at the server) instead of
             # queueing work behind a device that keeps killing the loop
-            remaining = max(0.1, (self._degraded_until or 0.0) - time.monotonic())
+            remaining = max(0.1, (self._degraded_until or 0.0) - self._clock())
             raise EngineUnavailable(
                 "engine degraded after repeated restarts", retry_after_s=remaining
             )
@@ -1139,7 +1154,7 @@ class GenerationEngine:
                         self.scheduler.release_kv(kv_pages - new_pages)
                         kv_pages = new_pages
             admitted = True
-        now = time.monotonic()
+        now = self._clock()
         fut: Future = Future()
         if stream is not None:
             # attach BEFORE the queue put: if the engine resolves (or drains)
@@ -1352,7 +1367,7 @@ class GenerationEngine:
     def _loop(self):
         try:
             while self._running:
-                self._beat = time.monotonic()
+                self._beat = self._clock()
                 if self._degraded_until is not None and not self._degraded_wait():
                     continue
                 try:
@@ -1376,7 +1391,7 @@ class GenerationEngine:
                     # backoff escalates over CONSECUTIVE failures only)
                     self._consecutive_failures = 0
                     if not admitted and self.num_active == 0 and not self._inflight:
-                        time.sleep(self.idle_poll_s)
+                        self._sleep(self.idle_poll_s)
                 except Exception as e:
                     logger.exception(
                         "engine-fatal loop error; attempting crash-only restart"
@@ -1393,7 +1408,7 @@ class GenerationEngine:
         """One degraded-mode loop beat.  Returns True when the cooldown has
         elapsed (half-open: restart history clears and the loop resumes —
         the next fault inside the window re-trips immediately)."""
-        now = time.monotonic()
+        now = self._clock()
         if self._degraded_until is not None and now >= self._degraded_until:
             logger.warning(
                 "engine circuit half-open: resuming after %.1fs degraded cooldown",
@@ -1410,7 +1425,7 @@ class GenerationEngine:
         # honoring deadlines/cancels while the engine cools down
         with self._iter_lock:
             self._reap_dead_slots()
-        time.sleep(min(0.05, max(0.0, (self._degraded_until or now) - now)))
+        self._sleep(min(0.05, max(0.0, (self._degraded_until or now) - now)))
         return False
 
     def _backoff_after_failure(self) -> None:
@@ -1422,7 +1437,7 @@ class GenerationEngine:
             self.restart_backoff_s * (2 ** (self._consecutive_failures - 1)),
         )
         if delay > 0:
-            time.sleep(delay)
+            self._sleep(delay)
 
     def _shutdown(self):
         """End-of-loop drain, run BY the engine thread: fail live slots and
@@ -1456,7 +1471,7 @@ class GenerationEngine:
         request's DeadlineExceeded lands at ~its deadline even on a saturated
         engine, and dead entries stop inflating queue depth (which would shed
         admittable work with spurious queue_full 429s)."""
-        now = time.monotonic()
+        now = self._clock()
         if self.scheduler is not None:
             self.scheduler.reap(now)
         elif self._pending:
@@ -1612,7 +1627,13 @@ class GenerationEngine:
     def _peek_next(self, now: float) -> Optional[_Request]:
         """Head-of-queue inspection without removal.  Scheduler path: the
         weighted-fair-share winner (dead entries reaped inside).  Legacy FIFO
-        path: the `_pending` head, skipping cancelled/expired entries."""
+        path: the `_pending` head, skipping cancelled/expired entries.
+
+        peek()/pop() resolve reaped DeadlineExceeded futures after releasing
+        the SCHEDULER lock, but this caller runs under _iter_lock — so those
+        done-callbacks execute under the iteration lock and fall under the
+        CALLBACK CONTRACT at _iter_lock's creation site (callbacks must never
+        acquire any engine's _iter_lock)."""
         if self.scheduler is not None:
             return self.scheduler.peek(now)
         while self._pending:
@@ -1633,6 +1654,7 @@ class GenerationEngine:
         return None
 
     def _take_next(self, now: float) -> Optional[_Request]:
+        # same _iter_lock callback-contract note as _peek_next
         if self.scheduler is not None:
             return self.scheduler.pop(now)
         return self._pending.popleft() if self._pending else None
@@ -1660,7 +1682,7 @@ class GenerationEngine:
                 self.scheduler.enqueue(req)
             else:
                 self._pending.append(req)
-        now = time.monotonic()
+        now = self._clock()
         free = self._free_slots()
         batch: List[tuple[int, _Request, Any]] = []
         while free:
@@ -2213,7 +2235,7 @@ class GenerationEngine:
                 self._cache = self._insert_prefix(
                     self._cache, prefix.pk, prefix.pv, jnp.asarray(slot, jnp.int32)
                 )
-        req.started_at = time.monotonic()
+        req.started_at = self._clock()
         self._chunking = _ChunkedPrefill(
             request=req, slot=slot, ids=ids, starts=starts, n=n
         )
@@ -2251,7 +2273,7 @@ class GenerationEngine:
             self._chunking = None
             return
         dl = st.request.deadline_at
-        if dl is not None and time.monotonic() >= dl:
+        if dl is not None and self._clock() >= dl:
             # expired mid-prefill: abandon the remaining chunks entirely
             self.reclaimed_slots += 1
             if self.scheduler is not None:
@@ -2311,7 +2333,7 @@ class GenerationEngine:
                     logits, self._tokens_dev, self._rng, temps, top_ps, scatter_idx
                 )
         ref_slots = []
-        now_started = time.monotonic()
+        now_started = self._clock()
         for slot, req in zip(slots, reqs):
             if req.started_at is None:  # chunked prefills set it at begin
                 req.started_at = now_started
@@ -2483,15 +2505,15 @@ class GenerationEngine:
 
         Waits up to 10 s for the loop to drain its speculative lookahead ticks
         (requests resolve `lookahead` ticks before the deque empties)."""
-        deadline = time.monotonic() + 10.0
+        deadline = self._clock() + 10.0
         while True:
             self._iter_lock.acquire()
             if self.num_active == 0 and not self._inflight and not self._chunking:
                 break  # idle, and the loop is parked outside its iteration body
             self._iter_lock.release()
-            if time.monotonic() >= deadline:
+            if self._clock() >= deadline:
                 raise RuntimeError("probe_decode requires an idle engine")
-            time.sleep(0.01)
+            self._sleep(0.01)
         try:
             return self._probe_decode_locked(iters, fill_len)
         finally:
@@ -2572,10 +2594,10 @@ class GenerationEngine:
             # compile of that op is absorbed by the min.
             rtt = float("inf")
             for _ in range(3):
-                t0 = time.monotonic()
+                t0 = self._clock()
                 _np.asarray(self._tokens_dev + 0)
-                rtt = min(rtt, time.monotonic() - t0)
-            t0 = time.monotonic()
+                rtt = min(rtt, self._clock() - t0)
+            t0 = self._clock()
             for _ in range(iters):
                 toks, last, self._cache, self._rng = self._decode_tick(
                     self.params, self._tokens_dev, self._cache, active,
@@ -2583,7 +2605,7 @@ class GenerationEngine:
                 )
                 self._tokens_dev = last
             _np.asarray(toks)
-        wall = time.monotonic() - t0
+        wall = self._clock() - t0
         return max(wall - rtt, wall * 0.5) / (iters * self.burst)
 
     def _spec_disabled_gauge(self) -> dict:
@@ -2608,15 +2630,15 @@ class GenerationEngine:
         sweep and the honest breakeven report both come from here."""
         if not self.speculative:
             raise RuntimeError("probe_spec requires a speculative engine")
-        deadline = time.monotonic() + 10.0
+        deadline = self._clock() + 10.0
         while True:
             self._iter_lock.acquire()
             if self.num_active == 0 and not self._inflight and not self._chunking:
                 break
             self._iter_lock.release()
-            if time.monotonic() >= deadline:
+            if self._clock() >= deadline:
                 raise RuntimeError("probe_spec requires an idle engine")
-            time.sleep(0.01)
+            self._sleep(0.01)
         try:
             return self._measure_spec_costs(iters)
         finally:
@@ -2634,17 +2656,17 @@ class GenerationEngine:
         inactive = jnp.zeros((self.max_slots,), bool)
 
         def time_plain():
-            t0 = time.monotonic()
+            t0 = self._clock()
             for _ in range(iters):
                 toks, self._tokens_dev, self._cache, self._rng = self._decode_tick(
                     self.params, self._tokens_dev, self._cache, inactive,
                     self._bt_dev, self._temps_dev, self._top_ps_dev, self._rng,
                 )
             np.asarray(toks)
-            return (time.monotonic() - t0) / iters
+            return (self._clock() - t0) / iters
 
         def time_rung(rung):
-            t0 = time.monotonic()
+            t0 = self._clock()
             for _ in range(iters):
                 toks, n_new, self._tokens_dev, self._history_dev, self._cache, \
                     self._rng = self._spec_ticks[rung](
@@ -2653,7 +2675,7 @@ class GenerationEngine:
                         self._temps_dev, self._top_ps_dev, self._rng,
                     )
             np.asarray(toks)
-            return (time.monotonic() - t0) / iters
+            return (self._clock() - t0) / iters
 
         with self._mesh_scope():
             time_plain()  # warm (jit cache is hot after warmup; cheap anyway)
@@ -2682,7 +2704,7 @@ class GenerationEngine:
         input chains device-to-device from the previous tick (the rng state
         too); the sampled ids stream back asynchronously and are consumed by
         :meth:`_process_tick`."""
-        t0 = time.monotonic()
+        t0 = self._clock()
         if self._faults is not None:
             # deterministic chaos (serving/faults.py): a thrown device
             # dispatch (engine-fatal -> crash-only restart) or injected
@@ -2690,7 +2712,7 @@ class GenerationEngine:
             self._faults.maybe_raise("tick_raise", "device step")
             delay = self._faults.sleep_s("slow_tick")
             if delay:
-                time.sleep(delay)
+                self._sleep(delay)
         self._refresh_sampling()
         if self.speculative:
             if self.scheduler is not None and self.scheduler.degraded():
@@ -2748,7 +2770,7 @@ class GenerationEngine:
             pass
         self._tokens_dev = last
         self.steps += self.burst
-        self._tick_issue_s += time.monotonic() - t0
+        self._tick_issue_s += self._clock() - t0
         self._ticks_issued += 1
         self._kv_frac_sum += self._kv_read_frac()
         live = [
@@ -2783,7 +2805,7 @@ class GenerationEngine:
         self._tokens_dev = last
         self.steps += 1
         self.spec_ticks_issued += 1
-        self._tick_issue_s += time.monotonic() - t0
+        self._tick_issue_s += self._clock() - t0
         self._ticks_issued += 1
         self._kv_frac_sum += 1.0  # the tree verify reads the full cache row
         live = [
@@ -2808,11 +2830,11 @@ class GenerationEngine:
 
     def _process_tick_inner(self):
         ref = self._inflight.popleft()
-        t0 = time.monotonic()
+        t0 = self._clock()
         vals = np.asarray(ref.nxt)
-        self._tick_block_s += time.monotonic() - t0
+        self._tick_block_s += self._clock() - t0
         self._ticks_processed += 1
-        now = time.monotonic()
+        now = self._clock()
         if (
             self._faults is not None
             and ref.slots
@@ -2942,7 +2964,7 @@ class GenerationEngine:
         hit_eos = bool(ids) and ids[-1] == self.tokenizer.eos_id
         if hit_eos:
             ids = ids[:-1]
-        now = time.monotonic()
+        now = self._clock()
         try:
             if self._faults is not None:
                 self._faults.maybe_raise("detok_raise", "detokenize")
@@ -2992,7 +3014,7 @@ class GenerationEngine:
     def degraded(self) -> bool:
         """True while the restart circuit is open (submit() fast-fails)."""
         dl = self._degraded_until
-        return dl is not None and time.monotonic() < dl
+        return dl is not None and self._clock() < dl
 
     def healthy(self) -> bool:
         """The single liveness predicate (any thread): running loop, alive
@@ -3005,13 +3027,13 @@ class GenerationEngine:
         t = self._thread
         if t is not None and not t.is_alive():
             return False
-        return (time.monotonic() - self._beat) < self.heartbeat_degraded_s
+        return (self._clock() - self._beat) < self.heartbeat_degraded_s
 
     def supervision_stats(self) -> dict:
         """Restart/quarantine/circuit counters + the loop heartbeat — the
         /healthz evidence that distinguishes a live engine from a wedged or
         degraded one (stale-but-green stats were the old failure mode)."""
-        now = time.monotonic()
+        now = self._clock()
         age = now - self._beat
         degraded = self.degraded()
         # dead-thread detection: a loop thread that died without running its
@@ -3048,7 +3070,7 @@ class GenerationEngine:
         request survives at most ``max_request_restarts`` restarts.  After
         ``max_restarts`` restarts inside ``restart_window_s`` the circuit
         opens: submit() fast-fails EngineUnavailable until the cooldown."""
-        now = time.monotonic()
+        now = self._clock()
         self.engine_restarts += 1
         self._restart_times.append(now)
         salvage: List[_Request] = []

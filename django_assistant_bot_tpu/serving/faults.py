@@ -56,7 +56,7 @@ import os
 import random
 import threading
 import time
-from typing import Any, Dict, Mapping, Optional
+from typing import Any, Callable, Dict, Mapping, Optional
 
 ENGINE_SITES = ("tick_raise", "nan_logits", "detok_raise", "slow_tick")
 HTTP_SITES = ("timeout", "conn_reset", "http_5xx")
@@ -127,8 +127,15 @@ class FaultInjector:
     regardless of how sites interleave.
     """
 
-    def __init__(self, spec: Mapping[str, Any], *, seed: int = 0):
+    def __init__(
+        self,
+        spec: Mapping[str, Any],
+        *,
+        seed: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.seed = int(seed)
+        self._clock = clock
         self._lock = threading.Lock()
         self._sites: Dict[str, _Site] = {}
         self._rngs: Dict[str, random.Random] = {}
@@ -203,7 +210,7 @@ class FaultInjector:
                 fire = True
             if fire:
                 s.fires += 1
-                s.last_fire_monotonic = time.monotonic()
+                s.last_fire_monotonic = self._clock()
             return fire
 
     def maybe_raise(self, site: str, detail: str = "") -> None:
@@ -237,7 +244,7 @@ class FaultInjector:
             )
 
     def last_fire_at(self, site: str) -> Optional[float]:
-        """time.monotonic() of the site's most recent fire (bench: recovery
+        """clock() stamp (default time.monotonic) of the site's most recent fire (bench: recovery
         time is measured from here to the next successful completion)."""
         with self._lock:
             s = self._sites.get(site)

@@ -136,7 +136,15 @@ class _Routed:
         "deadline_at",
     )
 
-    def __init__(self, prompt_ids: List[int], kwargs: dict, outer: Future, shim: _StreamShim):
+    def __init__(
+        self,
+        prompt_ids: List[int],
+        kwargs: dict,
+        outer: Future,
+        shim: _StreamShim,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.prompt_ids = prompt_ids
         self.kwargs = kwargs
         self.outer = outer
@@ -147,10 +155,12 @@ class _Routed:
         # the client's ABSOLUTE deadline, fixed at first submission: each
         # engine.submit computes its own deadline_at from deadline_s, so a
         # re-route must pass the REMAINING budget, not restart the clock —
-        # otherwise every hop silently grants the client a fresh deadline
+        # otherwise every hop silently grants the client a fresh deadline.
+        # The router's injectable clock rides in so fake-time drain tests
+        # see deadline math too (dabtlint DABT105).
         self.deadline_at: Optional[float] = None
         if kwargs.get("deadline_s") is not None:
-            self.deadline_at = time.monotonic() + float(kwargs["deadline_s"])
+            self.deadline_at = clock() + float(kwargs["deadline_s"])
         # replicas whose prefix registry held this prompt's prefix at the
         # last candidate ordering — a hit is counted only when the replica
         # ACTUALLY dispatched to is one of them (a skipped holder is a miss)
@@ -308,6 +318,7 @@ class EngineRouter:
             ),
             outer,
             _StreamShim(stream),
+            clock=self._clock,
         )
         if self._faults is not None and self._faults.should_fire("replica_dead"):
             order = self._candidate_order(state, None)
@@ -434,7 +445,7 @@ class EngineRouter:
                     # _Request.deadline_at; the fleet contract must match —
                     # pass the REMAINING budget, and a hop with none left is
                     # a deadline failure, not a fresh attempt
-                    remaining = state.deadline_at - time.monotonic()
+                    remaining = state.deadline_at - self._clock()
                     if remaining <= 0:
                         from .scheduler import DeadlineExceeded
 

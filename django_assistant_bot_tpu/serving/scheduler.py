@@ -47,7 +47,7 @@ import collections
 import dataclasses
 import threading
 import time
-from typing import Deque, Dict, Mapping, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Mapping, Optional, Tuple
 
 INTERACTIVE = "interactive"
 BACKGROUND = "background"
@@ -121,9 +121,16 @@ class RequestScheduler:
     the cross-thread counters stay coherent).
     """
 
-    def __init__(self, cfg: Optional[SchedulerConfig] = None, *, slots: int = 8):
+    def __init__(
+        self,
+        cfg: Optional[SchedulerConfig] = None,
+        *,
+        slots: int = 8,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.cfg = cfg or SchedulerConfig()
         self._slots = max(1, int(slots))
+        self._clock = clock
         self._lock = threading.Lock()
         self._queues: Dict[Tuple[str, str], Deque] = {}
         self._pass: Dict[Tuple[str, str], float] = {}
@@ -309,12 +316,15 @@ class RequestScheduler:
                 best = (cand, key)
         return best[1] if best is not None else None
 
-    def _reap_head_locked(self, now: float):
+    def _reap_head_locked(self, now: float, expired: List):
         """Drop dead entries (cancelled / expired) from whichever queue is
-        next up, resolving their futures; returns the live (key, req) head or
-        None when everything is empty."""
-        from .engine import _safe_resolve  # local import: engine imports us too
-
+        next up; returns the live (key, req) head or None when everything is
+        empty.  Expired entries are APPENDED to ``expired``, not resolved —
+        resolving a future runs its done-callbacks synchronously (the
+        multi-replica router's re-dispatch takes other locks there), and
+        doing that under ``self._lock`` is exactly the ABBA shape PR 7's
+        review outlawed in :meth:`reap`/:meth:`drain`.  Callers resolve after
+        releasing the lock (found by dabtlint DABT102)."""
         while True:
             key = self._best_key_locked()
             if key is None:
@@ -333,39 +343,50 @@ class RequestScheduler:
                 self._depth = max(0, self._depth - 1)
                 self._release_kv_locked(req)
                 self.expired_queued[key[0]] += 1
-                _safe_resolve(
-                    req.future,
-                    exc=DeadlineExceeded(
-                        f"deadline expired after {now - req.submitted_at:.2f}s in queue"
-                    ),
-                )
+                expired.append(req)
                 continue
             return key, req
+
+    def _resolve_expired(self, expired: List, now: float) -> None:
+        """Fail reaped entries OUTSIDE the lock (see _reap_head_locked)."""
+        from .engine import _safe_resolve  # local import: engine imports us too
+
+        for req in expired:
+            _safe_resolve(
+                req.future,
+                exc=DeadlineExceeded(
+                    f"deadline expired after {now - req.submitted_at:.2f}s in queue"
+                ),
+            )
 
     def peek(self, now: Optional[float] = None):
         """Next request the fair-share policy would run, without removing it
         (dead heads are reaped as a side effect)."""
+        now = now if now is not None else self._clock()
+        expired: List = []
         with self._lock:
-            head = self._reap_head_locked(now if now is not None else time.monotonic())
-            return head[1] if head else None
+            head = self._reap_head_locked(now, expired)
+        self._resolve_expired(expired, now)
+        return head[1] if head else None
 
     def pop(self, now: Optional[float] = None):
         """Remove and return the next request; charges its queue's virtual
         pass (this is the fair-share accounting step)."""
-        now = now if now is not None else time.monotonic()
+        now = now if now is not None else self._clock()
+        expired: List = []
         with self._lock:
-            head = self._reap_head_locked(now)
-            if head is None:
-                return None
-            key, req = head
-            self._queues[key].popleft()
-            self._depth = max(0, self._depth - 1)
-            self._release_kv_locked(req)
-            self._vtime = self._pass[key]
-            self._pass[key] += 1.0 / self._weight(key)
-            self.admitted[key[0]] += 1
-            self._waits[key[0]].append(now - req.submitted_at)
-            return req
+            head = self._reap_head_locked(now, expired)
+            if head is not None:
+                key, req = head
+                self._queues[key].popleft()
+                self._depth = max(0, self._depth - 1)
+                self._release_kv_locked(req)
+                self._vtime = self._pass[key]
+                self._pass[key] += 1.0 / self._weight(key)
+                self.admitted[key[0]] += 1
+                self._waits[key[0]].append(now - req.submitted_at)
+        self._resolve_expired(expired, now)
+        return head[1] if head else None
 
     def reap(self, now: Optional[float] = None) -> int:
         """Drop cancelled/deadline-expired entries ANYWHERE in the queues
@@ -376,7 +397,7 @@ class RequestScheduler:
         Returns the number of entries dropped."""
         from .engine import _safe_resolve
 
-        now = now if now is not None else time.monotonic()
+        now = now if now is not None else self._clock()
         dropped = 0
         expired = []
         with self._lock:

@@ -579,6 +579,13 @@ class AsyncSearcher:
         k_max = max(k for _, k, _ in batch)
         loop = asyncio.get_running_loop()
 
+        # Audited against the PR 7 resolve-under-lock rule (dabtlint DABT102):
+        # these are *asyncio* futures resolved on the event-loop thread with
+        # NO lock held — the batch list was detached from self._pending above,
+        # VectorIndex._lock is only taken inside search_batch's to_thread
+        # worker (released before results return), and asyncio callbacks are
+        # scheduled via call_soon rather than run synchronously.  The deadlock
+        # ingredients (held lock + synchronous done-callback) are both absent.
         async def run():
             try:
                 rows = await asyncio.to_thread(self.index.search_batch, vecs, k_max)

@@ -190,7 +190,7 @@ def test_allocator_property_fuzz_invariants():
         min_prefix_tokens=1,
     )
     held = []  # lists of pages we hold refs on
-    for step in range(2000):
+    for _step in range(2000):
         op = rng.random()
         if op < 0.4:
             n = rng.randint(1, 6)

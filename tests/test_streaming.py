@@ -149,7 +149,7 @@ def test_engine_stream_disconnect_frees_slot_within_tick(stream_engine):
     async def go():
         agen = eng.generate_stream("x" * 16, max_tokens=2000, temperature=0.8)
         got = 0
-        async for c in agen:
+        async for _c in agen:
             got += 1
             if got >= 2:
                 break  # client gone; generator cleanup cancels the future

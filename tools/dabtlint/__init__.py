@@ -1,0 +1,29 @@
+"""dabtlint — concurrency- and hot-path-aware static analysis for the
+django-assistant-bot-tpu serving stack, plus a runtime lock-order witness.
+
+Checkers (docs/STATIC_ANALYSIS.md has the full catalog with the real bugs
+that motivated each):
+
+- DABT101  lock-order cycles (with Future->done-callback edges)
+- DABT102  Future resolved while a lock is held
+- DABT103  blocking calls inside ``async def``
+- DABT104  device->host syncs reachable from the decode hot paths
+- DABT105  raw time in clock-disciplined serving modules
+
+Stdlib-only on purpose: the CI gate runs before any dependency install.
+"""
+
+from .baseline import Baseline, BaselineError
+from .checks import Analysis, run_analysis
+from .findings import CHECKERS, Finding
+from .project import Project
+
+__all__ = [
+    "Analysis",
+    "Baseline",
+    "BaselineError",
+    "CHECKERS",
+    "Finding",
+    "Project",
+    "run_analysis",
+]

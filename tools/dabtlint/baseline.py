@@ -1,0 +1,128 @@
+"""Baseline file: accepted pre-existing findings, each with a justification.
+
+CI gates on *new* findings only: a finding whose ``(code, module, symbol,
+detail)`` key appears in the baseline is reported as accepted, everything
+else fails the run.  Two hard rules keep the baseline honest:
+
+- every entry MUST carry a non-empty ``justification`` that does not start
+  with "TODO" — ``--write-baseline`` emits TODO stubs precisely so an
+  unjustified refresh cannot silently pass CI;
+- stale entries (matching nothing on the current tree) are reported so the
+  baseline shrinks as code improves (warn-only: a fix should not turn CI red).
+
+The ``witness`` section is the runtime half of the same contract: lock
+classes (named by their creation site, ``path::target``) under which the
+lock-order witness accepts Future resolution.  See dabtlint/witness.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .findings import Finding
+
+
+class BaselineError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class Baseline:
+    path: str
+    entries: List[dict]
+    witness: Dict[str, str]  # lock creation-site name -> justification
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(path, [], {})
+        with open(path, "r", encoding="utf-8") as fh:
+            try:
+                data = json.load(fh)
+            except json.JSONDecodeError as e:
+                raise BaselineError(f"{path}: not valid JSON ({e})") from e
+        entries = data.get("findings", [])
+        witness = data.get("witness", {})
+        for i, ent in enumerate(entries):
+            missing = {"code", "module", "symbol", "detail"} - set(ent)
+            if missing:
+                raise BaselineError(
+                    f"{path}: entry {i} is missing {sorted(missing)}"
+                )
+            just = (ent.get("justification") or "").strip()
+            if not just or just.upper().startswith("TODO"):
+                raise BaselineError(
+                    f"{path}: entry {i} ({ent['code']} {ent['module']}::"
+                    f"{ent['symbol']}) has no justification — every accepted "
+                    "finding must say WHY it is safe"
+                )
+        for lock, just in witness.items():
+            just = (just or "").strip()
+            if not just or just.upper().startswith("TODO"):
+                raise BaselineError(
+                    f"{path}: witness entry {lock!r} has no justification"
+                )
+        return cls(path, entries, dict(witness))
+
+    def _keys(self) -> Dict[Tuple[str, str, str, str], dict]:
+        return {
+            (e["code"], e["module"], e["symbol"], e["detail"]): e
+            for e in self.entries
+        }
+
+    def split(
+        self, findings: Sequence[Finding]
+    ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+        """(new, accepted, stale_entries)."""
+        keys = self._keys()
+        matched = set()
+        new: List[Finding] = []
+        accepted: List[Finding] = []
+        for f in findings:
+            if f.key in keys:
+                matched.add(f.key)
+                accepted.append(f)
+            else:
+                new.append(f)
+        stale = [e for k, e in keys.items() if k not in matched]
+        return new, accepted, stale
+
+    @staticmethod
+    def write(
+        path: str,
+        findings: Sequence[Finding],
+        *,
+        keep: Optional["Baseline"] = None,
+    ) -> int:
+        """Write the current finding set as a baseline.  Entries already in
+        ``keep`` that still match keep their justification; new entries get a
+        TODO stub (the loader rejects stubs, forcing a human sentence)."""
+        prior = keep._keys() if keep is not None else {}
+        entries = []
+        for f in sorted(set(x.key for x in findings)):
+            code, module, symbol, detail = f
+            old = prior.get(f)
+            entries.append(
+                {
+                    "code": code,
+                    "module": module,
+                    "symbol": symbol,
+                    "detail": detail,
+                    "justification": (
+                        old["justification"]
+                        if old is not None
+                        else "TODO: justify or fix"
+                    ),
+                }
+            )
+        data = {
+            "findings": entries,
+            "witness": dict(keep.witness) if keep is not None else {},
+        }
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+        return len(entries)

@@ -1,0 +1,148 @@
+"""dabtlint command line.
+
+    dabtlint django_assistant_bot_tpu/                 # gate: exit 1 on new findings
+    dabtlint pkg/ --codes DABT101,DABT102              # subset of checkers
+    dabtlint pkg/ --write-baseline                     # refresh the baseline (TODO stubs!)
+    dabtlint pkg/ --format json                        # machine-readable
+    dabtlint pkg/ --show-accepted                      # print baselined findings too
+
+Exit codes: 0 clean (possibly with baselined/suppressed findings), 1 new
+findings, 2 configuration error (bad baseline, bad code list).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from .baseline import Baseline, BaselineError
+from .checks import Analysis
+from .findings import Finding, parse_code_list
+from .project import Project
+from .suppress import apply_suppressions
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    *,
+    base_dir: Optional[str] = None,
+    select=None,
+):
+    project = Project.load(paths, base_dir=base_dir)
+    findings = Analysis(project).run(select)
+    lines_by_module: Dict[str, List[str]] = {m.relpath: m.lines for m in project.modules}
+    return project, findings, lines_by_module
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dabtlint",
+        description="concurrency- and hot-path-aware static analysis for the "
+        "django-assistant-bot-tpu serving stack (DABT101..DABT105)",
+    )
+    ap.add_argument("paths", nargs="+", help="package directories or files to analyze")
+    ap.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline JSON of accepted findings (default: tools/dabtlint/baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline entirely (report every finding as new)",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write the current finding set to the baseline file; NEW entries "
+        "get a 'TODO' justification stub the loader refuses, so every "
+        "acceptance still needs a human sentence",
+    )
+    ap.add_argument("--codes", default="all", help="comma-separated checker codes to run")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--show-accepted",
+        action="store_true",
+        help="also print findings matched by the baseline",
+    )
+    ap.add_argument("--no-hints", action="store_true", help="omit fix-it hints")
+    args = ap.parse_args(argv)
+
+    try:
+        select = parse_code_list(args.codes)
+    except ValueError as e:
+        print(f"dabtlint: {e}", file=sys.stderr)
+        return 2
+
+    _, findings, lines_by_module = analyze_paths(args.paths, select=select)
+    kept, suppressed, problems = apply_suppressions(findings, lines_by_module)
+
+    baseline = None
+    if not args.no_baseline:
+        try:
+            baseline = Baseline.load(args.baseline)
+        except BaselineError as e:
+            print(f"dabtlint: {e}", file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        n = Baseline.write(args.baseline, kept, keep=baseline)
+        print(
+            f"dabtlint: wrote {n} baseline entr{'y' if n == 1 else 'ies'} to "
+            f"{args.baseline} — fill in every TODO justification before "
+            "committing (the loader rejects stubs)"
+        )
+        return 0
+
+    if baseline is not None:
+        new, accepted, stale = baseline.split(kept)
+    else:
+        new, accepted, stale = list(kept), [], []
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "new": [f.__dict__ for f in new],
+                    "accepted": [f.__dict__ for f in accepted],
+                    "suppressed": [f.__dict__ for f in suppressed],
+                    "stale_baseline_entries": stale,
+                    "suppression_problems": [
+                        {"module": m, "line": line, "problem": p}
+                        for m, line, p in problems
+                    ],
+                },
+                indent=2,
+            )
+        )
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render(show_hint=not args.no_hints))
+    if args.show_accepted:
+        for f in accepted:
+            print(f"[baselined] {f.render(show_hint=False)}")
+    for m, line, p in problems:
+        print(f"{m}:{line}: warning: {p}")
+    for ent in stale:
+        print(
+            f"warning: stale baseline entry ({ent['code']} {ent['module']}::"
+            f"{ent['symbol']}) matches nothing — remove it"
+        )
+    summary = (
+        f"dabtlint: {len(new)} new finding{'s' if len(new) != 1 else ''}, "
+        f"{len(accepted)} baselined, {len(suppressed)} suppressed"
+    )
+    if stale:
+        summary += f", {len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'}"
+    print(summary)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

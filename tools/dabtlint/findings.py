@@ -1,0 +1,122 @@
+"""Finding model and checker registry for dabtlint.
+
+Every checker has a stable code, a one-line description, and a fix-it hint.
+A finding's *identity* — the key the baseline matches on — deliberately
+excludes line numbers: ``(code, module, symbol, detail)``.  Unrelated edits
+above a baselined site must not resurrect it, and a baselined site that moves
+within its function stays baselined.  The ``detail`` string is therefore
+written by checkers from stable names (lock classes, callee names, hot-path
+roots), never from positions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+CHECKERS: Dict[str, Dict[str, str]] = {
+    "DABT101": {
+        "title": "lock-order cycle",
+        "description": (
+            "The static lock-acquisition graph (with-blocks, acquire() spans, "
+            "calls made inside a span, and Future-resolution -> done-callback "
+            "edges) contains a cycle: two threads taking these locks in "
+            "opposite orders can deadlock (the PR 7 router/scheduler ABBA "
+            "shape)."
+        ),
+        "hint": (
+            "Break the cycle: resolve futures and run callbacks OUTSIDE the "
+            "lock (collect under the lock, act after releasing), or impose a "
+            "single global acquisition order."
+        ),
+    },
+    "DABT102": {
+        "title": "future resolved while holding a lock",
+        "description": (
+            "set_result/set_exception/cancel (or a project helper that calls "
+            "them) runs while a lock is held.  Done-callbacks run "
+            "synchronously on the resolving thread and may take other locks "
+            "— the raw material of every ABBA deadlock this repo has shipped."
+        ),
+        "hint": (
+            "Collect the futures under the lock, release it, then resolve "
+            "(see RequestScheduler.drain for the pattern)."
+        ),
+    },
+    "DABT103": {
+        "title": "blocking call in async def",
+        "description": (
+            "A blocking call (time.sleep, sync HTTP, subprocess, an "
+            "un-timed-out acquire) inside an async function stalls the whole "
+            "event loop — every SSE stream and health probe on it."
+        ),
+        "hint": (
+            "Use the async equivalent (asyncio.sleep, aiohttp), offload via "
+            "asyncio.to_thread, or pass a timeout to acquire()."
+        ),
+    },
+    "DABT104": {
+        "title": "device->host sync reachable from a hot path",
+        "description": (
+            "A host-synchronizing call (.item()/.tolist()/np.asarray/"
+            "jax.device_get/block_until_ready, or float()/int() of a traced "
+            "value) is reachable from the decode hot-path registry "
+            "(_process_tick / decode_step* / spec tick / paged ops).  Each "
+            "one stalls the dispatch pipeline for a device round trip."
+        ),
+        "hint": (
+            "Keep device values on device through the tick; batch host reads "
+            "through the existing async copy path, or move the sync off the "
+            "hot path."
+        ),
+    },
+    "DABT105": {
+        "title": "non-injectable time in a clock-disciplined module",
+        "description": (
+            "Raw time.time()/time.monotonic()/time.sleep() in a serving "
+            "module that already follows the injectable clock=/sleep= "
+            "convention.  Raw sites are invisible to fake-clock tests — the "
+            "chaos/drain suites depend on every timestamp being injectable."
+        ),
+        "hint": (
+            "Thread the module's clock()/sleep() parameters through (default "
+            "them to time.monotonic/time.sleep so behavior is unchanged)."
+        ),
+    },
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    code: str
+    module: str  # repo-relative path, '/'-separated
+    symbol: str  # function/method qualname ('<module>' for module level)
+    detail: str  # stable, line-free description (baseline identity)
+    line: int  # 1-based; display only, never part of the identity
+    col: int = 0
+
+    @property
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.code, self.module, self.symbol, self.detail)
+
+    @property
+    def hint(self) -> str:
+        return CHECKERS[self.code]["hint"]
+
+    def render(self, show_hint: bool = True) -> str:
+        head = f"{self.module}:{self.line}: {self.code} [{self.symbol}] {self.detail}"
+        if show_hint:
+            return f"{head}\n    fix: {self.hint}"
+        return head
+
+
+def parse_code_list(text: str) -> Optional[set]:
+    """'DABT101,DABT105' -> {'DABT101', 'DABT105'}; '' / 'all' -> None (all)."""
+    text = (text or "").strip()
+    if not text or text.lower() == "all":
+        return None
+    codes = {c.strip().upper() for c in text.split(",") if c.strip()}
+    unknown = codes - set(CHECKERS)
+    if unknown:
+        raise ValueError(f"unknown checker code(s): {sorted(unknown)}")
+    return codes

@@ -1,0 +1,392 @@
+"""Lock identities, acquisition spans, and per-function event extraction.
+
+A *lock identity* is a class of locks, not an instance — every
+``RequestScheduler`` shares the node ``serving/scheduler.py::RequestScheduler._lock``
+exactly like FreeBSD WITNESS merges lock instances into lock classes.  The
+acquisition-order graph and the cycle check run over these classes.
+
+Per function, one walk produces an ordered event list:
+
+- ``AcquireEvent``  — a ``with lock:`` entry or a bare ``lock.acquire()``,
+  with the set of lock classes already held at that point (a bare acquire
+  holds until the matching ``release()`` in the same statement list, else to
+  the end of the function — a deliberate over-approximation: spans that leak
+  are a finding-shaped smell on their own)
+- ``CallEvent``     — a resolved project call with the held-set at the site
+- ``ResolveEvent``  — a direct future resolution (``set_result`` /
+  ``set_exception`` / ``_resolve``, plus ``cancel`` on a future-named
+  receiver) with the held-set
+- ``RegisterEvent`` — an ``add_done_callback(cb)`` registration; ``cb`` is
+  resolved to project functions (lambdas contribute the calls in their body)
+
+Alias resolution covers the shapes this repo actually writes: ``self._lock``,
+module-level ``_lock``, a local ``lk = self._lock`` rebinding, and locks
+created locally in the function.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from .project import FunctionInfo, ModuleInfo, Project, _is_lock_factory_call
+
+RESOLVE_METHODS = {"set_result", "set_exception", "_resolve"}
+FUTURE_NAME_HINTS = ("fut", "future", "promise")
+
+
+@dataclasses.dataclass
+class AcquireEvent:
+    lock: str
+    held: Tuple[str, ...]
+    line: int
+    blocking_noarg: bool = False  # bare .acquire() with no timeout
+
+
+@dataclasses.dataclass
+class CallEvent:
+    node: ast.Call
+    targets: List[FunctionInfo]
+    held: Tuple[str, ...]
+    line: int
+    display: str
+
+
+@dataclasses.dataclass
+class ResolveEvent:
+    method: str
+    receiver: str
+    held: Tuple[str, ...]
+    line: int
+
+
+@dataclasses.dataclass
+class RegisterEvent:
+    targets: List[FunctionInfo]
+    line: int
+
+
+@dataclasses.dataclass
+class FunctionEvents:
+    fi: FunctionInfo
+    acquires: List[AcquireEvent]
+    calls: List[CallEvent]
+    resolves: List[ResolveEvent]
+    registers: List[RegisterEvent]
+
+
+def _expr_display(expr: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+    return ".".join(reversed(parts)) or "<expr>"
+
+
+def _call_display(call: ast.Call) -> str:
+    return _expr_display(call.func) if not isinstance(call.func, ast.Call) else "<call>"
+
+
+def _walk_no_lambda(node: ast.AST):
+    """ast.walk that does not descend into Lambda bodies (deferred code) —
+    a call inside ``add_done_callback(lambda f: ...)`` runs at resolution
+    time, not at the registration site."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, ast.Lambda):
+                continue
+            stack.append(child)
+
+
+def _looks_like_future(name: str) -> bool:
+    low = name.lower()
+    return any(h in low for h in FUTURE_NAME_HINTS)
+
+
+def _acquire_is_timed(call: ast.Call) -> bool:
+    """True when an ``.acquire(...)`` call cannot block forever: it carries a
+    timeout (kwarg or 2nd positional), or it is the non-blocking try-acquire
+    form (``acquire(False)`` / ``acquire(blocking=False)``)."""
+    if any(kw.arg == "timeout" for kw in call.keywords) or len(call.args) >= 2:
+        return True
+    if call.args and isinstance(call.args[0], ast.Constant) and not call.args[0].value:
+        return True  # acquire(False) / acquire(0): try-acquire, never blocks
+    return any(
+        kw.arg == "blocking"
+        and isinstance(kw.value, ast.Constant)
+        and not kw.value.value
+        for kw in call.keywords
+    )
+
+
+class LockResolver:
+    """Maps lock-shaped expressions to lock-class identities."""
+
+    def __init__(self, project: Project):
+        self.project = project
+
+    def lock_id(
+        self,
+        fi: FunctionInfo,
+        expr: ast.AST,
+        aliases: Dict[str, str],
+        local_locks: Dict[str, str],
+    ) -> Optional[str]:
+        m = fi.module
+        if isinstance(expr, ast.Name):
+            if expr.id in aliases:
+                return aliases[expr.id]
+            if expr.id in local_locks:
+                return local_locks[expr.id]
+            if expr.id in m.module_locks:
+                return f"{m.relpath}::{expr.id}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and fi.cls is not None:
+                    owner = self._class_owning_lock(m, fi.cls, expr.attr)
+                    if owner is not None:
+                        omod, ocls = owner
+                        return f"{omod.relpath}::{ocls}.{expr.attr}"
+                    return None
+                tm = self.project.resolve_module(m, base.id)
+                if tm is not None and expr.attr in tm.module_locks:
+                    return f"{tm.relpath}::{expr.attr}"
+                return None
+            # self.attr._lock — a known-typed attribute's lock
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+                and fi.cls is not None
+            ):
+                ci = m.classes.get(fi.cls)
+                if ci is not None and base.attr in ci.attr_types:
+                    cmod, cname = ci.attr_types[base.attr]
+                    owner = self._class_owning_lock(cmod, cname, expr.attr)
+                    if owner is not None:
+                        omod, ocls = owner
+                        return f"{omod.relpath}::{ocls}.{expr.attr}"
+        return None
+
+    def _class_owning_lock(
+        self, mod: ModuleInfo, cls_name: str, attr: str, _seen=None
+    ) -> Optional[Tuple[ModuleInfo, str]]:
+        _seen = _seen or set()
+        if (id(mod), cls_name) in _seen:
+            return None
+        _seen.add((id(mod), cls_name))
+        ci = mod.classes.get(cls_name)
+        if ci is None:
+            return None
+        if attr in ci.lock_attrs:
+            return (mod, cls_name)
+        for base in ci.bases:
+            resolved = self.project.resolve_class_by_name(mod, base)
+            if resolved is not None:
+                owner = self._class_owning_lock(resolved[0], resolved[1], attr, _seen)
+                if owner is not None:
+                    return owner
+        return None
+
+
+class _FunctionWalker:
+    """One pass over a function body tracking the held lock-class stack."""
+
+    def __init__(self, project: Project, resolver: LockResolver, fi: FunctionInfo):
+        self.project = project
+        self.resolver = resolver
+        self.fi = fi
+        self.aliases: Dict[str, str] = {}
+        self.local_locks: Dict[str, str] = {}
+        self.local_types = project._local_var_types(fi)
+        self.held: List[str] = []
+        self.out = FunctionEvents(fi, [], [], [], [])
+
+    # -- helpers -----------------------------------------------------------
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        return self.resolver.lock_id(self.fi, expr, self.aliases, self.local_locks)
+
+    def _push(self, lock: str, line: int, *, blocking_noarg: bool = False) -> bool:
+        if lock in self.held:
+            return False  # re-entrant view: one class node per thread stack
+        self.out.acquires.append(
+            AcquireEvent(lock, tuple(self.held), line, blocking_noarg)
+        )
+        self.held.append(lock)
+        return True
+
+    def _pop(self, lock: str) -> None:
+        if lock in self.held:
+            self.held.remove(lock)
+
+    # -- statement walk ----------------------------------------------------
+    def walk(self) -> FunctionEvents:
+        self._walk_block(self.fi.node.body)
+        return self.out
+
+    def _walk_block(self, stmts: List[ast.stmt]) -> None:
+        # bare acquires stay held until a release() statement pops them (at
+        # any block level) or the function ends — the deliberate
+        # over-approximation: "may still be held"
+        for stmt in stmts:
+            lock = self._bare_acquire(stmt)
+            if lock is not None:
+                call = stmt.value  # type: ignore[attr-defined]
+                self._push(
+                    lock, stmt.lineno, blocking_noarg=not _acquire_is_timed(call)
+                )
+                self._visit_exprs(stmt)
+                continue
+            rel = self._bare_release(stmt)
+            if rel is not None:
+                self._pop(rel)
+                self._visit_exprs(stmt)
+                continue
+            self._walk_stmt(stmt)
+
+    def _bare_acquire(self, stmt: ast.stmt) -> Optional[str]:
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+            and stmt.value.func.attr == "acquire"
+        ):
+            return self._lock_of(stmt.value.func.value)
+        return None
+
+    def _bare_release(self, stmt: ast.stmt) -> Optional[str]:
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+            and stmt.value.func.attr == "release"
+        ):
+            return self._lock_of(stmt.value.func.value)
+        return None
+
+    def _walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested defs analyzed as their own functions
+        if isinstance(stmt, ast.Assign):
+            self._track_assign(stmt)
+            self._visit_exprs(stmt)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            entered: List[str] = []
+            for item in stmt.items:
+                for node in _walk_no_lambda(item.context_expr):
+                    if isinstance(node, ast.Call):
+                        self._note_call(node)
+                lock = self._lock_of(item.context_expr)
+                if lock is not None and self._push(lock, stmt.lineno):
+                    entered.append(lock)
+            self._walk_block(stmt.body)
+            for lock in entered:
+                self._pop(lock)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_block(stmt.body)
+            for handler in stmt.handlers:
+                self._walk_block(handler.body)
+            self._walk_block(stmt.orelse)
+            self._walk_block(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.If,)):
+            self._visit_exprs(stmt.test)
+            self._walk_block(stmt.body)
+            self._walk_block(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._visit_exprs(stmt.iter)
+            self._walk_block(stmt.body)
+            self._walk_block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self._visit_exprs(stmt.test)
+            self._walk_block(stmt.body)
+            self._walk_block(stmt.orelse)
+            return
+        self._visit_exprs(stmt)
+
+    def _track_assign(self, stmt: ast.Assign) -> None:
+        if _is_lock_factory_call(stmt.value):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    self.local_locks[tgt.id] = (
+                        f"{self.fi.module.relpath}::{self.fi.qualname}.{tgt.id}"
+                    )
+            return
+        lock = self._lock_of(stmt.value)
+        if lock is not None:
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    self.aliases[tgt.id] = lock
+
+    # -- expression-level events -------------------------------------------
+    def _visit_exprs(self, node: ast.AST) -> None:
+        for sub in _walk_no_lambda(node):
+            if isinstance(sub, ast.Call):
+                self._note_call(sub)
+
+    def _note_call(self, call: ast.Call) -> None:
+        func = call.func
+        held = tuple(self.held)
+        if isinstance(func, ast.Attribute):
+            meth = func.attr
+            recv = _expr_display(func.value)
+            if meth in RESOLVE_METHODS or (meth == "cancel" and _looks_like_future(recv)):
+                # skip the lock-shaped false positive: event.set_result doesn't
+                # exist, but lock.acquire/release were handled above
+                self.out.resolves.append(ResolveEvent(meth, recv, held, call.lineno))
+            if meth == "add_done_callback" and call.args:
+                targets = self._callback_targets(call.args[0])
+                self.out.registers.append(RegisterEvent(targets, call.lineno))
+            if meth == "acquire":
+                lock = self._lock_of(func.value)
+                if lock is not None and lock not in self.held:
+                    # non-statement acquire (e.g. `if lock.acquire(timeout=t):`)
+                    self.out.acquires.append(
+                        AcquireEvent(
+                            lock,
+                            held,
+                            call.lineno,
+                            blocking_noarg=not _acquire_is_timed(call),
+                        )
+                    )
+        targets = self.project.resolve_call(self.fi, call, self.local_types)
+        if targets:
+            self.out.calls.append(
+                CallEvent(call, targets, held, call.lineno, _call_display(call))
+            )
+
+    def _callback_targets(self, arg: ast.AST) -> List[FunctionInfo]:
+        if isinstance(arg, ast.Lambda):
+            out: List[FunctionInfo] = []
+            for sub in ast.walk(arg.body):
+                if isinstance(sub, ast.Call):
+                    out.extend(self.project.resolve_call(self.fi, sub, self.local_types))
+            return out
+        if isinstance(arg, ast.Call):  # functools.partial(f, ...)
+            disp = _call_display(arg)
+            if disp.endswith("partial") and arg.args:
+                return self._callback_targets(arg.args[0])
+            return []
+        return self.project.resolve_callable(self.fi, arg, self.local_types)
+
+
+def extract_events(project: Project) -> Dict[str, FunctionEvents]:
+    """display-qualname -> events, for every function in the project."""
+    resolver = LockResolver(project)
+    out: Dict[str, FunctionEvents] = {}
+    for m in project.modules:
+        for fi in m.functions.values():
+            out[fi.display] = _FunctionWalker(project, resolver, fi).walk()
+    return out

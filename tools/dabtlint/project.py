@@ -1,0 +1,414 @@
+"""Project model: modules, symbol tables, and a conservative call graph.
+
+dabtlint is *project-aware*, not whole-program: it parses every ``.py`` file
+under the analyzed roots, builds per-module symbol tables (functions, classes,
+imports, lock-holding attributes), and resolves calls only when it can name
+the target with confidence:
+
+- ``self.method(...)``         -> method of the same class (or a project base)
+- ``name(...)``                -> function in the same module, or one imported
+                                  ``from project.module import name``
+- ``mod.func(...)``            -> function of an imported project module
+- ``self.attr.method(...)``    -> method of ``attr``'s class, when the class is
+                                  known from a constructor assignment
+                                  (``self.attr = ClassName(...)``) or a
+                                  parameter/attribute annotation
+- ``var.method(...)``          -> same, for locals assigned from a project
+                                  class constructor in the same function
+
+Anything else is unresolved and contributes no call edge — missing an edge
+can miss a finding, but never invents one.  The same discipline applies to
+lock identities (see :mod:`dabtlint.locks`).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qualname: str  # "Class.method", "func", or "outer.<locals>.inner"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    module: "ModuleInfo"
+    cls: Optional[str]
+    is_async: bool
+
+    @property
+    def display(self) -> str:
+        return f"{self.module.relpath}::{self.qualname}"
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    bases: List[str]
+    methods: Dict[str, FunctionInfo]
+    # attribute -> (module, classname) of the attribute's project class
+    attr_types: Dict[str, Tuple["ModuleInfo", str]]
+    # attributes assigned threading.Lock()/RLock()/Condition()/Semaphore()
+    lock_attrs: Dict[str, int]  # attr -> lineno of creation
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str
+    relpath: str  # '/'-separated, relative to the analysis root's parent
+    modname: str  # dotted module name ("pkg.serving.engine")
+    tree: ast.Module
+    lines: List[str]
+    functions: Dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+    # local name -> fully dotted target ("pkg.mod" or "pkg.mod.attr")
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    module_locks: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def __hash__(self):  # identity hash: one object per parsed file
+        return id(self)
+
+
+def _is_lock_factory_call(node: ast.AST) -> bool:
+    """threading.Lock() / threading.RLock() / Lock() (imported) ..."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in LOCK_FACTORIES:
+        return isinstance(f.value, ast.Name) and f.value.id == "threading"
+    if isinstance(f, ast.Name) and f.id in LOCK_FACTORIES:
+        return True
+    return False
+
+
+def _annotation_class_name(ann: ast.AST) -> Optional[str]:
+    """Extract a plain class name out of `X`, `"X"`, `Optional[X]`,
+    `Optional["X"]`.  Anything fancier resolves to None (no type info)."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value.strip()
+        return name if name.isidentifier() else None
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Subscript):
+        base = ann.value
+        base_name = base.attr if isinstance(base, ast.Attribute) else getattr(base, "id", "")
+        if base_name == "Optional":
+            return _annotation_class_name(ann.slice)
+    return None
+
+
+class Project:
+    """All parsed modules plus name-resolution helpers."""
+
+    def __init__(self, root_label: str = ""):
+        self.modules: List[ModuleInfo] = []
+        self.by_modname: Dict[str, ModuleInfo] = {}
+        self.root_label = root_label
+
+    # ----------------------------------------------------------------- loading
+    @classmethod
+    def load(cls, roots: Sequence[str], *, base_dir: Optional[str] = None) -> "Project":
+        """Parse every .py file under each root (a package dir or a single
+        file).  ``base_dir`` anchors relpaths/modnames; defaults to each
+        root's parent so `pkg/sub/mod.py` becomes modname `pkg.sub.mod`."""
+        proj = cls()
+        for root in roots:
+            root = os.path.abspath(root)
+            anchor = os.path.abspath(base_dir) if base_dir else os.path.dirname(root)
+            if os.path.isfile(root):
+                proj._load_file(root, anchor)
+                continue
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in {"__pycache__", ".git"}
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        proj._load_file(os.path.join(dirpath, fn), anchor)
+        proj._index()
+        return proj
+
+    def _load_file(self, path: str, anchor: str) -> None:
+        rel = os.path.relpath(path, anchor).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+        except (SyntaxError, UnicodeDecodeError):
+            return  # dabtlint is not a syntax checker; skip unparsable files
+        modname = rel[:-3].replace("/", ".")
+        if modname.endswith(".__init__"):
+            modname = modname[: -len(".__init__")]
+        self.modules.append(
+            ModuleInfo(
+                path=path,
+                relpath=rel,
+                modname=modname,
+                tree=tree,
+                lines=src.splitlines(),
+            )
+        )
+
+    # ---------------------------------------------------------------- indexing
+    def _index(self) -> None:
+        for m in self.modules:
+            self.by_modname[m.modname] = m
+        for m in self.modules:
+            self._index_module(m)
+        # attribute types need imports + classes of every module, so a second
+        # pass resolves them once the whole project is indexed
+        for m in self.modules:
+            for ci in m.classes.values():
+                self._index_attr_types(m, ci)
+
+    def _index_module(self, m: ModuleInfo) -> None:
+        # imports anywhere in the module (function-local "from .engine import
+        # _safe_resolve" is the repo's circular-import idiom — those names
+        # must resolve or the interprocedural summaries go blind)
+        for node in ast.walk(m.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._index_import(m, node)
+        for node in m.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(m, node, prefix="", cls=None)
+            elif isinstance(node, ast.ClassDef):
+                self._index_class(m, node)
+            elif isinstance(node, ast.Assign):
+                if _is_lock_factory_call(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            m.module_locks[tgt.id] = node.lineno
+
+    def _index_import(self, m: ModuleInfo, node: ast.AST) -> None:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    m.imports[alias.asname] = alias.name
+                else:
+                    root = alias.name.split(".")[0]
+                    m.imports[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative import: resolve against this module
+                parts = m.modname.split(".")
+                # level 1 = same package; __init__ modnames already dropped
+                base = parts[: len(parts) - node.level]
+                prefix = ".".join(base + ([node.module] if node.module else []))
+            else:
+                prefix = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                target = f"{prefix}.{alias.name}" if prefix else alias.name
+                m.imports[alias.asname or alias.name] = target
+
+    def _index_function(
+        self, m: ModuleInfo, node: ast.AST, *, prefix: str, cls: Optional[str]
+    ) -> None:
+        qualname = f"{prefix}{node.name}" if prefix else node.name
+        fi = FunctionInfo(
+            qualname=qualname,
+            node=node,
+            module=m,
+            cls=cls,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+        )
+        m.functions[qualname] = fi
+        if cls is not None and "." in qualname and "<locals>" not in qualname:
+            m.classes[cls].methods[node.name] = fi
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(
+                    m, child, prefix=f"{qualname}.<locals>.", cls=cls
+                )
+
+    def _index_class(self, m: ModuleInfo, node: ast.ClassDef) -> None:
+        bases = []
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                bases.append(b.attr)
+        ci = ClassInfo(node.name, bases, {}, {}, {})
+        m.classes[node.name] = ci
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(m, child, prefix=f"{node.name}.", cls=node.name)
+            elif isinstance(child, ast.Assign) and _is_lock_factory_call(child.value):
+                for tgt in child.targets:
+                    if isinstance(tgt, ast.Name):
+                        ci.lock_attrs[tgt.id] = child.lineno
+
+    def _index_attr_types(self, m: ModuleInfo, ci: ClassInfo) -> None:
+        """self.X = ClassName(...) / self.X = <param annotated ClassName> /
+        self.X = threading.Lock() inside any method of the class."""
+        for fi in ci.methods.values():
+            params_by_name = {}
+            args = fi.node.args
+            for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+                params_by_name[a.arg] = _annotation_class_name(a.annotation)
+            for stmt in ast.walk(fi.node):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for tgt in stmt.targets:
+                    if not (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        continue
+                    attr = tgt.attr
+                    if _is_lock_factory_call(stmt.value):
+                        ci.lock_attrs.setdefault(attr, stmt.lineno)
+                        continue
+                    resolved = None
+                    if isinstance(stmt.value, ast.Call):
+                        resolved = self.resolve_class(m, stmt.value.func)
+                    elif isinstance(stmt.value, ast.Name):
+                        ann = params_by_name.get(stmt.value.id)
+                        if ann:
+                            resolved = self.resolve_class_by_name(m, ann)
+                    if resolved is not None:
+                        ci.attr_types.setdefault(attr, resolved)
+
+    # -------------------------------------------------------------- resolution
+    def resolve_module(self, m: ModuleInfo, name: str) -> Optional[ModuleInfo]:
+        target = m.imports.get(name, name)
+        return self.by_modname.get(target)
+
+    def resolve_class_by_name(
+        self, m: ModuleInfo, name: str
+    ) -> Optional[Tuple[ModuleInfo, str]]:
+        if name in m.classes:
+            return (m, name)
+        target = m.imports.get(name)
+        if target and "." in target:
+            modname, _, cls_name = target.rpartition(".")
+            tm = self.by_modname.get(modname)
+            if tm is not None and cls_name in tm.classes:
+                return (tm, cls_name)
+        return None
+
+    def resolve_class(
+        self, m: ModuleInfo, func_expr: ast.AST
+    ) -> Optional[Tuple[ModuleInfo, str]]:
+        """The class a constructor expression names (Name or mod.Name)."""
+        if isinstance(func_expr, ast.Name):
+            return self.resolve_class_by_name(m, func_expr.id)
+        if isinstance(func_expr, ast.Attribute) and isinstance(func_expr.value, ast.Name):
+            tm = self.resolve_module(m, func_expr.value.id)
+            if tm is not None and func_expr.attr in tm.classes:
+                return (tm, func_expr.attr)
+        return None
+
+    def class_method(
+        self, mod: ModuleInfo, cls_name: str, meth: str, _seen=None
+    ) -> Optional[FunctionInfo]:
+        """Method lookup through project base classes (by name)."""
+        _seen = _seen or set()
+        if (id(mod), cls_name) in _seen:
+            return None
+        _seen.add((id(mod), cls_name))
+        ci = mod.classes.get(cls_name)
+        if ci is None:
+            return None
+        if meth in ci.methods:
+            return ci.methods[meth]
+        for base in ci.bases:
+            resolved = self.resolve_class_by_name(mod, base)
+            if resolved is not None:
+                found = self.class_method(resolved[0], resolved[1], meth, _seen)
+                if found is not None:
+                    return found
+        return None
+
+    def _local_var_types(self, fi: FunctionInfo) -> Dict[str, Tuple[ModuleInfo, str]]:
+        """name -> project class, for `v = ClassName(...)` locals."""
+        out: Dict[str, Tuple[ModuleInfo, str]] = {}
+        for stmt in ast.walk(fi.node):
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                resolved = self.resolve_class(fi.module, stmt.value.func)
+                if resolved is None:
+                    continue
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.setdefault(tgt.id, resolved)
+        return out
+
+    def resolve_call(
+        self,
+        fi: FunctionInfo,
+        call: ast.Call,
+        local_types: Optional[Dict[str, Tuple[ModuleInfo, str]]] = None,
+    ) -> List[FunctionInfo]:
+        """Project functions a call may target; [] when unresolvable."""
+        return self.resolve_callable(fi, call.func, local_types)
+
+    def resolve_callable(
+        self,
+        fi: FunctionInfo,
+        func: ast.AST,
+        local_types: Optional[Dict[str, Tuple[ModuleInfo, str]]] = None,
+    ) -> List[FunctionInfo]:
+        m = fi.module
+        if isinstance(func, ast.Name):
+            name = func.id
+            # nested function defined in an enclosing scope of this function
+            scope = fi.qualname
+            while True:
+                nested = m.functions.get(f"{scope}.<locals>.{name}")
+                if nested is not None:
+                    return [nested]
+                if ".<locals>." not in scope:
+                    break
+                scope = scope.rsplit(".<locals>.", 1)[0]
+            if name in m.functions:
+                return [m.functions[name]]
+            cls = self.resolve_class_by_name(m, name)
+            if cls is not None:
+                init = self.class_method(cls[0], cls[1], "__init__")
+                return [init] if init is not None else []
+            target = m.imports.get(name)
+            if target and "." in target:
+                modname, _, fname = target.rpartition(".")
+                tm = self.by_modname.get(modname)
+                if tm is not None and fname in tm.functions:
+                    return [tm.functions[fname]]
+            return []
+        if not isinstance(func, ast.Attribute):
+            return []
+        meth = func.attr
+        value = func.value
+        if isinstance(value, ast.Name):
+            if value.id == "self" and fi.cls is not None:
+                found = self.class_method(m, fi.cls, meth)
+                return [found] if found is not None else []
+            tm = self.resolve_module(m, value.id)
+            if tm is not None and meth in tm.functions:
+                return [tm.functions[meth]]
+            ltypes = local_types or {}
+            if value.id in ltypes:
+                cmod, cname = ltypes[value.id]
+                found = self.class_method(cmod, cname, meth)
+                return [found] if found is not None else []
+            cls = self.resolve_class_by_name(m, value.id)
+            if cls is not None:  # ClassName.method(...) — unbound/static use
+                found = self.class_method(cls[0], cls[1], meth)
+                return [found] if found is not None else []
+            return []
+        if (
+            isinstance(value, ast.Attribute)
+            and isinstance(value.value, ast.Name)
+            and value.value.id == "self"
+            and fi.cls is not None
+        ):
+            ci = m.classes.get(fi.cls)
+            if ci is not None and value.attr in ci.attr_types:
+                cmod, cname = ci.attr_types[value.attr]
+                found = self.class_method(cmod, cname, meth)
+                return [found] if found is not None else []
+        return []

@@ -1,0 +1,73 @@
+"""Inline suppression: ``# dabtlint: ignore[DABT102] <reason>``.
+
+A suppression comment applies to findings on its own line, or — when the
+comment stands alone on a line — to the first following non-comment line.
+The reason is mandatory: a bare ``ignore[...]`` suppresses nothing and is
+itself reported, so every silenced finding carries its WHY in the source.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Sequence, Set, Tuple
+
+from .findings import Finding
+
+_RE = re.compile(r"#\s*dabtlint:\s*ignore\[([A-Za-z0-9_,\s]+)\]\s*(.*?)\s*$")
+
+
+def _parse_line(line: str) -> Tuple[Set[str], str] | None:
+    m = _RE.search(line)
+    if not m:
+        return None
+    codes = {c.strip().upper() for c in m.group(1).split(",") if c.strip()}
+    return codes, m.group(2).strip()
+
+
+def suppressions_for(lines: Sequence[str]) -> Tuple[Dict[int, Set[str]], List[Tuple[int, str]]]:
+    """lineno(1-based) -> suppressed codes; plus [(lineno, problem)] for
+    malformed suppressions (missing reason)."""
+    out: Dict[int, Set[str]] = {}
+    bad: List[Tuple[int, str]] = []
+    for i, line in enumerate(lines, start=1):
+        parsed = _parse_line(line)
+        if parsed is None:
+            continue
+        codes, reason = parsed
+        if not reason:
+            bad.append((i, "suppression without a reason (ignored)"))
+            continue
+        target = i
+        if line.lstrip().startswith("#"):
+            # standalone comment: applies to the next non-comment source line
+            j = i + 1
+            while j <= len(lines) and (
+                not lines[j - 1].strip() or lines[j - 1].lstrip().startswith("#")
+            ):
+                j += 1
+            target = j
+        out.setdefault(target, set()).update(codes)
+        if target != i:
+            out.setdefault(i, set()).update(codes)
+    return out, bad
+
+
+def apply_suppressions(
+    findings: Sequence[Finding], lines_by_module: Dict[str, Sequence[str]]
+) -> Tuple[List[Finding], List[Finding], List[Tuple[str, int, str]]]:
+    """(kept, suppressed, problems)."""
+    cache: Dict[str, Tuple[Dict[int, Set[str]], List[Tuple[int, str]]]] = {}
+    problems: List[Tuple[str, int, str]] = []
+    for module, lines in lines_by_module.items():
+        cache[module] = suppressions_for(lines)
+        for lineno, why in cache[module][1]:
+            problems.append((module, lineno, why))
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        supp = cache.get(f.module, ({}, []))[0]
+        if f.code in supp.get(f.line, set()):
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    return kept, suppressed, problems

@@ -1,0 +1,444 @@
+"""Runtime lock-order witness — the dynamic half of DABT101/DABT102.
+
+Opt-in (``DABT_LOCK_WITNESS=1``): the repo-root conftest registers
+:class:`WitnessPlugin`, which monkeypatches ``threading.Lock``/``RLock`` so
+that every lock *created by project code* (caller filename under the project
+root) is wrapped.  The wrapper maintains a per-thread held stack and a global
+acquisition-order graph over lock *classes* — locks are classed by their
+creation site (``path::assignment-target``), so every ``RequestScheduler``
+instance shares one node, exactly like FreeBSD WITNESS lock classes.
+
+Recorded violations (reported at session end; the session FAILS on any):
+
+- **lock-order cycle** — acquiring B while holding A when the graph already
+  knows a B -> ... -> A path.  Orders are recorded *before* blocking, so two
+  suites that each take only one side of an ABBA pair still convict the pair.
+- **same-class nesting** — acquiring a lock of class A while holding a
+  *different instance* of A (the scheduler<->scheduler double-death deadlock
+  of PR 7: no single-threaded order exists between peer instances).
+- **future resolved under a held lock** — ``Future.set_result`` /
+  ``set_exception`` / ``cancel`` called while the thread holds any witnessed
+  lock whose class is not in the baseline's witness allowlist
+  (done-callbacks run synchronously on the resolving thread; see DABT102).
+
+The static pass proves what the AST can see; the witness confirms what the
+test suites actually execute — including orders through jitted callbacks and
+dynamic dispatch the AST cannot resolve.
+"""
+
+from __future__ import annotations
+
+import linecache
+import os
+import re
+import sys
+import threading
+import traceback
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+_ASSIGN_RE = re.compile(r"([A-Za-z_][\w\.]*)\s*=\s*threading\.(?:R?Lock)\s*\(")
+
+
+class WitnessViolation:
+    def __init__(self, kind: str, description: str, stack: str):
+        self.kind = kind
+        self.description = description
+        self.stack = stack
+
+    def __repr__(self):
+        return f"<WitnessViolation {self.kind}: {self.description}>"
+
+    def render(self) -> str:
+        return f"[{self.kind}] {self.description}\n{self.stack}"
+
+
+def _stack_summary(limit: int = 14) -> str:
+    frames = traceback.extract_stack()[:-3]
+    interesting = [
+        f
+        for f in frames
+        if "site-packages" not in f.filename and os.sep + "lib" + os.sep not in f.filename
+    ] or frames
+    return "".join(
+        f"    {os.path.basename(f.filename)}:{f.lineno} in {f.name}\n"
+        for f in interesting[-limit:]
+    )
+
+
+class _Held:
+    __slots__ = ("cls", "instance", "count")
+
+    def __init__(self, cls: str, instance: int):
+        self.cls = cls
+        self.instance = instance
+        self.count = 1
+
+
+class LockOrderWitness:
+    """The global recorder.  One instance per installed session."""
+
+    def __init__(
+        self,
+        project_root: str,
+        *,
+        allowed_held: Optional[Dict[str, str]] = None,
+        real_lock_factory=None,
+    ):
+        self.project_root = os.path.abspath(project_root)
+        # lock classes allowed to be held across a Future resolution,
+        # name -> justification (the baseline's "witness" section)
+        self.allowed_held = dict(allowed_held or {})
+        self._factory = real_lock_factory or threading.Lock
+        self._mu = self._factory()  # a REAL lock: guards graph + violations
+        self._graph: Dict[str, set] = {}
+        self._edge_sites: Dict[Tuple[str, str], str] = {}
+        self.lock_classes: Dict[str, int] = {}  # class name -> instances made
+        self.violations: List[WitnessViolation] = []
+        self._dedupe: set = set()
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------- held stack
+    def _held(self) -> List[_Held]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def held_classes(self) -> List[str]:
+        return [h.cls for h in self._held()]
+
+    # ---------------------------------------------------------------- events
+    def note_acquire(
+        self, cls: str, instance: int, reentrant: bool, blocking: bool = True
+    ) -> None:
+        held = self._held()
+        for h in held:
+            if h.instance == instance:
+                if reentrant:
+                    h.count += 1
+                    return
+                if blocking:
+                    # non-reentrant BLOCKING re-acquire of the same instance:
+                    # a guaranteed self-deadlock the real acquire demonstrates
+                    # (a try-acquire just returns False — legal, not flagged)
+                    self._record(
+                        "self-deadlock",
+                        f"thread re-acquires non-reentrant lock {cls}",
+                    )
+                break
+        else:
+            for h in held:
+                if h.cls == cls and h.instance != instance:
+                    self._record(
+                        "same-class-nesting",
+                        f"acquiring {cls} while holding a different instance "
+                        f"of {cls} — peer instances have no global order "
+                        "(two threads nesting opposite instances deadlock)",
+                    )
+            self._note_edges(cls, [h.cls for h in held if h.cls != cls])
+            held.append(_Held(cls, instance))
+            return
+        held.append(_Held(cls, instance))
+
+    def note_release(self, instance: int) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].instance == instance:
+                held[i].count -= 1
+                if held[i].count <= 0:
+                    del held[i]
+                return
+
+    def note_acquire_failed(self, instance: int) -> None:
+        # timed acquire that returned False: the edge stays recorded (the
+        # ORDER was attempted) but the lock is not held
+        self.note_release(instance)
+
+    def _note_edges(self, new: str, held_classes: List[str]) -> None:
+        if not held_classes:
+            return
+        with self._mu:
+            for h in held_classes:
+                if (h, new) in self._edge_sites:
+                    continue
+                # does the reverse path already exist?  check BEFORE adding,
+                # so the cycle is reported exactly once, at the closing edge
+                path = self._path(new, h)
+                self._graph.setdefault(h, set()).add(new)
+                self._graph.setdefault(new, set())
+                self._edge_sites[(h, new)] = _stack_summary()
+                if path is not None:
+                    cyc = " -> ".join([h, new] + path[1:])
+                    first = self._edge_sites.get(
+                        (new, path[1]) if len(path) > 1 else (new, h), ""
+                    )
+                    self._record_unlocked(
+                        "lock-order-cycle",
+                        f"acquisition order cycle: {cyc} (this thread took "
+                        f"{h} then {new}; an earlier order took the reverse "
+                        "path)",
+                        extra=f"  reverse-order site:\n{first}" if first else "",
+                    )
+
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        """Path src -> ... -> dst in the current graph (call with _mu held)."""
+        if src == dst:
+            return [src]
+        seen = {src}
+        stack = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(self._graph.get(node, ())):
+                if nxt == dst:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def note_future_resolution(self, what: str) -> None:
+        held = [
+            h.cls for h in self._held() if h.cls not in self.allowed_held
+        ]
+        if held:
+            self._record(
+                "future-under-lock",
+                f"Future.{what}() while holding {', '.join(sorted(set(held)))}"
+                " — done-callbacks run synchronously under that lock",
+            )
+
+    # ------------------------------------------------------------- recording
+    def _record(self, kind: str, description: str, extra: str = "") -> None:
+        with self._mu:
+            self._record_unlocked(kind, description, extra=extra)
+
+    def _record_unlocked(self, kind: str, description: str, extra: str = "") -> None:
+        key = (kind, description)
+        if key in self._dedupe:
+            return
+        self._dedupe.add(key)
+        self.violations.append(
+            WitnessViolation(kind, description, _stack_summary() + extra)
+        )
+
+    # ---------------------------------------------------------------- naming
+    def class_name_for_creation(self, filename: str, lineno: int) -> str:
+        rel = os.path.relpath(filename, os.path.dirname(self.project_root)).replace(
+            os.sep, "/"
+        )
+        line = linecache.getline(filename, lineno)
+        m = _ASSIGN_RE.search(line)
+        target = m.group(1) if m else f"line{lineno}"
+        name = f"{rel}::{target}"
+        with self._mu:
+            self.lock_classes[name] = self.lock_classes.get(name, 0) + 1
+        return name
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "lock_classes": len(self.lock_classes),
+                "order_edges": len(self._edge_sites),
+                "violations": len(self.violations),
+            }
+
+
+class WitnessedLock:
+    """Wraps a real ``threading.Lock``/``RLock`` with witness bookkeeping."""
+
+    __slots__ = ("_lock", "_witness", "_cls", "_reentrant")
+
+    def __init__(self, real, witness: LockOrderWitness, cls: str, reentrant: bool):
+        self._lock = real
+        self._witness = witness
+        self._cls = cls
+        self._reentrant = reentrant
+
+    def acquire(self, *args, **kwargs):
+        # record the attempted ORDER before blocking: a real ABBA interleaving
+        # hangs in the real acquire below, but the witness has already
+        # convicted the order by then
+        blocking = bool(args[0]) if args else bool(kwargs.get("blocking", True))
+        self._witness.note_acquire(
+            self._cls, id(self), self._reentrant, blocking=blocking
+        )
+        ok = self._lock.acquire(*args, **kwargs)
+        if not ok:
+            self._witness.note_acquire_failed(id(self))
+        return ok
+
+    def release(self):
+        self._lock.release()
+        self._witness.note_release(id(self))
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __repr__(self):
+        return f"<WitnessedLock {self._cls} of {self._lock!r}>"
+
+
+_installed: Optional[dict] = None
+
+
+def install(witness: LockOrderWitness) -> LockOrderWitness:
+    """Patch threading.Lock/RLock and Future resolution.  Locks created by
+    files under ``witness.project_root`` are wrapped; everything else (stdlib,
+    jax, site-packages) gets the real thing."""
+    global _installed
+    if _installed is not None:
+        raise RuntimeError("lock-order witness already installed")
+    real_lock = threading.Lock
+    real_rlock = threading.RLock
+
+    def make_lock():
+        caller = sys._getframe(1)
+        if caller.f_code.co_filename.startswith(witness.project_root):
+            cls = witness.class_name_for_creation(
+                caller.f_code.co_filename, caller.f_lineno
+            )
+            return WitnessedLock(real_lock(), witness, cls, reentrant=False)
+        return real_lock()
+
+    def make_rlock():
+        caller = sys._getframe(1)
+        if caller.f_code.co_filename.startswith(witness.project_root):
+            cls = witness.class_name_for_creation(
+                caller.f_code.co_filename, caller.f_lineno
+            )
+            return WitnessedLock(real_rlock(), witness, cls, reentrant=True)
+        return real_rlock()
+
+    real_set_result = Future.set_result
+    real_set_exception = Future.set_exception
+    real_cancel = Future.cancel
+
+    def set_result(self, result):
+        witness.note_future_resolution("set_result")
+        return real_set_result(self, result)
+
+    def set_exception(self, exc):
+        witness.note_future_resolution("set_exception")
+        return real_set_exception(self, exc)
+
+    def cancel(self):
+        cancelled = real_cancel(self)
+        if cancelled:
+            # only a SUCCESSFUL cancel runs done-callbacks; a False return
+            # (already running/done) invokes nothing and is hazard-free
+            witness.note_future_resolution("cancel")
+        return cancelled
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    Future.set_result = set_result
+    Future.set_exception = set_exception
+    Future.cancel = cancel
+    _installed = {
+        "witness": witness,
+        "Lock": real_lock,
+        "RLock": real_rlock,
+        "set_result": real_set_result,
+        "set_exception": real_set_exception,
+        "cancel": real_cancel,
+    }
+    return witness
+
+
+def uninstall() -> Optional[LockOrderWitness]:
+    global _installed
+    if _installed is None:
+        return None
+    threading.Lock = _installed["Lock"]
+    threading.RLock = _installed["RLock"]
+    Future.set_result = _installed["set_result"]
+    Future.set_exception = _installed["set_exception"]
+    Future.cancel = _installed["cancel"]
+    witness = _installed["witness"]
+    _installed = None
+    return witness
+
+
+def load_witness_allowlist(baseline_path: str) -> Dict[str, str]:
+    import json
+
+    if not os.path.exists(baseline_path):
+        return {}
+    try:
+        with open(baseline_path, "r", encoding="utf-8") as fh:
+            return dict(json.load(fh).get("witness", {}))
+    except (ValueError, OSError):
+        return {}
+
+
+def pytest_configure(config):
+    """Module-level hook so ``pytest -p dabtlint.witness`` works without the
+    repo-root conftest (subprocess tests, other repos).  Env-driven:
+    DABT_LOCK_WITNESS=1 arms it, DABT_WITNESS_ROOT names the project root,
+    DABT_WITNESS_BASELINE (optional) the baseline with the witness
+    allowlist."""
+    if os.environ.get("DABT_LOCK_WITNESS") != "1":
+        return
+    if config.pluginmanager.has_plugin("dabt-lock-witness"):
+        return
+    root = os.environ.get("DABT_WITNESS_ROOT")
+    if not root:
+        return
+    config.pluginmanager.register(
+        WitnessPlugin(root, os.environ.get("DABT_WITNESS_BASELINE")),
+        "dabt-lock-witness",
+    )
+
+
+class WitnessPlugin:
+    """Pytest plugin: install at configure, report + fail at session end.
+
+    Registered by the repo-root conftest when ``DABT_LOCK_WITNESS=1`` — see
+    docs/STATIC_ANALYSIS.md for the local workflow."""
+
+    def __init__(self, project_root: str, baseline_path: Optional[str] = None):
+        self.witness = LockOrderWitness(
+            project_root,
+            allowed_held=(
+                load_witness_allowlist(baseline_path) if baseline_path else {}
+            ),
+        )
+
+    def pytest_configure(self, config):
+        install(self.witness)
+
+    def pytest_sessionfinish(self, session, exitstatus):
+        uninstall()
+        if self.witness.violations:
+            # wrap_session re-reads session.exitstatus after the finally that
+            # fires this hook, so setting it here fails the run
+            session.exitstatus = 1
+
+    def pytest_terminal_summary(self, terminalreporter):
+        tr = terminalreporter
+        stats = self.witness.stats()
+        tr.section("lock-order witness (DABT_LOCK_WITNESS=1)")
+        tr.line(
+            f"{stats['lock_classes']} project lock class(es), "
+            f"{stats['order_edges']} acquisition-order edge(s), "
+            f"{stats['violations']} violation(s)"
+        )
+        for v in self.witness.violations:
+            tr.line("")
+            tr.line(v.render())
+        if self.witness.violations:
+            tr.line("")
+            tr.line(
+                "the session FAILS on witness violations; accepted lock "
+                "classes live in tools/dabtlint/baseline.json ('witness' "
+                "section, justification required)"
+            )
