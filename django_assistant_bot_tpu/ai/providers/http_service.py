@@ -14,6 +14,7 @@ import email.utils
 import json
 import logging
 import random
+import uuid
 from typing import List, Optional
 
 import aiohttp
@@ -107,7 +108,16 @@ async def _iter_sse_lines(content):
         yield buf.decode("utf-8", errors="replace").strip()
 
 
-async def _post_with_shed_retry(session, url: str, payload: dict, *, idempotent: bool = True):
+def _new_request_id() -> str:
+    """Wire-format twin of serving.obs.new_trace_id — duplicated here so HTTP
+    client processes never import the jax-heavy serving package (the same
+    discipline as `_fault_injector` above)."""
+    return uuid.uuid4().hex[:16]
+
+
+async def _post_with_shed_retry(
+    session, url: str, payload: dict, *, idempotent: bool = True, headers=None
+):
     """POST with the bounded retry policy.
 
     - **429** (scheduler load shed) always retries, honoring ``Retry-After``
@@ -118,6 +128,10 @@ async def _post_with_shed_retry(session, url: str, payload: dict, *, idempotent:
       ``Retry-After`` wins over the computed backoff.
     - Everything else raises immediately; a still-failing server surfaces its
       final error to the caller after ``SHED_RETRIES`` retries.
+
+    ``headers`` ride on every attempt unchanged — the caller's
+    ``X-Request-Id`` stays constant across shed retries, so a 429 and the
+    retry that follows it correlate server-side by one trace id.
     """
     inj = _fault_injector()
     for attempt in range(SHED_RETRIES + 1):
@@ -127,7 +141,7 @@ async def _post_with_shed_retry(session, url: str, payload: dict, *, idempotent:
                 # chaos plane: injected timeout/conn_reset/http_5xx exercise
                 # this very retry policy without a misbehaving server
                 inj.raise_http_fault(url)
-            resp = await session.post(url, json=payload)
+            resp = await session.post(url, json=payload, headers=headers)
         except aiohttp.ClientResponseError as e:
             # a response-shaped failure (incl. the injector's http_5xx);
             # the server's Retry-After still wins over the computed backoff
@@ -195,6 +209,10 @@ class GPUServiceProvider(AIProvider):
         self._deadline_s = deadline_s
         self._timeout = aiohttp.ClientTimeout(total=timeout_s)
         self.calls_attempts: List[int] = []
+        # the X-Request-Id of the most recent call (observability: callers
+        # quote it when reporting a failed turn; the server's trace ring and
+        # flight-recorder events carry the same id)
+        self.last_request_id: Optional[str] = None
 
     @property
     def context_size(self) -> int:
@@ -220,11 +238,24 @@ class GPUServiceProvider(AIProvider):
         }
         if self._deadline_s is not None:
             payload["deadline_s"] = self._deadline_s
+        # one trace id per logical call, constant across shed retries; the
+        # server echoes it on every response shape (and uses it as the
+        # engine-side trace_id), so client and server logs correlate
+        rid = _new_request_id()
+        self.last_request_id = rid
         async with aiohttp.ClientSession(timeout=self._timeout) as session:
             async with await _post_with_shed_retry(
-                session, f"{self._base}/dialog/", payload
+                session,
+                f"{self._base}/dialog/",
+                payload,
+                headers={"X-Request-Id": rid},
             ) as resp:
                 data = await resp.json()
+                echoed = resp.headers.get("X-Request-Id")
+                if echoed and echoed != rid:  # pragma: no cover - server bug
+                    logger.warning(
+                        "X-Request-Id mismatch: sent %s, got %s", rid, echoed
+                    )
         body = data["response"]
         result = body["result"]
         if json_format and isinstance(result, str):
@@ -265,10 +296,15 @@ class GPUServiceProvider(AIProvider):
         }
         if self._deadline_s is not None:
             payload["deadline_s"] = self._deadline_s
+        rid = _new_request_id()
+        self.last_request_id = rid
         acc: List[str] = []
         async with aiohttp.ClientSession(timeout=self._timeout) as session:
             async with await _post_with_shed_retry(
-                session, f"{self._base}/dialog/", payload
+                session,
+                f"{self._base}/dialog/",
+                payload,
+                headers={"X-Request-Id": rid},
             ) as resp:
                 async for line in _iter_sse_lines(resp.content):
                     if not line.startswith("data:"):

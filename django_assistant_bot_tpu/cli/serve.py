@@ -33,6 +33,14 @@ def add_parser(sub):
         "before — no router object exists at all",
     )
     p.add_argument(
+        "--log-json",
+        action="store_true",
+        help="structured JSON logging for the serving process: one JSON line "
+        "per event with trace_id/model/replica fields where the event "
+        "carries them (equivalent to DABT_LOG_JSON=1; plain-text default "
+        "unchanged — docs/OBSERVABILITY.md)",
+    )
+    p.add_argument(
         "--drain-deadline-s",
         type=float,
         default=None,
@@ -136,9 +144,14 @@ def add_parser(sub):
 
 
 def run(args) -> int:
+    from ..serving.obs import setup_json_logging
     from ..serving.registry import ModelRegistry
     from ..serving.server import load_config_file, run_server
     from ..utils.compile_cache import enable_persistent_compile_cache
+
+    # structured logging first, so even model-load lines come out as JSON
+    # when opted in (--log-json or DABT_LOG_JSON=1); no-op otherwise
+    setup_json_logging(force=bool(getattr(args, "log_json", False)))
 
     # point XLA's persistent compilation cache at a stable dir BEFORE any model
     # loads/warms: a second boot then skips the one-time kernel-compile tax

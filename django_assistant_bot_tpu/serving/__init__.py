@@ -21,9 +21,13 @@ deficiencies).  This plane is one process driving the whole TPU slice:
 - :mod:`.router`    — fault-tolerant multi-replica front door: health- and
   prefix-affinity-aware dispatch over N supervised engine replicas with
   per-replica circuit breakers, token-less re-route, and graceful drain;
+- :mod:`.obs`       — serving-plane observability: per-request span traces
+  (``X-Request-Id`` end to end), Prometheus ``/metrics`` histograms, and the
+  crash flight recorder the failure paths dump (docs/OBSERVABILITY.md);
 - :mod:`.registry`  — model registry loading checkpoints onto the mesh;
 - :mod:`.server`    — aiohttp app exposing the reference's exact HTTP contract
-  (``POST /embeddings/``, ``POST /dialog/``) plus SSE streaming.
+  (``POST /embeddings/``, ``POST /dialog/``) plus SSE streaming, ``/healthz``
+  and ``GET /metrics``.
 """
 
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer  # noqa: F401
@@ -35,6 +39,15 @@ from .engine import (  # noqa: F401
     RequestPoisoned,
 )
 from .faults import FaultInjected, FaultInjector  # noqa: F401
+from .obs import (  # noqa: F401
+    EngineObs,
+    FlightRecorder,
+    Histogram,
+    new_trace_id,
+    parse_prometheus_text,
+    render_prometheus,
+    setup_json_logging,
+)
 from .streaming import (  # noqa: F401
     IncrementalDetokenizer,
     StreamChunk,

@@ -41,6 +41,7 @@ import numpy as np
 
 from ..models import DecoderConfig, EncoderConfig, encoder, llama
 from ..ops.sampling import sample_logits
+from .obs import EngineObs, new_trace_id
 from .scheduler import DeadlineExceeded, RequestScheduler, SchedulerRejected
 from .tokenizer import Tokenizer
 
@@ -150,6 +151,10 @@ class _Request:
     # paged KV plane: worst-case page reservation (ceil((prompt + max_tokens)
     # / page_size)) — the scheduler's KV-pressure admission charge
     kv_pages: int = 0
+    # observability (serving/obs.py): the request/trace correlation id —
+    # client X-Request-Id or generated at submit; stable across router
+    # re-route hops and crash-restart re-submissions
+    trace_id: str = ""
 
 
 # slot-cache precision knob -> concrete dtype (None = the model's cfg.dtype);
@@ -264,6 +269,9 @@ class GenerationEngine:
         degraded_cooldown_s: float = 30.0,
         heartbeat_degraded_s: float = 30.0,
         max_request_restarts: int = 2,
+        name: str = "engine",
+        obs: bool = True,
+        obs_dump_dir: Optional[str] = None,
         mesh=None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
@@ -274,6 +282,18 @@ class GenerationEngine:
         # are the real thing — production behavior is byte-identical.
         self._clock = clock
         self._sleep = sleep
+        # Observability plane (serving/obs.py, docs/OBSERVABILITY.md): span
+        # traces, metric histograms and the crash flight recorder.  On by
+        # default — recording is pure host bookkeeping over values the tick
+        # path already holds (enforced by dabtlint's DABT104 registry), and
+        # the bench's obs_* A/B keeps the overhead claim honest.  obs=False
+        # is the A/B off-arm: no recorder object exists at all, the hot path
+        # pays one `is None` check (the faults-plane discipline).
+        self.name = name
+        if obs:
+            self.obs = EngineObs(name=name, clock=clock, dump_dir=obs_dump_dir)
+        else:
+            self.obs = None
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -1086,6 +1106,7 @@ class GenerationEngine:
         tenant: str = "default",
         deadline_s: Optional[float] = None,
         stream: Any = None,
+        trace_id: Optional[str] = None,
     ) -> Future:
         """Thread-safe submission; returns a concurrent Future[GenerationResult].
 
@@ -1103,7 +1124,13 @@ class GenerationEngine:
         ``stream``: a :class:`~.streaming.TokenStream` to receive per-token
         events as device results resolve (EOS is not emitted) plus a terminal
         event wired through the future's done-callback — every resolution
-        path (finish, deadline, failure, cancel) closes the stream."""
+        path (finish, deadline, failure, cancel) closes the stream.
+
+        ``trace_id``: the request's correlation id (client ``X-Request-Id``
+        or a router-assigned id); generated here when absent, stamped on the
+        ``_Request``, and carried through the obs plane's trace ring and
+        flight recorder (docs/OBSERVABILITY.md)."""
+        trace_id = trace_id or new_trace_id()
         if self.degraded():
             # restart circuit open: fail fast (503 at the server) instead of
             # queueing work behind a device that keeps killing the loop
@@ -1141,6 +1168,12 @@ class GenerationEngine:
                 deadline_s = self.scheduler.cfg.default_deadline_s
             adm = self.scheduler.try_admit(priority, deadline_s, kv_pages=kv_pages)
             if not adm.ok:
+                if self.obs is not None:
+                    # a shed 429 used to be uncorrelatable with the client
+                    # retry that follows — the flight ring keeps the evidence,
+                    # trace_id included, so a post-mortem dump matches the
+                    # client-reported request id
+                    self.obs.on_shed(adm.reason, priority, trace_id=trace_id)
                 raise SchedulerRejected(adm.reason, adm.retry_after_s)
             if adm.clamp_max_tokens is not None:
                 max_tokens = min(max_tokens, adm.clamp_max_tokens)
@@ -1160,6 +1193,8 @@ class GenerationEngine:
             # attach BEFORE the queue put: if the engine resolves (or drains)
             # the future immediately, the callback still fires post-hoc
             fut.add_done_callback(stream.finish)
+        if self.obs is not None:
+            self.obs.on_admit(trace_id, priority, tenant, len(prompt_ids))
         self._queue.put(
             _Request(
                 prompt_ids=prompt_ids,
@@ -1176,6 +1211,7 @@ class GenerationEngine:
                 admitted=admitted,
                 stream=stream,
                 kv_pages=kv_pages,
+                trace_id=trace_id,
             )
         )
         # A stop() racing (or preceding) the put above would leave the request
@@ -1198,6 +1234,7 @@ class GenerationEngine:
         priority: str = "interactive",
         tenant: str = "default",
         deadline_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> GenerationResult:
         """Async convenience: tokenize (chat-templating message lists), run, decode."""
         import asyncio
@@ -1220,6 +1257,7 @@ class GenerationEngine:
             priority=priority,
             tenant=tenant,
             deadline_s=deadline_s,
+            trace_id=trace_id,
         )
         return await asyncio.wrap_future(fut)
 
@@ -1234,6 +1272,7 @@ class GenerationEngine:
         priority: str = "interactive",
         tenant: str = "default",
         deadline_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ):
         """Async iterator of :class:`~.streaming.StreamChunk`: per-token
         UTF-8-safe text deltas as device results resolve, then one terminal
@@ -1275,6 +1314,7 @@ class GenerationEngine:
             tenant=tenant,
             deadline_s=deadline_s,
             stream=stream,
+            trace_id=trace_id,
         )
         detok = IncrementalDetokenizer(self.tokenizer)
         idx = 0
@@ -2832,8 +2872,13 @@ class GenerationEngine:
         ref = self._inflight.popleft()
         t0 = self._clock()
         vals = np.asarray(ref.nxt)
-        self._tick_block_s += self._clock() - t0
+        block_s = self._clock() - t0
+        self._tick_block_s += block_s
         self._ticks_processed += 1
+        if self.obs is not None:
+            # tick-duration histogram + periodic flight-ring summary — host
+            # floats only, no device state (dabtlint DABT104 hot-path root)
+            self.obs.on_tick(block_s, len(ref.slots))
         now = self._clock()
         if (
             self._faults is not None
@@ -2881,6 +2926,8 @@ class GenerationEngine:
                 self._spec_ctl.note_tick(
                     tick_accepted, K, greedy_rows, rung=ref.spec_rung
                 )
+                if self.obs is not None:
+                    self.obs.on_spec_tick(tick_accepted, K * greedy_rows)
             return
         for k in range(vals.shape[0]):  # burst steps, oldest first
             for slot, epoch in ref.slots:
@@ -2921,12 +2968,16 @@ class GenerationEngine:
         if req.first_token_at is None:
             req.first_token_at = now
             self._ttft_s.append(now - req.submitted_at)
+            if self.obs is not None:
+                self.obs.on_first_token(now - req.submitted_at)
         elif s.last_token_at is not None and now > s.last_token_at:
             # tokens of one tick batch share `now` — a zero "gap" between
             # burst/speculative batch-mates would collapse the percentiles to
             # 0; sampling only across batches measures the real host-arrival
             # cadence (per-token ITL ~ gap / tokens-per-tick)
             self._itl_s.append(now - s.last_token_at)
+            if self.obs is not None:
+                self.obs.on_token_gap(now - s.last_token_at)
         s.last_token_at = now
         if req.stream is not None and tok != self.tokenizer.eos_id:
             if req.stream.push_token(tok, notify=False):
@@ -2974,8 +3025,14 @@ class GenerationEngine:
             # — fail it and keep serving (the slot is already freed above)
             logger.warning("detokenization failed; quarantining request: %s", e)
             self.poisoned_requests += 1
+            if self.obs is not None:
+                self.obs.flight.record(
+                    "quarantine", trace_id=req.trace_id, error=str(e)
+                )
+                self.obs.flight.dump("quarantine", trace_id=req.trace_id)
             _safe_resolve(req.future, exc=e)
             return
+        detok_s = max(0.0, self._clock() - now)
         result = GenerationResult(
             token_ids=ids,
             text=text,
@@ -2993,6 +3050,10 @@ class GenerationEngine:
             self.scheduler.note_service(
                 now - (req.started_at or req.first_token_at or now)
             )
+        if self.obs is not None:
+            # close the request's span trace from the host timestamps the
+            # tick path already stamped — deliver is the resolve below
+            self.obs.on_finish(req, result, now=now + detok_s, detok_s=detok_s)
         _safe_resolve(req.future, result=result)
 
     def _quarantine(self, slot: int, err: BaseException) -> None:
@@ -3009,6 +3070,14 @@ class GenerationEngine:
         self._sampling_dirty = True
         self._free_slot_pages(slot)
         self.poisoned_requests += 1
+        if self.obs is not None:
+            self.obs.flight.record(
+                "quarantine",
+                trace_id=s.request.trace_id,
+                slot=slot,
+                error=str(err),
+            )
+            self.obs.flight.dump("quarantine", trace_id=s.request.trace_id)
         _safe_resolve(s.request.future, exc=err)
 
     def degraded(self) -> bool:
@@ -3073,6 +3142,18 @@ class GenerationEngine:
         now = self._clock()
         self.engine_restarts += 1
         self._restart_times.append(now)
+        if self.obs is not None:
+            from .faults import FaultInjected
+
+            if isinstance(err, FaultInjected):
+                # the injector fire is its own flight event, distinct from the
+                # restart it provoked — a chaos dump names the site directly
+                self.obs.flight.record("fault_fire", site=err.site, error=str(err))
+            self.obs.flight.record(
+                "restart",
+                error=f"{type(err).__name__}: {err}",
+                engine_restarts=self.engine_restarts,
+            )
         salvage: List[_Request] = []
         if self._starting_batch is not None:
             salvage.extend(req for _, req in self._starting_batch)
@@ -3116,12 +3197,20 @@ class GenerationEngine:
                 continue
             if req.restarts >= self.max_request_restarts:
                 self.restarted_failed += 1
+                if self.obs is not None:
+                    self.obs.flight.record(
+                        "restart_failed", trace_id=req.trace_id, restarts=req.restarts
+                    )
                 _safe_resolve(req.future, exc=err)
                 continue
             req.restarts += 1
             req.started_at = None
             req.first_token_at = None
             self.restarted_resubmitted += 1
+            if self.obs is not None:
+                self.obs.flight.record(
+                    "resubmit", trace_id=req.trace_id, restarts=req.restarts
+                )
             requeue.append(req)
         # head of the queue, class/tenant tags riding on the request —
         # salvaged work must not requeue behind later arrivals.  Head inserts
@@ -3156,11 +3245,20 @@ class GenerationEngine:
                 "engine recovery failed; declaring the engine dead"
             )
             self._running = False
+            if self.obs is not None:
+                self.obs.flight.record("engine_dead", error=f"{type(err).__name__}: {err}")
+                self.obs.flight.dump("engine_dead", error=str(err))
             return
         recent = [t for t in self._restart_times if t >= now - self.restart_window_s]
         if len(recent) >= self.max_restarts:
             self.circuit_trips += 1
             self._degraded_until = now + self.degraded_cooldown_s
+            if self.obs is not None:
+                self.obs.flight.record(
+                    "circuit_open",
+                    restarts_in_window=len(recent),
+                    cooldown_s=self.degraded_cooldown_s,
+                )
             logger.error(
                 "engine circuit OPEN: %d restarts in %.0fs; degraded for %.1fs "
                 "(submit fast-fails EngineUnavailable)",
@@ -3168,6 +3266,11 @@ class GenerationEngine:
                 self.restart_window_s,
                 self.degraded_cooldown_s,
             )
+        if self.obs is not None:
+            # the post-mortem artifact: the whole recent-event ring (fault
+            # fire, restart, per-request resubmits) as one JSON file — a
+            # chaos failure is diagnosable without reproducing it
+            self.obs.flight.dump("restart", error=str(err))
 
 
 class EmbeddingEngine:

@@ -129,6 +129,15 @@ class ModelSpec:
     # it fails (bounds retries of a prompt that deterministically kills the
     # device)
     max_request_restarts: int = 2
+    # --- observability (serving/obs.py; docs/OBSERVABILITY.md) ---
+    # per-request span traces, /metrics histograms, and the crash flight
+    # recorder.  On by default (host-side bookkeeping only — the bench's
+    # obs_* A/B keeps the overhead claim within noise); False is the
+    # rollback/A-B arm: no recorder object exists at all.
+    obs: bool = True
+    # flight-recorder dump directory (None = DABT_FLIGHT_DIR env, else
+    # <tmpdir>/dabt-flight)
+    obs_dump_dir: Optional[str] = None
     # --- multi-replica serving (serving/router.py; docs/RESILIENCE.md) ---
     # decoder-only: >1 loads N independently supervised engine replicas (each
     # with its own scheduler, KV page pool, and fault injector — seeds offset
@@ -362,6 +371,11 @@ class ModelRegistry:
                     degraded_cooldown_s=spec.degraded_cooldown_s,
                     heartbeat_degraded_s=spec.heartbeat_degraded_s,
                     max_request_restarts=spec.max_request_restarts,
+                    # replica-qualified name: flight-recorder artifacts and
+                    # /metrics `replica` labels match the router's names
+                    name=f"{name}/r{i}" if spec.replicas > 1 else name,
+                    obs=spec.obs,
+                    obs_dump_dir=spec.obs_dump_dir,
                     mesh=self.mesh,
                 )
                 if spec.warmup or spec.warmup_json:

@@ -63,6 +63,7 @@ from typing import Any, Callable, List, Optional, Sequence, Set
 
 from ..ai.providers.failover import CircuitBreaker
 from .engine import EngineUnavailable, GenerationEngine, _safe_resolve
+from .obs import new_trace_id
 from .scheduler import SchedulerRejected
 
 logger = logging.getLogger(__name__)
@@ -287,6 +288,7 @@ class EngineRouter:
         tenant: str = "default",
         deadline_s: Optional[float] = None,
         stream: Any = None,
+        trace_id: Optional[str] = None,
     ) -> Future:
         """Thread-safe fleet submission; returns Future[GenerationResult].
 
@@ -315,6 +317,10 @@ class EngineRouter:
                 priority=priority,
                 tenant=tenant,
                 deadline_s=deadline_s,
+                # assigned HERE (not per-engine) so every re-route hop and
+                # the flight-recorder events of each replica carry ONE id —
+                # a failed leg and its retry correlate by trace_id alone
+                trace_id=trace_id or new_trace_id(),
             ),
             outer,
             _StreamShim(stream),
@@ -461,6 +467,17 @@ class EngineRouter:
                 state.reroutes += 1
                 with self._lock:
                     self.reroutes += 1
+                obs = getattr(rep.engine, "obs", None)
+                if obs is not None:
+                    # the failed replica's flight ring keeps the hop evidence
+                    # (a later dump of EITHER replica shows the re-route)
+                    obs.flight.record(
+                        "reroute",
+                        trace_id=state.kwargs.get("trace_id"),
+                        from_replica=rep.name,
+                        hop=state.reroutes,
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
                 logger.warning(
                     "router: re-routing token-less request off %s (%s: %s); "
                     "hop %d/%d",
@@ -498,6 +515,9 @@ class EngineRouter:
         router must survive.  No drain, no goodbye."""
         rep = self.replicas[idx]
         logger.warning("router: chaos killed %s", rep.name)
+        obs = getattr(rep.engine, "obs", None)
+        if obs is not None:
+            obs.flight.record("replica_kill", replica=rep.name)
         rep.engine._running = False
 
     def restart_replica(self, idx: int, *, stop_timeout_s: float = 30.0) -> None:
@@ -534,6 +554,9 @@ class EngineRouter:
                 raise RuntimeError(f"{rep.name} is already draining")
             rep.draining = True
             self.drains += 1
+        obs = getattr(rep.engine, "obs", None)
+        if obs is not None:
+            obs.flight.record("drain_begin", replica=rep.name)
         t0 = self._clock()
         try:
             while not self._replica_idle(rep) and self._clock() - t0 < deadline_s:
@@ -553,6 +576,17 @@ class EngineRouter:
                 )
             if restart:
                 self.restart_replica(idx)
+            if obs is not None:
+                obs.flight.record(
+                    "drain_end",
+                    replica=rep.name,
+                    drained=drained,
+                    forced_failures=forced,
+                )
+                # a forced drain killed work the replica promised to finish:
+                # that is a post-mortem artifact, same as a crash restart
+                if forced:
+                    obs.flight.dump("drain_forced", replica=rep.name, forced=forced)
             return {
                 "replica": rep.name,
                 "drained": drained,

@@ -23,11 +23,13 @@ import asyncio
 import json
 import logging
 import math
+import re
 from typing import Any, Mapping, Optional
 
 from aiohttp import web
 
 from .engine import EngineUnavailable
+from .obs import new_trace_id, render_prometheus
 from .registry import ModelRegistry
 from .scheduler import DeadlineExceeded, SchedulerRejected
 
@@ -39,16 +41,33 @@ DRAIN_KEY: web.AppKey[dict] = web.AppKey("drain_state", dict)
 MAX_MAX_TOKENS = 1 << 17  # sanity ceiling; engines clamp to max_seq_len anyway
 PRIORITIES = ("interactive", "background")
 
+# client-supplied X-Request-Id values are echoed into headers and bodies:
+# only token-safe shapes pass through (anything else — or nothing — gets a
+# generated id), so a hostile header cannot smuggle CR/LF or grow unbounded
+_REQ_ID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,64}$")
 
-def _draining_response() -> web.Response:
+
+def _request_id(request: web.Request) -> str:
+    """The request's correlation id: the client's ``X-Request-Id`` when it is
+    token-safe, else a fresh trace id.  Echoed on EVERY ``/dialog/`` response
+    shape (JSON, SSE terminal event, 4xx/5xx error bodies) so a shed 429 and
+    the client retry that follows correlate by one id."""
+    rid = request.headers.get("X-Request-Id", "").strip()
+    if rid and _REQ_ID_RE.match(rid):
+        return rid
+    return new_trace_id()
+
+
+def _draining_response(rid: Optional[str] = None) -> web.Response:
     """Graceful shutdown in progress: stop admitting, finish in-flight work.
     New requests get an honest 503 + Retry-After instead of being accepted
     and then killed mid-generation by process exit."""
-    return web.json_response(
-        {"detail": "server draining for shutdown"},
-        status=503,
-        headers={"Retry-After": "2"},
-    )
+    body = {"detail": "server draining for shutdown"}
+    headers = {"Retry-After": "2"}
+    if rid is not None:
+        body["request_id"] = rid
+        headers["X-Request-Id"] = rid
+    return web.json_response(body, status=503, headers=headers)
 
 
 class _BadRequest(ValueError):
@@ -102,25 +121,43 @@ def _scheduling_fields(
     return priority, tenant.strip(), deadline_s
 
 
-def _shed_response(e: SchedulerRejected) -> web.Response:
+def _with_rid(body: dict, rid: Optional[str], headers: Optional[dict] = None):
+    """(body, headers) with the correlation id riding both (None = no id)."""
+    headers = dict(headers or {})
+    if rid is not None:
+        body["request_id"] = rid
+        headers["X-Request-Id"] = rid
+    return body, headers
+
+
+def _shed_response(e: SchedulerRejected, rid: Optional[str] = None) -> web.Response:
     """Load shed -> 429 with a Retry-After back-off hint."""
     retry = max(1, math.ceil(e.retry_after_s))
-    return web.json_response(
+    body, headers = _with_rid(
         {"detail": str(e), "reason": e.reason, "retry_after_s": e.retry_after_s},
-        status=429,
-        headers={"Retry-After": str(retry)},
+        rid,
+        {"Retry-After": str(retry)},
     )
+    return web.json_response(body, status=429, headers=headers)
 
 
-def _unavailable_response(e: EngineUnavailable) -> web.Response:
+def _unavailable_response(
+    e: EngineUnavailable, rid: Optional[str] = None
+) -> web.Response:
     """Engine restart circuit open -> 503 with a Retry-After covering the
     remaining degraded cooldown (docs/RESILIENCE.md)."""
     retry = max(1, math.ceil(e.retry_after_s))
-    return web.json_response(
+    body, headers = _with_rid(
         {"detail": str(e), "retry_after_s": e.retry_after_s},
-        status=503,
-        headers={"Retry-After": str(retry)},
+        rid,
+        {"Retry-After": str(retry)},
     )
+    return web.json_response(body, status=503, headers=headers)
+
+
+def _error_response(detail: str, status: int, rid: str) -> web.Response:
+    body, headers = _with_rid({"detail": detail}, rid)
+    return web.json_response(body, status=status, headers=headers)
 
 
 def _usage(model: str, result) -> dict:
@@ -133,7 +170,7 @@ def _sse(payload) -> bytes:
 
 
 async def _stream_dialog(
-    request: web.Request, eng, model: str, messages, **gen_kwargs
+    request: web.Request, eng, model: str, messages, rid: str, **gen_kwargs
 ) -> web.StreamResponse:
     """``"stream": true`` -> ``text/event-stream`` (wire format in
     docs/STREAMING.md): one ``data:`` event per emitted text delta, a terminal
@@ -146,20 +183,20 @@ async def _stream_dialog(
     open stream.  A client disconnect mid-stream abandons the generator, whose
     cleanup cancels the engine request — the per-iteration reap then frees the
     decode slot within one tick (the deadline epoch mechanism)."""
-    agen = eng.generate_stream(messages, **gen_kwargs)
+    agen = eng.generate_stream(messages, trace_id=rid, **gen_kwargs)
     try:
         first = await agen.__anext__()
     except StopAsyncIteration:
         first = None
     except SchedulerRejected as e:
-        return _shed_response(e)
+        return _shed_response(e, rid)
     except EngineUnavailable as e:
-        return _unavailable_response(e)
+        return _unavailable_response(e, rid)
     except DeadlineExceeded as e:
-        return web.json_response({"detail": str(e)}, status=504)
+        return _error_response(str(e), 504, rid)
     except Exception as e:
         logger.exception("stream dialog failed before first token")
-        return web.json_response({"detail": str(e)}, status=500)
+        return _error_response(str(e), 500, rid)
 
     resp = web.StreamResponse(
         status=200,
@@ -167,6 +204,7 @@ async def _stream_dialog(
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
             "X-Accel-Buffering": "no",
+            "X-Request-Id": rid,
         },
     )
     await resp.prepare(request)
@@ -187,6 +225,7 @@ async def _stream_dialog(
                             "result": r.text,
                             "usage": _usage(model, r),
                             "length_limited": r.length_limited,
+                            "request_id": rid,
                         }
                     )
                 )
@@ -213,7 +252,14 @@ async def _stream_dialog(
         logger.exception("stream dialog failed mid-stream")
         try:
             await resp.write(
-                _sse({"done": True, "finish_reason": "error", "error": str(e)})
+                _sse(
+                    {
+                        "done": True,
+                        "finish_reason": "error",
+                        "error": str(e),
+                        "request_id": rid,
+                    }
+                )
             )
             await resp.write(_sse("[DONE]"))
             await resp.write_eof()
@@ -261,8 +307,9 @@ def create_app(
             return web.json_response({"detail": str(e)}, status=500)
 
     async def dialog(request: web.Request) -> web.Response:
+        rid = _request_id(request)
         if drain["draining"]:
-            return _draining_response()
+            return _draining_response(rid)
         try:
             body = await request.json()
             model = body["model"]
@@ -285,18 +332,19 @@ def create_app(
             temperature, top_p, max_tokens = _validate_sampling(body)
             priority, tenant, deadline_s = _scheduling_fields(request, body)
         except _BadRequest as e:
-            return web.json_response({"detail": str(e)}, status=422)
+            return _error_response(str(e), 422, rid)
         except Exception:
-            return web.json_response({"detail": "invalid request"}, status=422)
+            return _error_response("invalid request", 422, rid)
         eng = registry.get_generator(model)
         if eng is None:
-            return web.json_response({"detail": "Model is not supported"}, status=400)
+            return _error_response("Model is not supported", 400, rid)
         if stream:
             return await _stream_dialog(
                 request,
                 eng,
                 model,
                 messages,
+                rid,
                 max_tokens=max_tokens,
                 temperature=temperature,
                 top_p=top_p,
@@ -319,6 +367,7 @@ def create_app(
                 priority=priority,
                 tenant=tenant,
                 deadline_s=deadline_s,
+                trace_id=rid,
             )
             return web.json_response(
                 {
@@ -326,18 +375,20 @@ def create_app(
                         "result": result.text,
                         "usage": _usage(model, result),
                         "length_limited": result.length_limited,
-                    }
-                }
+                    },
+                    "request_id": rid,
+                },
+                headers={"X-Request-Id": rid},
             )
         except SchedulerRejected as e:
-            return _shed_response(e)
+            return _shed_response(e, rid)
         except EngineUnavailable as e:
-            return _unavailable_response(e)
+            return _unavailable_response(e, rid)
         except DeadlineExceeded as e:
-            return web.json_response({"detail": str(e)}, status=504)
+            return _error_response(str(e), 504, rid)
         except Exception as e:
             logger.exception("dialog failed")
-            return web.json_response({"detail": str(e)}, status=500)
+            return _error_response(str(e), 500, rid)
 
     async def healthz(request: web.Request) -> web.Response:
         # status degrades when ANY generator is unhealthy: restart circuit
@@ -414,11 +465,29 @@ def create_app(
             }
         )
 
+    async def metrics(request: web.Request) -> web.Response:
+        # Prometheus text exposition (docs/OBSERVABILITY.md).  Deliberately
+        # NOT gated on the drain flag: a draining/degraded fleet is exactly
+        # when the scrape matters.  render_prometheus is a pure read path —
+        # every stats surface does its own fine-grained locking, and no
+        # router lock is ever held across an engine call (the PR 7 ABBA
+        # family; witness-covered by the CI obs smoke).
+        try:
+            text = render_prometheus(registry)
+        except Exception:
+            logger.exception("/metrics render failed")
+            return web.Response(status=500, text="metrics render failed")
+        return web.Response(
+            body=text.encode("utf-8"),
+            headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
+        )
+
     app.router.add_post("/embeddings/", embeddings)
     app.router.add_post("/embeddings", embeddings)
     app.router.add_post("/dialog/", dialog)
     app.router.add_post("/dialog", dialog)
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
     app.router.add_get("/models", models)
 
     async def on_shutdown(app):

@@ -307,6 +307,84 @@ def test_dabt104_aliased_numpy_import_still_caught(tmp_path):
     assert "_np.asarray()" in found[0].detail
 
 
+def test_dabt104_obs_recorder_entry_points_are_roots(tmp_path):
+    """The observability recorders (serving/obs.py) are DABT104 roots in
+    their own right: a device sync smuggled into metric recording — or into
+    a helper only the recorder reaches — is convicted even when no engine
+    hot path in the analyzed set calls it."""
+    src = """
+        import numpy as np
+
+        def _leak(v):
+            return v.item()
+
+        class EngineObs:
+            def on_tick(self, block_s, active):
+                return np.asarray(block_s)
+
+            def on_finish(self, req):  # NOT a hot-path root: lifecycle only
+                return np.asarray(req)
+
+        class Histogram:
+            def observe(self, v):
+                return _leak(v)
+
+        class FlightRecorder:
+            def record(self, event):
+                return np.asarray(event)
+    """
+    found = _findings(tmp_path, {"obs_fixture.py": src}, "DABT104")
+    by_symbol = {f.symbol for f in found}
+    assert "EngineObs.on_tick" in by_symbol
+    assert "FlightRecorder.record" in by_symbol
+    # the sync reached THROUGH Histogram.observe is attributed to the helper
+    assert "_leak" in by_symbol
+    roots = {f.symbol: f.detail for f in found}
+    assert "Histogram.observe" in roots["_leak"]
+    # request-lifecycle methods are off the tick path and stay unflagged
+    assert "EngineObs.on_finish" not in by_symbol
+
+
+def test_real_obs_module_is_hot_path_clean_and_clock_disciplined():
+    """The shipped serving/obs.py: its recorder entry points are in the
+    hot-path registry and the module carries the DABT105 injectable-clock
+    convention — so the gate (0 new findings) actively covers it."""
+    import ast
+
+    from dabtlint.checks import HOT_PATH_PATTERNS, _module_has_clock_convention
+    from dabtlint.project import Project
+
+    obs_path = REPO_ROOT / "django_assistant_bot_tpu" / "serving" / "obs.py"
+    proj = Project.load([str(obs_path)])
+    (mod,) = proj.modules
+    # DABT105 scope: serving/ dir + the opt-in convention both hold
+    assert _module_has_clock_convention(mod)
+    # the registry names real entry points (a rename would silently un-root
+    # the recorder; this pins pattern <-> method agreement)
+    import fnmatch
+
+    qualnames = set(mod.functions)
+    for pat in (
+        "*EngineObs.on_tick",
+        "*Histogram.observe",
+        "*FlightRecorder.record",
+    ):
+        assert any(fnmatch.fnmatch(q, pat) for q in qualnames), pat
+    assert any(pat == "*EngineObs.on_tick" for pat in HOT_PATH_PATTERNS)
+    # and the module itself contains no raw time.time()/monotonic() CALLS
+    # (injectable defaults are attribute references, not calls)
+    tree = ast.parse(obs_path.read_text())
+    raw_calls = [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and isinstance(n.func.value, ast.Name)
+        and n.func.value.id == "time"
+    ]
+    assert raw_calls == []
+
+
 # --------------------------------------------------------------------- DABT105
 def test_dabt105_convention_and_dir_scoping(tmp_path):
     files = {

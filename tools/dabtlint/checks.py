@@ -44,6 +44,16 @@ HOT_PATH_PATTERNS: Tuple[str, ...] = (
     "insert_sequences_paged",
     "prefill_suffix_paged",
     "prefill_chunk_paged",
+    # observability recorder entry points (serving/obs.py): called from the
+    # tick path's host bookkeeping, so metric recording can never silently
+    # add a device sync — roots in their own right, independent of whether
+    # the engine's `self.obs` attribute type resolves
+    "*EngineObs.on_tick",
+    "*EngineObs.on_spec_tick",
+    "*EngineObs.on_first_token",
+    "*EngineObs.on_token_gap",
+    "*Histogram.observe",
+    "*FlightRecorder.record",
 )
 
 # Modules under these path segments are clock-disciplined candidates for
