@@ -1721,6 +1721,217 @@ print(json.dumps(bench.bench_router()))
 """
 
 
+def bench_autoscale() -> dict:
+    """autoscale_* section (serving/autoscaler.py + workload/ evidence): the
+    closed-loop A/B.  ONE seeded diurnal-ramp trace (workload/generator.py,
+    seed pinned — deterministic arrivals, tenants, and token shapes) drives
+    two fleets built from the same shared weights:
+
+    - **off**: fixed at the minimum size (the reference's fixed-backend
+      shape — overload is handled only by shedding);
+    - **on**: starts at the minimum with the SLO autoscaler closing the loop
+      (scale-up on TTFT burn/shed-rate/backlog, trough scale-down).
+
+    Engine speed is pinned by a deterministic ``slow_tick`` injection (every
+    tick pays a fixed floor), so "the peak overloads one replica, three
+    hold it" is a property of the CONFIG, not of whichever host runs the
+    bench.  Reported: p95 TTFT and client-visible sheds per arm, the on-arm's
+    replica-seconds (the autoscaler's cost integral), and the fixed MAX-size
+    fleet's replica-seconds as the budget bound the on-arm must beat."""
+    import jax
+
+    from django_assistant_bot_tpu.models import llama
+    from django_assistant_bot_tpu.parallel import get_mesh, shard_pytree
+    from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
+    from django_assistant_bot_tpu.serving.autoscaler import (
+        AutoscalerConfig,
+        SLOAutoscaler,
+    )
+    from django_assistant_bot_tpu.serving.engine import EngineUnavailable
+    from django_assistant_bot_tpu.serving.faults import FaultInjector
+    from django_assistant_bot_tpu.serving.router import EngineRouter
+    from django_assistant_bot_tpu.serving.scheduler import (
+        RequestScheduler,
+        SchedulerConfig,
+        SchedulerRejected,
+    )
+    from django_assistant_bot_tpu.workload import (
+        WorkloadConfig,
+        WorkloadGenerator,
+        prompt_ids_for,
+        replay,
+    )
+
+    MIN_R, MAX_R = 1, 3
+    TICK_FLOOR_S = 0.03  # deterministic per-tick latency injection
+    SLO_TTFT_S = 0.5
+    cfg = _decoder_cfg()
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    mesh = get_mesh()
+    with mesh:
+        params = shard_pytree(params, llama.logical_axes(cfg), mesh)
+    # the SAME trace for both arms: one diurnal period — trough, a peak that
+    # overloads one 2-slot replica at the injected tick floor, trough again
+    trace = WorkloadGenerator(
+        WorkloadConfig(
+            seed=11,
+            duration_s=24.0,
+            base_rps=24.0,
+            shape="diurnal",
+            diurnal_period_s=24.0,
+            diurnal_min_frac=0.15,
+            tenants=4,
+            hot_tenant_frac=0.5,
+            background_frac=0.1,
+            longctx_frac=0.1,
+            chat_prompt_tokens=(8, 24),
+            chat_max_tokens=(4, 12),
+            longctx_prompt_tokens=(32, 56),
+            longctx_max_tokens=(8, 16),
+            # no shared prefixes: prefix-suffix prefill programs aren't in
+            # the factory's warmup set, and a mid-peak compile stall would
+            # pollute the latency A/B with compile noise
+            prefix_frac=0.0,
+        )
+    ).generate()
+
+    def build_engine(i: int) -> GenerationEngine:
+        eng = GenerationEngine(
+            cfg,
+            params,
+            ByteTokenizer(),
+            max_slots=2,
+            max_seq_len=128,
+            prefill_buckets=(64,),
+            chunk_size=64,
+            # one token per slot per tick: with the injected tick floor the
+            # per-replica capacity is a CONFIG constant (~2 tok / 30 ms),
+            # so "the peak overloads one replica, three hold" is
+            # host-independent
+            lookahead=1,
+            burst=1,
+            mesh=mesh,
+            name=f"as/r{i}",
+            scheduler=RequestScheduler(
+                SchedulerConfig(
+                    max_queue=8, admit_max_wait_s=2.0, admit_hist_min_samples=16
+                )
+            ),
+            faults=FaultInjector({"slow_tick": {"p": 1.0, "delay_s": TICK_FLOOR_S}}),
+        )
+        eng.warmup()  # the compile cache makes replica 2..N's warmup a replay
+        eng.start()
+        return eng
+
+    def run_arm(autoscale: bool) -> dict:
+        engines = [build_engine(i) for i in range(MIN_R)]
+        router = EngineRouter(engines, replica_factory=build_engine)
+        asc = None
+        if autoscale:
+            asc = SLOAutoscaler(
+                router,
+                AutoscalerConfig(
+                    min_replicas=MIN_R,
+                    max_replicas=MAX_R,
+                    interval_s=0.25,
+                    slo_ttft_p95_s=SLO_TTFT_S,
+                    up_consecutive=2,
+                    up_cooldown_s=1.0,
+                    down_consecutive=6,
+                    down_cooldown_s=1.0,
+                    drain_deadline_s=60.0,
+                ),
+                name="bench-autoscaler",
+            ).start()
+        futs = []
+        shed = 0
+        peak_fleet = len(router.replicas)
+
+        def submit(ev):
+            nonlocal shed, peak_fleet
+            peak_fleet = max(peak_fleet, len(router.replicas))
+            try:
+                futs.append(
+                    router.submit(
+                        prompt_ids_for(ev),
+                        max_tokens=ev.max_tokens,
+                        temperature=0.0,
+                        priority=ev.priority,
+                        tenant=ev.tenant,
+                        prefix_len=ev.prefix_len,
+                    )
+                )
+            except (SchedulerRejected, EngineUnavailable):
+                shed += 1
+
+        try:
+            router.submit([1, 2, 3], max_tokens=2, temperature=0.0).result(
+                timeout=600
+            )  # settle the first replica before the clock starts
+            t0 = time.perf_counter()
+            replay(trace, submit)
+            ok = failed = 0
+            for f in futs:
+                try:
+                    f.result(timeout=600)
+                    ok += 1
+                except Exception:
+                    failed += 1
+            wall = time.perf_counter() - t0
+            lat = router.latency_stats()
+            if asc is not None:
+                asc.stop()  # also closes the replica-seconds integral
+                replica_seconds = asc.replica_seconds
+            else:
+                replica_seconds = MIN_R * wall
+            return {
+                "wall_s": round(wall, 3),
+                "requests": len(trace),
+                "ok": ok,
+                "failed": failed,
+                "shed": shed,
+                "ttft_p95_s": round(lat["ttft_p95_ms"] / 1e3, 4),
+                "ttft_p50_s": round(lat["ttft_p50_ms"] / 1e3, 4),
+                "replica_seconds": round(replica_seconds, 2),
+                "peak_replicas": peak_fleet,
+                "scale_ups": asc.scale_ups if asc else 0,
+                "scale_downs": asc.scale_downs if asc else 0,
+                "drain_shed": router.drain_shed,
+            }
+        finally:
+            if asc is not None:
+                asc.stop()
+            router.stop()
+
+    off = run_arm(False)
+    on = run_arm(True)
+    return {
+        "autoscale_p95_ttft_off_s": off["ttft_p95_s"],
+        "autoscale_p95_ttft_on_s": on["ttft_p95_s"],
+        "autoscale_shed_off": off["shed"],
+        "autoscale_shed_on": on["shed"],
+        "autoscale_replica_seconds": on["replica_seconds"],
+        # the cost bound the acceptance criterion names: a fixed fleet at the
+        # MAX size pays max_replicas for the whole trace
+        "autoscale_replica_seconds_fixed_max": round(MAX_R * off["wall_s"], 2),
+        "autoscale_peak_replicas": on["peak_replicas"],
+        "autoscale_scale_ups": on["scale_ups"],
+        "autoscale_scale_downs": on["scale_downs"],
+        "autoscale_drain_shed": on["drain_shed"],
+        "autoscale_requests": len(trace),
+        "autoscale_ok_on": on["ok"],
+        "autoscale_ok_off": off["ok"],
+        "autoscale_trace": "diurnal seed=11 24s peak=24rps tick_floor=30ms",
+    }
+
+
+_AUTOSCALE_SNIPPET = """
+import json
+import bench
+print(json.dumps(bench.bench_autoscale()))
+"""
+
+
 def bench_obs() -> dict:
     """obs_* section (serving/obs.py evidence): the observability plane's two
     claims.  (1) Tracing + metric recording on the decode path costs within
@@ -2571,6 +2782,13 @@ _COMPACT_KEYS = (
     "router_recovery_s",
     "router_reroutes",
     "router_drain_shed",
+    "autoscale_p95_ttft_on_s",
+    "autoscale_p95_ttft_off_s",
+    "autoscale_shed_on",
+    "autoscale_shed_off",
+    "autoscale_replica_seconds",
+    "autoscale_replica_seconds_fixed_max",
+    "autoscale_peak_replicas",
     "obs_overhead_frac",
     "obs_ab_noise_frac",
     "obs_scrape_ms",
@@ -2676,6 +2894,7 @@ def main() -> None:
         extras.update(bench_overload())
         extras.update(bench_chaos())
         extras.update(bench_router())
+        extras.update(bench_autoscale())
         extras.update(bench_obs())
         extras.update(bench_stream())
         baseline_thread.join(timeout=600)
@@ -2736,6 +2955,11 @@ def main() -> None:
     #       recovery-to-first-success on the restarted replica, and a
     #       rolling restart under live traffic (serving/router.py evidence)
     run("router", _ROUTER_SNIPPET, cap_s=400)
+    # 3c'''a) autoscale: the closed loop — fixed-min fleet vs SLO autoscaler
+    #        on the SAME seeded diurnal trace (p95 TTFT, sheds,
+    #        replica-seconds vs the fixed max-size budget —
+    #        serving/autoscaler.py + workload/ evidence)
+    run("autoscale", _AUTOSCALE_SNIPPET, cap_s=400)
     # 3c''') obs: tracing+metrics decode-throughput A/B (must be within
     #        noise) + /metrics scrape cost and exposition validity against a
     #        known trace (serving/obs.py evidence)

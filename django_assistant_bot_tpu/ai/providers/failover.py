@@ -106,6 +106,21 @@ class CircuitBreaker:
                 return True
             return False
 
+    def retry_in_s(self) -> float:
+        """Seconds until this breaker would admit a request again (0 = now).
+        The engine router derives an honest ``Retry-After`` for the
+        no-healthy-replica 503 from the soonest breaker instead of a fixed
+        constant; a breaker mid-probe reports the full timeout (the probe
+        slot is taken — the caller would be rejected until it resolves)."""
+        with self._lock:
+            if self._opened_at is None:
+                return 0.0
+            if self._probing:
+                return self.reset_timeout_s
+            return max(
+                0.0, self.reset_timeout_s - (self._clock() - self._opened_at)
+            )
+
     def record_success(self) -> None:
         with self._lock:
             self._failures = 0

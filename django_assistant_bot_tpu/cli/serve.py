@@ -33,6 +33,43 @@ def add_parser(sub):
         "before — no router object exists at all",
     )
     p.add_argument(
+        "--autoscale",
+        action="store_true",
+        help="SLO-driven autoscaling for every decoder (serving/autoscaler.py; "
+        "docs/AUTOSCALING.md): a controller thread scales the replica fleet "
+        "within [--min-replicas, --max-replicas] on p95-TTFT SLO burn, shed "
+        "rate, queue backlog and KV pressure, and engages load-adaptive "
+        "degradation (max_tokens clamp + speculative decode off) when a "
+        "replica can't help.  Every decision is a dabt_autoscale_* metric "
+        "and a flight-recorder event",
+    )
+    p.add_argument(
+        "--min-replicas",
+        type=int,
+        default=None,
+        metavar="N",
+        help="initial/minimum replica count per decoder for the dynamic "
+        "fleet (alias for replicas when autoscaling; the autoscaler never "
+        "drains below it)",
+    )
+    p.add_argument(
+        "--max-replicas",
+        type=int,
+        default=None,
+        metavar="N",
+        help="replica-count ceiling per decoder (>= --min-replicas); the "
+        "router's add_replica spawns up to here from the shared weights",
+    )
+    p.add_argument(
+        "--slo-ttft-p95-s",
+        type=float,
+        default=None,
+        metavar="S",
+        help="the p95 time-to-first-token SLO the autoscaler defends "
+        "(default 1.0); p95/SLO is the burn signal driving scale-up and "
+        "degradation",
+    )
+    p.add_argument(
         "--log-json",
         action="store_true",
         help="structured JSON logging for the serving process: one JSON line "
@@ -179,6 +216,27 @@ def run(args) -> int:
     sched_overrides = {}
     if getattr(args, "replicas", None) is not None:
         sched_overrides["replicas"] = args.replicas
+    # dynamic-fleet flags (docs/AUTOSCALING.md): --min-replicas is the
+    # initial/min size (same knob as --replicas), --max-replicas the ceiling,
+    # --autoscale turns the controller on per decoder
+    if getattr(args, "min_replicas", None) is not None:
+        sched_overrides["replicas"] = args.min_replicas
+    if getattr(args, "max_replicas", None) is not None:
+        sched_overrides["max_replicas"] = args.max_replicas
+    if getattr(args, "autoscale", False):
+        sched_overrides["autoscale"] = True
+        if getattr(args, "max_replicas", None) is None:
+            # max_replicas defaults to the min size: a controller with
+            # min == max can only engage degradation, never add a replica —
+            # legitimate, but almost never what `--autoscale` meant
+            print(
+                "warning: --autoscale without --max-replicas leaves the fleet "
+                "ceiling at the minimum size; the controller can clamp load "
+                "(degradation) but never scale up — pass --max-replicas N "
+                "to allow replica growth (docs/AUTOSCALING.md)"
+            )
+    if getattr(args, "slo_ttft_p95_s", None) is not None:
+        sched_overrides["autoscale_slo_ttft_p95_s"] = args.slo_ttft_p95_s
     if getattr(args, "kv_layout", None) is not None:
         sched_overrides["kv_layout"] = args.kv_layout
     if getattr(args, "kv_pages", None) is not None:

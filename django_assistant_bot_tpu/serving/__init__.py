@@ -20,7 +20,12 @@ deficiencies).  This plane is one process driving the whole TPU slice:
   that exercises the engine's quarantine/restart/circuit recovery paths);
 - :mod:`.router`    — fault-tolerant multi-replica front door: health- and
   prefix-affinity-aware dispatch over N supervised engine replicas with
-  per-replica circuit breakers, token-less re-route, and graceful drain;
+  per-replica circuit breakers, token-less re-route, graceful drain, and a
+  dynamic fleet surface (``add_replica``/``remove_replica``);
+- :mod:`.autoscaler` — the SLO-driven control loop over the obs plane's
+  signals: replica count, predictive admission, and load-adaptive
+  degradation actuated from p95 TTFT burn / shed rate / queue backlog / KV
+  pressure (docs/AUTOSCALING.md);
 - :mod:`.obs`       — serving-plane observability: per-request span traces
   (``X-Request-Id`` end to end), Prometheus ``/metrics`` histograms, and the
   crash flight recorder the failure paths dump (docs/OBSERVABILITY.md);
@@ -60,4 +65,5 @@ from .scheduler import (  # noqa: F401
     SchedulerRejected,
 )
 from .router import EngineRouter  # noqa: F401
+from .autoscaler import AutoscalerConfig, SLOAutoscaler  # noqa: F401
 from .registry import ModelRegistry, ModelSpec  # noqa: F401

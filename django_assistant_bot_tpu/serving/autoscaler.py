@@ -1,0 +1,459 @@
+"""SLO-driven autoscaler: the control loop that closes the telemetry plane.
+
+PR 10 gave the fleet real signals (TTFT/queue-wait histograms, shed counters,
+KV gauges, per-replica health — serving/obs.py); ROADMAP item 6 named the gap:
+nothing *consumed* them.  :class:`SLOAutoscaler` is the consumer — a small
+controller thread that scrapes the fleet's own stats surfaces and actuates
+through the router's existing primitives (docs/AUTOSCALING.md):
+
+- **Signals** (read every ``interval_s``; no locks held across any of them):
+  p95 TTFT against the SLO (*burn* = observed/target), admission shed RATE
+  (delta of the schedulers' shed counters over the control interval),
+  queue-wait backlog (the schedulers' predicted wait — histogram-quantile
+  floored, serving/scheduler.py), and KV page-pool occupancy.
+- **Actuators**, cheapest first: *degradation* (force every replica's
+  scheduler degrade band on: max_tokens clamp + speculative decode off),
+  *scale-up* (``router.add_replica()`` — a fresh replica from the shared
+  ModelSpec weights), *scale-down* (``router.remove_replica()`` —
+  drain-then-detach, zero-shed by construction; chaos-verified against the
+  replica dying mid-drain, the exact race the flight recorder and lock
+  witness exist to catch).
+- **Flap prevention**: scale-up needs ``up_consecutive`` overloaded control
+  ticks, scale-down ``down_consecutive`` trough ticks (*all* signals calm, a
+  one-replica-smaller fleet projected to hold, zero sheds in the window);
+  each direction then starts its own cooldown.  Bounds
+  ``[min_replicas, max_replicas]`` are hard.
+
+Clock discipline (dabtlint DABT105): every timestamp flows through the
+injectable ``clock``/``sleep``, so the whole decision suite runs under a fake
+clock — scale-up on SLO burn, trough scale-down, hysteresis under an
+oscillating trace — with zero sleep-and-hope.  Every decision lands in the
+autoscaler's own flight-recorder ring (dumped alongside engine artifacts) and
+as ``dabt_autoscale_*`` metrics on ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from .obs import FlightRecorder
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    # fleet bounds (hard): ModelSpec.replicas is the initial/min size,
+    # ModelSpec.max_replicas the ceiling (serving/registry.py)
+    min_replicas: int = 1
+    max_replicas: int = 1
+    # control period: signals are deltas/levels over this window
+    interval_s: float = 1.0
+    # the SLO this controller defends: p95 time-to-first-token
+    slo_ttft_p95_s: float = 1.0
+    # ---- scale-up triggers (ANY fires the overload band) -------------------
+    up_burn: float = 1.0  # p95 TTFT / SLO at or past this
+    up_shed_per_s: float = 0.5  # admission sheds per second over the window
+    up_est_wait_frac: float = 0.5  # predicted queue wait / SLO
+    up_kv_frac: float = 0.9  # KV pages used / total
+    up_consecutive: int = 2  # overloaded ticks before actuating (hysteresis)
+    up_cooldown_s: float = 5.0
+    # ---- scale-down triggers (ALL must hold for the trough band) -----------
+    down_burn: float = 0.5
+    down_est_wait_frac: float = 0.1
+    down_kv_frac: float = 0.5
+    # a one-replica-smaller fleet must be projected to hold the current load:
+    # (queued + active) / (slots * (n-1)) <= this utilization
+    down_util: float = 0.5
+    down_consecutive: int = 3
+    down_cooldown_s: float = 30.0
+    # scale-down drain budget (remove_replica deadline)
+    drain_deadline_s: float = 30.0
+    # ---- load-adaptive degradation (cheaper than a replica) ----------------
+    # engage when burn crosses degrade_burn while the overload band holds (or
+    # the fleet is already at max); release when burn falls below
+    # degrade_release_burn AND the overload band has cleared — two thresholds,
+    # so the band cannot chatter around one line
+    degrade_burn: float = 1.5
+    degrade_release_burn: float = 0.75
+    degrade_max_tokens: int = 256
+
+    def validate(self) -> "AutoscalerConfig":
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if self.interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        if self.slo_ttft_p95_s <= 0:
+            raise ValueError("slo_ttft_p95_s must be > 0")
+        if self.degrade_release_burn >= self.degrade_burn:
+            raise ValueError(
+                "degrade_release_burn must be < degrade_burn (hysteresis)"
+            )
+        return self
+
+
+class SLOAutoscaler:
+    """One controller per :class:`~.router.EngineRouter`.
+
+    ``tick()`` is the whole policy — one signal read, one decision, at most
+    one actuation — and is public so the deterministic test suite drives it
+    directly under a fake clock; :meth:`start` just runs it on a daemon
+    thread every ``interval_s``.
+    """
+
+    def __init__(
+        self,
+        router,
+        cfg: AutoscalerConfig,
+        *,
+        name: str = "autoscaler",
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Optional[Callable[[float], None]] = None,
+    ):
+        self.router = router
+        self.cfg = cfg.validate()
+        self.name = name
+        self._clock = clock
+        # tests inject sleep; the thread otherwise waits on the stop event so
+        # stop() interrupts an interval instead of riding it out
+        self._sleep = sleep
+        self.flight = FlightRecorder(name=name, clock=clock)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()  # guards the counters below only
+        # controller state (single control thread; counters read by scrapes)
+        self._last_at: Optional[float] = None
+        # per-replica shed totals by replica NAME (names are never reused —
+        # the router's spawn counter is monotonic), so the per-window shed
+        # delta stays monotone across scale-downs: summing only the live
+        # fleet would go NEGATIVE when a replica detaches with history,
+        # masking real sheds in exactly the interval load got redistributed
+        self._shed_seen: dict = {}
+        self._shed_primed = False
+        self._up_ticks = 0
+        self._down_ticks = 0
+        self._up_ok_at = 0.0  # cooldown expiry stamps (clock domain)
+        self._down_ok_at = 0.0
+        self.degrade_active = False
+        # decision counters (the dabt_autoscale_* metric surface)
+        self.ticks = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.scale_up_failures = 0
+        self.degrade_engaged = 0
+        self.degrade_released = 0
+        # integral of fleet size over time — the cost axis of the bench A/B
+        # (replica-seconds: what a fixed max-size fleet pays all the time)
+        self.replica_seconds = 0.0
+        self.last_signals: dict = {}
+        self.last_decision: str = "init"
+
+    # ------------------------------------------------------------- signals
+    def _signals(self) -> dict:
+        """One scrape of the fleet's own stats surfaces.  Each surface does
+        its own locking; nothing here holds one component's lock across
+        another's call (the PR 7 ABBA family the witness convicts)."""
+        router = self.router
+        lat = router.latency_stats()
+        ttft_p95_s = float(lat.get("ttft_p95_ms", 0.0)) / 1e3
+        shed_total = 0
+        shed_delta = 0
+        seen: dict = {}
+        est_wait_s = 0.0
+        queued = 0
+        active = 0
+        slots = 0
+        for rep in list(router.replicas):
+            eng = rep.engine
+            queued += eng.queued_depth()
+            active += eng.num_active
+            slots += getattr(eng, "max_slots", 0)
+            sched = getattr(eng, "scheduler", None)
+            if sched is not None:
+                st = sched.stats()
+                total = sum(st.get("shed", {}).values())
+                shed_total += total
+                name = getattr(rep, "name", str(id(rep)))
+                seen[name] = total
+                shed_delta += max(0, total - self._shed_seen.get(name, 0))
+                est_wait_s = max(est_wait_s, float(st.get("est_wait_s", 0.0)))
+        if not self._shed_primed:
+            # first scrape: pre-existing counters are history, not a window
+            shed_delta = 0
+            self._shed_primed = True
+        self._shed_seen = seen
+        kv = router.kv_stats()
+        kv_total = kv.get("kv_pages_total", 0)
+        if kv_total:
+            # pressure = pages a new request could NOT obtain: evictable
+            # cached-prefix pages don't count (a warm prefix cache is not
+            # load, and must not pin the overload band / block the trough)
+            obtainable = kv.get(
+                "kv_pages_obtainable",
+                kv_total - kv.get("kv_pages_used", 0),
+            )
+            kv_frac = 1.0 - obtainable / kv_total
+        else:
+            kv_frac = 0.0
+        return {
+            "replicas": len(router.replicas),
+            "ttft_p95_s": round(ttft_p95_s, 4),
+            "ttft_n": lat.get("ttft_n", 0),
+            "shed_total": shed_total,
+            "shed_delta": shed_delta,
+            "est_wait_s": round(est_wait_s, 4),
+            "kv_frac": round(kv_frac, 4),
+            "queued": queued,
+            "active": active,
+            "slots": slots,
+        }
+
+    # ------------------------------------------------------------- the loop
+    def tick(self) -> dict:
+        """One control iteration: read signals, classify the band, actuate at
+        most once.  Returns the decision record (also appended to the flight
+        ring) — the deterministic test surface."""
+        cfg = self.cfg
+        now = self._clock()
+        dt = 0.0 if self._last_at is None else max(0.0, now - self._last_at)
+        self._last_at = now
+        sig = self._signals()
+        n = sig["replicas"]
+        with self._lock:
+            # the integral is also closed by stop(), possibly while a zombie
+            # tick is mid-drain — both sites go through the lock
+            self.replica_seconds += n * dt
+        shed_delta = sig["shed_delta"]
+        shed_rate = shed_delta / dt if dt > 0 else float(shed_delta)
+        burn = sig["ttft_p95_s"] / cfg.slo_ttft_p95_s
+        sig.update(
+            shed_rate=round(shed_rate, 4),
+            burn=round(burn, 4),
+        )
+
+        # the TTFT p95 comes from the engines' ROLLING sample window: after
+        # traffic stops, the window keeps reporting the last spike forever.
+        # Burn is evidence only while work is actually in flight — an idle
+        # fleet with a scary stale p95 must neither hold the overload band
+        # nor be blocked from scaling down / releasing degradation.
+        busy = (sig["queued"] + sig["active"]) > 0
+        sig["busy"] = busy
+        overload = (
+            (busy and burn >= cfg.up_burn)
+            or shed_rate >= cfg.up_shed_per_s
+            or sig["est_wait_s"] >= cfg.up_est_wait_frac * cfg.slo_ttft_p95_s
+            or sig["kv_frac"] >= cfg.up_kv_frac
+        )
+        burn_calm = not busy or burn <= cfg.down_burn
+        burn_released = not busy or burn <= cfg.degrade_release_burn
+        # projected utilization of a ONE-SMALLER fleet: scale-down must not
+        # immediately re-trigger scale-up (the flap the bands exist to stop)
+        smaller_slots = max(1, sig["slots"] - sig["slots"] // max(1, n))
+        shrunk_util = (sig["queued"] + sig["active"]) / smaller_slots
+        trough = (
+            not overload
+            and burn_calm
+            and shed_delta == 0
+            and sig["est_wait_s"] <= cfg.down_est_wait_frac * cfg.slo_ttft_p95_s
+            and sig["kv_frac"] <= cfg.down_kv_frac
+            and shrunk_util <= cfg.down_util
+        )
+        if overload:
+            self._up_ticks += 1
+            self._down_ticks = 0
+        elif trough:
+            self._down_ticks += 1
+            self._up_ticks = 0
+        else:
+            self._up_ticks = 0
+            self._down_ticks = 0
+
+        decision = "hold"
+        if overload and self._up_ticks >= cfg.up_consecutive:
+            if n < cfg.max_replicas and now >= self._up_ok_at:
+                decision = self._scale_up(now)
+            elif burn >= cfg.degrade_burn and not self.degrade_active:
+                decision = self._set_degrade(True)
+            elif n >= cfg.max_replicas and not self.degrade_active:
+                # at the ceiling with the overload band held: shaping load is
+                # the only actuator left, whatever the burn level
+                decision = self._set_degrade(True)
+        elif trough and self._down_ticks >= cfg.down_consecutive:
+            if self.degrade_active and burn_released:
+                decision = self._set_degrade(False)
+            elif n > cfg.min_replicas and now >= self._down_ok_at:
+                decision = self._scale_down(now)
+        elif self.degrade_active and not overload and burn_released:
+            decision = self._set_degrade(False)
+
+        with self._lock:
+            self.ticks += 1
+            self.last_signals = sig
+            self.last_decision = decision
+        record = {"decision": decision, **sig}
+        if decision != "hold":
+            self.flight.record("autoscale", **record)
+        return record
+
+    # ----------------------------------------------------------- actuators
+    def _scale_up(self, now: float) -> str:
+        try:
+            name = self.router.add_replica()
+        except Exception as e:
+            # a failed spawn (OOM, factory error) must not kill the control
+            # loop: count it, leave the cooldown untouched so the next tick
+            # can retry
+            logger.exception("autoscaler: scale-up failed")
+            with self._lock:
+                self.scale_up_failures += 1
+            self.flight.record("scale_up_failed", error=f"{type(e).__name__}: {e}")
+            return "scale_up_failed"
+        with self._lock:
+            self.scale_ups += 1
+        self._up_ok_at = now + self.cfg.up_cooldown_s
+        self._up_ticks = 0
+        if self.degrade_active:
+            # the new replica must degrade with the rest of the fleet until
+            # the band releases
+            self._apply_degrade(True)
+        logger.info("autoscaler: scaled up (+%s)", name)
+        return "scale_up"
+
+    def _pick_victim(self) -> Optional[int]:
+        """Least-loaded non-draining replica's CURRENT index (resolved at
+        call time; remove_replica re-checks under its own lock)."""
+        reps = list(self.router.replicas)
+        best = None
+        for i, rep in enumerate(reps):
+            if rep.draining:
+                continue
+            load = rep.engine.queued_depth() + rep.engine.num_active
+            if best is None or load < best[0]:
+                best = (load, i)
+        return best[1] if best is not None else None
+
+    def _scale_down(self, now: float) -> str:
+        victim = self._pick_victim()
+        if victim is None:
+            return "hold"
+        try:
+            report = self.router.remove_replica(
+                victim, deadline_s=self.cfg.drain_deadline_s
+            )
+        except RuntimeError as e:
+            # lost the race with a concurrent drain/removal — not a failure
+            self.flight.record("scale_down_skipped", error=str(e))
+            return "hold"
+        with self._lock:
+            self.scale_downs += 1
+        self._down_ok_at = now + self.cfg.down_cooldown_s
+        self._down_ticks = 0
+        self.flight.record("scale_down_report", **report)
+        logger.info(
+            "autoscaler: scaled down (-%s, drained=%s)",
+            report["replica"],
+            report["drained"],
+        )
+        return "scale_down"
+
+    def _apply_degrade(self, on: bool) -> None:
+        clamp = self.cfg.degrade_max_tokens if on else None
+        for rep in list(self.router.replicas):
+            sched = getattr(rep.engine, "scheduler", None)
+            if sched is not None:
+                sched.set_degrade(clamp)
+
+    def _set_degrade(self, on: bool) -> str:
+        self._apply_degrade(on)
+        self.degrade_active = on
+        with self._lock:
+            if on:
+                self.degrade_engaged += 1
+            else:
+                self.degrade_released += 1
+        logger.info("autoscaler: degradation band %s", "ENGAGED" if on else "released")
+        return "degrade_on" if on else "degrade_off"
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "SLOAutoscaler":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{self.name}-loop", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:
+                # the controller must never die of a transient scrape error
+                # (a replica mid-restart raising from a stats surface)
+                logger.exception("autoscaler: tick failed")
+            if self._sleep is not None:
+                self._sleep(self.cfg.interval_s)
+            else:
+                self._stop.wait(self.cfg.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            # the control thread may be INSIDE a scale-down drain: the join
+            # must outlast drain_deadline_s, or the registry would proceed to
+            # stop engines while a zombie tick still mutates the fleet
+            t.join(
+                timeout=max(
+                    5.0, 2 * self.cfg.interval_s, self.cfg.drain_deadline_s + 5.0
+                )
+            )
+            if t.is_alive():  # pragma: no cover - pathological drain wedge
+                logger.warning(
+                    "autoscaler: control thread still draining at stop(); "
+                    "proceeding (its replica was already detached from dispatch)"
+                )
+        self._thread = None
+        if self.degrade_active:
+            # never leave the fleet clamped after the controller goes away
+            self._set_degrade(False)
+        # close the replica-seconds integral up to NOW — accounting only, no
+        # policy (a post-stop tick() could still actuate); idempotent because
+        # _last_at advances with the accumulation, and locked against a
+        # concurrent tick's own accumulation
+        now = self._clock()
+        with self._lock:
+            if self._last_at is not None:
+                self.replica_seconds += len(self.router.replicas) * max(
+                    0.0, now - self._last_at
+                )
+                self._last_at = now
+
+    # --------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        """One JSON-able snapshot for /healthz and the /metrics renderer."""
+        with self._lock:
+            return {
+                "min_replicas": self.cfg.min_replicas,
+                "max_replicas": self.cfg.max_replicas,
+                "replicas": len(self.router.replicas),
+                "slo_ttft_p95_s": self.cfg.slo_ttft_p95_s,
+                "ticks": self.ticks,
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "scale_up_failures": self.scale_up_failures,
+                "degrade_active": self.degrade_active,
+                "degrade_engaged": self.degrade_engaged,
+                "degrade_released": self.degrade_released,
+                "replica_seconds": round(self.replica_seconds, 3),
+                "last_decision": self.last_decision,
+                "last_signals": dict(self.last_signals),
+            }

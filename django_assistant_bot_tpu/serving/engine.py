@@ -500,6 +500,12 @@ class GenerationEngine:
                 scheduler.bind_kv(
                     self._kv_pool.available, self._kv_pool.n_pages
                 )
+            if self.obs is not None:
+                # predictive admission (docs/AUTOSCALING.md): once warm, the
+                # obs plane's queue-wait histogram floors the estimated-wait
+                # model with the measured tail of realized waits, and the 429
+                # Retry-After becomes that prediction instead of a heuristic
+                scheduler.bind_wait_hist(self.obs.queue_wait_s)
         # --- supervision (docs/RESILIENCE.md) ---------------------------------
         # Deterministic fault injection (serving/faults.py).  None = off: the
         # hot path pays one `is None` check per tick, nothing else.

@@ -275,11 +275,23 @@ class PageAllocator:
         with self._lock:
             used = self.n_pages - len(self._free)
             shared_pages = {p for ent in self._shared.values() for p in ent.pages}
+            # free + evictable cached-prefix pages — the same quantity
+            # available() reports.  Consumers judging POOL PRESSURE (the
+            # autoscaler's kv_frac) must use this, not used/total: a warm
+            # prefix cache legitimately occupies pages without denying them
+            # to anyone (they evict on demand).
+            evictable = sum(
+                1
+                for ent in self._shared.values()
+                for p in ent.pages
+                if self._refs.get(p) == 1
+            )
             return {
                 "kv_pages_total": self.n_pages,
                 "kv_page_size": self.page_size,
                 "kv_pages_used": used,
                 "kv_pages_free": len(self._free),
+                "kv_pages_obtainable": len(self._free) + evictable,
                 "kv_shared_pages": len(shared_pages),
                 "kv_shared_page_frac": round(len(shared_pages) / max(1, used), 4)
                 if used

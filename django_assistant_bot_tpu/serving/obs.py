@@ -41,6 +41,7 @@ import bisect
 import collections
 import json
 import logging
+import math
 import os
 import tempfile
 import threading
@@ -99,6 +100,25 @@ class Histogram:
         with self._lock:
             return self._n
 
+    def raw_counts(self) -> Tuple[List[int], int]:
+        """(per-bucket raw counts incl. the +Inf bucket, total n) — the
+        windowing substrate: consumers diff two snapshots to quantile over
+        only the observations BETWEEN them (serving/scheduler.py)."""
+        with self._lock:
+            return list(self._counts), self._n
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated ``q``-quantile of the observed values.
+
+        The estimate linearly interpolates inside the bucket that contains
+        the target rank; values in the ``+Inf`` bucket report the largest
+        finite bound (a deliberate *under*-estimate — the admission plane
+        uses this as a prediction, and an unbounded guess would shed
+        everything forever).  Returns 0.0 on an empty histogram — callers
+        gate on :attr:`count` to tell "cold" from "fast"."""
+        counts, n = self.raw_counts()
+        return quantile_from_counts(self.bounds, counts, q)
+
     def snapshot(self) -> Tuple[List[Tuple[float, int]], float, int]:
         """(cumulative ``le`` buckets, sum, count) — the exposition shape."""
         with self._lock:
@@ -111,6 +131,33 @@ class Histogram:
             out.append((b, acc))
         out.append((float("inf"), acc + counts[-1]))
         return out, total, n
+
+
+def quantile_from_counts(
+    bounds: Tuple[float, ...], counts: List[int], q: float
+) -> float:
+    """The bucket-interpolation quantile over RAW per-bucket counts (the last
+    entry being the +Inf bucket).  Shared by :meth:`Histogram.quantile` and
+    the scheduler's windowed predictive-admission floor, which quantiles the
+    DIFFERENCE of two count snapshots."""
+    q = min(1.0, max(0.0, float(q)))
+    n = sum(counts)
+    if n == 0:
+        return 0.0
+    target = max(1, math.ceil(q * n))
+    acc = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if acc + c >= target:
+            if i >= len(bounds):  # +Inf bucket: report the finite ceiling
+                return bounds[-1]
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (target - acc) / c
+            return lo + frac * (hi - lo)
+        acc += c
+    return bounds[-1]  # pragma: no cover - defensive
 
 
 class _Exposition:
@@ -620,12 +667,37 @@ def render_prometheus(registry: Any) -> str:
             x.add("dabt_router_failed_past_first_token_total", "counter", "replica failures not re-routable (tokens emitted)", rs["failed_past_first_token"], rlab)
             x.add("dabt_router_no_replica_total", "counter", "submissions with no replica available", rs["no_replica_available"], rlab)
             x.add("dabt_router_drains_total", "counter", "replica drains", rs["drains"], rlab)
+            x.add("dabt_router_replicas_added_total", "counter", "replicas added to the fleet (scale-up)", rs.get("replicas_added"), rlab)
+            x.add("dabt_router_replicas_removed_total", "counter", "replicas drained and detached (scale-down)", rs.get("replicas_removed"), rlab)
+            x.add("dabt_router_replica_restarts_total", "counter", "replica restarts (operator or drain-restart)", rs.get("replica_restarts"), rlab)
             x.add("dabt_router_affinity_hit_rate", "gauge", "prefix-affinity dispatch hit rate", rs["affinity_hit_rate"], rlab)
             for rep_stats in rs["replicas"]:
                 plab = {"model": model, "replica": rep_stats["name"]}
                 x.add("dabt_replica_draining", "gauge", "replica drain flag", rep_stats["draining"], plab)
                 x.add("dabt_replica_breaker_open", "gauge", "router breaker not closed", rep_stats["breaker"] != "closed", plab)
                 x.add("dabt_replica_dispatched_total", "counter", "requests dispatched to replica", rep_stats["dispatched"], plab)
+    for model, asc in sorted(getattr(registry, "autoscalers", {}).items()):
+        # SLO autoscaler (serving/autoscaler.py): every decision is
+        # scrapeable — fleet size vs bounds, scale/degrade counters, and the
+        # last control tick's raw signals
+        lab = {"model": model}
+        st = asc.stats()
+        x.add("dabt_autoscale_replicas", "gauge", "current fleet size", st["replicas"], lab)
+        x.add("dabt_autoscale_min_replicas", "gauge", "fleet floor", st["min_replicas"], lab)
+        x.add("dabt_autoscale_max_replicas", "gauge", "fleet ceiling", st["max_replicas"], lab)
+        x.add("dabt_autoscale_ticks_total", "counter", "control-loop iterations", st["ticks"], lab)
+        x.add("dabt_autoscale_scale_ups_total", "counter", "replicas added by the controller", st["scale_ups"], lab)
+        x.add("dabt_autoscale_scale_downs_total", "counter", "replicas removed by the controller", st["scale_downs"], lab)
+        x.add("dabt_autoscale_scale_up_failures_total", "counter", "failed scale-up attempts", st["scale_up_failures"], lab)
+        x.add("dabt_autoscale_degrade_active", "gauge", "load-adaptive degradation engaged", st["degrade_active"], lab)
+        x.add("dabt_autoscale_degrade_engaged_total", "counter", "degradation band engagements", st["degrade_engaged"], lab)
+        x.add("dabt_autoscale_replica_seconds_total", "counter", "integral of fleet size over time", st["replica_seconds"], lab)
+        sig = st.get("last_signals", {})
+        x.add("dabt_autoscale_slo_burn", "gauge", "last tick's p95 TTFT / SLO", sig.get("burn"), lab)
+        x.add("dabt_autoscale_ttft_p95_seconds", "gauge", "last tick's observed p95 TTFT", sig.get("ttft_p95_s"), lab)
+        x.add("dabt_autoscale_shed_rate", "gauge", "last tick's admission sheds per second", sig.get("shed_rate"), lab)
+        x.add("dabt_autoscale_est_wait_seconds", "gauge", "last tick's worst predicted queue wait", sig.get("est_wait_s"), lab)
+        x.add("dabt_autoscale_kv_frac", "gauge", "last tick's KV pool occupancy", sig.get("kv_frac"), lab)
     for model, emb in sorted(getattr(registry, "embedders", {}).items()):
         lab = {"model": model}
         x.add("dabt_embed_queue_depth", "gauge", "embedding coalescer queue depth", emb._queue.qsize(), lab)

@@ -144,12 +144,31 @@ class ModelSpec:
     # per replica) behind an EngineRouter doing health- and prefix-affinity-
     # aware dispatch with per-replica circuit breakers and token-less
     # re-route.  1 = the single-engine path, byte-identical to before (the
-    # bench baseline; no router object exists at all).
+    # bench baseline; no router object exists at all).  With a dynamic fleet
+    # (max_replicas above this, or autoscale on) this is the INITIAL and
+    # MINIMUM size, not a fixed count.
     replicas: int = 1
+    # ceiling for the dynamic fleet: the router's add_replica/remove_replica
+    # (and the autoscaler driving them) keep the fleet within
+    # [replicas, max_replicas].  0 = fixed fleet at `replicas` exactly.
+    # Any value above `replicas` builds a router (even at replicas=1) so the
+    # fleet can grow; validated >= replicas.
+    max_replicas: int = 0
     # per-replica router breaker: consecutive replica-shaped failures before
     # the breaker opens, and how long it stays open before one probe request
     router_breaker_threshold: int = 3
     router_breaker_reset_s: float = 10.0
+    # --- SLO-driven autoscaling (serving/autoscaler.py; docs/AUTOSCALING.md)
+    # closes the control loop over the obs plane: scales the fleet within
+    # [replicas, max_replicas] on p95-TTFT SLO burn / shed rate / queue
+    # backlog / KV pressure, and engages load-adaptive degradation
+    # (max_tokens clamp + speculative decode off) when a replica can't help
+    autoscale: bool = False
+    autoscale_interval_s: float = 1.0
+    autoscale_slo_ttft_p95_s: float = 1.0
+    autoscale_up_cooldown_s: float = 5.0
+    autoscale_down_cooldown_s: float = 30.0
+    autoscale_degrade_max_tokens: int = 256
 
     @classmethod
     def from_dict(cls, name: str, d: Mapping[str, Any]) -> "ModelSpec":
@@ -179,6 +198,10 @@ class ModelRegistry:
         self.specs: Dict[str, ModelSpec] = {}
         self.embedders: Dict[str, Any] = {}
         self.generators: Dict[str, Any] = {}
+        # SLO autoscalers by model name (autoscale=true decoder entries):
+        # /healthz and /metrics read their stats; stop() halts them FIRST so
+        # no scale decision races engine shutdown
+        self.autoscalers: Dict[str, Any] = {}
         for spec in (specs or {}).values():
             self.load(spec)
 
@@ -237,6 +260,15 @@ class ModelRegistry:
             raise ValueError(
                 f"model {name}: replicas is decoder-only (the embedding "
                 "coalescer already batches across callers in one engine)"
+            )
+        if spec.max_replicas and spec.max_replicas < spec.replicas:
+            raise ValueError(
+                f"model {name}: max_replicas ({spec.max_replicas}) must be "
+                f">= replicas ({spec.replicas} — the initial/min fleet size)"
+            )
+        if (spec.max_replicas or spec.autoscale) and spec.kind == "encoder":
+            raise ValueError(
+                f"model {name}: max_replicas/autoscale are decoder-only"
             )
         tokenizer_path = spec.path
         logger.info("loading model %r (%s, tiny=%s)", name, spec.kind, spec.tiny)
@@ -338,8 +370,17 @@ class ModelRegistry:
                     )
                 return FaultInjector.from_env(seed_offset=seed_offset)
 
-            engines = []
-            for i in range(spec.replicas):
+            # dynamic fleet: max_replicas above the initial size (or the
+            # autoscaler on) needs the router's add/remove surface even when
+            # the fleet STARTS at one replica
+            max_replicas = spec.max_replicas or spec.replicas
+            fleet = spec.replicas > 1 or max_replicas > spec.replicas or spec.autoscale
+
+            def _build_engine(i: int):
+                """Replica ``i`` from the SHARED weight tree — used for the
+                initial fleet and as the router's scale-up factory (the
+                autoscaler spawns replicas through this exact closure, so a
+                scaled-up replica is indistinguishable from a boot-time one)."""
                 eng = GenerationEngine(
                     cfg,
                     params,  # weights are read-only: every replica shares them
@@ -373,7 +414,7 @@ class ModelRegistry:
                     max_request_restarts=spec.max_request_restarts,
                     # replica-qualified name: flight-recorder artifacts and
                     # /metrics `replica` labels match the router's names
-                    name=f"{name}/r{i}" if spec.replicas > 1 else name,
+                    name=f"{name}/r{i}" if fleet else name,
                     obs=spec.obs,
                     obs_dump_dir=spec.obs_dump_dir,
                     mesh=self.mesh,
@@ -383,27 +424,50 @@ class ModelRegistry:
                     # warmup a cache replay, not a recompile
                     eng.warmup(json=spec.warmup_json)
                 eng.start()
-                engines.append(eng)
-            if spec.replicas == 1:
-                # single engine, no router object: byte-identical to the
-                # pre-router serving path (the bench baseline)
+                return eng
+
+            engines = [_build_engine(i) for i in range(spec.replicas)]
+            if not fleet:
+                # single fixed engine, no router object: byte-identical to
+                # the pre-router serving path (the bench baseline)
                 self.generators[name] = engines[0]
             else:
                 from .router import EngineRouter
 
-                self.generators[name] = EngineRouter(
+                router = EngineRouter(
                     engines,
                     names=[f"{name}/r{i}" for i in range(spec.replicas)],
                     breaker_threshold=spec.router_breaker_threshold,
                     breaker_reset_s=spec.router_breaker_reset_s,
                     max_reroutes=spec.max_request_restarts,
                     faults=_build_faults(len(engines)),
+                    replica_factory=_build_engine,
                 )
+                self.generators[name] = router
+                if spec.autoscale:
+                    from .autoscaler import AutoscalerConfig, SLOAutoscaler
+
+                    self.autoscalers[name] = SLOAutoscaler(
+                        router,
+                        AutoscalerConfig(
+                            min_replicas=spec.replicas,
+                            max_replicas=max_replicas,
+                            interval_s=spec.autoscale_interval_s,
+                            slo_ttft_p95_s=spec.autoscale_slo_ttft_p95_s,
+                            up_cooldown_s=spec.autoscale_up_cooldown_s,
+                            down_cooldown_s=spec.autoscale_down_cooldown_s,
+                            degrade_max_tokens=spec.autoscale_degrade_max_tokens,
+                        ),
+                        name=f"{name}-autoscaler",
+                    ).start()
         else:
             raise ValueError(f"model {name}: unknown kind {spec.kind!r}")
         self.specs[name] = spec
 
     def stop(self):
+        # autoscalers first: a scale decision must not race engine shutdown
+        for asc in self.autoscalers.values():
+            asc.stop()
         for eng in list(self.embedders.values()) + list(self.generators.values()):
             eng.stop()
 

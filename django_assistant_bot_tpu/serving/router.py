@@ -46,6 +46,13 @@ Dispatch policy (docs/RESILIENCE.md "Fleet topology"):
   the server stops admission, the fleet finishes what it accepted, the
   process exits 0.
 
+- **Dynamic fleet size.**  :meth:`add_replica` spawns a fresh replica from
+  the registry-provided factory (same shared weights, its own scheduler/KV
+  pool/faults) and :meth:`remove_replica` drains one and detaches it — the
+  SLO autoscaler's actuators (serving/autoscaler.py, docs/AUTOSCALING.md).
+  Dispatch state is held by replica OBJECT, never by index, so a request's
+  re-route callback stays correct while the fleet grows or shrinks under it.
+
 Chaos sites ``replica_dead`` / ``replica_slow`` (serving/faults.py) exercise
 all of the above deterministically: ``replica_dead`` kills the replica the
 dispatcher is about to pick — in-flight work fails, the breaker trips, and
@@ -151,7 +158,9 @@ class _Routed:
         self.outer = outer
         self.shim = shim
         self.reroutes = 0
-        self.replica: Optional[int] = None
+        # the _Replica OBJECT currently carrying the request — never an index:
+        # add_replica/remove_replica shift list positions under live requests
+        self.replica: Optional[_Replica] = None
         self.inner: Optional[Future] = None
         # the client's ABSOLUTE deadline, fixed at first submission: each
         # engine.submit computes its own deadline_at from deadline_s, so a
@@ -165,7 +174,7 @@ class _Routed:
         # replicas whose prefix registry held this prompt's prefix at the
         # last candidate ordering — a hit is counted only when the replica
         # ACTUALLY dispatched to is one of them (a skipped holder is a miss)
-        self.holders: Set[int] = set()
+        self.holders: Set["_Replica"] = set()
 
 
 class EngineRouter:
@@ -184,6 +193,7 @@ class EngineRouter:
         breaker_reset_s: float = 10.0,
         max_reroutes: Optional[int] = None,
         faults=None,
+        replica_factory: Optional[Callable[[int], GenerationEngine]] = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
     ):
@@ -192,6 +202,11 @@ class EngineRouter:
         self._clock = clock
         self._sleep = sleep
         self._faults = faults
+        # spawns replica N from the shared ModelSpec weights (registry
+        # closure) — the autoscaler's scale-up actuator; None = fixed fleet
+        self._replica_factory = replica_factory
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_reset_s = float(breaker_reset_s)
         names = list(names) if names else [f"replica{i}" for i in range(len(engines))]
         if len(names) != len(engines):
             raise ValueError("names must match engines 1:1")
@@ -203,6 +218,9 @@ class EngineRouter:
             )
             for eng, name in zip(engines, names)
         ]
+        # monotonic spawn counter: replica names are never reused, so flight
+        # artifacts and /metrics labels stay unambiguous across scale cycles
+        self._spawned = len(engines)
         # one request survives at most this many replica hops — the same
         # budget the engines' own crash-restart salvage enforces per replica
         self.max_reroutes = (
@@ -230,6 +248,10 @@ class EngineRouter:
         self.drains = 0
         self.drain_shed = 0  # requests failed by a deadline-forced drain
         self.no_replica_available = 0
+        # dynamic-fleet counters (scale events are scrapeable via /metrics)
+        self.replicas_added = 0
+        self.replicas_removed = 0
+        self.replica_restarts = 0
 
     # engine.generate / generate_stream only touch self.tokenizer and
     # self.submit — both present here, so the router reuses them verbatim
@@ -248,32 +270,38 @@ class EngineRouter:
     def _load(self, rep: _Replica) -> int:
         return rep.engine.queued_depth() + rep.engine.num_active
 
-    def _candidate_order(self, state: _Routed, exclude: Optional[Set[int]]) -> List[int]:
+    def _candidate_order(
+        self, state: _Routed, exclude: Optional[Set["_Replica"]]
+    ) -> List["_Replica"]:
         """Dispatch preference: non-draining replicas, prefix-registry holders
         first (least-loaded among holders), then everything else least-loaded
-        with a rotating tie-break."""
+        with a rotating tie-break.  Returns replica OBJECTS over a snapshot of
+        the (possibly growing/shrinking) fleet — positions are only used for
+        the rotation tie-break."""
         with self._lock:
             self._rr += 1
             rr = self._rr
-        n = len(self.replicas)
-        idxs = [
-            i
-            for i, rep in enumerate(self.replicas)
-            if not rep.draining and (not exclude or i not in exclude)
+            reps = list(self.replicas)
+        n = max(1, len(reps))
+        pos = {id(rep): i for i, rep in enumerate(reps)}
+        cands = [
+            rep
+            for rep in reps
+            if not rep.draining and (not exclude or rep not in exclude)
         ]
-        idxs.sort(key=lambda i: (self._load(self.replicas[i]), (i - rr) % n))
+        cands.sort(key=lambda rep: (self._load(rep), (pos[id(rep)] - rr) % n))
         prefix_len = state.kwargs.get("prefix_len", 0)
         state.holders = set()
-        if prefix_len and len(idxs) > 1:
+        if prefix_len and len(cands) > 1:
             holders = [
-                i
-                for i in idxs
-                if self.replicas[i].engine.holds_prefix(state.prompt_ids, prefix_len)
+                rep
+                for rep in cands
+                if rep.engine.holds_prefix(state.prompt_ids, prefix_len)
             ]
             if holders:
                 state.holders = set(holders)
-                idxs = holders + [i for i in idxs if i not in holders]
-        return idxs
+                cands = holders + [rep for rep in cands if rep not in holders]
+        return cands
 
     def submit(
         self,
@@ -329,7 +357,7 @@ class EngineRouter:
         if self._faults is not None and self._faults.should_fire("replica_dead"):
             order = self._candidate_order(state, None)
             if order:
-                self.kill_replica(order[0])
+                self._kill(order[0])
         self._dispatch(state, exclude=None, sync=True)
         # outer cancel (client disconnect) must reach whichever inner future
         # currently carries the request so the engine's reap frees the slot
@@ -342,14 +370,15 @@ class EngineRouter:
             if inner is not None and not inner.done():
                 inner.cancel()
 
-    def _dispatch(self, state: _Routed, exclude: Optional[Set[int]], *, sync: bool) -> None:
+    def _dispatch(
+        self, state: _Routed, exclude: Optional[Set["_Replica"]], *, sync: bool
+    ) -> None:
         """Try candidates in preference order; on ``sync`` (the caller's
         thread) synchronous rejections raise, on re-route they resolve the
         outer future instead."""
         last_unavail: Optional[EngineUnavailable] = None
         last_shed: Optional[SchedulerRejected] = None
-        for idx in self._candidate_order(state, exclude):
-            rep = self.replicas[idx]
+        for rep in self._candidate_order(state, exclude):
             br = rep.breaker
             if not br.allow():
                 continue
@@ -376,21 +405,22 @@ class EngineRouter:
                     # a hit only if THIS replica holds the prefix — a holder
                     # skipped for health/breaker reasons is a miss (the
                     # request re-prefills), and the gauge must say so
-                    if idx in state.holders:
+                    if rep in state.holders:
                         self.affinity_hits += 1
                     else:
                         self.affinity_misses += 1
-            state.replica = idx
+            state.replica = rep
             state.inner = inner
             if state.outer.cancelled():
                 inner.cancel()
             inner.add_done_callback(
-                lambda f, s=state, i=idx: self._on_inner_done(s, i, f)
+                lambda f, s=state, r=rep: self._on_inner_done(s, r, f)
             )
             return
         # no replica took it
         with self._lock:
             self.no_replica_available += 1
+            reps = list(self.replicas)
         exc: BaseException
         if last_shed is not None and last_unavail is None:
             exc = last_shed
@@ -401,8 +431,14 @@ class EngineRouter:
             # the fleet is alive, the client should back off and retry
             exc = last_shed
         else:
+            # honest Retry-After: the soonest any breaker would re-admit —
+            # the predictive-admission discipline (no fixed constants) applied
+            # to the 503 path too (docs/AUTOSCALING.md)
+            hints = [rep.breaker.retry_in_s() for rep in reps]
+            retry = min((h for h in hints if h > 0), default=1.0)
             exc = EngineUnavailable(
-                "no healthy replica available", retry_after_s=1.0
+                "no healthy replica available",
+                retry_after_s=min(30.0, max(0.5, retry)),
             )
         if sync:
             raise exc
@@ -422,8 +458,7 @@ class EngineRouter:
             return False
         return isinstance(exc, Exception)
 
-    def _on_inner_done(self, state: _Routed, idx: int, inner: Future) -> None:
-        rep = self.replicas[idx]
+    def _on_inner_done(self, state: _Routed, rep: "_Replica", inner: Future) -> None:
         br = rep.breaker
         if state.outer.cancelled():
             # the client went away; the engine's reap already owns cleanup —
@@ -488,7 +523,7 @@ class EngineRouter:
                     self.max_reroutes,
                 )
                 try:
-                    self._dispatch(state, exclude={idx}, sync=False)
+                    self._dispatch(state, exclude={rep}, sync=False)
                 except Exception as redispatch_exc:  # pragma: no cover - belt
                     # an unexpected submit error here would otherwise be
                     # swallowed by Future._invoke_callbacks and leave the
@@ -508,31 +543,157 @@ class EngineRouter:
         _safe_resolve(state.outer, exc=exc)
 
     # ------------------------------------------------------ chaos / recovery
-    def kill_replica(self, idx: int) -> None:
-        """Abrupt replica death (the ``replica_dead`` chaos site): drop the
-        engine's run flag so its loop exits at the top of the next iteration
-        and its ``_shutdown`` fails everything in flight — exactly what the
-        router must survive.  No drain, no goodbye."""
-        rep = self.replicas[idx]
+    def _kill(self, rep: "_Replica") -> None:
         logger.warning("router: chaos killed %s", rep.name)
         obs = getattr(rep.engine, "obs", None)
         if obs is not None:
             obs.flight.record("replica_kill", replica=rep.name)
         rep.engine._running = False
 
+    def kill_replica(self, idx: int) -> None:
+        """Abrupt replica death (the ``replica_dead`` chaos site): drop the
+        engine's run flag so its loop exits at the top of the next iteration
+        and its ``_shutdown`` fails everything in flight — exactly what the
+        router must survive.  No drain, no goodbye."""
+        self._kill(self.replicas[idx])
+
+    def _restart_rep(self, rep: "_Replica", *, stop_timeout_s: float = 30.0) -> None:
+        rep.engine.stop(drain_timeout_s=stop_timeout_s)
+        rep.engine.start()
+        rep.breaker.record_success()
+        with self._lock:
+            self.replica_restarts += 1
+
     def restart_replica(self, idx: int, *, stop_timeout_s: float = 30.0) -> None:
         """Operator restart of a (dead or drained) replica: bounded stop —
         failing whatever the dead loop left behind — then a fresh loop
         thread.  The breaker closes on the explicit restart; the device
         state (weights, caches, prefix registry) carries over."""
-        rep = self.replicas[idx]
-        rep.engine.stop(drain_timeout_s=stop_timeout_s)
-        rep.engine.start()
-        rep.breaker.record_success()
+        self._restart_rep(self.replicas[idx], stop_timeout_s=stop_timeout_s)
+
+    # ------------------------------------------------------- dynamic fleet
+    def add_replica(self, engine: Optional[GenerationEngine] = None) -> str:
+        """Grow the fleet by one replica and return its name — the
+        autoscaler's scale-up actuator.  ``engine`` defaults to one spawned
+        from the registry's ``replica_factory`` (shared ModelSpec weights;
+        the factory returns a STARTED engine).  The new replica opens for
+        dispatch atomically with its list append; its spawn index is
+        monotonic, so names are never reused across scale cycles."""
+        with self._lock:
+            spawn_idx = self._spawned
+            self._spawned += 1
+        if engine is None:
+            if self._replica_factory is None:
+                with self._lock:
+                    self._spawned -= 1
+                raise RuntimeError(
+                    "add_replica needs an engine or a replica_factory"
+                )
+            engine = self._replica_factory(spawn_idx)
+        name = getattr(engine, "name", None) or f"replica{spawn_idx}"
+        rep = _Replica(
+            engine,
+            name,
+            CircuitBreaker(
+                self._breaker_threshold, self._breaker_reset_s, clock=self._clock
+            ),
+        )
+        if not getattr(engine, "_running", False):
+            engine.start()
+        obs = getattr(engine, "obs", None)
+        if obs is not None:
+            obs.flight.record("replica_added", replica=name)
+        with self._lock:
+            self.replicas.append(rep)
+            self.replicas_added += 1
+        logger.info("router: added replica %s (fleet=%d)", name, len(self.replicas))
+        return name
+
+    def remove_replica(self, idx: int, *, deadline_s: float = 30.0, poll_s: float = 0.005) -> dict:
+        """Shrink the fleet by one replica: stop admitting to it, wait —
+        deadline-bounded — for its in-flight work, then stop and DETACH it
+        (the autoscaler's scale-down actuator; drain-then-detach, no
+        restart).  Safe against the replica dying mid-drain: a dead engine
+        fails its in-flight work and reads idle, so the drain completes
+        instead of wedging — and the race leaves a flight-recorder artifact
+        carrying both the kill and this scale decision."""
+        with self._lock:
+            if len(self.replicas) <= 1:
+                raise RuntimeError("cannot remove the last replica")
+            rep = self.replicas[idx]
+            if rep.draining:
+                raise RuntimeError(f"{rep.name} is already draining")
+            rep.draining = True
+            self.drains += 1
+        obs = getattr(rep.engine, "obs", None)
+        if obs is not None:
+            obs.flight.record("scale_down", replica=rep.name)
+        wait = self._wait_replica_idle(
+            rep, deadline_s=deadline_s, poll_s=poll_s, tail="they fail on detach"
+        )
+        died = not rep.engine._running
+        # stop fails anything the deadline forced (token-less victims
+        # re-route through their done-callbacks, same as a replica death)
+        rep.engine.stop(drain_timeout_s=1.0)
+        with self._lock:
+            if rep in self.replicas:
+                self.replicas.remove(rep)
+            self.replicas_removed += 1
+            rep.draining = False
+        report = {
+            "replica": rep.name,
+            "died_mid_drain": died,
+            **wait,
+        }
+        if obs is not None:
+            obs.flight.record("replica_removed", **report)
+            if died or wait["forced_failures"]:
+                # the race the lock witness + flight recorder exist to catch:
+                # the replica died (or shed) under a scale-down — dump the
+                # ring so the artifact shows the kill AND the scale decision
+                obs.flight.dump("scale_down_interrupted", **report)
+        logger.info(
+            "router: removed replica %s (fleet=%d, drained=%s)",
+            rep.name,
+            len(self.replicas),
+            wait["drained"],
+        )
+        return report
 
     # ---------------------------------------------------------------- drain
     def _replica_idle(self, rep: _Replica) -> bool:
         return rep.engine.idle()
+
+    def _wait_replica_idle(
+        self, rep: _Replica, *, deadline_s: float, poll_s: float, tail: str
+    ) -> dict:
+        """The drain-wait core shared by graceful drain (restart epilogue)
+        and scale-down (detach epilogue): poll until the replica holds no
+        accepted work or the deadline lands, charging ``drain_shed`` for
+        whatever the deadline forces.  ``tail`` names the caller's fate for
+        the forced work in the log line."""
+        t0 = self._clock()
+        while not self._replica_idle(rep) and self._clock() - t0 < deadline_s:
+            self._sleep(poll_s)
+        drained = self._replica_idle(rep)
+        forced = 0
+        if not drained:
+            forced = rep.engine.num_active + rep.engine.queued_depth()
+            with self._lock:
+                self.drain_shed += forced
+            logger.warning(
+                "router: drain of %s hit its %.1fs deadline with %d "
+                "request(s) still in flight; %s",
+                rep.name,
+                deadline_s,
+                forced,
+                tail,
+            )
+        return {
+            "drained": drained,
+            "forced_failures": forced,
+            "waited_s": round(self._clock() - t0, 3),
+        }
 
     def drain(
         self,
@@ -548,8 +709,32 @@ class EngineRouter:
         summary dict; ``forced_failures`` counts requests the deadline
         forced to fail (0 on a clean drain — the zero-shed rolling-restart
         contract)."""
-        rep = self.replicas[idx]
+        return self._drain_rep(
+            self.replicas[idx], deadline_s=deadline_s, restart=restart, poll_s=poll_s
+        )
+
+    def _drain_rep(
+        self,
+        rep: "_Replica",
+        *,
+        deadline_s: float = 30.0,
+        restart: bool = True,
+        poll_s: float = 0.005,
+    ) -> dict:
         with self._lock:
+            if rep not in self.replicas:
+                # a concurrent remove_replica (autoscaler scale-down) won the
+                # race: the replica is already detached and stopped — there
+                # is nothing to drain and NOTHING to restart (restarting a
+                # detached engine would orphan a running loop no dispatch
+                # can reach and no stop() will ever visit)
+                return {
+                    "replica": rep.name,
+                    "drained": True,
+                    "forced_failures": 0,
+                    "waited_s": 0.0,
+                    "skipped": "detached",
+                }
             if rep.draining:
                 raise RuntimeError(f"{rep.name} is already draining")
             rep.draining = True
@@ -557,25 +742,19 @@ class EngineRouter:
         obs = getattr(rep.engine, "obs", None)
         if obs is not None:
             obs.flight.record("drain_begin", replica=rep.name)
-        t0 = self._clock()
         try:
-            while not self._replica_idle(rep) and self._clock() - t0 < deadline_s:
-                self._sleep(poll_s)
-            drained = self._replica_idle(rep)
-            forced = 0
-            if not drained:
-                forced = rep.engine.num_active + rep.engine.queued_depth()
-                with self._lock:
-                    self.drain_shed += forced
-                logger.warning(
-                    "router: drain of %s hit its %.1fs deadline with %d "
-                    "request(s) still in flight; they fail on restart",
-                    rep.name,
-                    deadline_s,
-                    forced,
-                )
+            wait = self._wait_replica_idle(
+                rep,
+                deadline_s=deadline_s,
+                poll_s=poll_s,
+                tail="they fail on restart",
+            )
+            drained, forced = wait["drained"], wait["forced_failures"]
             if restart:
-                self.restart_replica(idx)
+                with self._lock:
+                    still_attached = rep in self.replicas
+                if still_attached:
+                    self._restart_rep(rep)
             if obs is not None:
                 obs.flight.record(
                     "drain_end",
@@ -587,12 +766,7 @@ class EngineRouter:
                 # that is a post-mortem artifact, same as a crash restart
                 if forced:
                     obs.flight.dump("drain_forced", replica=rep.name, forced=forced)
-            return {
-                "replica": rep.name,
-                "drained": drained,
-                "forced_failures": forced,
-                "waited_s": round(self._clock() - t0, 3),
-            }
+            return {"replica": rep.name, **wait}
         finally:
             with self._lock:
                 rep.draining = False
@@ -600,11 +774,24 @@ class EngineRouter:
     def rolling_restart(self, *, deadline_s: float = 30.0) -> List[dict]:
         """Drain-and-restart every replica, one at a time, under live
         traffic — the zero-downtime restart path.  With >= 2 replicas the
-        fleet keeps serving throughout."""
-        return [
-            self.drain(i, deadline_s=deadline_s, restart=True)
-            for i in range(len(self.replicas))
-        ]
+        fleet keeps serving throughout.  Snapshots the fleet first: replicas
+        an autoscaler adds mid-restart are already fresh, and ones it drains
+        or detaches concurrently are SKIPPED (reported, not fatal) — an
+        aborted rolling restart would leave the tail of the fleet on the old
+        state."""
+        with self._lock:
+            reps = list(self.replicas)
+        reports = []
+        for rep in reps:
+            try:
+                reports.append(
+                    self._drain_rep(rep, deadline_s=deadline_s, restart=True)
+                )
+            except RuntimeError as e:
+                # concurrently draining (autoscaler scale-down mid-flight):
+                # that drain already does the work this pass wanted
+                reports.append({"replica": rep.name, "skipped": str(e)})
+        return reports
 
     def begin_drain(self) -> None:
         """Non-blocking fleet-wide admission stop (the SIGTERM path): every
@@ -622,46 +809,49 @@ class EngineRouter:
         self.begin_drain()
         t0 = self._clock()
         while self._clock() - t0 < deadline_s:
-            if all(self._replica_idle(rep) for rep in self.replicas):
+            if all(self._replica_idle(rep) for rep in list(self.replicas)):
                 return True
             self._sleep(poll_s)
-        return all(self._replica_idle(rep) for rep in self.replicas)
+        return all(self._replica_idle(rep) for rep in list(self.replicas))
 
     # ------------------------------------------------------- engine surface
+    # (aggregates snapshot the fleet list: add_replica/remove_replica mutate
+    # it under the router lock while these read from scrape/HTTP threads)
     @property
     def num_active(self) -> int:
-        return sum(rep.engine.num_active for rep in self.replicas)
+        return sum(rep.engine.num_active for rep in list(self.replicas))
 
     @property
     def steps(self) -> int:
-        return sum(rep.engine.steps for rep in self.replicas)
+        return sum(rep.engine.steps for rep in list(self.replicas))
 
     @property
     def reclaimed_slots(self) -> int:
-        return sum(rep.engine.reclaimed_slots for rep in self.replicas)
+        return sum(rep.engine.reclaimed_slots for rep in list(self.replicas))
 
     @property
     def cancelled_slots(self) -> int:
-        return sum(rep.engine.cancelled_slots for rep in self.replicas)
+        return sum(rep.engine.cancelled_slots for rep in list(self.replicas))
 
     def queued_depth(self) -> int:
-        return sum(rep.engine.queued_depth() for rep in self.replicas)
+        return sum(rep.engine.queued_depth() for rep in list(self.replicas))
 
     def idle(self) -> bool:
-        return all(rep.engine.idle() for rep in self.replicas)
+        return all(rep.engine.idle() for rep in list(self.replicas))
 
     def holds_prefix(self, prompt_ids: Sequence[int], prefix_len: int) -> bool:
         return any(
-            rep.engine.holds_prefix(prompt_ids, prefix_len) for rep in self.replicas
+            rep.engine.holds_prefix(prompt_ids, prefix_len)
+            for rep in list(self.replicas)
         )
 
     def start(self) -> "EngineRouter":
-        for rep in self.replicas:
+        for rep in list(self.replicas):
             rep.engine.start()
         return self
 
     def stop(self, drain_timeout_s: float = 120.0) -> None:
-        for rep in self.replicas:
+        for rep in list(self.replicas):
             rep.engine.stop(drain_timeout_s=drain_timeout_s)
 
     # --------------------------------------------------------------- stats
@@ -678,8 +868,9 @@ class EngineRouter:
         a replica death."""
         with self._lock:
             hits, misses = self.affinity_hits, self.affinity_misses
+            reps = list(self.replicas)
             out = {
-                "n_replicas": len(self.replicas),
+                "n_replicas": len(reps),
                 "affinity_hits": hits,
                 "affinity_misses": misses,
                 "affinity_hit_rate": round(hits / max(1, hits + misses), 4),
@@ -689,6 +880,9 @@ class EngineRouter:
                 "drains": self.drains,
                 "drain_shed": self.drain_shed,
                 "no_replica_available": self.no_replica_available,
+                "replicas_added": self.replicas_added,
+                "replicas_removed": self.replicas_removed,
+                "replica_restarts": self.replica_restarts,
             }
         out["replicas"] = [
             {
@@ -701,7 +895,7 @@ class EngineRouter:
                 "dispatched": rep.dispatched,
                 "completed_ok": rep.completed_ok,
             }
-            for rep in self.replicas
+            for rep in reps
         ]
         return out
 
@@ -711,7 +905,7 @@ class EngineRouter:
         per-replica percentiles)."""
         ttft: List[float] = []
         itl: List[float] = []
-        for rep in self.replicas:
+        for rep in list(self.replicas):
             ttft.extend(rep.engine._ttft_s)
             itl.extend(rep.engine._itl_s)
         p = GenerationEngine._pctl_ms
@@ -729,7 +923,7 @@ class EngineRouter:
         """Aggregated KV gauges + the per-replica blocks (each carries its
         own kv_layout_requested/effective so one replica silently on the
         legacy plane is visible)."""
-        per = [rep.engine.kv_stats() for rep in self.replicas]
+        per = [rep.engine.kv_stats() for rep in list(self.replicas)]
         layouts = {p["kv_layout_effective"] for p in per}
         out: dict = {
             "kv_layout": per[0]["kv_layout"] if len(layouts) == 1 else "mixed",
@@ -742,6 +936,10 @@ class EngineRouter:
         if all("kv_pages_total" in p for p in per):
             for key in ("kv_pages_total", "kv_pages_used", "kv_pages_free"):
                 out[key] = sum(p[key] for p in per)
+            if all("kv_pages_obtainable" in p for p in per):
+                out["kv_pages_obtainable"] = sum(
+                    p["kv_pages_obtainable"] for p in per
+                )
         return out
 
     def supervision_stats(self) -> dict:
@@ -749,7 +947,7 @@ class EngineRouter:
         dead replica of N is exactly what an operator must see as degraded),
         with the per-replica blocks attached for /healthz."""
         per = []
-        for rep in self.replicas:
+        for rep in list(self.replicas):
             s = rep.engine.supervision_stats()
             s["name"] = rep.name
             s["breaker"] = rep.breaker.state
@@ -779,7 +977,7 @@ class EngineRouter:
             "router": self.router_stats(),
             "kv": self.kv_stats(),
             "supervision": self.supervision_stats(),
-            "replicas": [rep.engine.tick_stats() for rep in self.replicas],
+            "replicas": [rep.engine.tick_stats() for rep in list(self.replicas)],
         }
         out.update(self.latency_stats())
         return out

@@ -83,6 +83,19 @@ class SchedulerConfig:
     # estimated-wait admission ceiling: shed when the queue's estimated wait
     # exceeds this (None disables the test; the depth bound still applies)
     admit_max_wait_s: Optional[float] = 60.0
+    # predictive admission (docs/AUTOSCALING.md): when the engine's queue-wait
+    # histogram (obs plane) holds at least admit_hist_min_samples RECENT
+    # observations, the estimated wait is the MAX of the depth*EMA/slots model
+    # and this quantile of *realized* queue waits — the empirical tail the
+    # point EMA cannot see (service-time variance, multi-slot effects).  The
+    # histogram is bound via bind_wait_hist(); cold histograms fall back to
+    # the EMA model alone.  The quantile is computed over a two-window
+    # rotation of the histogram's counts (rotated every admit_hist_window
+    # samples), NOT its process lifetime — an overload hours ago must not
+    # inflate predictions (and 429 Retry-After hints) at today's light load.
+    admit_wait_quantile: float = 0.95
+    admit_hist_min_samples: int = 32
+    admit_hist_window: int = 2048
     # deadline applied when the client sends none (None = no deadline)
     default_deadline_s: Optional[float] = None
     # graceful degradation band: past this fraction of max_queue, clamp
@@ -144,6 +157,20 @@ class RequestScheduler:
         self._kv_total = 0
         self._queued_kv_pages = 0
         self._spec_gauge_fn = None  # engine's spec_disabled gauge (bind_spec)
+        # queue-wait histogram (obs plane) for predictive admission; None
+        # keeps the pure EMA model (bind_wait_hist).  The windowing state
+        # (last rotation's raw-count mark + the completed previous window)
+        # has its own lock: _hist_wait_q runs OUTSIDE the scheduler lock and
+        # must still rotate atomically across admitting threads.
+        self._wait_hist = None
+        self._hist_lock = threading.Lock()
+        self._hist_mark: Optional[list] = None
+        self._hist_prev: Optional[list] = None
+        # autoscaler degradation override (set_degrade): when set, the
+        # degradation band is forced on regardless of queue pressure — the
+        # clamp applies at admission and degraded() reports True, which also
+        # makes the engine skip speculative verify forwards
+        self._degrade_forced: Optional[int] = None
         self._service_ema_s = float(self.cfg.service_time_init)
         # per-class counters (created lazily so new classes just appear)
         self.submitted: Dict[str, int] = collections.defaultdict(int)
@@ -172,6 +199,66 @@ class RequestScheduler:
         self._spec_gauge_fn = gauge_fn
         return self
 
+    def bind_wait_hist(self, hist) -> "RequestScheduler":
+        """Wire the obs plane's queue-wait histogram into admission: once it
+        holds ``admit_hist_min_samples``, the estimated wait (and therefore
+        the shed test and the 429 ``Retry-After`` hint) is floored by the
+        ``admit_wait_quantile`` of *realized* waits instead of trusting the
+        point service-time EMA alone.  ``hist`` needs ``.count`` and
+        ``.quantile(q)`` (serving/obs.py :class:`~.obs.Histogram`)."""
+        self._wait_hist = hist
+        return self
+
+    def set_degrade(self, clamp_max_tokens: Optional[int]) -> None:
+        """Force the degradation band on (``clamp_max_tokens``) or release it
+        (``None``) — the autoscaler's load-shaping actuator.  While forced,
+        :meth:`try_admit` clamps ``max_tokens`` and :meth:`degraded` reports
+        True (which also makes the engine skip speculative verify forwards),
+        independent of the queue-pressure band."""
+        with self._lock:
+            self._degrade_forced = (
+                None if clamp_max_tokens is None else max(1, int(clamp_max_tokens))
+            )
+
+    def _hist_wait_q(self) -> Optional[float]:
+        """The WINDOWED wait quantile, or None (cold / unbound).
+
+        Quantiles the previous + current window of the histogram's raw
+        counts (two-window rotation every ``admit_hist_window`` samples), so
+        the prediction tracks recent traffic instead of the histogram's
+        process lifetime.  Called OUTSIDE self._lock: the histogram does its
+        own locking, the rotation state its own — no lock is ever nested."""
+        from .obs import quantile_from_counts
+
+        h = self._wait_hist
+        if h is None:
+            return None
+        cfg = self.cfg
+        with self._hist_lock:
+            # the snapshot read happens INSIDE the rotation lock: two
+            # admitting threads interleaving "read snapshot / rotate mark"
+            # would otherwise diff against a NEWER mark and produce negative
+            # window counts (a garbage ~30s quantile).  Lock order is
+            # _hist_lock -> Histogram._lock only; nothing acquires them the
+            # other way.
+            counts, _n = h.raw_counts()
+            if self._hist_mark is None:
+                self._hist_mark = [0] * len(counts)
+            cur = [c - m for c, m in zip(counts, self._hist_mark)]
+            eff = (
+                cur
+                if self._hist_prev is None
+                else [a + b for a, b in zip(cur, self._hist_prev)]
+            )
+            if sum(cur) >= cfg.admit_hist_window:
+                # rotate: the current window becomes "previous", so there is
+                # always up to 2x window of recent history behind the estimate
+                self._hist_prev = cur
+                self._hist_mark = counts
+        if sum(eff) < cfg.admit_hist_min_samples:
+            return None
+        return float(quantile_from_counts(h.bounds, eff, cfg.admit_wait_quantile))
+
     def bind_kv(self, available_fn, total_pages: int) -> "RequestScheduler":
         """Wire the paged-KV pool into admission: ``available_fn`` reports
         obtainable pages (free + evictable cached prefixes), ``total_pages``
@@ -190,8 +277,18 @@ class RequestScheduler:
         with self._lock:
             self._queued_kv_pages = max(0, self._queued_kv_pages - max(0, pages))
 
-    def _est_wait_s_locked(self, extra: int = 0) -> float:
-        return (self._depth + extra) * self._service_ema_s / self._slots
+    def _est_wait_s_locked(self, extra: int = 0, hist_q: Optional[float] = None) -> float:
+        """Predicted time until a newly queued request could START.
+
+        The depth*EMA/slots model is the rising-load term (a deepening queue
+        pushes the prediction up immediately); ``hist_q`` — the warm
+        queue-wait histogram quantile, computed by the caller outside the
+        lock — floors it with the measured tail of realized waits, which the
+        point EMA systematically underestimates under service-time variance."""
+        model = (self._depth + extra) * self._service_ema_s / self._slots
+        if hist_q is not None and self._depth + extra > 0:
+            return max(model, hist_q)
+        return model
 
     def try_admit(
         self,
@@ -206,13 +303,18 @@ class RequestScheduler:
         reservation — are charged here so a racing burst cannot overshoot
         either bound)."""
         cfg = self.cfg
+        # the warm histogram quantile reads outside self._lock (its own lock)
+        hist_q = self._hist_wait_q()
         with self._lock:
             self.submitted[priority] += 1
             # time until this request could START (everything ahead of it over
             # the engine's slots) — its own service time is the client's
-            # business, the deadline test below only covers the queue wait
-            est = self._est_wait_s_locked()
-            retry = min(30.0, max(0.2, est / 2.0))
+            # business, the deadline test below only covers the queue wait.
+            # The Retry-After hint IS that prediction (clamped): a client that
+            # backs off exactly this long lands when a slot is expected free,
+            # instead of the old est/2 guess (docs/AUTOSCALING.md).
+            est = self._est_wait_s_locked(hist_q=hist_q)
+            retry = min(30.0, max(0.2, est))
             if self._depth >= cfg.max_queue:
                 self.shed["queue_full"] += 1
                 return Admission(False, "queue_full", retry)
@@ -257,13 +359,23 @@ class RequestScheduler:
                 and self._depth >= cfg.degrade_at * cfg.max_queue
             ):
                 clamp = int(cfg.degrade_max_tokens)
+            if self._degrade_forced is not None:
+                # autoscaler override: the tighter clamp wins
+                clamp = (
+                    self._degrade_forced
+                    if clamp is None
+                    else min(clamp, self._degrade_forced)
+                )
             return Admission(True, clamp_max_tokens=clamp)
 
     def degraded(self) -> bool:
-        """Queue pressure is in the degradation band: the engine should skip
+        """The degradation band is active — queue pressure past ``degrade_at``
+        or the autoscaler's forced override — so the engine should skip
         speculative decoding (wasted verify forwards under load)."""
         cfg = self.cfg
         with self._lock:
+            if self._degrade_forced is not None:
+                return True
             return cfg.degrade_at < 1.0 and (
                 self._depth >= cfg.degrade_at * cfg.max_queue
             )
@@ -485,8 +597,9 @@ class RequestScheduler:
             return self._depth / max(1, self.cfg.max_queue)
 
     def est_wait_s(self) -> float:
+        hist_q = self._hist_wait_q()
         with self._lock:
-            return self._est_wait_s_locked()
+            return self._est_wait_s_locked(hist_q=hist_q)
 
     @staticmethod
     def _pctl(sorted_vals, frac: float) -> float:
@@ -514,16 +627,23 @@ class RequestScheduler:
         # the engine-side gauge runs OUTSIDE the lock: it reads engine state
         # (controller verdict, degradation band) and must not nest locks
         spec = self._spec_gauge_fn() if self._spec_gauge_fn is not None else None
+        hist_q = self._hist_wait_q()
         with self._lock:
             return {
                 "queue_depth": self._depth,
                 "queued_kv_pages": self._queued_kv_pages,
                 "max_queue": self.cfg.max_queue,
                 "pressure": round(self._depth / max(1, self.cfg.max_queue), 4),
-                "est_wait_s": round(self._est_wait_s_locked(), 4),
+                "est_wait_s": round(self._est_wait_s_locked(hist_q=hist_q), 4),
+                "est_wait_source": "histogram" if hist_q is not None else "ema",
+                "wait_hist_q_s": round(hist_q, 4) if hist_q is not None else None,
                 "service_ema_s": round(self._service_ema_s, 4),
-                "degraded": self.cfg.degrade_at < 1.0
-                and self._depth >= self.cfg.degrade_at * self.cfg.max_queue,
+                "degraded": self._degrade_forced is not None
+                or (
+                    self.cfg.degrade_at < 1.0
+                    and self._depth >= self.cfg.degrade_at * self.cfg.max_queue
+                ),
+                "degrade_forced": self._degrade_forced is not None,
                 "submitted": dict(self.submitted),
                 "admitted": dict(self.admitted),
                 "shed": dict(self.shed),

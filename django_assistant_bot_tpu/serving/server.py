@@ -429,8 +429,14 @@ def create_app(
             router = getattr(eng, "router_stats", None)
             if callable(router):
                 # multi-replica fleet gauges: per-replica depth/breaker,
-                # affinity hit rate, re-routes, drains (serving/router.py)
+                # affinity hit rate, re-routes, drains, scale events
+                # (serving/router.py)
                 g["router"] = router()
+            asc = getattr(registry, "autoscalers", {}).get(name)
+            if asc is not None:
+                # SLO autoscaler: current band/decision, fleet bounds, scale
+                # and degradation counters (serving/autoscaler.py)
+                g["autoscaler"] = asc.stats()
             sup = getattr(eng, "supervision_stats", None)
             if callable(sup):
                 # restart/quarantine/circuit counters + loop_heartbeat_age_s

@@ -1,0 +1,19 @@
+"""Production-shaped workload generation (docs/AUTOSCALING.md §Workload).
+
+Seeded, deterministic arrival traces — diurnal ramps, bursts, multi-tenant
+hot spots, chat vs long-context mixtures — with JSONL serialization and
+clock-injectable replay.  The scenario engine behind the ``autoscale_*``
+bench A/B and the chaos harness's traffic shapes.
+"""
+
+from .generator import (  # noqa: F401
+    PRIORITIES,
+    SHAPES,
+    WorkloadConfig,
+    WorkloadGenerator,
+    WorkloadRequest,
+    load_trace,
+    prompt_ids_for,
+    replay,
+    save_trace,
+)

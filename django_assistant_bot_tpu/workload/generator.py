@@ -1,0 +1,299 @@
+"""Deterministic, seeded workload generation for the serving plane.
+
+The bench and chaos suites have so far driven the fleet with hand-rolled
+bursts of identical prompts — nothing shaped like the traffic "millions of
+users" actually send.  This module is the missing scenario engine: a seeded
+generator producing arrival traces with the production shapes named in
+ROADMAP item 6 —
+
+- **diurnal ramps** (a raised-cosine day: trough -> peak -> trough),
+- **linear ramps** (capacity-walk load tests),
+- **bursty arrivals** (a base rate with periodic burst windows),
+- **multi-tenant hot spots** (one tenant takes ``hot_tenant_frac`` of all
+  traffic; the rest spread uniformly),
+- **long-context vs chat mixtures** (two token-length regimes with separate
+  prompt/output distributions),
+- plus a background-class fraction riding on every shape.
+
+Arrivals are a non-homogeneous Poisson process drawn by Lewis thinning: the
+generator steps exponential inter-arrival candidates at the envelope's peak
+rate and accepts each with ``rate(t)/peak`` — every draw comes from one
+``random.Random(seed)``, so the same seed yields the *identical* trace
+(asserted in tests/test_workload.py), across processes and platforms.
+
+Traces serialize to JSONL (one request per line, stable key order) and replay
+against any submit callable under an injectable clock/sleep — the bench's
+``autoscale_*`` A/B and the chaos harness both feed from here, so an
+autoscaler claim is always made against a reproducible trace, never against
+"some load we generated that day".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import random
+import time
+from typing import Callable, Iterable, List, Optional, Sequence
+
+SHAPES = ("constant", "diurnal", "ramp", "burst")
+PRIORITIES = ("interactive", "background")
+
+
+@dataclasses.dataclass
+class WorkloadRequest:
+    """One arrival in a trace.  ``t_s`` is seconds from trace start; the
+    token fields are *shapes* (counts), not content — prompt content is
+    synthesized deterministically from ``seed`` at submit time
+    (:func:`prompt_ids_for`), so a JSONL trace stays compact."""
+
+    t_s: float
+    tenant: str = "default"
+    priority: str = "interactive"
+    kind: str = "chat"  # "chat" | "longctx"
+    prompt_tokens: int = 32
+    max_tokens: int = 16
+    prefix_len: int = 0
+    seed: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "t_s": round(self.t_s, 6),
+            "tenant": self.tenant,
+            "priority": self.priority,
+            "kind": self.kind,
+            "prompt_tokens": self.prompt_tokens,
+            "max_tokens": self.max_tokens,
+            "prefix_len": self.prefix_len,
+            "seed": self.seed,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WorkloadRequest":
+        return cls(
+            t_s=float(d["t_s"]),
+            tenant=str(d.get("tenant", "default")),
+            priority=str(d.get("priority", "interactive")),
+            kind=str(d.get("kind", "chat")),
+            prompt_tokens=int(d.get("prompt_tokens", 32)),
+            max_tokens=int(d.get("max_tokens", 16)),
+            prefix_len=int(d.get("prefix_len", 0)),
+            seed=int(d.get("seed", 0)),
+        )
+
+
+@dataclasses.dataclass
+class WorkloadConfig:
+    seed: int = 0
+    duration_s: float = 60.0
+    # the arrival-rate envelope (requests/s)
+    base_rps: float = 2.0
+    shape: str = "diurnal"
+    # diurnal: one raised-cosine period — rate(t) spans
+    # [base*min_frac, base], trough at t=0 and t=period, peak at period/2
+    diurnal_period_s: float = 60.0
+    diurnal_min_frac: float = 0.2
+    # ramp: linear base_rps -> ramp_to_rps over the duration
+    ramp_to_rps: float = 8.0
+    # burst: base_rps everywhere, plus burst_rps inside every
+    # [k*burst_every_s, k*burst_every_s + burst_len_s) window
+    burst_every_s: float = 20.0
+    burst_len_s: float = 2.0
+    burst_rps: float = 10.0
+    # ---- request mixture ----------------------------------------------------
+    tenants: int = 4  # tenant0..tenantN-1
+    hot_tenant_frac: float = 0.5  # fraction of ALL traffic tenant0 takes
+    background_frac: float = 0.1  # priority="background" fraction
+    longctx_frac: float = 0.1  # "longctx" kind fraction (rest is "chat")
+    # token-count ranges [lo, hi] drawn uniformly per kind
+    chat_prompt_tokens: Sequence[int] = (8, 48)
+    chat_max_tokens: Sequence[int] = (4, 24)
+    longctx_prompt_tokens: Sequence[int] = (96, 192)
+    longctx_max_tokens: Sequence[int] = (8, 32)
+    # fraction of chat requests carrying a shared cacheable prefix of
+    # prefix_tokens (the system-prompt/RAG-block shape prefix affinity eats)
+    prefix_frac: float = 0.5
+    prefix_tokens: int = 16
+
+    def validate(self) -> "WorkloadConfig":
+        if self.shape not in SHAPES:
+            raise ValueError(f"unknown shape {self.shape!r}; expected {SHAPES}")
+        if self.duration_s <= 0 or self.base_rps < 0:
+            raise ValueError("duration_s must be > 0 and base_rps >= 0")
+        if self.tenants < 1:
+            raise ValueError("tenants must be >= 1")
+        for frac_name in ("hot_tenant_frac", "background_frac", "longctx_frac", "prefix_frac"):
+            v = getattr(self, frac_name)
+            if not (0.0 <= v <= 1.0):
+                raise ValueError(f"{frac_name} must be within [0, 1]")
+        return self
+
+
+class WorkloadGenerator:
+    """Seeded trace generator over a :class:`WorkloadConfig`."""
+
+    def __init__(self, cfg: WorkloadConfig):
+        self.cfg = cfg.validate()
+
+    # ------------------------------------------------------------- envelope
+    def rate_at(self, t: float) -> float:
+        """The arrival-rate envelope (requests/s) at trace time ``t``."""
+        c = self.cfg
+        if c.shape == "constant":
+            return c.base_rps
+        if c.shape == "diurnal":
+            # raised cosine: trough at t=0, peak at period/2
+            phase = 0.5 * (1.0 - math.cos(2.0 * math.pi * t / c.diurnal_period_s))
+            return c.base_rps * (c.diurnal_min_frac + (1.0 - c.diurnal_min_frac) * phase)
+        if c.shape == "ramp":
+            frac = min(1.0, max(0.0, t / c.duration_s))
+            return c.base_rps + (c.ramp_to_rps - c.base_rps) * frac
+        # burst
+        in_burst = (t % c.burst_every_s) < c.burst_len_s
+        return c.base_rps + (c.burst_rps if in_burst else 0.0)
+
+    def peak_rate(self) -> float:
+        c = self.cfg
+        if c.shape == "constant":
+            return c.base_rps
+        if c.shape == "diurnal":
+            return c.base_rps
+        if c.shape == "ramp":
+            return max(c.base_rps, c.ramp_to_rps)
+        return c.base_rps + c.burst_rps
+
+    # ------------------------------------------------------------- the trace
+    def generate(self) -> List[WorkloadRequest]:
+        """The full trace, deterministically from ``cfg.seed`` (same seed →
+        byte-identical trace; str-seeded Random hashes via sha512, stable
+        across processes)."""
+        c = self.cfg
+        rng = random.Random(f"workload:{c.seed}")
+        peak = self.peak_rate()
+        out: List[WorkloadRequest] = []
+        if peak <= 0:
+            return out
+        t = 0.0
+        while True:
+            # Lewis thinning: candidate arrivals at the peak rate, accepted
+            # with rate(t)/peak — a non-homogeneous Poisson process
+            t += rng.expovariate(peak)
+            if t >= c.duration_s:
+                return out
+            if rng.random() >= self.rate_at(t) / peak:
+                continue
+            # tenant hot spot: tenant0 takes hot_tenant_frac of everything
+            if c.tenants == 1 or rng.random() < c.hot_tenant_frac:
+                tenant = "tenant0"
+            else:
+                tenant = f"tenant{rng.randrange(1, c.tenants)}"
+            priority = (
+                "background" if rng.random() < c.background_frac else "interactive"
+            )
+            longctx = rng.random() < c.longctx_frac
+            if longctx:
+                kind = "longctx"
+                prompt_tokens = rng.randint(*_pair(c.longctx_prompt_tokens))
+                max_tokens = rng.randint(*_pair(c.longctx_max_tokens))
+                prefix_len = 0
+            else:
+                kind = "chat"
+                prompt_tokens = rng.randint(*_pair(c.chat_prompt_tokens))
+                max_tokens = rng.randint(*_pair(c.chat_max_tokens))
+                prefix_len = (
+                    min(c.prefix_tokens, prompt_tokens - 1)
+                    if rng.random() < c.prefix_frac
+                    else 0
+                )
+            out.append(
+                WorkloadRequest(
+                    # rounded HERE so a generated trace and its JSONL
+                    # round-trip compare equal (to_dict emits 6 decimals)
+                    t_s=round(t, 6),
+                    tenant=tenant,
+                    priority=priority,
+                    kind=kind,
+                    prompt_tokens=prompt_tokens,
+                    max_tokens=max_tokens,
+                    prefix_len=max(0, prefix_len),
+                    seed=rng.randrange(1 << 31),
+                )
+            )
+
+
+def _pair(r: Sequence[int]):
+    lo, hi = int(r[0]), int(r[1])
+    if lo > hi:
+        raise ValueError(f"token range {r!r} has lo > hi")
+    return lo, hi
+
+
+def prompt_ids_for(req: WorkloadRequest, *, vocab: int = 255) -> List[int]:
+    """Deterministic token ids for a trace request: requests sharing a
+    ``prefix_len`` share the SAME leading tokens (so prefix caching and
+    affinity see real reuse), the body is drawn from the request's own seed.
+    Ids stay within [1, vocab] — safe for the byte tokenizer."""
+    prefix = [1 + (i % vocab) for i in range(req.prefix_len)]
+    body_rng = random.Random(f"prompt:{req.seed}")
+    body = [
+        body_rng.randint(1, vocab)
+        for _ in range(max(1, req.prompt_tokens - req.prefix_len))
+    ]
+    return prefix + body
+
+
+# ----------------------------------------------------------------- JSONL I/O
+def save_trace(events: Iterable[WorkloadRequest], path: str) -> int:
+    """One JSON object per line, stable key order; returns the line count."""
+    n = 0
+    with open(path, "w", encoding="utf-8") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev.to_dict(), sort_keys=True) + "\n")
+            n += 1
+    return n
+
+
+def load_trace(path: str) -> List[WorkloadRequest]:
+    out: List[WorkloadRequest] = []
+    with open(path, encoding="utf-8") as fh:
+        for line_no, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(WorkloadRequest.from_dict(json.loads(line)))
+            except (ValueError, KeyError) as e:
+                raise ValueError(f"{path}:{line_no}: bad trace line: {e}") from e
+    return out
+
+
+# ------------------------------------------------------------------- replay
+def replay(
+    events: Sequence[WorkloadRequest],
+    submit: Callable[[WorkloadRequest], object],
+    *,
+    speed: float = 1.0,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+    stop: Optional[Callable[[], bool]] = None,
+) -> List[object]:
+    """Drive ``submit(req)`` at the trace's arrival times (divided by
+    ``speed``); returns whatever each submit returned, in trace order.
+    Exceptions from submit are CAUGHT and returned in-place — a shed (429)
+    is a data point for the A/B, not a reason to abort the trace.  The
+    injectable clock/sleep make replay exact under fake time."""
+    t0 = clock()
+    results: List[object] = []
+    for ev in sorted(events, key=lambda e: e.t_s):
+        if stop is not None and stop():
+            break
+        due = t0 + ev.t_s / max(1e-9, speed)
+        delay = due - clock()
+        if delay > 0:
+            sleep(delay)
+        try:
+            results.append(submit(ev))
+        except Exception as e:  # sheds/unavailable are trace outcomes
+            results.append(e)
+    return results

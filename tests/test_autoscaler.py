@@ -1,0 +1,592 @@
+"""SLO autoscaler (serving/autoscaler.py; docs/AUTOSCALING.md).
+
+Two layers, matching the chaos-suite discipline:
+
+- a DETERMINISTIC fake-clock decision suite over a stub fleet — scale-up on
+  SLO burn, trough scale-down, hysteresis/cooldown no-flap under an
+  oscillating trace, degradation engage/release, min/max bounds — with zero
+  sleeps and zero devices;
+- real-engine integration: the router's dynamic-fleet surface
+  (add_replica/remove_replica) under live traffic, and THE acceptance race —
+  a scale-down drain racing ``replica_dead`` on the same replica: goodput
+  1.0, no wedged drain, and a flight-recorder artifact carrying both the
+  kill and the scale decision.  Runs under DABT_LOCK_WITNESS in CI.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+import jax
+
+from django_assistant_bot_tpu.models import DecoderConfig, llama
+from django_assistant_bot_tpu.serving import (
+    AutoscalerConfig,
+    ByteTokenizer,
+    EngineRouter,
+    FaultInjector,
+    GenerationEngine,
+    ModelRegistry,
+    SLOAutoscaler,
+    render_prometheus,
+    parse_prometheus_text,
+)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+    def sleep(self, dt: float) -> None:
+        self.t += dt
+        time.sleep(min(dt, 0.005))
+
+
+# ---------------------------------------------------------------- stub fleet
+class _StubSched:
+    def __init__(self):
+        self.shed_total = 0
+        self.est_wait_s = 0.0
+        self.degrade_clamp = None
+        self.degrade_calls = []
+
+    def stats(self):
+        return {"shed": {"queue_full": self.shed_total},
+                "est_wait_s": self.est_wait_s}
+
+    def set_degrade(self, clamp):
+        self.degrade_clamp = clamp
+        self.degrade_calls.append(clamp)
+
+
+class _StubEngine:
+    def __init__(self):
+        self.scheduler = _StubSched()
+        self.max_slots = 4
+        self.queued = 0
+        self.active = 0
+
+    def queued_depth(self):
+        return self.queued
+
+    @property
+    def num_active(self):
+        return self.active
+
+
+class _StubRep:
+    def __init__(self):
+        self.engine = _StubEngine()
+        self.draining = False
+
+
+class _StubFleet:
+    """The exact read/actuate surface the controller touches, nothing more."""
+
+    def __init__(self, n=1):
+        self.replicas = [_StubRep() for _ in range(n)]
+        self.ttft_p95_s = 0.0
+        self.kv_used = 0
+        self.kv_total = 100
+        self.added = 0
+        self.removed = 0
+        self.fail_add = False
+
+    def latency_stats(self):
+        return {"ttft_p95_ms": self.ttft_p95_s * 1e3, "ttft_n": 64}
+
+    def kv_stats(self):
+        return {"kv_pages_total": self.kv_total, "kv_pages_used": self.kv_used}
+
+    def add_replica(self):
+        if self.fail_add:
+            raise RuntimeError("spawn failed")
+        self.replicas.append(_StubRep())
+        self.added += 1
+        return f"stub/r{len(self.replicas) - 1}"
+
+    def remove_replica(self, idx, *, deadline_s=30.0):
+        rep = self.replicas.pop(idx)
+        self.removed += 1
+        return {"replica": "stub", "drained": True, "forced_failures": 0,
+                "died_mid_drain": False, "waited_s": 0.0}
+
+
+def _asc(fleet, clock, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("slo_ttft_p95_s", 1.0)
+    kw.setdefault("up_consecutive", 2)
+    kw.setdefault("down_consecutive", 3)
+    kw.setdefault("up_cooldown_s", 5.0)
+    kw.setdefault("down_cooldown_s", 10.0)
+    return SLOAutoscaler(fleet, AutoscalerConfig(**kw), clock=clock)
+
+
+def _ticks(asc, clock, n, dt=1.0):
+    out = []
+    for _ in range(n):
+        clock.advance(dt)
+        out.append(asc.tick())
+    return out
+
+
+# ------------------------------------------------------------ decision suite
+def test_scale_up_on_slo_burn_after_hysteresis_and_cooldown():
+    clock = _FakeClock()
+    fleet = _StubFleet(1)
+    asc = _asc(fleet, clock)
+    # burn 1.2: over the SLO (up_burn 1.0) but below degrade_burn, so the
+    # only actuator in play is the replica count.  Burn counts as evidence
+    # only with work in flight (a stale rolling window must not scale an
+    # idle fleet), so the stub carries one active request.
+    fleet.replicas[0].engine.active = 1
+    fleet.ttft_p95_s = 1.2
+    recs = _ticks(asc, clock, 2)
+    # tick 1 arms the band (hysteresis), tick 2 actuates
+    assert recs[0]["decision"] == "hold"
+    assert recs[1]["decision"] == "scale_up"
+    assert fleet.added == 1 and len(fleet.replicas) == 2
+    # still burning, but inside the up-cooldown: no second replica yet
+    recs = _ticks(asc, clock, 2)
+    assert all(r["decision"] == "hold" for r in recs)
+    # cooldown expires (5s): the next sustained burn adds the third
+    recs = _ticks(asc, clock, 3)
+    assert "scale_up" in [r["decision"] for r in recs]
+    assert len(fleet.replicas) == 3
+
+
+def test_scale_up_on_shed_rate_and_kv_pressure_signals():
+    clock = _FakeClock()
+    fleet = _StubFleet(1)
+    asc = _asc(fleet, clock)
+    # shed-rate path: a SUSTAINED 5 sheds/s (the signal is the counter's
+    # per-tick delta, so the sheds must keep landing across the hysteresis
+    # window, not just once)
+    _ticks(asc, clock, 1)
+    fleet.replicas[0].engine.scheduler.shed_total = 5
+    _ticks(asc, clock, 1)
+    fleet.replicas[0].engine.scheduler.shed_total = 10
+    recs = _ticks(asc, clock, 1)
+    assert recs[-1]["decision"] == "scale_up"
+    # kv-pressure path on a fresh controller
+    clock2, fleet2 = _FakeClock(), _StubFleet(1)
+    asc2 = _asc(fleet2, clock2)
+    fleet2.kv_used = 95  # 0.95 >= up_kv_frac 0.9
+    recs = _ticks(asc2, clock2, 2)
+    assert recs[-1]["decision"] == "scale_up"
+
+
+def test_scale_down_at_trough_requires_consecutive_calm_ticks():
+    clock = _FakeClock()
+    fleet = _StubFleet(3)
+    asc = _asc(fleet, clock)
+    # all signals calm, a smaller fleet trivially holds the (zero) load
+    recs = _ticks(asc, clock, 3)
+    assert [r["decision"] for r in recs] == ["hold", "hold", "scale_down"]
+    assert fleet.removed == 1 and len(fleet.replicas) == 2
+    # down-cooldown (10s) holds the second removal off
+    recs = _ticks(asc, clock, 3)
+    assert all(r["decision"] == "hold" for r in recs)
+    recs = _ticks(asc, clock, 8)
+    assert "scale_down" in [r["decision"] for r in recs]
+    assert len(fleet.replicas) == 1  # and never below min_replicas
+    recs = _ticks(asc, clock, 20)
+    assert fleet.removed == 2 and len(fleet.replicas) == 1
+
+
+def test_no_flap_under_oscillating_trace():
+    """A trace that alternates hot/calm every tick must produce ZERO scale
+    actions: the consecutive-tick bands reset on every flip (the classic
+    flapping controller this config exists to rule out)."""
+    clock = _FakeClock()
+    fleet = _StubFleet(2)
+    fleet.replicas[0].engine.active = 1  # burn needs in-flight work to count
+    asc = _asc(fleet, clock)
+    for i in range(20):
+        fleet.ttft_p95_s = 2.0 if i % 2 == 0 else 0.1
+        clock.advance(1.0)
+        rec = asc.tick()
+        assert rec["decision"] == "hold", (i, rec)
+    assert fleet.added == 0 and fleet.removed == 0
+    assert len(fleet.replicas) == 2
+
+
+def test_scale_down_blocked_when_smaller_fleet_would_not_hold():
+    """Calm latency but real load: (queued+active)/(slots of n-1 replicas)
+    above down_util blocks the trough band — scaling down into a fleet that
+    would immediately re-trigger scale-up is the flap we refuse."""
+    clock = _FakeClock()
+    fleet = _StubFleet(2)
+    for rep in fleet.replicas:
+        rep.engine.active = 3  # 6 active over 4 remaining slots >> down_util
+    asc = _asc(fleet, clock)
+    recs = _ticks(asc, clock, 6)
+    assert all(r["decision"] == "hold" for r in recs)
+    assert fleet.removed == 0
+
+
+def test_degradation_band_engages_at_max_fleet_and_releases_with_hysteresis():
+    clock = _FakeClock()
+    fleet = _StubFleet(3)  # already at max_replicas
+    fleet.replicas[0].engine.active = 1  # burn needs in-flight work to count
+    asc = _asc(fleet, clock)
+    fleet.ttft_p95_s = 2.0  # burn 2.0 >= degrade_burn 1.5
+    recs = _ticks(asc, clock, 2)
+    assert recs[-1]["decision"] == "degrade_on"
+    assert asc.degrade_active
+    # every replica's scheduler got the clamp (spec disable rides degraded())
+    for rep in fleet.replicas:
+        assert rep.engine.scheduler.degrade_clamp == asc.cfg.degrade_max_tokens
+    # burn above the release threshold: the band HOLDS (hysteresis)
+    fleet.ttft_p95_s = 1.0  # release needs <= 0.75
+    recs = _ticks(asc, clock, 3)
+    assert asc.degrade_active
+    # burn below release: the band releases and the clamps lift
+    fleet.ttft_p95_s = 0.2
+    recs = _ticks(asc, clock, 1)
+    assert recs[-1]["decision"] == "degrade_off"
+    assert not asc.degrade_active
+    for rep in fleet.replicas:
+        assert rep.engine.scheduler.degrade_clamp is None
+
+
+def test_degradation_precedes_nothing_below_max_fleet():
+    """Below the ceiling a replica is the better actuator: sustained burn
+    scales up first; degradation engages only once the fleet is maxed."""
+    clock = _FakeClock()
+    fleet = _StubFleet(2)
+    fleet.replicas[0].engine.active = 1  # burn needs in-flight work to count
+    asc = _asc(fleet, clock, up_cooldown_s=0.5)
+    fleet.ttft_p95_s = 2.0
+    decisions = [r["decision"] for r in _ticks(asc, clock, 6)]
+    assert decisions.count("scale_up") == 1  # 2 -> 3 (max)
+    assert "degrade_on" in decisions  # then shaping, at the ceiling
+    assert fleet.added == 1
+
+
+def test_scale_up_failure_counts_and_does_not_kill_the_loop():
+    clock = _FakeClock()
+    fleet = _StubFleet(1)
+    fleet.fail_add = True
+    fleet.replicas[0].engine.active = 1  # burn needs in-flight work to count
+    asc = _asc(fleet, clock)
+    fleet.ttft_p95_s = 3.0
+    recs = _ticks(asc, clock, 3)
+    assert "scale_up_failed" in [r["decision"] for r in recs]
+    assert asc.stats()["scale_up_failures"] >= 1
+    # the factory recovers; the controller retries without a cooldown penalty
+    fleet.fail_add = False
+    recs = _ticks(asc, clock, 2)
+    assert "scale_up" in [r["decision"] for r in recs]
+
+
+def test_replica_seconds_integrates_fleet_size_over_time():
+    clock = _FakeClock()
+    fleet = _StubFleet(2)
+    asc = _asc(fleet, clock, down_consecutive=100)  # hold the fleet still
+    # the first tick anchors the window (dt=0); the next four each cover 2s
+    # at 2 replicas -> 16 replica-seconds
+    _ticks(asc, clock, 5, dt=2.0)
+    assert asc.replica_seconds == pytest.approx(16.0)
+    st = asc.stats()
+    assert st["replica_seconds"] == pytest.approx(16.0)
+    assert st["ticks"] == 5
+
+
+def test_autoscaler_config_validation():
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutoscalerConfig(min_replicas=3, max_replicas=1).validate()
+    with pytest.raises(ValueError, match="hysteresis"):
+        AutoscalerConfig(degrade_burn=1.0, degrade_release_burn=1.0).validate()
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoscalerConfig(min_replicas=0).validate()
+
+
+def test_decisions_land_in_the_flight_ring():
+    clock = _FakeClock()
+    fleet = _StubFleet(1)
+    fleet.replicas[0].engine.active = 1  # burn needs in-flight work to count
+    asc = _asc(fleet, clock)
+    fleet.ttft_p95_s = 2.0
+    _ticks(asc, clock, 2)
+    events = asc.flight.events()
+    assert any(e["event"] == "autoscale" and e["decision"] == "scale_up"
+               for e in events)
+    # hold ticks do NOT flood the ring
+    assert not any(e.get("decision") == "hold" for e in events)
+
+
+# ------------------------------------------------------- real-engine plane
+def _params(seed=1):
+    cfg = DecoderConfig.tiny()
+    return cfg, llama.init(cfg, jax.random.key(seed))
+
+
+def _fleet(n=2, **kw):
+    cfg, params = _params()
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_seq_len", 64)
+
+    def factory(i):
+        return GenerationEngine(
+            cfg, params, ByteTokenizer(), name=f"t/r{i}",
+            faults=FaultInjector({}), **kw
+        ).start()
+
+    engines = [factory(i) for i in range(n)]
+    return engines, factory
+
+
+def _stall(engine, delay_s=0.1, fires=16):
+    inj = engine._faults
+    inj.arm("slow_tick", fires)
+    with inj._lock:
+        inj._sites["slow_tick"].delay_s = delay_s
+
+
+def test_add_replica_serves_and_names_never_reuse():
+    engines, factory = _fleet(1)
+    r = EngineRouter(engines, replica_factory=factory)
+    try:
+        name1 = r.add_replica()
+        assert len(r.replicas) == 2 and r.replicas_added == 1
+        f = r.submit([1, 2, 3], max_tokens=3, temperature=0.0)
+        assert len(f.result(timeout=120).token_ids) == 3
+        r.remove_replica(1, deadline_s=30.0)
+        name2 = r.add_replica()
+        assert name2 != name1  # spawn indices are monotonic, names unique
+        assert r.router_stats()["replicas_added"] == 2
+        assert r.router_stats()["replicas_removed"] == 1
+    finally:
+        r.stop()
+
+
+def test_remove_replica_drains_cleanly_under_traffic_zero_shed():
+    engines, factory = _fleet(2)
+    r = EngineRouter(engines, replica_factory=factory)
+    try:
+        futs = [r.submit([1, 2, 3 + i], max_tokens=4, temperature=0.0)
+                for i in range(6)]
+        report = r.remove_replica(0, deadline_s=60.0)
+        assert report["drained"] is True
+        assert report["forced_failures"] == 0
+        assert not report["died_mid_drain"]
+        for f in futs:
+            assert len(f.result(timeout=120).token_ids) == 4  # goodput 1.0
+        assert r.drain_shed == 0
+        assert len(r.replicas) == 1
+        with pytest.raises(RuntimeError, match="last replica"):
+            r.remove_replica(0)
+    finally:
+        r.stop()
+
+
+def test_scale_down_drain_racing_replica_death(tmp_path, monkeypatch):
+    """THE acceptance race (ISSUE 11): a scale-down drain and ``replica_dead``
+    land on the SAME replica.  Contract: goodput 1.0 (every token-less victim
+    re-routes to the survivor), the drain completes instead of wedging on a
+    dead engine, and the flight-recorder artifact carries BOTH the kill and
+    the scale decision.  Runs under DABT_LOCK_WITNESS in the CI smoke."""
+    monkeypatch.setenv("DABT_FLIGHT_DIR", str(tmp_path))
+    engines, factory = _fleet(2)
+    r = EngineRouter(engines, replica_factory=factory, breaker_reset_s=0.2)
+    try:
+        for i in range(2):  # warm both replicas (compiles out of the way)
+            r.submit([1, 2, 3 + i], max_tokens=2, temperature=0.0).result(
+                timeout=120
+            )
+        # pin a batch of work onto replica0, stalled so it stays token-less
+        r.replicas[1].draining = True
+        _stall(engines[0], delay_s=0.2, fires=32)
+        futs = [r.submit([5, 6, 7 + i], max_tokens=4, temperature=0.0)
+                for i in range(4)]
+        r.replicas[1].draining = False
+        # scale-down drain on replica0 (blocked behind the stalled work)...
+        reports = []
+        t = threading.Thread(
+            target=lambda: reports.append(
+                r.remove_replica(0, deadline_s=1e9)
+            )
+        )
+        t.start()
+        time.sleep(0.05)
+        # ...and the SAME replica dies mid-drain
+        r.kill_replica(0)
+        t.join(timeout=120)
+        assert not t.is_alive(), "scale-down drain wedged on a dead replica"
+        report = reports[0]
+        assert report["died_mid_drain"] is True
+        # goodput 1.0: every pinned (token-less) request re-routed and won
+        for f in futs:
+            assert len(f.result(timeout=120).token_ids) == 4
+        assert r.rerouted_failed == 0
+        assert r.failed_past_first_token == 0
+        assert len(r.replicas) == 1
+        # the artifact: one dump, holding the kill AND the scale decision
+        dumps = sorted(tmp_path.glob("flight-*.json"))
+        assert dumps, "scale_down race left no flight-recorder artifact"
+        payload = json.loads(dumps[-1].read_text())
+        assert payload["reason"] == "scale_down_interrupted"
+        events = [e["event"] for e in payload["events"]]
+        assert "scale_down" in events
+        assert "replica_kill" in events
+    finally:
+        r.stop()
+
+
+def test_autoscaler_scales_real_fleet_down_at_trough():
+    """Closed loop on real engines: an idle 2-replica fleet under a
+    min=1/max=3 controller drains back to one replica with zero shed —
+    driven by tick() under the injected clock, no controller thread."""
+    engines, factory = _fleet(2)
+    clock = _FakeClock()
+    r = EngineRouter(engines, replica_factory=factory,
+                     clock=clock, sleep=clock.sleep)
+    asc = SLOAutoscaler(
+        r,
+        AutoscalerConfig(min_replicas=1, max_replicas=3,
+                         # the warm-up request's compile-inflated TTFT sample
+                         # must not read as SLO burn on the CPU mesh
+                         slo_ttft_p95_s=600.0,
+                         down_consecutive=2, down_cooldown_s=0.1,
+                         drain_deadline_s=1e9),
+        clock=clock,
+        sleep=clock.sleep,
+    )
+    try:
+        r.submit([1, 2, 3], max_tokens=2, temperature=0.0).result(timeout=120)
+        decisions = []
+        for _ in range(4):
+            clock.advance(1.0)
+            decisions.append(asc.tick()["decision"])
+        assert "scale_down" in decisions
+        assert len(r.replicas) == 1
+        assert r.drain_shed == 0
+        st = asc.stats()
+        assert st["scale_downs"] == 1 and st["replicas"] == 1
+        # the fleet still serves after the scale-down
+        f = r.submit([9, 9, 9], max_tokens=3, temperature=0.0)
+        assert len(f.result(timeout=120).token_ids) == 3
+    finally:
+        r.stop()
+
+
+# ----------------------------------------------------- registry + /metrics
+def test_registry_dynamic_fleet_and_validation():
+    # max_replicas above replicas builds a router even at replicas=1
+    registry = ModelRegistry.from_config(
+        {"tiny-chat": {"kind": "decoder", "tiny": True, "max_slots": 2,
+                       "max_seq_len": 64, "replicas": 1, "max_replicas": 2}}
+    )
+    try:
+        router = registry.get_generator("tiny-chat")
+        assert isinstance(router, EngineRouter)
+        assert len(router.replicas) == 1
+        router.add_replica()  # the factory spawns from the shared weights
+        assert len(router.replicas) == 2
+        f = router.submit([1, 2, 3], max_tokens=2, temperature=0.0)
+        assert len(f.result(timeout=120).token_ids) == 2
+    finally:
+        registry.stop()
+    with pytest.raises(ValueError, match="max_replicas"):
+        ModelRegistry.from_config(
+            {"x": {"kind": "decoder", "tiny": True, "replicas": 2,
+                   "max_replicas": 1}}
+        )
+    with pytest.raises(ValueError, match="decoder-only"):
+        ModelRegistry.from_config(
+            {"e": {"kind": "encoder", "tiny": True, "autoscale": True}}
+        )
+
+
+def test_registry_autoscaler_metrics_and_healthz_surface():
+    registry = ModelRegistry.from_config(
+        {"tiny-chat": {"kind": "decoder", "tiny": True, "max_slots": 2,
+                       "max_seq_len": 64, "replicas": 1, "max_replicas": 2,
+                       "autoscale": True, "autoscale_interval_s": 30.0}}
+    )
+    try:
+        asc = registry.autoscalers["tiny-chat"]
+        st = asc.stats()
+        assert st["min_replicas"] == 1 and st["max_replicas"] == 2
+        text = render_prometheus(registry)
+        fams = parse_prometheus_text(text)
+        for fam in ("dabt_autoscale_replicas", "dabt_autoscale_scale_ups_total",
+                    "dabt_autoscale_degrade_active",
+                    "dabt_router_replicas_added_total",
+                    "dabt_router_replica_restarts_total"):
+            assert fam in fams, fam
+    finally:
+        registry.stop()
+    # stop() released any forced degradation and halted the control thread
+    assert not asc.degrade_active
+
+
+def test_workload_trace_drives_chaos_fleet_with_tokenless_goodput():
+    """The scenario engine meets the chaos plane: a seeded burst trace
+    replayed (fake-paced) against a 2-replica fleet whose dispatcher kills a
+    replica mid-trace (``replica_dead``, armed exactly once).  Sheds are
+    trace outcomes, token-less victims re-route, nothing is silently lost:
+    ok + shed + failed-past-first-token == trace length."""
+    from django_assistant_bot_tpu.serving import SchedulerRejected
+    from django_assistant_bot_tpu.serving.engine import EngineUnavailable
+    from django_assistant_bot_tpu.workload import (
+        WorkloadConfig,
+        WorkloadGenerator,
+        prompt_ids_for,
+        replay,
+    )
+
+    trace = WorkloadGenerator(
+        WorkloadConfig(seed=5, duration_s=6.0, base_rps=4.0, shape="burst",
+                       burst_every_s=3.0, burst_len_s=1.0, burst_rps=8.0,
+                       chat_prompt_tokens=(4, 12), chat_max_tokens=(2, 4),
+                       longctx_frac=0.0, prefix_frac=0.0)
+    ).generate()
+    assert len(trace) >= 10
+    engines, factory = _fleet(2)
+    inj = FaultInjector({"replica_dead": {"fire_on": [len(trace) // 2]}})
+    r = EngineRouter(engines, faults=inj, breaker_reset_s=0.2)
+    try:
+        r.submit([1, 2, 3], max_tokens=2, temperature=0.0).result(timeout=120)
+        futs, shed = [], 0
+
+        def submit(ev):
+            nonlocal shed
+            try:
+                futs.append(
+                    r.submit(prompt_ids_for(ev), max_tokens=ev.max_tokens,
+                             temperature=0.0, priority=ev.priority,
+                             tenant=ev.tenant)
+                )
+            except (SchedulerRejected, EngineUnavailable):
+                shed += 1
+
+        replay(trace, submit, speed=8.0)  # paced, but compressed for CI
+        ok = failed = 0
+        for f in futs:
+            try:
+                f.result(timeout=120)
+                ok += 1
+            except Exception:
+                failed += 1
+        assert inj.stats()["replica_dead"]["fires"] == 1
+        assert sum(not rep.engine._running for rep in r.replicas) == 1
+        # accounting closes: every trace arrival is ok, shed, or an honest
+        # past-first-token casualty of the kill
+        assert ok + shed + failed == len(trace)
+        assert ok > 0
+        assert failed == r.failed_past_first_token
+        assert r.rerouted_failed == 0
+    finally:
+        r.stop()

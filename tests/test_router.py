@@ -329,12 +329,12 @@ def test_reroute_preserves_remaining_deadline():
     try:
         # budget already gone: no fresh attempt, the client gets its 504
         state, failed = routed(0.2, time.monotonic() - 1.0)
-        r._on_inner_done(state, 0, failed)
+        r._on_inner_done(state, r.replicas[0], failed)
         assert isinstance(state.outer.exception(timeout=10), DeadlineExceeded)
         assert r.reroutes == 0
         # budget remaining: the hop happens with the SHRUNK deadline
         state, failed = routed(100.0, time.monotonic() + 30.0)
-        r._on_inner_done(state, 0, failed)
+        r._on_inner_done(state, r.replicas[0], failed)
         assert state.outer.result(timeout=120).token_ids
         assert r.reroutes == 1
         assert state.kwargs["deadline_s"] <= 30.0

@@ -513,3 +513,141 @@ def test_embedding_coalescer_drops_cancelled_futures():
         assert eng.dropped_cancelled == 1
     finally:
         eng.stop()
+
+
+# ------------------------- predictive admission (queue-wait histogram, PR 11)
+def test_histogram_quantile_interpolates_and_caps():
+    from django_assistant_bot_tpu.serving import Histogram
+
+    h = Histogram((0.1, 1.0, 10.0))
+    assert h.quantile(0.95) == 0.0  # empty = cold, callers gate on .count
+    for _ in range(90):
+        h.observe(0.05)  # le 0.1 bucket
+    for _ in range(10):
+        h.observe(5.0)  # (1.0, 10.0] bucket
+    q50 = h.quantile(0.5)
+    assert 0.0 < q50 <= 0.1
+    q95 = h.quantile(0.95)
+    assert 1.0 < q95 <= 10.0
+    # +Inf bucket values report the largest finite bound (a deliberate
+    # under-estimate: predictions must stay actionable)
+    h2 = Histogram((0.1, 1.0))
+    h2.observe(99.0)
+    assert h2.quantile(0.99) == 1.0
+
+
+def test_warm_wait_histogram_floors_estimated_wait_and_retry():
+    """The point-EMA model underestimates the tail; once the bound queue-wait
+    histogram is warm, the estimated wait (and the 429 Retry-After derived
+    from it) is floored by the configured quantile of realized waits."""
+    from django_assistant_bot_tpu.serving import Histogram
+
+    s = RequestScheduler(
+        SchedulerConfig(
+            max_queue=100,
+            admit_max_wait_s=5.0,
+            service_time_init=0.01,  # the EMA model predicts ~nothing
+            admit_wait_quantile=0.95,
+            admit_hist_min_samples=8,
+        ),
+        slots=1,
+    )
+    h = Histogram((0.1, 1.0, 10.0, 30.0))
+    s.bind_wait_hist(h)
+    # cold histogram: the EMA model alone drives the estimate
+    _admit_and_enqueue(s)
+    assert s.stats()["est_wait_source"] == "ema"
+    assert s.est_wait_s() < 0.1
+    # warm it with a heavy observed tail (queue waits ~8s)
+    for _ in range(16):
+        h.observe(8.0)
+    st = s.stats()
+    assert st["est_wait_source"] == "histogram"
+    assert s.est_wait_s() > 1.0  # the measured tail floors the model
+    # and the shed decision + Retry-After hint follow the SAME prediction:
+    # est > admit_max_wait_s -> shed, with retry ~= the predicted wait
+    adm = s.try_admit("interactive")
+    assert not adm.ok and adm.reason == "estimated_wait"
+    assert adm.retry_after_s == pytest.approx(s.est_wait_s(), rel=0.35)
+    # empty queue: nothing ahead of the request, no histogram floor applies
+    s2 = RequestScheduler(
+        SchedulerConfig(admit_hist_min_samples=8), slots=1
+    )
+    s2.bind_wait_hist(h)
+    assert s2.est_wait_s() == 0.0
+
+
+def test_engine_binds_queue_wait_histogram_into_scheduler():
+    cfg = DecoderConfig.tiny()
+    params = llama.init(cfg, jax.random.key(0))
+    sched = RequestScheduler(SchedulerConfig(admit_hist_min_samples=4))
+    eng = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=64,
+        scheduler=sched,
+    )
+    assert sched._wait_hist is eng.obs.queue_wait_s
+    # obs=False: no histogram exists, the EMA path stays
+    sched2 = RequestScheduler(SchedulerConfig())
+    GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=64,
+        scheduler=sched2, obs=False,
+    )
+    assert sched2._wait_hist is None
+
+
+def test_degrade_override_clamps_and_reports():
+    """The autoscaler's load-shaping actuator: set_degrade forces the band on
+    (max_tokens clamp at admission + degraded() True, which the engine reads
+    as 'skip speculative verify forwards') independent of queue pressure."""
+    s = RequestScheduler(SchedulerConfig(max_queue=100, degrade_at=1.0))
+    assert not s.degraded()
+    s.set_degrade(64)
+    assert s.degraded()
+    adm = s.try_admit("interactive")
+    assert adm.ok and adm.clamp_max_tokens == 64
+    st = s.stats()
+    assert st["degraded"] is True and st["degrade_forced"] is True
+    s.set_degrade(None)
+    assert not s.degraded()
+    assert s.try_admit("interactive").clamp_max_tokens is None
+    # the band clamp and the override compose: the tighter one wins
+    s3 = RequestScheduler(
+        SchedulerConfig(max_queue=4, degrade_at=0.25, degrade_max_tokens=128)
+    )
+    s3.set_degrade(32)
+    _admit_and_enqueue(s3)
+    adm = s3.try_admit("interactive")
+    assert adm.clamp_max_tokens == 32
+
+
+def test_wait_histogram_floor_is_windowed_not_lifetime():
+    """A past overload's tail must roll OUT of the prediction: after two
+    window rotations of fast traffic, the quantile floor tracks the recent
+    regime, not the process lifetime (a stale ~8s Retry-After at light load
+    was the bug)."""
+    from django_assistant_bot_tpu.serving import Histogram
+
+    window = 32
+    s = RequestScheduler(
+        SchedulerConfig(
+            service_time_init=0.01,
+            admit_wait_quantile=0.95,
+            admit_hist_min_samples=8,
+            admit_hist_window=window,
+        ),
+        slots=1,
+    )
+    h = Histogram((0.1, 1.0, 10.0, 30.0))
+    s.bind_wait_hist(h)
+    _admit_and_enqueue(s)  # depth > 0 so the floor applies
+    for _ in range(16):
+        h.observe(8.0)  # the overload period
+    assert s.est_wait_s() > 1.0
+    # two full windows of fast traffic rotate the slow tail out entirely
+    # (rotation happens inside the admission-path checks, so interleave the
+    # reads the way live admissions would)
+    for _ in range(2 * window):
+        h.observe(0.05)
+        s.est_wait_s()
+    assert s.est_wait_s() < 0.2
+    assert s.stats()["est_wait_source"] == "histogram"  # still warm

@@ -1,0 +1,175 @@
+"""Workload scenario engine (workload/generator.py; docs/AUTOSCALING.md):
+seeded determinism (same seed -> byte-identical trace), the production
+traffic shapes (diurnal / ramp / burst / constant, hot tenants, chat vs
+long-context mixtures), JSONL trace round-trips, and clock-injectable
+replay.  Everything here is pure host code — no device, no sleeps."""
+
+import math
+
+import pytest
+
+from django_assistant_bot_tpu.workload import (
+    WorkloadConfig,
+    WorkloadGenerator,
+    WorkloadRequest,
+    load_trace,
+    prompt_ids_for,
+    replay,
+    save_trace,
+)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, dt: float) -> None:
+        self.t += dt
+
+
+# --------------------------------------------------------------- determinism
+def test_same_seed_identical_trace():
+    cfg = WorkloadConfig(seed=7, duration_s=30, base_rps=4, shape="diurnal",
+                         diurnal_period_s=30)
+    a = WorkloadGenerator(cfg).generate()
+    b = WorkloadGenerator(cfg).generate()
+    assert a and a == b  # full structural equality, not just lengths
+    c = WorkloadGenerator(WorkloadConfig(**{**cfg.__dict__, "seed": 8})).generate()
+    assert a != c  # and the seed actually matters
+
+
+def test_trace_timestamps_sorted_and_bounded():
+    cfg = WorkloadConfig(seed=1, duration_s=12, base_rps=6, shape="burst",
+                         burst_every_s=4, burst_len_s=1, burst_rps=20)
+    ev = WorkloadGenerator(cfg).generate()
+    assert all(0 <= e.t_s < cfg.duration_s for e in ev)
+    assert all(a.t_s <= b.t_s for a, b in zip(ev, ev[1:]))
+
+
+# --------------------------------------------------------------- the shapes
+def test_diurnal_peak_denser_than_trough():
+    cfg = WorkloadConfig(seed=3, duration_s=60, base_rps=8, shape="diurnal",
+                         diurnal_period_s=60, diurnal_min_frac=0.1)
+    g = WorkloadGenerator(cfg)
+    # envelope: trough at the edges, peak at period/2
+    assert g.rate_at(0.0) == pytest.approx(0.8, rel=1e-6)
+    assert g.rate_at(30.0) == pytest.approx(8.0, rel=1e-6)
+    ev = g.generate()
+    trough = sum(1 for e in ev if e.t_s < 10 or e.t_s > 50)
+    peak = sum(1 for e in ev if 20 <= e.t_s <= 40)
+    assert peak > 2 * trough
+
+
+def test_burst_windows_denser_than_base():
+    cfg = WorkloadConfig(seed=5, duration_s=40, base_rps=2, shape="burst",
+                         burst_every_s=10, burst_len_s=2, burst_rps=30)
+    ev = WorkloadGenerator(cfg).generate()
+    in_burst = sum(1 for e in ev if (e.t_s % 10) < 2)
+    out_burst = len(ev) - in_burst
+    # burst windows are 20% of the time but carry most of the traffic
+    assert in_burst > out_burst
+
+
+def test_ramp_monotonic_envelope():
+    cfg = WorkloadConfig(seed=2, duration_s=20, base_rps=1, shape="ramp",
+                         ramp_to_rps=9)
+    g = WorkloadGenerator(cfg)
+    rates = [g.rate_at(t) for t in (0, 5, 10, 15, 20)]
+    assert rates == sorted(rates)
+    ev = g.generate()
+    first_half = sum(1 for e in ev if e.t_s < 10)
+    assert len(ev) - first_half > first_half
+
+
+def test_hot_tenant_and_mixture_fractions():
+    cfg = WorkloadConfig(seed=11, duration_s=200, base_rps=10,
+                         shape="constant", tenants=5, hot_tenant_frac=0.6,
+                         background_frac=0.2, longctx_frac=0.25)
+    ev = WorkloadGenerator(cfg).generate()
+    n = len(ev)
+    hot = sum(1 for e in ev if e.tenant == "tenant0") / n
+    bg = sum(1 for e in ev if e.priority == "background") / n
+    lc = sum(1 for e in ev if e.kind == "longctx") / n
+    assert math.isclose(hot, 0.6, abs_tol=0.05)
+    assert math.isclose(bg, 0.2, abs_tol=0.05)
+    assert math.isclose(lc, 0.25, abs_tol=0.05)
+    # long-context requests draw from the long token regime, chat from its own
+    for e in ev:
+        lo, hi = (cfg.longctx_prompt_tokens if e.kind == "longctx"
+                  else cfg.chat_prompt_tokens)
+        assert lo <= e.prompt_tokens <= hi
+
+
+def test_config_validation_rejects_nonsense():
+    with pytest.raises(ValueError, match="shape"):
+        WorkloadGenerator(WorkloadConfig(shape="sinusoid"))
+    with pytest.raises(ValueError, match="duration"):
+        WorkloadGenerator(WorkloadConfig(duration_s=0))
+    with pytest.raises(ValueError, match="hot_tenant_frac"):
+        WorkloadGenerator(WorkloadConfig(hot_tenant_frac=1.5))
+
+
+# ------------------------------------------------------------------- prompts
+def test_prompt_ids_share_prefix_and_are_deterministic():
+    a = WorkloadRequest(t_s=0.0, prompt_tokens=32, prefix_len=16, seed=42)
+    b = WorkloadRequest(t_s=1.0, prompt_tokens=24, prefix_len=16, seed=43)
+    ids_a, ids_b = prompt_ids_for(a), prompt_ids_for(b)
+    assert ids_a == prompt_ids_for(a)  # same request -> same ids
+    assert ids_a[:16] == ids_b[:16]  # shared prefix really is shared
+    assert ids_a[16:] != ids_b[16:]  # bodies differ by seed
+    assert len(ids_a) == 32
+    assert all(1 <= t <= 255 for t in ids_a)  # byte-tokenizer-safe
+
+
+# --------------------------------------------------------------------- JSONL
+def test_jsonl_round_trip_identity(tmp_path):
+    cfg = WorkloadConfig(seed=9, duration_s=15, base_rps=5, shape="diurnal",
+                         diurnal_period_s=15)
+    ev = WorkloadGenerator(cfg).generate()
+    path = str(tmp_path / "trace.jsonl")
+    assert save_trace(ev, path) == len(ev)
+    assert load_trace(path) == ev
+
+
+def test_jsonl_rejects_malformed_lines(tmp_path):
+    path = str(tmp_path / "bad.jsonl")
+    with open(path, "w") as fh:
+        fh.write('{"t_s": 1.0}\nnot json\n')
+    with pytest.raises(ValueError, match="bad.jsonl:2"):
+        load_trace(path)
+
+
+# -------------------------------------------------------------------- replay
+def test_replay_paces_by_trace_time_and_speed():
+    ev = [WorkloadRequest(t_s=t) for t in (0.0, 1.0, 3.0)]
+    clock = _FakeClock()
+    seen = []
+    replay(ev, lambda e: seen.append((e.t_s, clock.t)),
+           clock=clock, sleep=clock.sleep, speed=2.0)
+    # each submit fires at trace-time / speed on the injected clock
+    assert seen == [(0.0, 0.0), (1.0, 0.5), (3.0, 1.5)]
+
+
+def test_replay_catches_submit_exceptions_as_outcomes():
+    ev = [WorkloadRequest(t_s=0.0), WorkloadRequest(t_s=0.1)]
+    clock = _FakeClock()
+
+    def submit(e):
+        if e.t_s == 0.0:
+            raise RuntimeError("shed")
+        return "ok"
+
+    out = replay(ev, submit, clock=clock, sleep=clock.sleep)
+    assert isinstance(out[0], RuntimeError) and out[1] == "ok"
+
+
+def test_replay_honors_stop_predicate():
+    ev = [WorkloadRequest(t_s=float(i)) for i in range(10)]
+    clock = _FakeClock()
+    n = []
+    out = replay(ev, lambda e: n.append(1), clock=clock, sleep=clock.sleep,
+                 stop=lambda: len(n) >= 3)
+    assert len(out) == 3
