@@ -1932,6 +1932,305 @@ print(json.dumps(bench.bench_autoscale()))
 """
 
 
+def bench_kv_tier() -> dict:
+    """kv_tier_* section (docs/KV_PAGING.md "Tiered KV" evidence): durable
+    warm state on a many-session trace where live KV >> HBM.
+
+    ONE pinned session-shaped trace (workload/generator.py sessions: per-
+    session think-times, per-turn prompts extending the previous turn)
+    drives two engines whose page pool is sized well BELOW the sessions'
+    aggregate warm footprint, so LRU pressure evicts registered prefixes
+    continuously:
+
+    - **hbm_only** (kv_host_bytes=0): an evicted prefix is gone — the next
+      turn re-prefills it cold (and the pre-tiering pool could only shed
+      this shape as kv_pressure);
+    - **tiered**: evictions spill to host DRAM and the next turn RESTORES
+      (upload + suffix prefill, bit-identity-tested in
+      tests/test_kv_tiering.py).
+
+    Reported per arm: prefix-hit-eligible turn TTFT p50/p95, kv_pressure
+    sheds, restore/spill counters.  Then two durability probes on the SAME
+    warmed engines: (a) a tick_raise crash-only restart followed by one more
+    turn per session — the tiered arm restores from the surviving host tier
+    (goodput 1.0, warm TTFT), the hbm_only arm re-prefills; (b) a 2-replica
+    fleet scale-down with migration on vs off — pages_lost_at_detach ~ 0
+    with migration, > 0 without, and the migrated sessions' next turns stay
+    warm-tier on the survivor."""
+    import jax
+
+    from django_assistant_bot_tpu.models import llama
+    from django_assistant_bot_tpu.parallel import get_mesh, shard_pytree
+    from django_assistant_bot_tpu.serving import ByteTokenizer, GenerationEngine
+    from django_assistant_bot_tpu.serving.engine import EngineUnavailable
+    from django_assistant_bot_tpu.serving.faults import FaultInjector
+    from django_assistant_bot_tpu.serving.router import EngineRouter
+    from django_assistant_bot_tpu.serving.scheduler import (
+        RequestScheduler,
+        SchedulerConfig,
+        SchedulerRejected,
+    )
+    from django_assistant_bot_tpu.workload import (
+        WorkloadConfig,
+        WorkloadGenerator,
+        WorkloadRequest,
+        prompt_ids_for,
+        replay,
+    )
+
+    cfg = _decoder_cfg()
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    mesh = get_mesh()
+    with mesh:
+        params = shard_pytree(params, llama.logical_axes(cfg), mesh)
+    N_SESSIONS = 12 if not SMALL else 8
+    POOL_PAGES = 10  # ~5 warm 2-page prefixes; the trace warms 2-3x that
+    trace = WorkloadGenerator(
+        WorkloadConfig(
+            seed=13,
+            duration_s=10.0,
+            base_rps=0.0,  # sessions only: the many-idle-sessions shape
+            sessions=N_SESSIONS,
+            session_turns=(3, 4),
+            session_think_s=(0.4, 1.5),
+            session_prefix_tokens=(48, 80),
+            session_body_tokens=(8, 24),
+            session_max_tokens=(4, 8),
+            session_start_frac=0.6,
+        )
+    ).generate()
+    by_session: dict = {}
+    for ev in trace:
+        by_session.setdefault(ev.session, []).append(ev)
+
+    def build(host_bytes, name):
+        eng = GenerationEngine(
+            cfg,
+            params,
+            ByteTokenizer(),
+            max_slots=4,
+            max_seq_len=256,
+            prefill_buckets=(32, 64, 128),
+            chunk_size=128,
+            decode_kv_chunk=64,
+            prefix_cache_size=32,  # entry bound is not the pressure: pages are
+            prefix_min_tokens=16,
+            kv_layout="paged",
+            kv_pages=POOL_PAGES,
+            kv_host_bytes=host_bytes,
+            lookahead=1,
+            burst=1,
+            mesh=mesh,
+            name=name,
+            scheduler=RequestScheduler(
+                SchedulerConfig(max_queue=64, admit_max_wait_s=8.0)
+            ),
+            faults=FaultInjector({}),
+        )
+        eng.warmup()
+        eng.start()
+        return eng
+
+    def pctl(vals, frac):
+        vals = sorted(vals)
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, max(0, round(frac * (len(vals) - 1))))]
+
+    def next_turn(ev, extra=16):
+        """One more turn of ev's session: the prompt extends ev's by
+        `extra` tokens and declares ev's full prompt as its prefix."""
+        return WorkloadRequest(
+            t_s=0.0,
+            tenant=ev.tenant,
+            kind="session",
+            prompt_tokens=ev.prompt_tokens + extra,
+            max_tokens=4,
+            prefix_len=ev.prompt_tokens,
+            seed=ev.seed,
+            session=ev.session,
+            turn=ev.turn + 1,
+        )
+
+    def run_arm(host_bytes, name):
+        eng = build(host_bytes, name)
+        done = []
+        shed = 0
+
+        def submit(ev):
+            nonlocal shed
+            try:
+                fut = eng.submit(
+                    prompt_ids_for(ev),
+                    max_tokens=ev.max_tokens,
+                    temperature=0.0,
+                    prefix_len=ev.prefix_len,
+                )
+            except (SchedulerRejected, EngineUnavailable):
+                shed += 1
+                return
+            done.append((ev, fut))
+
+        try:
+            eng.submit([1, 2, 3], max_tokens=2, temperature=0.0).result(
+                timeout=600
+            )  # settle before the clock starts
+            replay(trace, submit)
+            hit_ttfts, ok = [], 0
+            for ev, fut in done:
+                try:
+                    r = fut.result(timeout=600)
+                    ok += 1
+                    if ev.turn > 0:  # prefix-hit-eligible turns
+                        hit_ttfts.append(r.ttft_s)
+                except Exception:
+                    pass
+            st = eng.kv_stats()
+            sched_shed = eng.scheduler.stats()["shed"]
+            # ---- durability probe (a): crash-only restart mid-session ----
+            eng._faults.arm("tick_raise")
+            probes = [
+                next_turn(evs[-1])
+                for evs in by_session.values()
+                if evs and evs[-1].turn > 0
+            ]
+            futs = [
+                (p, eng.submit(
+                    prompt_ids_for(p),
+                    max_tokens=p.max_tokens,
+                    temperature=0.0,
+                    prefix_len=p.prefix_len,
+                ))
+                for p in probes
+            ]
+            restart_ttfts, restart_ok = [], 0
+            for p, fut in futs:
+                try:
+                    r = fut.result(timeout=600)
+                    restart_ok += 1
+                    restart_ttfts.append(r.ttft_s)
+                except Exception:
+                    pass
+            st_after = eng.kv_stats()
+            return {
+                "ok": ok,
+                "shed_submit": shed,
+                "kv_pressure_sheds": sched_shed.get("kv_pressure", 0),
+                "hit_ttft_p50_s": round(pctl(hit_ttfts, 0.5), 4),
+                "hit_ttft_p95_s": round(pctl(hit_ttfts, 0.95), 4),
+                "hit_turns": len(hit_ttfts),
+                "prefix_hits": st["prefix_hits"],
+                "prefix_misses": st["prefix_misses"],
+                "evictions": st["kv_evictions"],
+                "restores": st.get("kv_restores", 0),
+                "spills": st.get("kv_spills", 0),
+                "restart_goodput_frac": round(
+                    restart_ok / max(1, len(probes)), 4
+                ),
+                "restart_ttft_p50_s": round(pctl(restart_ttfts, 0.5), 4),
+                "restarts": eng.engine_restarts,
+                "restores_after_restart": st_after.get("kv_restores", 0)
+                - st.get("kv_restores", 0),
+            }
+        finally:
+            eng.stop()
+
+    hbm = run_arm(0, "kvt/hbm")
+    tiered = run_arm(1 << 30, "kvt/tiered")
+
+    # ---- durability probe (b): scale-down migration on a 2-replica fleet --
+    def scale_down_probe(migrate):
+        engines = [build(1 << 30, f"kvt/sd{i}") for i in range(2)]
+        router = EngineRouter(engines, names=["sd0", "sd1"])
+        try:
+            warm = [evs[0] for evs in list(by_session.values())[:4]]
+            for ev in warm:
+                router.submit(
+                    prompt_ids_for(ev),
+                    max_tokens=2,
+                    temperature=0.0,
+                    prefix_len=ev.prefix_len,
+                ).result(timeout=600)
+            # detach whichever replica holds warm state
+            holder = 0
+            for i, rep in enumerate(router.replicas):
+                if rep.engine.kv_stats()["kv_shared_entries"] > 0:
+                    holder = i
+                    break
+            router.remove_replica(holder, deadline_s=30.0, migrate=migrate)
+            ttfts = []
+            for ev in warm:
+                r = router.submit(
+                    prompt_ids_for(next_turn(ev)),
+                    max_tokens=4,
+                    temperature=0.0,
+                    prefix_len=ev.prompt_tokens,
+                ).result(timeout=600)
+                ttfts.append(r.ttft_s)
+            rs = router.router_stats()
+            return {
+                "pages_lost": rs["pages_lost_at_detach"],
+                "entries_migrated": rs["entries_migrated"],
+                "post_detach_ttft_p50_s": round(pctl(ttfts, 0.5), 4),
+            }
+        finally:
+            router.stop()
+
+    mig_on = scale_down_probe(True)
+    mig_off = scale_down_probe(False)
+
+    return {
+        "kv_tier_hit_ttft_p50_s": tiered["hit_ttft_p50_s"],
+        "kv_tier_hit_ttft_p95_s": tiered["hit_ttft_p95_s"],
+        "kv_tier_hit_ttft_p50_hbm_only_s": hbm["hit_ttft_p50_s"],
+        "kv_tier_hit_ttft_p95_hbm_only_s": hbm["hit_ttft_p95_s"],
+        "kv_tier_pressure_sheds": tiered["kv_pressure_sheds"],
+        "kv_tier_pressure_sheds_hbm_only": hbm["kv_pressure_sheds"],
+        "kv_tier_prefix_hits": tiered["prefix_hits"],
+        "kv_tier_prefix_hits_hbm_only": hbm["prefix_hits"],
+        "kv_tier_prefix_misses": tiered["prefix_misses"],
+        "kv_tier_prefix_misses_hbm_only": hbm["prefix_misses"],
+        "kv_tier_restores": tiered["restores"],
+        "kv_tier_spills": tiered["spills"],
+        "kv_tier_evictions": tiered["evictions"],
+        "kv_tier_ok": tiered["ok"],
+        "kv_tier_ok_hbm_only": hbm["ok"],
+        # restart survival: warm-tier TTFT + goodput through a tick_raise
+        # crash (the host tier survives the allocator reset)
+        "kv_tier_restart_goodput_frac": tiered["restart_goodput_frac"],
+        "kv_tier_restart_goodput_frac_hbm_only": hbm["restart_goodput_frac"],
+        "kv_tier_restart_ttft_p50_s": tiered["restart_ttft_p50_s"],
+        "kv_tier_restart_ttft_p50_hbm_only_s": hbm["restart_ttft_p50_s"],
+        "kv_tier_restores_after_restart": tiered["restores_after_restart"],
+        # scale-down survival: migration keeps pages_lost_at_detach ~ 0 and
+        # the migrated sessions' next turns warm on the survivor
+        "kv_tier_detach_pages_lost_migrate_on": mig_on["pages_lost"],
+        "kv_tier_detach_pages_lost_migrate_off": mig_off["pages_lost"],
+        "kv_tier_detach_entries_migrated": mig_on["entries_migrated"],
+        "kv_tier_detach_ttft_p50_migrate_on_s": mig_on["post_detach_ttft_p50_s"],
+        "kv_tier_detach_ttft_p50_migrate_off_s": mig_off["post_detach_ttft_p50_s"],
+        "kv_tier_trace": (
+            f"sessions seed=13 n={N_SESSIONS} turns=3-4 "
+            f"pool={POOL_PAGES}p page=64"
+        ),
+        # Honesty note (the stream-bench discipline): at CPU-tiny geometry a
+        # full prefix re-prefill costs single-digit ms, so the wall-clock
+        # TTFT arms measure mostly harness noise — the DETERMINISTIC tier
+        # evidence here is hits/misses (warm turns served without prefix
+        # recompute), restore/spill counts, restart goodput, and the
+        # detach pages-lost A/B.  The TTFT criterion binds on real geometry,
+        # where the avoided recompute is ~0.9 s (BENCH_r05 prefix numbers).
+        "kv_tier_note": "toy-geometry TTFT ~ noise; hits/misses + counters are the tier evidence",
+    }
+
+
+_KV_TIER_SNIPPET = """
+import json
+import bench
+print(json.dumps(bench.bench_kv_tier()))
+"""
+
+
 def bench_obs() -> dict:
     """obs_* section (serving/obs.py evidence): the observability plane's two
     claims.  (1) Tracing + metric recording on the decode path costs within
@@ -2789,6 +3088,15 @@ _COMPACT_KEYS = (
     "autoscale_replica_seconds",
     "autoscale_replica_seconds_fixed_max",
     "autoscale_peak_replicas",
+    "kv_tier_hit_ttft_p95_s",
+    "kv_tier_hit_ttft_p95_hbm_only_s",
+    "kv_tier_pressure_sheds",
+    "kv_tier_pressure_sheds_hbm_only",
+    "kv_tier_restart_goodput_frac",
+    "kv_tier_restart_ttft_p50_s",
+    "kv_tier_restart_ttft_p50_hbm_only_s",
+    "kv_tier_detach_pages_lost_migrate_on",
+    "kv_tier_detach_pages_lost_migrate_off",
     "obs_overhead_frac",
     "obs_ab_noise_frac",
     "obs_scrape_ms",
@@ -2895,6 +3203,7 @@ def main() -> None:
         extras.update(bench_chaos())
         extras.update(bench_router())
         extras.update(bench_autoscale())
+        extras.update(bench_kv_tier())
         extras.update(bench_obs())
         extras.update(bench_stream())
         baseline_thread.join(timeout=600)
@@ -2960,6 +3269,11 @@ def main() -> None:
     #        replica-seconds vs the fixed max-size budget —
     #        serving/autoscaler.py + workload/ evidence)
     run("autoscale", _AUTOSCALE_SNIPPET, cap_s=400)
+    # 3c'''b) kv_tier: durable warm state — tiered vs HBM-only prefix-hit
+    #        TTFT + kv_pressure sheds on the pinned many-session trace
+    #        (live KV >> HBM), plus restart-survival and scale-down
+    #        migration probes (serving/kv_pool.py host tier evidence)
+    run("kv_tier", _KV_TIER_SNIPPET, cap_s=500)
     # 3c''') obs: tracing+metrics decode-throughput A/B (must be within
     #        noise) + /metrics scrape cost and exposition validity against a
     #        known trace (serving/obs.py evidence)

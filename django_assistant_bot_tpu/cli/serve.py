@@ -109,6 +109,24 @@ def add_parser(sub):
         metavar="TOKENS",
         help="KV page size in tokens (0 = align with decode_kv_chunk)",
     )
+    p.add_argument(
+        "--kv-host-bytes",
+        type=int,
+        default=None,
+        metavar="BYTES",
+        help="host-DRAM budget for the KV durability tier on every decoder: "
+        "evicted/registered prefixes keep a host copy and restore instead of "
+        "re-prefilling — warm sessions survive eviction, crash restarts, and "
+        "scale-downs (0 = off; docs/KV_PAGING.md 'Tiered KV')",
+    )
+    p.add_argument(
+        "--kv-spill-dir",
+        default=None,
+        metavar="DIR",
+        help="disk tier for the KV durability plane: host-tier evictions "
+        "demote to .npz files here instead of dropping (also honors the "
+        "DABT_KV_SPILL_DIR env var)",
+    )
     # deprecated r4 prefix-LRU flags: kept working, mapped onto the page-pool
     # prefix registry (run() logs a one-line warning when used)
     p.add_argument("--prefix-cache-size", type=int, default=None, help=(
@@ -243,6 +261,10 @@ def run(args) -> int:
         sched_overrides["kv_pages"] = args.kv_pages
     if getattr(args, "kv_page_size", None) is not None:
         sched_overrides["kv_page_size"] = args.kv_page_size
+    if getattr(args, "kv_host_bytes", None) is not None:
+        sched_overrides["kv_host_bytes"] = args.kv_host_bytes
+    if getattr(args, "kv_spill_dir", None) is not None:
+        sched_overrides["kv_spill_dir"] = args.kv_spill_dir
     # deprecated prefix-LRU flags: one-line warning, then mapped onto the
     # page-pool prefix registry (identical semantics under the paged layout)
     _dep = {

@@ -146,6 +146,10 @@ class SLOAutoscaler:
         self.scale_ups = 0
         self.scale_downs = 0
         self.scale_up_failures = 0
+        # warm-state durability: what scale-downs preserved vs dropped
+        self.warm_entries_migrated = 0
+        self.warm_pages_migrated = 0
+        self.warm_pages_lost = 0
         self.degrade_engaged = 0
         self.degrade_released = 0
         # integral of fleet size over time — the cost axis of the bench A/B
@@ -352,6 +356,13 @@ class SLOAutoscaler:
             return "hold"
         with self._lock:
             self.scale_downs += 1
+            # warm-state durability accounting (docs/KV_PAGING.md "Tiered
+            # KV"): a scale-down is no longer a silent cache wipe — the
+            # migration result rides in the detach report, accumulates
+            # here, and is scrapeable next to the scale counters
+            self.warm_entries_migrated += int(report.get("migrated_entries", 0))
+            self.warm_pages_migrated += int(report.get("migrated_pages", 0))
+            self.warm_pages_lost += int(report.get("lost_pages", 0))
         self._down_ok_at = now + self.cfg.down_cooldown_s
         self._down_ticks = 0
         self.flight.record("scale_down_report", **report)
@@ -450,6 +461,9 @@ class SLOAutoscaler:
                 "scale_ups": self.scale_ups,
                 "scale_downs": self.scale_downs,
                 "scale_up_failures": self.scale_up_failures,
+                "warm_entries_migrated": self.warm_entries_migrated,
+                "warm_pages_migrated": self.warm_pages_migrated,
+                "warm_pages_lost": self.warm_pages_lost,
                 "degrade_active": self.degrade_active,
                 "degrade_engaged": self.degrade_engaged,
                 "degrade_released": self.degrade_released,

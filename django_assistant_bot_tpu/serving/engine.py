@@ -155,6 +155,10 @@ class _Request:
     # client X-Request-Id or generated at submit; stable across router
     # re-route hops and crash-restart re-submissions
     trace_id: str = ""
+    # host-tier KV restore: admission found this request's prefix in the
+    # host tier and uploaded it into fresh pages ahead of the suffix prefill
+    # (the restores-in-flight gauge decrements when the slot activates)
+    restored_from_host: bool = False
 
 
 # slot-cache precision knob -> concrete dtype (None = the model's cfg.dtype);
@@ -179,6 +183,23 @@ class _Prefix:
     pv: Any
     length: int
     pb: int
+
+
+@dataclasses.dataclass
+class _HostHit:
+    """A prefix found in the HOST tier (the HBM registry missed).  Admission
+    allocates fresh pages, uploads the spilled K/V into them ahead of the
+    slot's suffix prefill (restore-then-suffix-prefill — bit-identical to a
+    cold full prefill, since the bytes ARE the prefill's bytes), and
+    re-registers the restored pages so later requests share them in HBM.
+    Carries ``.length`` so the admission/suffix machinery treats it exactly
+    like a device registry hit."""
+
+    entry: Any  # kv_pool.HostPrefixEntry
+
+    @property
+    def length(self) -> int:
+        return self.entry.length
 
 
 @dataclasses.dataclass
@@ -260,6 +281,9 @@ class GenerationEngine:
         kv_layout: str = "paged",
         kv_page_size: int = 0,
         kv_pages: int = 0,
+        kv_host_bytes: int = 0,
+        kv_spill_dir: Optional[str] = None,
+        kv_host_writethrough: bool = True,
         scheduler: Optional[RequestScheduler] = None,
         faults=None,
         max_restarts: int = 5,
@@ -428,6 +452,17 @@ class GenerationEngine:
         self.kv_page_size = 0
         self._kv_blocks = 0
         self._kv_pool = None
+        self._kv_host = None
+        # host-tier restore bookkeeping: counters + a bounded window of
+        # restore DISPATCH times (host fetch + upload issue — the async
+        # restore's host-visible cost; the device overlap hides the rest)
+        self.kv_restores = 0
+        self.kv_host_hits = 0
+        self._kv_restores_inflight = 0
+        self._restore_s: "collections.deque[float]" = collections.deque(maxlen=512)
+        # fleet prefix listener (router-owned registry): tier-transition
+        # events forward here AFTER the engine's own flight recording
+        self._prefix_listener: Optional[Callable[..., None]] = None
         if self.paged:
             page = int(kv_page_size) or self.decode_kv_chunk or 0
             if not page:
@@ -471,6 +506,31 @@ class GenerationEngine:
                     * 2  # K and V
                     * kv_itemsize
                 )
+                # --- host KV tier (docs/KV_PAGING.md "Tiered KV") ---------
+                # kv_host_bytes > 0 (or a spill dir) arms the durability
+                # tier: evicted/registered prefixes keep a host-DRAM copy
+                # (then disk), admission restores them into fresh pages ahead
+                # of the suffix prefill, and crash-only _restart re-seeds
+                # warm sessions from here instead of losing them.
+                import os as _os
+
+                from .kv_pool import HostKVTier
+
+                spill_dir = kv_spill_dir or _os.environ.get(
+                    "DABT_KV_SPILL_DIR", ""
+                ).strip() or None
+                host_tier = None
+                if int(kv_host_bytes) > 0 or spill_dir:
+                    host_tier = HostKVTier(
+                        # a spill dir alone gets a small DRAM staging budget
+                        # (entries flow through host DRAM on their way down)
+                        int(kv_host_bytes) or 64 * page_bytes,
+                        page_size=page,
+                        page_bytes=page_bytes,
+                        spill_dir=spill_dir,
+                        name=f"{name}-kv-host",
+                    )
+                self._kv_host = host_tier
                 # the r4 prefix-LRU knobs map straight onto the page pool:
                 # entry count -> registry entries, byte budget -> shared-page
                 # budget, min tokens -> registration threshold
@@ -481,7 +541,13 @@ class GenerationEngine:
                     max_shared_bytes=self.prefix_cache_max_bytes,
                     max_shared_entries=self.prefix_cache_size,
                     min_prefix_tokens=self.prefix_min_tokens,
+                    host_tier=host_tier,
+                    writethrough=bool(kv_host_writethrough),
                 )
+                self._kv_pool.bind_spill_fetch(self._fetch_pages_host)
+                self._kv_pool.on_event = self._on_kv_tier_event
+                if host_tier is not None:
+                    host_tier.on_event = self._on_kv_tier_event
                 self._kv_sentinel = n_pages  # block-table "unallocated" marker
         # Admission-controlled scheduling (serving/scheduler.py): when present,
         # submit() runs its admission test (bounded queue, estimated wait) and
@@ -500,6 +566,11 @@ class GenerationEngine:
                 scheduler.bind_kv(
                     self._kv_pool.available, self._kv_pool.n_pages
                 )
+                if self._kv_host is not None:
+                    # host/disk-tier gauges ride in the scheduler's stats()
+                    # block so operators (and the autoscaler) read pool
+                    # pressure and warm-tier depth side by side
+                    scheduler.bind_kv_tier(self._kv_host.stats)
             if self.obs is not None:
                 # predictive admission (docs/AUTOSCALING.md): once warm, the
                 # obs plane's queue-wait histogram floors the estimated-wait
@@ -712,6 +783,33 @@ class GenerationEngine:
             self._copy_pages = jax.jit(
                 llama.copy_pages, donate_argnums=(0,), out_shardings=insert_out
             )
+            # host-tier spill/restore primitives (docs/KV_PAGING.md "Tiered
+            # KV").  The gather does NOT donate the cache — it is a read-only
+            # device->host copy off the hot path (the spill side); the write
+            # donates like every other cache mutation (the restore side: the
+            # upload is dispatched ahead of the slot's suffix prefill and the
+            # device stream orders them, so admission never blocks on it).
+            def _gather_pages(cache, idx):
+                return (
+                    jnp.take(cache.k, idx, axis=1),
+                    jnp.take(cache.v, idx, axis=1),
+                )
+
+            gather_out = (
+                (_replicated(mesh), _replicated(mesh)) if mesh is not None else None
+            )
+            self._gather_pages = jax.jit(_gather_pages, out_shardings=gather_out)
+
+            def _write_pages(cache, idx, k, v):
+                return llama.PagedKVCache(
+                    k=cache.k.at[:, idx].set(k.astype(cache.k.dtype)),
+                    v=cache.v.at[:, idx].set(v.astype(cache.v.dtype)),
+                    lengths=cache.lengths,
+                )
+
+            self._write_pages = jax.jit(
+                _write_pages, donate_argnums=(0,), out_shardings=insert_out
+            )
             self._insert_prefix = self._extract_prefix = None
         else:
             self._insert = jax.jit(
@@ -744,6 +842,7 @@ class GenerationEngine:
                 llama.extract_prefix, static_argnums=(2,), out_shardings=extract_out
             )
             self._copy_pages = None
+            self._gather_pages = self._write_pages = None
 
     def _make_activate(self, json_mode: bool):
         """Build the jitted activation: mask (JSON), sample the first token per
@@ -1395,7 +1494,13 @@ class GenerationEngine:
         if self.prefix_cache_size <= 0 or prefix_len < self.prefix_min_tokens:
             return False
         if self.paged:
-            return self._kv_pool.holds_prefix(prompt_ids, prefix_len)
+            if self._kv_pool.holds_prefix(prompt_ids, prefix_len):
+                return True
+            # a host/disk-tier copy is still a reason to route here: the
+            # restore costs an upload, not a prefill
+            return self._kv_host is not None and self._kv_host.holds(
+                prompt_ids, prefix_len
+            )
         n = len(prompt_ids)
         try:
             for key, ent in list(self._prefix_lru.items()):
@@ -1404,6 +1509,89 @@ class GenerationEngine:
         except RuntimeError:  # dict resized mid-scan (engine thread won)
             return False
         return False
+
+    # ------------------------------------------------------- host KV tier
+    @property
+    def kv_host_tier(self):
+        """The engine's host-DRAM KV tier (None when tiering is off) — the
+        router's scale-down migration exports/imports through this."""
+        return self._kv_host
+
+    def _drop_restore_inflight(self, req: _Request) -> None:
+        if req.restored_from_host:
+            req.restored_from_host = False
+            self._kv_restores_inflight = max(0, self._kv_restores_inflight - 1)
+
+    def _fetch_pages_host(self, pages: Sequence[int]):
+        """Device->host copy of whole pages (``[L, n, KH, page, D]`` x2) —
+        the spill side of the tier.  Engine-thread-only (the cache is
+        engine-thread-owned); called from the allocator's eviction/
+        write-through paths, which run under admission, never under the
+        decode hot path (dabtlint DABT104 stays at 0 findings)."""
+        if not pages:
+            return None
+        with self._mesh_scope():
+            k, v = self._gather_pages(
+                self._cache, jnp.asarray(list(pages), jnp.int32)
+            )
+        return np.asarray(jax.device_get(k)), np.asarray(jax.device_get(v))
+
+    def _on_kv_tier_event(
+        self, event: str, key: tuple, length: int, pages: int
+    ) -> None:
+        """Every tier transition is a flight-recorder event, then forwards to
+        the fleet prefix registry's listener (router-owned).  Fired outside
+        the allocator/tier locks; thread-safe (engine thread for
+        spill/restore/register, router thread when a migration target
+        absorbs entries)."""
+        if self.obs is not None:
+            self.obs.flight.record(
+                "kv_tier",
+                op=event,
+                prefix_tokens=int(length),
+                pages=int(pages),
+            )
+        fn = self._prefix_listener
+        if fn is not None:
+            try:
+                fn(event, key, length, pages)
+            except Exception:
+                logger.exception("fleet prefix listener failed (%s)", event)
+
+    def set_prefix_listener(self, fn: Optional[Callable[..., None]]) -> None:
+        """Subscribe the router's fleet prefix registry to this engine's
+        tier-transition events (register/spill/restore/evict)."""
+        self._prefix_listener = fn
+
+    def spill_registered_to_host(self) -> int:
+        """Force a host copy of every device-registry entry that lacks one —
+        the scale-down migration's export step (a cheap ``has()`` sweep when
+        write-through already mirrored everything, which is the default).
+        Takes ``_iter_lock`` so the page gather cannot interleave with a loop
+        iteration (the probe_decode discipline); resolves no futures under
+        it.  Returns how many entries were newly spilled."""
+        if not self.paged or self._kv_host is None:
+            return 0
+        n = 0
+        with self._iter_lock:
+            for key, ent in self._kv_pool.shared_entries():
+                if self._kv_host.has(key):
+                    continue
+                try:
+                    fetched = self._fetch_pages_host(ent.pages)
+                except Exception:
+                    # a dead/poisoned device mid-migration: the entry is
+                    # lost (counted by the router), migration continues —
+                    # charged to the same gauge as the evict/write-through
+                    # spill paths so telemetry counts every failed spill
+                    self._kv_pool.spill_failures += 1
+                    logger.exception("migration spill fetch failed")
+                    continue
+                if fetched is not None and self._kv_host.put(
+                    key, ent.length, *fetched
+                ):
+                    n += 1
+        return n
 
     # ---------------------------------------------------------------- internal
     def _free_slots(self) -> List[int]:
@@ -1580,7 +1768,22 @@ class GenerationEngine:
             return None
         if self.paged:
             hit = self._kv_pool.lookup(req.prompt_ids, req.prefix_len)
-            return self._paged_usable_hit(req, hit)
+            hit = self._paged_usable_hit(req, hit)
+            if hit is not None:
+                return hit
+            if self._kv_host is not None:
+                # HBM missed (evicted, or a pre-restart registration): the
+                # host tier may still hold the prefix — admission restores
+                # it into fresh pages instead of re-prefilling.  An HBM hit
+                # always wins over a host hit (no upload, no fresh pages).
+                ent = self._kv_host.lookup(
+                    req.prompt_ids,
+                    req.prefix_len,
+                    min_tokens=self.prefix_min_tokens,
+                )
+                if ent is not None:
+                    return self._paged_usable_hit(req, _HostHit(ent))
+            return None
         n = len(req.prompt_ids)
         best_key = None
         best: Optional[_Prefix] = None
@@ -1609,11 +1812,60 @@ class GenerationEngine:
             return None
         return hit
 
+    def _paged_admit_restore(self, slot: int, req: _Request, hit: _HostHit) -> bool:
+        """Host-tier restore admission: allocate the request's full page
+        demand, upload the spilled prefix K/V into the leading pages (async
+        dispatch — the device stream orders it ahead of the suffix prefill
+        that consumes those pages), and re-register the restored prefix so
+        later requests share it in HBM again.  False = out of pages (the
+        request stays queued, or retries as a full prefill)."""
+        page = self.kv_page_size
+        ent = hit.entry
+        demand_tokens = min(
+            len(req.prompt_ids) + req.max_tokens, self.max_seq_len
+        )
+        total = -(-demand_tokens // page)
+        pages = self._kv_pool.alloc(total)
+        if pages is None:
+            return False
+        t0 = self._clock()
+        prefix_pages = pages[: ent.pages]
+        with self._mesh_scope():
+            self._cache = self._write_pages(
+                self._cache,
+                jnp.asarray(prefix_pages, jnp.int32),
+                jnp.asarray(ent.k),
+                jnp.asarray(ent.v),
+            )
+        # re-register: the registry increfs the restored pages, so they
+        # outlive this request like any warm prefix.  Write-through skips
+        # the redundant device->host copy (the host tier already has it).
+        self._kv_pool.register(list(ent.key), ent.length, prefix_pages)
+        self.kv_restores += 1
+        self._kv_restores_inflight += 1
+        # the tier counts the serve HERE (not in lookup — a queued head
+        # re-runs the lookup every admission attempt) and LRU-touches
+        self._kv_host.note_restored(ent.key)
+        req.restored_from_host = True
+        # the host-visible restore cost: tier lookup was already paid; this
+        # window is host->device upload DISPATCH (the async-restore claim —
+        # the device overlaps the copy with whatever is in flight)
+        self._restore_s.append(self._clock() - t0)
+        self._on_kv_tier_event("restore", ent.key, ent.length, ent.pages)
+        self._slot_pages[slot] = pages
+        self._block_tables[slot, :] = self._kv_sentinel
+        self._block_tables[slot, : len(pages)] = pages
+        self._bt_dirty = True
+        return True
+
     def _paged_admit_slot(self, slot: int, req: _Request, hit) -> bool:
         """Reserve and wire pages for ``req`` in ``slot``: shared full prefix
         pages by reference (incref), the boundary page by copy-on-write clone,
-        everything else fresh from the pool.  False = the pool cannot place
+        everything else fresh from the pool.  A host-tier hit routes to
+        :meth:`_paged_admit_restore` instead.  False = the pool cannot place
         the request right now (it stays queued; pages free as slots finish)."""
+        if isinstance(hit, _HostHit):
+            return self._paged_admit_restore(slot, req, hit)
         page = self.kv_page_size
         demand_tokens = min(
             len(req.prompt_ids) + req.max_tokens, self.max_seq_len
@@ -1760,6 +2012,11 @@ class GenerationEngine:
                     break  # out of pages: the head waits for a slot to free
             taken = self._take_next(now)
             if taken is None:
+                # the peeked request vanished between peek and pop — if its
+                # admission already dispatched a restore, the pages free but
+                # the in-flight gauge must drop too (the restored prefix
+                # itself survives: it was re-registered)
+                self._drop_restore_inflight(req)
                 self._free_slot_pages(slot)
                 break
             if taken is not req:
@@ -1767,6 +2024,7 @@ class GenerationEngine:
                 # peeked request, or a concurrent enqueue re-ordered the fair
                 # share) — the POPPED request is the one that must be served;
                 # dropping it would leave its future unresolved forever
+                self._drop_restore_inflight(req)
                 self._free_slot_pages(slot)
                 req = taken
                 hit = self._prefix_lookup(req)
@@ -1828,6 +2086,9 @@ class GenerationEngine:
         if self.prefix_cache_size > 0 and req.prefix_len >= self.prefix_min_tokens:
             if hit is not None:
                 self.prefix_hits += 1
+                if isinstance(hit, _HostHit):
+                    # the warm-but-not-HBM subset: served via restore
+                    self.kv_host_hits += 1
             else:
                 self.prefix_misses += 1
 
@@ -1948,6 +2209,20 @@ class GenerationEngine:
                     jnp.zeros((1,), jnp.int32),
                     jnp.full((1,), self._kv_sentinel, jnp.int32),
                 )
+                if self._kv_host is not None:
+                    # host-tier spill/restore shapes for small page counts:
+                    # a serve-time restore of a 1-2 page prefix (the common
+                    # case) must not pay an XLA compile.  Gather-then-write
+                    # of page 0 onto itself is an identity write — safe on
+                    # the empty pre-start cache.
+                    for n_warm in (1, 2, 3, 4):
+                        if n_warm > self._kv_pool.n_pages:
+                            break
+                        idx = jnp.zeros((n_warm,), jnp.int32)
+                        wk, wv = self._gather_pages(self._cache, idx)
+                        self._cache = self._write_pages(
+                            self._cache, idx, wk, wv
+                        )
             elif self.prefix_cache_size > 0:
                 # prefix-cache path: suffix prefill per (batch, seq) bucket +
                 # the extract/insert copies per prefix bucket.  All warmup
@@ -2315,6 +2590,7 @@ class GenerationEngine:
             # the consumer vanished mid-prefill: abandon the remaining chunks
             self.reclaimed_slots += 1
             self.cancelled_slots += 1
+            self._drop_restore_inflight(st.request)
             self._free_slot_pages(st.slot)
             self._chunking = None
             return
@@ -2328,6 +2604,7 @@ class GenerationEngine:
                 st.request.future,
                 exc=DeadlineExceeded("deadline expired during chunked prefill"),
             )
+            self._drop_restore_inflight(st.request)
             self._free_slot_pages(st.slot)
             self._chunking = None
             return
@@ -2383,6 +2660,11 @@ class GenerationEngine:
         for slot, req in zip(slots, reqs):
             if req.started_at is None:  # chunked prefills set it at begin
                 req.started_at = now_started
+            if req.restored_from_host:
+                # the restore's consumer (the suffix prefill) is dispatched:
+                # the in-flight gauge drops here, where admission completes
+                req.restored_from_host = False
+                self._kv_restores_inflight = max(0, self._kv_restores_inflight - 1)
             self._slots[slot] = _Slot(request=req)
             self._temps[slot] = req.temperature
             self._top_ps[slot] = req.top_p
@@ -2495,6 +2777,26 @@ class GenerationEngine:
         out["kv_layout_effective"] = out["kv_layout"]
         if self.paged:
             out.update(self._kv_pool.stats())
+            if self._kv_host is not None:
+                # restore-side gauges (the tier's own spill/disk gauges ride
+                # in through the allocator's stats): counts, in-flight, and
+                # the host-visible restore-dispatch latency percentiles
+                out["kv_restores"] = self.kv_restores
+                out["kv_host_hits"] = self.kv_host_hits
+                out["kv_restores_inflight"] = self._kv_restores_inflight
+                # the engine thread appends concurrently; CPython's deque
+                # raises RuntimeError when a copy races an append, which
+                # must not fail a /metrics scrape mid-restore
+                for _ in range(4):
+                    try:
+                        restore = list(self._restore_s)
+                        break
+                    except RuntimeError:
+                        continue
+                else:
+                    restore = []
+                out["kv_restore_p50_ms"] = self._pctl_ms(restore, 0.50)
+                out["kv_restore_p95_ms"] = self._pctl_ms(restore, 0.95)
         else:
             out["prefix_entries"] = len(self._prefix_lru)
             out["prefix_bytes"] = self._prefix_bytes
@@ -3186,11 +3488,24 @@ class GenerationEngine:
             # crash-only discipline for the page plane too: every page back on
             # the free list, every block table unallocated, the registry
             # emptied (its pages were part of the poisoned lineage).  The
-            # device pool itself is rebuilt below with the rest.
+            # device pool itself is rebuilt below with the rest.  The HOST
+            # tier deliberately survives: its numpy copies were taken from a
+            # healthy pool (write-through at registration), so warmed
+            # sessions re-seed the fresh pool via restore on their next hit
+            # instead of paying a cold prefill — the durability contract
+            # docs/KV_PAGING.md "Tiered KV" chaos-tests.
             self._kv_pool.reset()
+            self._kv_restores_inflight = 0
             self._slot_pages = [[] for _ in range(self.max_slots)]
             self._block_tables[:] = self._kv_sentinel
             self._bt_dirty = True
+            if self.obs is not None and self._kv_host is not None:
+                hs = self._kv_host.stats()
+                self.obs.flight.record(
+                    "kv_tier_survives_restart",
+                    host_entries=hs["kv_host_entries"],
+                    disk_entries=hs["kv_disk_entries"],
+                )
         # a failure inside _activate_batch can leave a request both slotted
         # AND in _starting_batch — salvage each request once
         seen: set = set()

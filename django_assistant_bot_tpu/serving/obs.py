@@ -643,6 +643,19 @@ def render_prometheus(registry: Any) -> str:
         x.add("dabt_kv_pages_used", "gauge", "KV pool pages in use", kv.get("kv_pages_used"), lab)
         x.add("dabt_kv_pages_free", "gauge", "KV pool pages free", kv.get("kv_pages_free"), lab)
         x.add("dabt_kv_pages_total", "gauge", "KV pool size in pages", kv.get("kv_pages_total"), lab)
+        if "kv_host_entries" in kv:
+            # host/disk KV tier (docs/KV_PAGING.md "Tiered KV"): every tier
+            # transition is also a flight event; these are the scrape side
+            x.add("dabt_kv_tier_host_entries", "gauge", "warm prefixes resident in host DRAM", kv.get("kv_host_entries"), lab)
+            x.add("dabt_kv_tier_host_bytes", "gauge", "host-tier bytes in use", kv.get("kv_host_bytes"), lab)
+            x.add("dabt_kv_tier_host_pages", "gauge", "pages' worth of KV held in host DRAM", kv.get("kv_host_pages"), lab)
+            x.add("dabt_kv_tier_disk_entries", "gauge", "warm prefixes demoted to disk", kv.get("kv_disk_entries"), lab)
+            x.add("dabt_kv_tier_spills_total", "counter", "prefix entries spilled into the host tier", kv.get("kv_spills"), lab)
+            x.add("dabt_kv_tier_restores_total", "counter", "host-tier entries restored into HBM pages", kv.get("kv_restores"), lab)
+            x.add("dabt_kv_tier_restores_inflight", "gauge", "restores dispatched but not yet consumed by a prefill", kv.get("kv_restores_inflight"), lab)
+            x.add("dabt_kv_tier_restore_p95_seconds", "gauge", "p95 host-visible restore dispatch latency", (kv.get("kv_restore_p95_ms") or 0.0) / 1e3, lab)
+            x.add("dabt_kv_tier_dropped_total", "counter", "warm entries lost (budget/disk failure)", kv.get("kv_tier_dropped"), lab)
+            x.add("dabt_kv_tier_migrated_in_total", "counter", "entries absorbed from detaching replicas", kv.get("kv_migrated_in"), lab)
         spec = eng.spec_stats() if callable(getattr(eng, "spec_stats", None)) else None
         if spec is not None:
             x.add("dabt_spec_drafted_total", "counter", "speculative tokens drafted", spec["spec_drafted"], lab)
@@ -671,6 +684,16 @@ def render_prometheus(registry: Any) -> str:
             x.add("dabt_router_replicas_removed_total", "counter", "replicas drained and detached (scale-down)", rs.get("replicas_removed"), rlab)
             x.add("dabt_router_replica_restarts_total", "counter", "replica restarts (operator or drain-restart)", rs.get("replica_restarts"), rlab)
             x.add("dabt_router_affinity_hit_rate", "gauge", "prefix-affinity dispatch hit rate", rs["affinity_hit_rate"], rlab)
+            # fleet warm-state durability (scale-down migration; the
+            # pages_lost counter is the pre-migration visibility satellite)
+            x.add("dabt_kv_tier_pages_lost_at_detach_total", "counter", "warm KV pages dropped by replica detaches", rs.get("pages_lost_at_detach"), rlab)
+            x.add("dabt_kv_tier_pages_migrated_total", "counter", "warm KV pages migrated at scale-down", rs.get("pages_migrated"), rlab)
+            x.add("dabt_kv_tier_entries_migrated_total", "counter", "warm prefix entries migrated at scale-down", rs.get("entries_migrated"), rlab)
+            preg = rs.get("prefix_registry")
+            if preg:
+                x.add("dabt_kv_fleet_prefixes", "gauge", "distinct warm prefixes known fleet-wide", preg.get("prefixes"), rlab)
+                for tier in ("hbm", "host", "disk"):
+                    x.add("dabt_kv_fleet_holdings", "gauge", "fleet prefix-registry holdings by tier", preg.get(tier), {**rlab, "tier": tier})
             for rep_stats in rs["replicas"]:
                 plab = {"model": model, "replica": rep_stats["name"]}
                 x.add("dabt_replica_draining", "gauge", "replica drain flag", rep_stats["draining"], plab)

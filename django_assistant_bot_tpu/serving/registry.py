@@ -77,6 +77,20 @@ class ModelSpec:
     # (max_slots * max_seq_len / page_size) — raise max_slots past the legacy
     # count to actually bank the freed capacity as extra concurrency
     kv_pages: int = 0
+    # --- tiered KV durability (docs/KV_PAGING.md "Tiered KV") ---
+    # host-DRAM byte budget for spilled prefix K/V: > 0 arms the host tier —
+    # evicted/registered prefixes keep a host copy, admission restores them
+    # into fresh pages instead of re-prefilling, crash-only restarts and
+    # scale-down migrations preserve warm sessions.  0 = off (the bench's
+    # HBM-only A/B arm and the pre-tiering behavior).
+    kv_host_bytes: int = 0
+    # optional disk tier under this dir (host-tier evictions demote to .npz
+    # files instead of dropping); None also honors DABT_KV_SPILL_DIR
+    kv_spill_dir: Optional[str] = None
+    # copy every NEW registry entry down to the host tier at registration
+    # (one device->host page gather, off the hot path) — what makes warm
+    # state survive a crash-only restart; False spills only at eviction
+    kv_host_writethrough: bool = True
     # compile every (batch, seq) prefill/activation shape + decode ticks at
     # load time instead of on first traffic (GenerationEngine.warmup) — slower
     # boot, no multi-second serve-time compile stalls.  warmup_json also
@@ -254,6 +268,24 @@ class ModelRegistry:
                 f"model {name}: unknown kv_cache_dtype={spec.kv_cache_dtype!r}; "
                 f"expected one of {sorted(k for k in KV_CACHE_DTYPES if k)}"
             )
+        if spec.kv_host_bytes < 0:
+            raise ValueError(f"model {name}: kv_host_bytes must be >= 0")
+        if (spec.kv_host_bytes or spec.kv_spill_dir) and spec.kind == "encoder":
+            raise ValueError(
+                f"model {name}: kv_host_bytes/kv_spill_dir are decoder-only "
+                "(encoders have no KV cache)"
+            )
+        if (spec.kv_host_bytes or spec.kv_spill_dir) and spec.kv_layout == "legacy":
+            # not an error — kv_layout="legacy" is the documented one-flag
+            # paged rollback and must not force the operator to also unset
+            # the tiering knobs — but the engine only arms the host tier on
+            # the paged plane, so durability is OFF and that must be said
+            logger.warning(
+                "model %s: kv_host_bytes/kv_spill_dir have no effect with "
+                "kv_layout='legacy' — the host KV tier (spill/restore "
+                "durability) only runs on the paged plane",
+                name,
+            )
         if spec.replicas < 1:
             raise ValueError(f"model {name}: replicas must be >= 1")
         if spec.replicas > 1 and spec.kind == "encoder":
@@ -403,6 +435,9 @@ class ModelRegistry:
                     kv_layout=spec.kv_layout,
                     kv_page_size=spec.kv_page_size,
                     kv_pages=spec.kv_pages,
+                    kv_host_bytes=spec.kv_host_bytes,
+                    kv_spill_dir=spec.kv_spill_dir,
+                    kv_host_writethrough=spec.kv_host_writethrough,
                     scheduler=_build_sched(),
                     faults=_build_faults(i),
                     max_restarts=spec.max_restarts,

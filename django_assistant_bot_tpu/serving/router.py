@@ -66,14 +66,166 @@ import logging
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Callable, List, Optional, Sequence, Set
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
 from ..ai.providers.failover import CircuitBreaker
 from .engine import EngineUnavailable, GenerationEngine, _safe_resolve
+from .kv_pool import TIER_DISK, TIER_HBM, TIER_HOST
 from .obs import new_trace_id
 from .scheduler import SchedulerRejected
 
 logger = logging.getLogger(__name__)
+
+
+class FleetPrefixRegistry:
+    """Router-owned map of which replica holds which warm prefix, at which
+    tier — the fleet-level promotion of the per-replica ``holds_prefix`` peek
+    (docs/KV_PAGING.md "Tiered KV").
+
+    Fed by the engines' tier-transition events (register/spill/restore/
+    evict — :meth:`GenerationEngine.set_prefix_listener`), so it SURVIVES
+    what the per-replica peek cannot: a crash-only restart downgrades a
+    replica's entries from ``hbm`` to ``host`` (write-through kept the
+    bytes) instead of forgetting them, and a scale-down migration re-points
+    entries at the absorbing replica.  Affinity dispatch reads
+    :meth:`holders` instead of peeking N allocators per request.
+
+    Lock discipline: one leaf lock.  Event callbacks arrive from engine
+    threads (and the router thread during migration absorb) OUTSIDE every
+    engine/allocator/tier lock; readers are dispatch and stats threads.
+    Nothing is called out of this class while the lock is held."""
+
+    # event -> (tier, present-after-event)
+    _EVENTS = {
+        "register": (TIER_HBM, True),
+        "restore": (TIER_HBM, True),  # re-registered by the restore admit
+        "evict_spilled": (TIER_HBM, False),
+        "evict_dropped": (TIER_HBM, False),
+        "host_put": (TIER_HOST, True),
+        "disk_promote": (TIER_HOST, True),
+        "host_evict_disk": (TIER_HOST, False),
+        "host_evict_dropped": (TIER_HOST, False),
+        "host_put_too_large": (TIER_HOST, False),
+        "disk_drop": (TIER_DISK, False),
+    }
+    # host_evict_disk also ADDS the disk tier; disk_promote removes it
+    _RANK = {TIER_HBM: 0, TIER_HOST: 1, TIER_DISK: 2}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # key -> {replica_name -> set(tiers)}
+        self._entries: dict = {}
+        # first token -> set(keys): holders() only scans keys that can
+        # possibly prefix the prompt, so per-dispatch cost tracks the
+        # MATCHING warm set, not total fleet warm state
+        self._by_first: dict = {}
+
+    def _index_add_locked(self, key: tuple) -> None:
+        self._by_first.setdefault(key[0], set()).add(key)
+
+    def _index_drop_locked(self, key: tuple) -> None:
+        bucket = self._by_first.get(key[0])
+        if bucket is not None:
+            bucket.discard(key)
+            if not bucket:
+                del self._by_first[key[0]]
+
+    def on_event(self, replica: str, event: str, key: tuple, length: int) -> None:
+        tier_change = self._EVENTS.get(event)
+        if tier_change is None:
+            return
+        tier, present = tier_change
+        with self._lock:
+            holders = self._entries.setdefault(key, {})
+            self._index_add_locked(key)
+            tiers = holders.setdefault(replica, set())
+            if present:
+                tiers.add(tier)
+            else:
+                tiers.discard(tier)
+            if event == "host_evict_disk":
+                tiers.add(TIER_DISK)
+            elif event == "disk_promote":
+                tiers.discard(TIER_DISK)
+            if not tiers:
+                holders.pop(replica, None)
+            if not holders:
+                self._entries.pop(key, None)
+                self._index_drop_locked(key)
+
+    def drop_replica(self, replica: str) -> int:
+        """Forget every entry held only by ``replica`` (detach epilogue —
+        migrated entries were already re-pointed by the target's absorb
+        events).  Returns how many (key, replica) holdings dropped."""
+        n = 0
+        with self._lock:
+            for key in list(self._entries):
+                holders = self._entries[key]
+                if replica in holders:
+                    del holders[replica]
+                    n += 1
+                    if not holders:
+                        del self._entries[key]
+                        self._index_drop_locked(key)
+        return n
+
+    def holders(
+        self, prompt_ids: Sequence[int], prefix_len: int
+    ) -> Dict[str, str]:
+        """replica name -> best tier (``hbm`` < ``host`` < ``disk``) over
+        EVERY registered prefix of this prompt that replica holds — not just
+        the fleet-wide longest match.  Per-replica aggregation preserves the
+        old peek-every-allocator semantics: when the longest-prefix holder
+        is draining or unhealthy, a replica warm with a SHORTER prefix (an
+        earlier turn of the same session) still beats a cold one."""
+        if prefix_len <= 0:
+            return {}
+        n = len(prompt_ids)
+        if n == 0:
+            return {}
+        first = prompt_ids[0]
+        out: Dict[str, str] = {}
+        with self._lock:
+            # first-token bucket + O(1) last-token rejection before the
+            # O(ln) slice: this runs under the dispatch lock on EVERY
+            # routed request, so cost tracks the matching warm set, not
+            # total fleet warm state
+            for key in self._by_first.get(first, ()):
+                holders = self._entries.get(key)
+                if holders is None:
+                    continue
+                ln = len(key)
+                if (
+                    ln >= n
+                    or key[-1] != prompt_ids[ln - 1]
+                    or tuple(prompt_ids[:ln]) != key
+                ):
+                    continue
+                for rep, tiers in holders.items():
+                    if not tiers:
+                        continue
+                    tier = min(tiers, key=self._RANK.__getitem__)
+                    cur = out.get(rep)
+                    if cur is None or self._RANK[tier] < self._RANK[cur]:
+                        out[rep] = tier
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            per_tier = {TIER_HBM: 0, TIER_HOST: 0, TIER_DISK: 0}
+            holdings = 0
+            for holders in self._entries.values():
+                for tiers in holders.values():
+                    holdings += 1
+                    for t in tiers:
+                        per_tier[t] += 1
+            return {
+                "prefixes": len(self._entries),
+                "holdings": holdings,
+                "hbm": per_tier[TIER_HBM],
+                "host": per_tier[TIER_HOST],
+                "disk": per_tier[TIER_DISK],
+            }
 
 
 class _StreamShim:
@@ -252,6 +404,32 @@ class EngineRouter:
         self.replicas_added = 0
         self.replicas_removed = 0
         self.replica_restarts = 0
+        # --- durable warm state (docs/KV_PAGING.md "Tiered KV") -----------
+        # fleet-wide prefix registry: which replica holds which warm prefix,
+        # at which tier — affinity survives drains, restarts, scale-downs
+        self.prefix_registry = FleetPrefixRegistry()
+        # scale-down warm-state accounting: pages the fleet LOST at a
+        # detach (the satellite counter — visible even before migration
+        # lands a target) vs pages/entries migration preserved
+        self.pages_lost_at_detach = 0
+        self.pages_migrated = 0
+        self.entries_migrated = 0
+        self.detach_migrations = 0
+        for rep in self.replicas:
+            self._wire_replica(rep)
+
+    def _wire_replica(self, rep: "_Replica") -> None:
+        """Subscribe the fleet prefix registry to this replica's KV
+        tier-transition events (no-op for engines without the hook — stub
+        engines in tests)."""
+        setter = getattr(rep.engine, "set_prefix_listener", None)
+        if callable(setter):
+            name = rep.name
+            setter(
+                lambda event, key, length, pages, _n=name: (
+                    self.prefix_registry.on_event(_n, event, key, length)
+                )
+            )
 
     # engine.generate / generate_stream only touch self.tokenizer and
     # self.submit — both present here, so the router reuses them verbatim
@@ -293,14 +471,30 @@ class EngineRouter:
         prefix_len = state.kwargs.get("prefix_len", 0)
         state.holders = set()
         if prefix_len and len(cands) > 1:
-            holders = [
+            # the fleet registry answers in one lookup (and knows the TIER:
+            # an HBM holder beats a host/disk holder — zero-copy sharing vs
+            # a restore upload); the per-replica peek remains as a fallback
+            # for engines that emit no tier events (legacy layout, stubs)
+            tiers = self.prefix_registry.holders(state.prompt_ids, prefix_len)
+            hbm = [rep for rep in cands if tiers.get(rep.name) == TIER_HBM]
+            warm = [
                 rep
                 for rep in cands
-                if rep.engine.holds_prefix(state.prompt_ids, prefix_len)
+                if tiers.get(rep.name) in (TIER_HOST, TIER_DISK)
             ]
-            if holders:
-                state.holders = set(holders)
-                cands = holders + [rep for rep in cands if rep not in holders]
+            # peek every candidate the registry has NO answer for — not
+            # just the all-empty case: a non-event-emitting replica's warm
+            # state must stay visible even while event-emitting replicas
+            # hold (worse-tier) matches of the same session
+            for rep in cands:
+                if rep.name not in tiers and rep.engine.holds_prefix(
+                    state.prompt_ids, prefix_len
+                ):
+                    hbm.append(rep)
+            if hbm or warm:
+                state.holders = set(hbm) | set(warm)
+                rest = [rep for rep in cands if rep not in state.holders]
+                cands = hbm + warm + rest
         return cands
 
     def submit(
@@ -600,6 +794,7 @@ class EngineRouter:
         )
         if not getattr(engine, "_running", False):
             engine.start()
+        self._wire_replica(rep)
         obs = getattr(engine, "obs", None)
         if obs is not None:
             obs.flight.record("replica_added", replica=name)
@@ -609,14 +804,26 @@ class EngineRouter:
         logger.info("router: added replica %s (fleet=%d)", name, len(self.replicas))
         return name
 
-    def remove_replica(self, idx: int, *, deadline_s: float = 30.0, poll_s: float = 0.005) -> dict:
+    def remove_replica(
+        self,
+        idx: int,
+        *,
+        deadline_s: float = 30.0,
+        poll_s: float = 0.005,
+        migrate: bool = True,
+    ) -> dict:
         """Shrink the fleet by one replica: stop admitting to it, wait —
-        deadline-bounded — for its in-flight work, then stop and DETACH it
+        deadline-bounded — for its in-flight work, then MIGRATE its warm KV
+        state to a surviving replica's host tier, then stop and DETACH it
         (the autoscaler's scale-down actuator; drain-then-detach, no
         restart).  Safe against the replica dying mid-drain: a dead engine
         fails its in-flight work and reads idle, so the drain completes
-        instead of wedging — and the race leaves a flight-recorder artifact
-        carrying both the kill and this scale decision."""
+        instead of wedging — and because the migration export is a pure
+        host-memory snapshot (numpy copies, not device state), it still
+        lands even when the replica died under the drain.  Without
+        ``migrate`` (or without a host tier / a surviving target) the warm
+        state is DROPPED and charged to ``pages_lost_at_detach`` — the
+        scale-down-as-cache-wipe cost, now visible instead of silent."""
         with self._lock:
             if len(self.replicas) <= 1:
                 raise RuntimeError("cannot remove the last replica")
@@ -632,9 +839,15 @@ class EngineRouter:
             rep, deadline_s=deadline_s, poll_s=poll_s, tail="they fail on detach"
         )
         died = not rep.engine._running
+        # warm-state migration BEFORE stop(): the export snapshots host
+        # numpy (valid even if the engine died mid-drain — the race the
+        # lock witness covers); the device registry's not-yet-spilled
+        # entries are force-spilled while the engine object still exists
+        migration = self._migrate_warm_state(rep, migrate=migrate)
         # stop fails anything the deadline forced (token-less victims
         # re-route through their done-callbacks, same as a replica death)
         rep.engine.stop(drain_timeout_s=1.0)
+        self.prefix_registry.drop_replica(rep.name)
         with self._lock:
             if rep in self.replicas:
                 self.replicas.remove(rep)
@@ -644,6 +857,7 @@ class EngineRouter:
             "replica": rep.name,
             "died_mid_drain": died,
             **wait,
+            **migration,
         }
         if obs is not None:
             obs.flight.record("replica_removed", **report)
@@ -659,6 +873,144 @@ class EngineRouter:
             wait["drained"],
         )
         return report
+
+    def _migrate_warm_state(self, rep: "_Replica", *, migrate: bool) -> dict:
+        """Move the detaching replica's warm prefixes into a surviving
+        replica's host tier.  Returns the accounting block for the detach
+        report: entries/pages migrated vs lost.  Never raises — a scale-down
+        must complete even when the warm state cannot be saved."""
+        eng = rep.engine
+        pool = getattr(eng, "_kv_pool", None)
+        src_tier = getattr(eng, "kv_host_tier", None)
+        device_entries = pool.shared_keys() if pool is not None else []
+        out = {
+            "migrated_entries": 0,
+            "migrated_pages": 0,
+            "lost_entries": 0,
+            "lost_pages": 0,
+        }
+        lost_reason = None
+        if not migrate:
+            lost_reason = "migration disabled"
+        elif src_tier is None:
+            lost_reason = "no host tier on the detaching replica"
+        if lost_reason is None:
+            # entries the device registry holds that write-through never
+            # mirrored (writethrough=False): one last spill while the engine
+            # object is whole.  A dead device makes the fetch raise — the
+            # engine swallows it and those entries are charged as lost.
+            try:
+                eng.spill_registered_to_host()
+            except Exception:
+                logger.exception(
+                    "migration: device-registry spill failed on %s", rep.name
+                )
+            # the FULL export — host DRAM plus disk rows loaded back into
+            # memory (a prefix demoted to disk is still warm state; leaving
+            # it behind would wipe it silently, since the victim's disk
+            # namespace is swept on reuse).  Unreadable disk rows are
+            # charged lost below.
+            snapshot, unreadable = src_tier.export_all()
+            with self._lock:
+                others = [
+                    r
+                    for r in self.replicas
+                    if r is not rep
+                    and not r.draining
+                    and getattr(r.engine, "kv_host_tier", None) is not None
+                ]
+            others = [r for r in others if self._healthy(r)]
+            if not others:
+                lost_reason = "no surviving replica with a host tier"
+            else:
+                target = min(others, key=self._load)
+                # absorb() reports the snapshot keys the target RETAINS
+                # (host or its disk tier) — per-key accounting, because a
+                # put can be refused anywhere in the order (oversized
+                # entry) or evict an earlier import
+                retained = (
+                    set(target.engine.kv_host_tier.absorb(snapshot))
+                    if snapshot
+                    else set()
+                )
+                pages_by_key = {e.key: e.pages for e in snapshot}
+                out["migrated_entries"] = len(retained)
+                out["migrated_pages"] = sum(
+                    pg for key, pg in pages_by_key.items() if key in retained
+                )
+                # lost = export keys the target refused + disk rows whose
+                # file could not be read back + device-registry entries that
+                # never reached the export (spill failed / device died) —
+                # keyed per unique prefix so a key present in two tiers is
+                # charged once.  Accounted even when the export came back
+                # EMPTY (the dead-device + writethrough-off shape: the
+                # silent-wipe case pages_lost_at_detach exists to expose)
+                lost: Dict[tuple, int] = {
+                    key: pg
+                    for key, pg in pages_by_key.items()
+                    if key not in retained
+                }
+                for key, _ln, pg in unreadable:
+                    lost.setdefault(key, pg)
+                for key, _ln, pg in device_entries:
+                    if key not in pages_by_key:
+                        lost.setdefault(key, pg)
+                out["lost_entries"] = len(lost)
+                out["lost_pages"] = sum(lost.values())
+                if snapshot:
+                    with self._lock:
+                        self.detach_migrations += 1
+                        self.entries_migrated += out["migrated_entries"]
+                        self.pages_migrated += out["migrated_pages"]
+                    obs = getattr(eng, "obs", None)
+                    if obs is not None:
+                        obs.flight.record(
+                            "kv_migrate",
+                            from_replica=rep.name,
+                            to_replica=target.name,
+                            **out,
+                        )
+                    logger.info(
+                        "router: migrated %d warm prefix entries (%d pages) "
+                        "from %s to %s (%d lost)",
+                        out["migrated_entries"],
+                        out["migrated_pages"],
+                        rep.name,
+                        target.name,
+                        out["lost_entries"],
+                    )
+        if lost_reason is not None:
+            # the pre-migration bugfix half of the contract: a detach that
+            # discards warm state SAYS so — counter + flight event — instead
+            # of silently wiping the fleet's cache.  Count each UNIQUE
+            # prefix once: with write-through most device-registry entries
+            # also have a host copy, and summing both tiers would double
+            # the reported loss.
+            union: Dict[tuple, int] = {
+                key: pg for key, _, pg in device_entries
+            }
+            if src_tier is not None:
+                # warm_keys() spans host DRAM AND disk (no file reads) —
+                # a prefix demoted to disk is warm state being discarded
+                # just the same
+                for key, pg in src_tier.warm_keys():
+                    union.setdefault(key, pg)
+            out["lost_entries"] = len(union)
+            out["lost_pages"] = sum(union.values())
+            out["lost_reason"] = lost_reason
+        if out["lost_pages"]:
+            with self._lock:
+                self.pages_lost_at_detach += out["lost_pages"]
+            obs = getattr(eng, "obs", None)
+            if obs is not None:
+                obs.flight.record(
+                    "pages_lost_at_detach",
+                    replica=rep.name,
+                    pages=out["lost_pages"],
+                    entries=out["lost_entries"],
+                    reason=out.get("lost_reason", "budget/unsaved"),
+                )
+        return out
 
     # ---------------------------------------------------------------- drain
     def _replica_idle(self, rep: _Replica) -> bool:
@@ -883,7 +1235,14 @@ class EngineRouter:
                 "replicas_added": self.replicas_added,
                 "replicas_removed": self.replicas_removed,
                 "replica_restarts": self.replica_restarts,
+                "pages_lost_at_detach": self.pages_lost_at_detach,
+                "pages_migrated": self.pages_migrated,
+                "entries_migrated": self.entries_migrated,
+                "detach_migrations": self.detach_migrations,
             }
+        # fleet prefix registry block (its own leaf lock — never nested
+        # under the router lock)
+        out["prefix_registry"] = self.prefix_registry.stats()
         out["replicas"] = [
             {
                 "name": rep.name,

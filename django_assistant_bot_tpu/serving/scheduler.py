@@ -157,6 +157,7 @@ class RequestScheduler:
         self._kv_total = 0
         self._queued_kv_pages = 0
         self._spec_gauge_fn = None  # engine's spec_disabled gauge (bind_spec)
+        self._kv_tier_stats_fn = None  # host KV tier stats (bind_kv_tier)
         # queue-wait histogram (obs plane) for predictive admission; None
         # keeps the pure EMA model (bind_wait_hist).  The windowing state
         # (last rotation's raw-count mark + the completed previous window)
@@ -269,6 +270,17 @@ class RequestScheduler:
         running requests finish."""
         self._kv_available = available_fn
         self._kv_total = max(0, int(total_pages))
+        return self
+
+    def bind_kv_tier(self, stats_fn) -> "RequestScheduler":
+        """Wire the host/disk KV tier's stats into :meth:`stats` (the
+        ``bind_spec`` discipline: the gauge callable runs OUTSIDE this
+        scheduler's lock — it takes the tier's own lock).  Operators and the
+        autoscaler then read pool pressure (``queued_kv_pages``, sheds) and
+        warm-tier depth (``kv_tier.kv_host_entries`` / bytes) side by side:
+        a pool under pressure with a deep warm tier sheds *restorable* work,
+        one without sheds *unrecoverable* prefill."""
+        self._kv_tier_stats_fn = stats_fn
         return self
 
     def release_kv(self, pages: int) -> None:
@@ -624,9 +636,12 @@ class RequestScheduler:
     def stats(self) -> dict:
         """One JSON-able snapshot for /healthz and tick_stats."""
         waits = self.wait_stats()
-        # the engine-side gauge runs OUTSIDE the lock: it reads engine state
-        # (controller verdict, degradation band) and must not nest locks
+        # the engine-side gauges run OUTSIDE the lock: they read engine/tier
+        # state (controller verdict, host-tier ledger) and must not nest locks
         spec = self._spec_gauge_fn() if self._spec_gauge_fn is not None else None
+        kv_tier = (
+            self._kv_tier_stats_fn() if self._kv_tier_stats_fn is not None else None
+        )
         hist_q = self._hist_wait_q()
         with self._lock:
             return {
@@ -652,4 +667,5 @@ class RequestScheduler:
                 "cancelled_queued": dict(self.cancelled_queued),
                 "wait": waits,
                 **({"spec_disabled": spec} if spec is not None else {}),
+                **({"kv_tier": kv_tier} if kv_tier is not None else {}),
             }

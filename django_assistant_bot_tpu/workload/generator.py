@@ -39,6 +39,7 @@ from typing import Callable, Iterable, List, Optional, Sequence
 
 SHAPES = ("constant", "diurnal", "ramp", "burst")
 PRIORITIES = ("interactive", "background")
+KINDS = ("chat", "longctx", "session")
 
 
 @dataclasses.dataclass
@@ -51,14 +52,23 @@ class WorkloadRequest:
     t_s: float
     tenant: str = "default"
     priority: str = "interactive"
-    kind: str = "chat"  # "chat" | "longctx"
+    kind: str = "chat"  # "chat" | "longctx" | "session"
     prompt_tokens: int = 32
     max_tokens: int = 16
     prefix_len: int = 0
     seed: int = 0
+    # session-shaped traffic (kind == "session"): which multi-turn session
+    # this arrival belongs to and which turn it is.  All turns of a session
+    # share one `seed`, and turn k's prompt is the first `prompt_tokens` ids
+    # of the session's deterministic token stream — so turn k's prompt
+    # literally EXTENDS turn k-1's (prefix_len == the previous turn's full
+    # prompt length), the exact shape the prefix registry's longest-match
+    # and the host KV tier are built for.
+    session: str = ""
+    turn: int = 0
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "t_s": round(self.t_s, 6),
             "tenant": self.tenant,
             "priority": self.priority,
@@ -68,6 +78,10 @@ class WorkloadRequest:
             "prefix_len": self.prefix_len,
             "seed": self.seed,
         }
+        if self.session:
+            out["session"] = self.session
+            out["turn"] = self.turn
+        return out
 
     @classmethod
     def from_dict(cls, d: dict) -> "WorkloadRequest":
@@ -80,6 +94,8 @@ class WorkloadRequest:
             max_tokens=int(d.get("max_tokens", 16)),
             prefix_len=int(d.get("prefix_len", 0)),
             seed=int(d.get("seed", 0)),
+            session=str(d.get("session", "")),
+            turn=int(d.get("turn", 0)),
         )
 
 
@@ -115,6 +131,23 @@ class WorkloadConfig:
     # prefix_tokens (the system-prompt/RAG-block shape prefix affinity eats)
     prefix_frac: float = 0.5
     prefix_tokens: int = 16
+    # ---- session-shaped multi-turn traffic (ROADMAP item 6 remainder) ------
+    # sessions > 0 adds N seeded multi-turn sessions to the trace: each
+    # session starts inside [0, duration * session_start_frac], runs
+    # `session_turns` turns with per-turn think-times drawn from
+    # `session_think_s`, opens with a `session_prefix_tokens`-token system
+    # prefix, and grows by `session_body_tokens` per turn.  Turn k's prompt
+    # extends turn k-1's (prefix_len = the previous prompt's length), so a
+    # trace with many idle-between-turn sessions is exactly the "live KV >>
+    # HBM" shape the tiered KV plane (docs/KV_PAGING.md) is measured on.
+    sessions: int = 0
+    session_turns: Sequence[int] = (2, 5)
+    session_think_s: Sequence[float] = (1.0, 8.0)
+    session_prefix_tokens: Sequence[int] = (32, 96)
+    session_body_tokens: Sequence[int] = (8, 32)
+    session_max_tokens: Sequence[int] = (4, 16)
+    session_start_frac: float = 0.5
+    session_tenant: str = ""  # "" = spread over the tenant mixture
 
     def validate(self) -> "WorkloadConfig":
         if self.shape not in SHAPES:
@@ -127,6 +160,16 @@ class WorkloadConfig:
             v = getattr(self, frac_name)
             if not (0.0 <= v <= 1.0):
                 raise ValueError(f"{frac_name} must be within [0, 1]")
+        if self.sessions < 0:
+            raise ValueError("sessions must be >= 0")
+        if not (0.0 < self.session_start_frac <= 1.0):
+            raise ValueError("session_start_frac must be within (0, 1]")
+        if self.sessions:
+            lo, hi = _pair_f(self.session_think_s)
+            if lo < 0:
+                raise ValueError("session_think_s must be >= 0")
+            if int(self.session_turns[0]) < 1:
+                raise ValueError("session_turns must be >= 1")
         return self
 
 
@@ -172,7 +215,10 @@ class WorkloadGenerator:
         rng = random.Random(f"workload:{c.seed}")
         peak = self.peak_rate()
         out: List[WorkloadRequest] = []
+        if c.sessions:
+            out.extend(self.generate_sessions())
         if peak <= 0:
+            out.sort(key=lambda e: e.t_s)
             return out
         t = 0.0
         while True:
@@ -180,6 +226,7 @@ class WorkloadGenerator:
             # with rate(t)/peak — a non-homogeneous Poisson process
             t += rng.expovariate(peak)
             if t >= c.duration_s:
+                out.sort(key=lambda e: e.t_s)
                 return out
             if rng.random() >= self.rate_at(t) / peak:
                 continue
@@ -222,6 +269,55 @@ class WorkloadGenerator:
             )
 
 
+    def generate_sessions(self) -> List[WorkloadRequest]:
+        """The session-shaped half of the trace: ``cfg.sessions`` seeded
+        multi-turn dialogs with per-session think-times between turns and a
+        per-session shared prefix that GROWS turn over turn (turn k declares
+        turn k-1's full prompt as its cacheable prefix — the longest-match
+        shape the prefix registry serves).  Deterministic from ``cfg.seed``;
+        not sorted (``generate`` merges and sorts)."""
+        c = self.cfg
+        out: List[WorkloadRequest] = []
+        for i in range(c.sessions):
+            srng = random.Random(f"workload-session:{c.seed}:{i}")
+            session_seed = srng.randrange(1 << 31)
+            tenant = c.session_tenant or (
+                "tenant0"
+                if c.tenants == 1 or srng.random() < c.hot_tenant_frac
+                else f"tenant{srng.randrange(1, c.tenants)}"
+            )
+            turns = srng.randint(*_pair(c.session_turns))
+            t = srng.uniform(0.0, c.duration_s * c.session_start_frac)
+            prompt_tokens = srng.randint(*_pair(c.session_prefix_tokens))
+            prev_len = 0
+            for k in range(turns):
+                if k > 0:
+                    t += srng.uniform(*_pair_f(c.session_think_s))
+                    prompt_tokens += srng.randint(*_pair(c.session_body_tokens))
+                if t >= c.duration_s:
+                    break
+                out.append(
+                    WorkloadRequest(
+                        t_s=round(t, 6),
+                        tenant=tenant,
+                        priority="interactive",
+                        kind="session",
+                        prompt_tokens=prompt_tokens,
+                        max_tokens=srng.randint(*_pair(c.session_max_tokens)),
+                        # turn 0 declares its whole opening prompt (the
+                        # system prefix) cacheable; later turns declare the
+                        # previous turn's full prompt — what the engine
+                        # registered after that turn's prefill
+                        prefix_len=prev_len if k else prompt_tokens,
+                        seed=session_seed,
+                        session=f"s{c.seed}:{i}",
+                        turn=k,
+                    )
+                )
+                prev_len = prompt_tokens
+        return out
+
+
 def _pair(r: Sequence[int]):
     lo, hi = int(r[0]), int(r[1])
     if lo > hi:
@@ -229,11 +325,26 @@ def _pair(r: Sequence[int]):
     return lo, hi
 
 
+def _pair_f(r: Sequence[float]):
+    lo, hi = float(r[0]), float(r[1])
+    if lo > hi:
+        raise ValueError(f"range {r!r} has lo > hi")
+    return lo, hi
+
+
 def prompt_ids_for(req: WorkloadRequest, *, vocab: int = 255) -> List[int]:
     """Deterministic token ids for a trace request: requests sharing a
     ``prefix_len`` share the SAME leading tokens (so prefix caching and
     affinity see real reuse), the body is drawn from the request's own seed.
-    Ids stay within [1, vocab] — safe for the byte tokenizer."""
+    Ids stay within [1, vocab] — safe for the byte tokenizer.
+
+    Session requests (``kind == "session"``) draw from ONE deterministic
+    per-session token stream: turn k's prompt is the stream's first
+    ``prompt_tokens`` ids, so it extends every earlier turn's prompt exactly
+    — multi-turn history growth without storing the history in the trace."""
+    if req.session:
+        srng = random.Random(f"session-prompt:{req.seed}")
+        return [srng.randint(1, vocab) for _ in range(max(1, req.prompt_tokens))]
     prefix = [1 + (i % vocab) for i in range(req.prefix_len)]
     body_rng = random.Random(f"prompt:{req.seed}")
     body = [

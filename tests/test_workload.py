@@ -173,3 +173,85 @@ def test_replay_honors_stop_predicate():
     out = replay(ev, lambda e: n.append(1), clock=clock, sleep=clock.sleep,
                  stop=lambda: len(n) >= 3)
     assert len(out) == 3
+
+
+# ------------------------------------------------- session-shaped traffic
+def _session_cfg(**kw):
+    kw.setdefault("seed", 11)
+    kw.setdefault("duration_s", 120.0)
+    kw.setdefault("base_rps", 0.0)  # sessions only, unless a test adds load
+    kw.setdefault("sessions", 8)
+    return WorkloadConfig(**kw)
+
+
+def _by_session(trace):
+    out = {}
+    for e in trace:
+        if e.kind == "session":
+            out.setdefault(e.session, []).append(e)
+    for evs in out.values():
+        evs.sort(key=lambda e: e.turn)
+    return out
+
+
+def test_session_trace_deterministic_and_sorted():
+    a = WorkloadGenerator(_session_cfg()).generate()
+    b = WorkloadGenerator(_session_cfg()).generate()
+    assert [e.to_dict() for e in a] == [e.to_dict() for e in b]
+    assert a, "sessions must produce turns"
+    assert all(a[i].t_s <= a[i + 1].t_s for i in range(len(a) - 1))
+
+
+def test_session_turns_extend_previous_prompt_exactly():
+    """The tiered-KV trace contract: turn k's prompt ids literally extend
+    turn k-1's, and turn k declares the previous turn's FULL prompt as its
+    cacheable prefix — the longest-match shape the prefix registry and the
+    host tier restore serve."""
+    trace = WorkloadGenerator(_session_cfg()).generate()
+    sessions = _by_session(trace)
+    assert sessions
+    multi = [evs for evs in sessions.values() if len(evs) > 1]
+    assert multi, "at least one session must have several turns"
+    for evs in sessions.values():
+        prev_ids = None
+        for e in evs:
+            ids = prompt_ids_for(e)
+            assert len(ids) == e.prompt_tokens
+            if prev_ids is None:
+                # the opening turn declares its whole system prompt shareable
+                assert e.prefix_len == e.prompt_tokens
+            else:
+                assert ids[: len(prev_ids)] == prev_ids
+                assert e.prefix_len == len(prev_ids)
+            prev_ids = ids
+
+
+def test_session_think_times_within_config_range():
+    cfg = _session_cfg(session_think_s=(2.0, 5.0), session_turns=(3, 3),
+                       duration_s=1000.0)
+    trace = WorkloadGenerator(cfg).generate()
+    for evs in _by_session(trace).values():
+        for a, b in zip(evs, evs[1:]):
+            assert 2.0 <= b.t_s - a.t_s <= 5.0 + 1e-9
+
+
+def test_session_trace_jsonl_round_trip(tmp_path):
+    trace = WorkloadGenerator(_session_cfg(base_rps=1.0)).generate()
+    path = str(tmp_path / "sessions.jsonl")
+    save_trace(trace, path)
+    loaded = load_trace(path)
+    assert [e.to_dict() for e in loaded] == [e.to_dict() for e in trace]
+    # session fields survive; non-session lines stay field-compatible
+    kinds = {e.kind for e in loaded}
+    assert "session" in kinds
+
+
+def test_session_config_validation():
+    with pytest.raises(ValueError):
+        WorkloadConfig(sessions=-1).validate()
+    with pytest.raises(ValueError):
+        WorkloadConfig(sessions=2, session_think_s=(-1.0, 2.0)).validate()
+    with pytest.raises(ValueError):
+        WorkloadConfig(sessions=2, session_turns=(0, 2)).validate()
+    with pytest.raises(ValueError):
+        WorkloadConfig(sessions=1, session_start_frac=0.0).validate()
