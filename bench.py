@@ -2231,6 +2231,171 @@ print(json.dumps(bench.bench_kv_tier()))
 """
 
 
+def bench_taskplane() -> dict:
+    """taskplane_* section (tasks/queue.py + bot delivery ledger evidence):
+    exactly-once-effect bot delivery under a mid-answer worker kill, A/B'd
+    against the seed at-least-once plane on the SAME pinned update trace.
+
+    The trace: 6 "dialogs", each answering with 4 parts through the REAL
+    `_post_answer` delivery path into a recording platform.  Mid-trace the
+    ``task_worker_lost`` chaos site kills the worker right after a part is
+    delivered (exact fire-on-Nth schedule — deterministic, not flaky); lease
+    expiry + reclaim re-dispatch the task.  Arm A (ledger ON, the shipped
+    plane): every part must reach the user exactly once.  Arm B (ledger OFF —
+    the seed behavior): the re-execution re-posts everything it already sent,
+    which is the duplicate the ledger exists to kill.  Recovery time is
+    kill → the killed task's completion (lease wait + re-run), and the DLQ
+    must stay empty (worker loss is transient, not poison)."""
+    import tempfile
+
+    from django_assistant_bot_tpu.bot.domain import (
+        BotPlatform,
+        MultiPartAnswer,
+        SingleAnswer,
+    )
+    from django_assistant_bot_tpu.bot.tasks import _post_answer
+    from django_assistant_bot_tpu.serving.faults import (
+        FaultInjector,
+        reset_global_injector,
+        set_global_injector,
+    )
+    from django_assistant_bot_tpu.storage import db as dbmod
+    from django_assistant_bot_tpu.tasks.queue import TaskRecord, Worker, queue_stats, task
+
+    N_DIALOGS, N_PARTS = 6, 4
+    LEASE_S = 0.4
+
+    class BenchPlatform(BotPlatform):
+        def __init__(self):
+            self.posted = []
+
+        @property
+        def codename(self):
+            return "bench"
+
+        async def get_update(self, request):
+            raise NotImplementedError
+
+        async def post_answer(self, chat_id, answer):
+            self.posted.append((chat_id, answer.text))
+
+        async def action_typing(self, chat_id):
+            pass
+
+    platform_box: dict = {}
+    ledger_box = {"on": True}
+
+    @task(queue="bench_tp", max_retries=3, retry_delay=0.05, name="bench.taskplane_deliver")
+    def bench_deliver(scope, n_parts):
+        answer = MultiPartAnswer(
+            parts=[SingleAnswer(text=f"{scope}/part{i}") for i in range(n_parts)]
+        )
+        asyncio.run(
+            _post_answer(
+                platform_box["p"],
+                scope,
+                answer,
+                ledger_scope=scope if ledger_box["on"] else None,
+            )
+        )
+
+    def run_arm(use_ledger: bool) -> dict:
+        """One fresh-DB replay of the pinned trace with a kill mid-answer."""
+        tmp = tempfile.mkdtemp(prefix="dabt-bench-tp-")
+        prev_db = os.environ.get("DABT_DB_PATH")
+        os.environ["DABT_DB_PATH"] = os.path.join(tmp, "tasks.sqlite3")
+        dbmod.reset_default_database()
+        platform_box["p"] = BenchPlatform()
+        ledger_box["on"] = use_ledger
+        # the worker_lost site is consulted once pre-body + once per delivered
+        # part (5/task): calls 1-10 are dialogs 0-1, call 11 is dialog 2's
+        # pre-body, 12-13 its parts 0-1 — so call 13 kills the worker
+        # MID-ANSWER with parts 0-1 already sent and dialogs 3-5 queued
+        # behind; reclaim + re-dispatch must finish the whole trace
+        inj = FaultInjector({"task_worker_lost": {"fire_on": [13]}})
+        set_global_injector(inj)
+        try:
+            records = [
+                bench_deliver.delay(f"dlg{i}", N_PARTS) for i in range(N_DIALOGS)
+            ]
+            w = Worker(
+                ["bench_tp"], poll_s=0.01, lease_s=LEASE_S, concurrency=1
+            ).start()
+            try:
+                deadline = time.time() + 60.0
+                while time.time() < deadline:
+                    statuses = {
+                        r.refresh().status for r in records
+                    }
+                    if statuses <= {"done", "dead"}:
+                        break
+                    time.sleep(0.05)
+            finally:
+                w.stop(timeout_s=5.0)
+            fault_at = inj.last_fire_at("task_worker_lost")
+            recovery = None
+            if fault_at is not None:
+                recovery = time.monotonic() - fault_at  # bounded by the poll above
+            posted = platform_box["p"].posted
+            from collections import Counter
+
+            counts = Counter(text for _, text in posted)
+            expected = {f"dlg{i}/part{j}" for i in range(N_DIALOGS) for j in range(N_PARTS)}
+            dup_posts = sum(n - 1 for n in counts.values() if n > 1)
+            missing = len(expected - set(counts))
+            exactly_once = sum(
+                1 for k in expected if counts.get(k, 0) == 1
+            ) / len(expected)
+            stats = queue_stats()
+            wstats = w.stats()
+            return {
+                "exactly_once_frac": round(exactly_once, 4),
+                "duplicates": dup_posts,
+                "missing": missing,
+                "dlq": stats["dlq_size"],
+                "reclaimed": wstats["reclaimed_leases"],
+                "retries": wstats["retries"],
+                "kills": wstats["worker_lost_aborts"],
+                "recovery_s": round(recovery, 3) if recovery is not None else None,
+                "done": TaskRecord.objects.filter(status="done").count(),
+            }
+        finally:
+            reset_global_injector()
+            if prev_db is None:
+                os.environ.pop("DABT_DB_PATH", None)
+            else:
+                os.environ["DABT_DB_PATH"] = prev_db
+            dbmod.reset_default_database()
+
+    ledger = run_arm(use_ledger=True)
+    seedlike = run_arm(use_ledger=False)
+    # recovery_s from the arm loop is an upper bound (includes the final poll
+    # interval); the dominant term is the lease wait, which is the honest cost
+    # of a worker death — report it next to the lease so it is interpretable
+    return {
+        "taskplane_exactly_once_frac": ledger["exactly_once_frac"],
+        "taskplane_duplicates": ledger["duplicates"],
+        "taskplane_missing": ledger["missing"],
+        "taskplane_dlq": ledger["dlq"],
+        "taskplane_reclaimed": ledger["reclaimed"],
+        "taskplane_kills": ledger["kills"],
+        "taskplane_recovery_s": ledger["recovery_s"],
+        "taskplane_lease_s": LEASE_S,
+        "taskplane_done": ledger["done"],
+        "taskplane_baseline_exactly_once_frac": seedlike["exactly_once_frac"],
+        "taskplane_baseline_duplicates": seedlike["duplicates"],
+        "taskplane_baseline_dlq": seedlike["dlq"],
+        "taskplane_trace": f"{N_DIALOGS} dialogs x {N_PARTS} parts, 1 worker kill mid-answer",
+    }
+
+
+_TASKPLANE_SNIPPET = """
+import json
+import bench
+print(json.dumps(bench.bench_taskplane()))
+"""
+
+
 def bench_obs() -> dict:
     """obs_* section (serving/obs.py evidence): the observability plane's two
     claims.  (1) Tracing + metric recording on the decode path costs within
@@ -3097,6 +3262,12 @@ _COMPACT_KEYS = (
     "kv_tier_restart_ttft_p50_hbm_only_s",
     "kv_tier_detach_pages_lost_migrate_on",
     "kv_tier_detach_pages_lost_migrate_off",
+    "taskplane_exactly_once_frac",
+    "taskplane_duplicates",
+    "taskplane_baseline_exactly_once_frac",
+    "taskplane_baseline_duplicates",
+    "taskplane_recovery_s",
+    "taskplane_dlq",
     "obs_overhead_frac",
     "obs_ab_noise_frac",
     "obs_scrape_ms",
@@ -3204,6 +3375,7 @@ def main() -> None:
         extras.update(bench_router())
         extras.update(bench_autoscale())
         extras.update(bench_kv_tier())
+        extras.update(bench_taskplane())
         extras.update(bench_obs())
         extras.update(bench_stream())
         baseline_thread.join(timeout=600)
@@ -3274,6 +3446,11 @@ def main() -> None:
     #        (live KV >> HBM), plus restart-survival and scale-down
     #        migration probes (serving/kv_pool.py host tier evidence)
     run("kv_tier", _KV_TIER_SNIPPET, cap_s=500)
+    # 3c'''c) taskplane: exactly-once-effect bot delivery — ledger vs the seed
+    #        at-least-once plane under a mid-answer worker kill on the same
+    #        pinned trace (tasks/queue.py + bot delivery ledger evidence;
+    #        CPU-only, no engine)
+    run("taskplane", _TASKPLANE_SNIPPET, cap_s=200)
     # 3c''') obs: tracing+metrics decode-throughput A/B (must be within
     #        noise) + /metrics scrape cost and exposition validity against a
     #        known trace (serving/obs.py evidence)

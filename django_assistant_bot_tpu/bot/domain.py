@@ -110,6 +110,10 @@ class Update:
     user: Optional[User] = None
     callback_query: Optional[CallbackQuery] = None
     phone_number: Optional[str] = None
+    # the platform's own delivery id (Telegram update_id): ingestion dedups
+    # webhook/polling redeliveries on it, and the answer-delivery ledger keys
+    # the turn's idempotency scope on it (None: pre-ledger payloads round-trip)
+    update_id: Optional[int] = None
 
     def to_dict(self) -> Dict:
         res = dataclasses.asdict(self)

@@ -31,6 +31,33 @@ class TelegramBadRequest(TelegramAPIError):
     """400 — e.g. "Can't parse entities" for broken MarkdownV2."""
 
 
+class TelegramRetryAfter(TelegramAPIError):
+    """429 flood control.  ``retry_after_s`` carries the pacing Telegram sent
+    in ``parameters.retry_after`` — the task plane maps it to ``RetryLater``
+    so the queue retries exactly when the platform asked, not on its own
+    backoff schedule."""
+
+    def __init__(self, status: int, description: str, retry_after_s: Optional[float] = None):
+        super().__init__(status, description)
+        self.retry_after_s = retry_after_s
+
+
+def _raise_for_error(data: Dict) -> None:
+    """Map a Telegram error payload to the typed exception ladder."""
+    desc = data.get("description", "")
+    code = data.get("error_code", 0)
+    if code == 403:
+        raise TelegramForbidden(code, desc)
+    if code == 400:
+        raise TelegramBadRequest(code, desc)
+    if code == 429:
+        retry_after = (data.get("parameters") or {}).get("retry_after")
+        raise TelegramRetryAfter(
+            code, desc, float(retry_after) if retry_after is not None else None
+        )
+    raise TelegramAPIError(code, desc)
+
+
 class TelegramAPI:
     def __init__(self, token: str, base_url: str = "https://api.telegram.org", timeout_s: float = 60.0):
         self.token = token
@@ -46,13 +73,7 @@ class TelegramAPI:
             async with session.post(self._url(method), json=payload) as resp:
                 data = await resp.json(content_type=None)
         if not data.get("ok"):
-            desc = data.get("description", "")
-            code = data.get("error_code", 0)
-            if code == 403:
-                raise TelegramForbidden(code, desc)
-            if code == 400:
-                raise TelegramBadRequest(code, desc)
-            raise TelegramAPIError(code, desc)
+            _raise_for_error(data)
         return data["result"]
 
     async def send_message(
@@ -87,13 +108,7 @@ class TelegramAPI:
             async with session.post(self._url("sendAudio"), data=form) as resp:
                 data = await resp.json(content_type=None)
         if not data.get("ok"):
-            code = data.get("error_code", 0)
-            desc = data.get("description", "")
-            if code == 403:
-                raise TelegramForbidden(code, desc)
-            if code == 400:
-                raise TelegramBadRequest(code, desc)
-            raise TelegramAPIError(code, desc)
+            _raise_for_error(data)
         return data["result"]
 
     async def edit_message_text(

@@ -89,6 +89,7 @@ class TelegramBotPlatform(BotPlatform):
             message_id = callback["message"]["message_id"]
             text = callback.get("data")
 
+        raw_update_id = data.get("update_id")
         return Update(
             chat_id=str(chat_id),
             message_id=message_id,
@@ -96,6 +97,8 @@ class TelegramBotPlatform(BotPlatform):
             photo=photo,
             user=user,
             phone_number=phone_number,
+            # carried for ingestion dedup + the delivery ledger's turn scope
+            update_id=int(raw_update_id) if raw_update_id is not None else None,
         )
 
     async def get_update(self, request: Any) -> Update:

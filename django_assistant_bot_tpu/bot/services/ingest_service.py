@@ -56,9 +56,17 @@ def ingest_update(
     )
     record = None
     if enqueue:
-        from ..tasks import answer_task
+        from ..tasks import answer_task, mark_update_ingested, update_already_ingested
 
-        record = answer_task.delay(
-            bot_codename, dialog.id, platform_codename, update.to_dict()
-        )
+        # webhook redeliveries / polling overlap carry the same platform
+        # update_id: the message upsert above is idempotent either way, but a
+        # second answer_task would answer the user twice.  Order matters:
+        # enqueue FIRST, mark seen AFTER — a crash in between means the
+        # redelivery enqueues again (defused by the shared delivery-ledger
+        # scope), whereas marking first could drop the message forever.
+        if not update_already_ingested(platform_codename, bot_codename, update.update_id):
+            record = answer_task.delay(
+                bot_codename, dialog.id, platform_codename, update.to_dict()
+            )
+            mark_update_ingested(platform_codename, bot_codename, update.update_id)
     return dialog, record

@@ -4,17 +4,50 @@
 Update, take the per-instance advisory lock, run the engine, deliver the answer,
 roll up costs; Forbidden delivery marks the instance unavailable.
 ``send_answer_task`` delivers one pre-built answer (broadcasting uses it).
+
+Exactly-once-effect delivery (docs/RESILIENCE.md "Task plane"): the queue is
+at-least-once, so this module makes *re-execution* safe instead of pretending
+it never happens:
+
+- every answer part is recorded in the :class:`~...storage.models.
+  DeliveredPart` ledger BEFORE its platform POST and marked ``sent`` after —
+  a re-executed task (worker loss, lease expiry) skips parts the user
+  already received;
+- a completed turn writes a ``part=-1`` marker, so a replay of a fully
+  delivered turn skips the whole pipeline (no second LLM spend, no duplicate
+  history append);
+- transient delivery and AI-provider errors RE-RAISE so the queue's retry
+  policy owns them (a log line is not a retry policy); platform flood
+  control (``retry_after``-shaped errors) maps to
+  :class:`~...tasks.queue.RetryLater`; undecodable payloads raise
+  :class:`~...tasks.queue.PermanentTaskError` straight to the DLQ.
 """
 
 from __future__ import annotations
 
 import asyncio
+import datetime as _dt
 import logging
-from typing import Dict, Optional
+import time
+from typing import Dict, Optional, Tuple
 
 from ..storage.locks import InstanceLockAsync
-from ..storage.models import Bot as BotModel, BotUser, Dialog, Instance, Message
-from ..tasks.queue import CeleryQueues, task
+from ..storage.models import (
+    Bot as BotModel,
+    BotUser,
+    DeliveredPart,
+    Dialog,
+    Instance,
+    Message,
+)
+from ..storage.orm import DoesNotExist
+from ..tasks.queue import (
+    CeleryQueues,
+    PermanentTaskError,
+    RetryLater,
+    current_task,
+    task,
+)
 from .domain import (
     Answer,
     BotPlatform,
@@ -26,6 +59,62 @@ from .domain import (
 from .utils import get_bot_class, get_bot_platform
 
 logger = logging.getLogger(__name__)
+
+# ledger part index marking a turn as fully delivered + stored
+TURN_COMPLETE_PART = -1
+# ledger part index carrying the serialized Answer: persisted BEFORE any part
+# posts, so a partial-delivery replay re-delivers the SAME answer instead of
+# splicing a fresh LLM generation onto parts the user already received
+ANSWER_SNAPSHOT_PART = -2
+
+# ledger retention: dedup/idempotency only has to outlive the platform's
+# redelivery horizon (Telegram retries for well under a day); pruned lazily
+# from the ingestion path at most once per hour
+LEDGER_TTL_S = 7 * 24 * 3600.0
+_PRUNE_INTERVAL_S = 3600.0
+_last_prune = [0.0]
+
+# module-level delivery counters, exported as dabt_queue_delivery_* on
+# /metrics via Worker.register_metrics (plain dict writes under the GIL —
+# these are honest-enough monotonic counters, not synchronization)
+DELIVERY_STATS: Dict[str, int] = {
+    "deduped_parts": 0,
+    "uncertain_parts_skipped": 0,
+    "turn_replays_skipped": 0,
+    "answer_replays_from_snapshot": 0,
+    "inbound_updates_deduped": 0,
+}
+
+
+def _task_injector():
+    """Chaos injector via the lazy discipline (tasks/queue.py): no jax-heavy
+    serving import unless chaos is armed."""
+    from ..tasks.queue import _task_fault_injector
+
+    return _task_fault_injector()
+
+
+def delivery_scope(dialog_id: int, upd: Update) -> str:
+    """The turn's idempotency scope.  Prefers the platform's own delivery id
+    (Telegram ``update_id`` — unique per delivery attempt family), falling
+    back to the chat-local ``message_id``."""
+    key = upd.update_id if upd.update_id is not None else upd.message_id
+    return f"answer:{dialog_id}:{key}"
+
+
+def _turn_complete(scope: str) -> bool:
+    return DeliveredPart.objects.filter(
+        scope=scope, part=TURN_COMPLETE_PART, state="sent"
+    ).exists()
+
+
+def _mark_turn_complete(scope: str) -> None:
+    row, _ = DeliveredPart.objects.get_or_create(
+        scope=scope, part=TURN_COMPLETE_PART, defaults={"state": "sent"}
+    )
+    if row.state != "sent":
+        row.state = "sent"
+        row.save()
 
 
 @task(queue=CeleryQueues.QUERY.value)
@@ -42,29 +131,84 @@ async def _answer_task(
     platform: Optional[BotPlatform] = None,
 ):
     upd: Update = Update.from_dict(update)
+    scope = delivery_scope(dialog_id, upd)
+    if _turn_complete(scope):
+        # re-execution of a fully delivered turn (worker died between
+        # delivery and the queue's done-transition): nothing left to do —
+        # re-running the LLM would append a second answer to history
+        DELIVERY_STATS["turn_replays_skipped"] += 1
+        logger.info("turn %s already delivered; skipping replay", scope)
+        return None
+    try:
+        dialog = Dialog.objects.get(id=dialog_id)
+    except DoesNotExist as e:
+        # retrying cannot resurrect a deleted dialog — DLQ, not retry burn
+        raise PermanentTaskError(f"dialog {dialog_id} no longer exists") from e
     platform = platform or get_bot_platform(bot_codename, platform_codename)
-    dialog = Dialog.objects.get(id=dialog_id)
 
     bot_cls = get_bot_class(bot_codename)
     bot = bot_cls(dialog=dialog, platform=platform)
 
-    async with InstanceLockAsync(dialog.instance):
-        dialog_ids = [
-            d.id for d in Dialog.objects.filter(instance=dialog.instance_id)
-        ]
-        message_count = (
-            Message.objects.filter(dialog__in=dialog_ids).limit(2).count()
-            if dialog_ids
-            else 0
-        )
-        if message_count <= 1:
-            await bot.on_instance_created()
-        answer = await bot.handle_update(upd)
+    def _snapshot_answer() -> Optional[Answer]:
+        row = DeliveredPart.objects.get_or_none(scope=scope, part=ANSWER_SNAPSHOT_PART)
+        if row is not None and row.payload:
+            return answer_from_dict(row.payload)
+        return None
+
+    answer = _snapshot_answer()
+    if answer is not None:
+        # partial-delivery replay: the turn's answer was already decided and
+        # persisted before the first POST — deliver THAT answer (the parts
+        # the user received and the parts still owed belong to one
+        # generation), with no second LLM spend
+        DELIVERY_STATS["answer_replays_from_snapshot"] += 1
+        logger.info("turn %s: re-delivering the persisted answer snapshot", scope)
+    else:
+        async with InstanceLockAsync(dialog.instance):
+            # re-check under the instance lock: a concurrent duplicate of this
+            # turn (webhook redelivered inside ingestion's check/mark window)
+            # may have decided the answer while we waited — generating again
+            # would deliver a SPLICE of two generations under one part ledger
+            answer = _snapshot_answer()
+            if answer is not None:
+                DELIVERY_STATS["answer_replays_from_snapshot"] += 1
+            else:
+                dialog_ids = [
+                    d.id for d in Dialog.objects.filter(instance=dialog.instance_id)
+                ]
+                message_count = (
+                    Message.objects.filter(dialog__in=dialog_ids).limit(2).count()
+                    if dialog_ids
+                    else 0
+                )
+                if message_count <= 1:
+                    await bot.on_instance_created()
+                # AI-provider errors propagate from here: the queue's retry
+                # policy owns transient backend failures, with backoff — not a
+                # log line
+                answer = await bot.handle_update(upd)
+                if answer:
+                    # persist the decided answer BEFORE any part posts: a
+                    # worker killed mid-delivery re-delivers these exact bytes
+                    row, created = DeliveredPart.objects.get_or_create(
+                        scope=scope,
+                        part=ANSWER_SNAPSHOT_PART,
+                        defaults={"state": "snapshot", "payload": answer.to_dict()},
+                    )
+                    if not created and row.payload:
+                        # lost a (lock-bypassing) race: the FIRST persisted
+                        # answer is the turn's answer — adopt it, never mix
+                        answer = answer_from_dict(row.payload)
+                else:
+                    # the turn decided "nothing to deliver": record that, so a
+                    # replay does not re-run the LLM to re-decide it
+                    _mark_turn_complete(scope)
 
     if answer:
         try:
-            await _post_answer(platform, upd.chat_id, answer)
+            await _post_answer(platform, upd.chat_id, answer, ledger_scope=scope)
             await bot.on_answer_sent(answer)
+            _mark_turn_complete(scope)
         except UserUnavailableError:
             logger.warning(
                 "user %s unavailable; marking instance %s",
@@ -74,19 +218,99 @@ async def _answer_task(
             instance = dialog.instance
             instance.is_unavailable = True
             instance.save()
-        except Exception as e:
-            logger.error("error while sending answer: %s", e)
+            # the turn is over (the user is gone) — a replay must not retry it
+            _mark_turn_complete(scope)
+        # every other delivery error re-raises: transient platform failures
+        # (timeouts, 5xx, flood control → RetryLater) belong to the queue's
+        # retry policy, and exhausted turns land in the DLQ with the dialog
+        # id recoverable via `cli queue dlq list`
     return None
 
 
-async def _post_answer(platform: BotPlatform, chat_id: str, answer: Answer) -> None:
+async def _post_answer(
+    platform: BotPlatform,
+    chat_id: str,
+    answer: Answer,
+    *,
+    ledger_scope: Optional[str] = None,
+) -> None:
+    """Deliver each part once.
+
+    With ``ledger_scope``, each part is claimed in the delivery ledger BEFORE
+    its platform POST and marked ``sent`` after:
+
+    - ``sent`` rows skip (a re-executed task never double-posts);
+    - a clean failure in our frame deletes the claim so the retry re-posts;
+    - an ``inflight`` row from a PREVIOUS execution means that worker died
+      inside the POST window — whether the user saw the message is unknowable,
+      and the policy is skip: a duplicated message to a real user is worse
+      than a rare lost part, and the platform POST window is microseconds
+      against an LLM-turn task (counted as ``uncertain_parts_skipped``).
+
+    Chaos sites (serving/faults.py): ``platform_http_429`` raises
+    :class:`RetryLater` (flood control), ``platform_http_5xx`` a transient
+    ``ConnectionError``, and ``task_worker_lost`` — consulted AFTER a
+    successful part POST, the exact window where the seed plane duplicated —
+    kills the worker mid-answer.
+    """
     parts = answer.parts if isinstance(answer, MultiPartAnswer) else [answer]
-    for part in parts:
+    inj = _task_injector()
+    for idx, part in enumerate(parts):
         if getattr(part, "already_delivered", False):
             # progressive streaming already posted + final-edited this part
             # in place; re-posting would duplicate the message
             continue
-        await platform.post_answer(chat_id, part)
+        if inj is not None:
+            flood_delay = inj.sleep_s("platform_http_429")
+            if flood_delay > 0.0:
+                raise RetryLater(flood_delay, "injected platform flood control")
+            if inj.should_fire("platform_http_5xx"):
+                raise ConnectionError("injected fault: platform_http_5xx")
+        row = None
+        if ledger_scope is not None:
+            row, created = DeliveredPart.objects.get_or_create(
+                scope=ledger_scope, part=idx, defaults={"state": "inflight"}
+            )
+            if not created:
+                if row.state == "sent":
+                    DELIVERY_STATS["deduped_parts"] += 1
+                    continue
+                DELIVERY_STATS["uncertain_parts_skipped"] += 1
+                logger.warning(
+                    "part %d of %s: previous worker died mid-POST; "
+                    "skipping to avoid a possible duplicate",
+                    idx,
+                    ledger_scope,
+                )
+                continue
+        try:
+            await platform.post_answer(chat_id, part)
+        except BaseException as e:
+            if getattr(e, "site", None) == "task_worker_lost":
+                # simulated worker death INSIDE the POST window: a real dead
+                # worker cannot release its claim, so neither do we — the
+                # re-execution sees the inflight row and skips (at-most-once
+                # inside the unknowable window)
+                raise
+            # the POST did not complete in OUR frame: release the claim so a
+            # retry re-posts this part instead of skipping it
+            if row is not None:
+                row.delete()
+            retry_after = getattr(e, "retry_after_s", None)
+            if retry_after is not None:
+                # platform flood control (TelegramRetryAfter et al.): retry
+                # on the platform's schedule, not ours
+                raise RetryLater(
+                    float(retry_after), f"platform flood control: {e}"
+                ) from e
+            raise
+        if row is not None:
+            row.state = "sent"
+            row.save()
+        if inj is not None:
+            # fires AFTER the part was delivered + recorded: the mid-answer
+            # worker kill the exactly-once ledger exists for
+            inj.maybe_raise("task_worker_lost")
 
 
 @task(queue=CeleryQueues.QUERY.value)
@@ -117,14 +341,99 @@ async def _send_answer_task(
     try:
         answer = answer_from_dict(answer_data)
     except Exception as e:
-        logger.error("could not deserialize answer: %s", e)
-        return
+        # undecodable payload: no retry can fix it — DLQ with the full trail,
+        # not a silently swallowed `return`
+        raise PermanentTaskError(f"could not deserialize answer: {e}") from e
+    # the queue invocation is the delivery identity for broadcast sends (one
+    # ledger scope per TaskRecord, so a re-executed send dedups its parts);
+    # direct/eager calls have no record and deliver unledgered — they run once
+    record = current_task()
+    scope = f"send:{record.id}" if record is not None and record.id is not None else None
     try:
-        await _post_answer(platform, chat_id, answer)
+        await _post_answer(platform, chat_id, answer, ledger_scope=scope)
     except UserUnavailableError:
         logger.warning("user %s became unavailable during send", chat_id)
         if instance:
             instance.is_unavailable = True
             instance.save()
-    except Exception as e:
-        logger.error("error sending answer to %s: %s", chat_id, e)
+    # transient delivery errors re-raise: the queue's retry/backoff/DLQ
+    # policy owns them
+
+
+def update_already_ingested(
+    platform_codename: str, bot_codename: str, update_id: Optional[int]
+) -> bool:
+    """True when this platform update id was already ingested (webhook
+    redelivery / polling overlap) — the caller must then NOT enqueue a second
+    answer_task.  Check-only: the caller marks the id AFTER enqueueing
+    (:func:`mark_update_ingested`), so a crash between check and enqueue
+    leaves NO dedup row and the platform's redelivery re-enqueues — a lost
+    message is unrecoverable, while the rare double-enqueue from that
+    ordering is defused by the delivery ledger (both tasks share one scope)."""
+    if update_id is None:
+        return False
+    from ..storage.models import SeenUpdate
+
+    row = SeenUpdate.objects.get_or_none(
+        platform=platform_codename,
+        bot_codename=bot_codename,
+        update_id=int(update_id),
+    )
+    if row is not None:
+        DELIVERY_STATS["inbound_updates_deduped"] += 1
+        logger.info(
+            "duplicate update %s for %s/%s; not re-enqueueing",
+            update_id,
+            bot_codename,
+            platform_codename,
+        )
+    return row is not None
+
+
+def mark_update_ingested(
+    platform_codename: str, bot_codename: str, update_id: Optional[int]
+) -> None:
+    """Record an ingested update id (idempotent)."""
+    if update_id is not None:
+        from ..storage.models import SeenUpdate
+
+        SeenUpdate.objects.get_or_create(
+            platform=platform_codename,
+            bot_codename=bot_codename,
+            update_id=int(update_id),
+        )
+
+
+def _maybe_prune_ledgers(now: Optional[float] = None, *, force: bool = False) -> int:
+    """TTL sweep over both ledgers, at most once per `_PRUNE_INTERVAL_S`
+    unless forced: dedup and replay protection only need to outlive the
+    platform's redelivery horizon, and unpruned per-message rows would grow
+    forever at fleet scale.  Runs from the WORKER's beat cadence
+    (:func:`prune_ledgers_task`), never the webhook request path; the
+    ``created_at`` index keeps the delete bounded by what actually expired."""
+    now = time.time() if now is None else now
+    if not force and now - _last_prune[0] < _PRUNE_INTERVAL_S:
+        return 0
+    _last_prune[0] = now
+    from ..storage.models import SeenUpdate
+
+    cutoff = _dt.datetime.fromtimestamp(now - LEDGER_TTL_S, _dt.timezone.utc)
+    pruned = DeliveredPart.objects.filter(created_at__lt=cutoff).delete()
+    pruned += SeenUpdate.objects.filter(created_at__lt=cutoff).delete()
+    if pruned:
+        logger.info("pruned %d expired delivery/dedup ledger rows", pruned)
+    return pruned
+
+
+@task(queue=CeleryQueues.QUERY.value, max_retries=0)
+def prune_ledgers_task():
+    """Beat-scheduled ledger maintenance (cli worker enqueues it hourly)."""
+    return _maybe_prune_ledgers(force=True)
+
+
+def delivery_ledger_state(scope: str) -> Tuple[int, bool]:
+    """(parts marked sent, turn complete) — operator/diagnostic helper."""
+    sent = DeliveredPart.objects.filter(scope=scope, state="sent").exclude(
+        part=TURN_COMPLETE_PART
+    ).count()
+    return sent, _turn_complete(scope)

@@ -1,19 +1,106 @@
-"""Queue inspection CLI (reference: assistant/admin/management/commands/queue.py:15-74)."""
+"""Queue inspection CLI (reference: assistant/admin/management/commands/queue.py:15-74).
+
+Adds the dead-letter workflow (docs/RESILIENCE.md "Task plane"):
+
+    dabt queue dlq list              # what died, why, and for which dialog
+    dabt queue dlq requeue --id 42   # one more chance (attempts reset)
+    dabt queue dlq requeue --all
+    dabt queue dlq purge
+    dabt queue stats                 # per-queue depth / oldest-pending age / DLQ
+"""
 
 from __future__ import annotations
 
 
 def add_parser(sub):
-    p = sub.add_parser("queue", help="list/clear/remove queued tasks")
-    p.add_argument("action", choices=("list", "clear", "remove"), nargs="?", default="list")
+    p = sub.add_parser("queue", help="list/clear/remove/stats/dlq for queued tasks")
+    p.add_argument(
+        "action",
+        choices=("list", "clear", "remove", "stats", "dlq"),
+        nargs="?",
+        default="list",
+    )
+    p.add_argument(
+        "subaction",
+        nargs="?",
+        default=None,
+        help="for dlq: list (default) | requeue | purge",
+    )
     p.add_argument("--queue", default=None, help="restrict to one queue")
-    p.add_argument("--id", type=int, default=None, help="task id (for remove)")
+    p.add_argument("--id", type=int, default=None, help="task id (remove / dlq requeue)")
     p.add_argument("--status", default=None, help="filter by status")
+    p.add_argument("--all", action="store_true", help="dlq requeue: every dead task")
     return p
 
 
+def _dialog_hint(t) -> str:
+    """Recover the dialog id from a dead answer task's payload so an operator
+    can correlate a DLQ row with the user turn it failed."""
+    if t.name.endswith("answer_task") and isinstance(t.args, list) and len(t.args) >= 2:
+        return f"dialog={t.args[1]}"
+    return ""
+
+
+def _run_dlq(args) -> int:
+    from ..tasks.queue import TaskRecord, _now_iso
+
+    sub = args.subaction or "list"
+    qs = TaskRecord.objects.filter(status="dead")
+    if args.queue:
+        qs = qs.filter(queue=args.queue)
+
+    if sub == "list":
+        rows = qs.order_by("id").all()
+        if not rows:
+            print("(dlq empty)")
+        for t in rows:
+            last_error = (t.error or "").strip().splitlines()[-1:] or [""]
+            print(
+                f"{t.id:6d}  {t.queue:12s}  {t.error_kind or '?':18s}  "
+                f"attempts={t.attempts}  {t.name}  {_dialog_hint(t)}  "
+                f"dead_at={t.dead_at or '?'}  | {last_error[0][:120]}"
+            )
+        return 0
+    if sub == "requeue":
+        if args.id is None and not args.all:
+            print("--id or --all required for dlq requeue")
+            return 1
+        if args.id is not None:
+            qs = qs.filter(id=args.id)
+        n = qs.update(
+            status="pending",
+            attempts=0,
+            error_kind=None,
+            dead_at=None,
+            eta=_now_iso(),
+            lease_owner=None,
+        )
+        print(f"requeued {n} task(s)")
+        return 0
+    if sub == "purge":
+        n = qs.delete()
+        print(f"purged {n} dead task(s)")
+        return 0
+    print(f"unknown dlq subaction {sub!r} (expected list|requeue|purge)")
+    return 1
+
+
 def run(args) -> int:
-    from ..tasks.queue import TaskRecord
+    from ..tasks.queue import TaskRecord, queue_stats
+
+    if args.action == "dlq":
+        return _run_dlq(args)
+    if args.action == "stats":
+        stats = queue_stats()
+        for q, s in sorted(stats["queues"].items()):
+            age = s["oldest_pending_age_s"]
+            print(
+                f"{q:12s}  pending={s['pending']:<5d} running={s['running']:<4d} "
+                f"done={s['done']:<6d} dead={s['dead']:<4d} "
+                f"oldest_pending_age_s={age if age is not None else '-'}"
+            )
+        print(f"dlq_size={stats['dlq_size']}")
+        return 0
 
     qs = TaskRecord.objects.all()
     if args.queue:

@@ -13,6 +13,14 @@ def add_parser(sub):
     p.add_argument("--queues", default=None, help="comma-separated queue names")
     p.add_argument("--concurrency", type=int, default=2)
     p.add_argument("--beat", action="store_true", help="also run periodic schedule")
+    p.add_argument(
+        "--lease-s", type=float, default=300.0,
+        help="lease duration; the executing worker heartbeats it (lease/3)",
+    )
+    p.add_argument(
+        "--drain-s", type=float, default=30.0,
+        help="graceful-drain deadline on shutdown (finish in-flight tasks)",
+    )
     return p
 
 
@@ -27,20 +35,44 @@ def run(args) -> int:
     except ImportError:
         broadcasting_tasks = None
 
-    queues = args.queues.split(",") if args.queues else None
-    worker = Worker(queues, concurrency=args.concurrency).start()
-    beat = None
-    if args.beat and broadcasting_tasks is not None:
-        from ..tasks import Beat
+    # dead-letter / worker-loss events land in a crash-artifact trail like the
+    # serving plane's; optional — a worker without the obs plane keeps running
+    flight = None
+    try:
+        from ..serving.obs import FlightRecorder
 
-        beat = Beat().add(broadcasting_tasks.check_scheduled_broadcasts, 30.0).start()
+        flight = FlightRecorder(name="task-worker")
+    except Exception:
+        logger.warning("serving.obs unavailable; no task flight recorder")
+
+    queues = args.queues.split(",") if args.queues else None
+    worker = Worker(
+        queues, concurrency=args.concurrency, lease_s=args.lease_s, flight=flight
+    ).start()
+    worker.register_metrics()
+    from ..tasks import Beat
+
+    # ledger TTL maintenance rides the worker's beat — never the webhook
+    # request path (the sweep is a delete over the created_at index)
+    beat = Beat().add(bot_tasks.prune_ledgers_task, 3600.0)
+    if args.beat and broadcasting_tasks is not None:
+        beat.add(broadcasting_tasks.check_scheduled_broadcasts, 30.0)
+    beat.start()
     print(f"worker started (queues={worker.queues}, concurrency={args.concurrency})")
     try:
         while True:
             time.sleep(1)
     except KeyboardInterrupt:
-        print("stopping...")
-        worker.stop()
+        print(f"draining (deadline {args.drain_s:g}s)...")
         if beat:
             beat.stop()
+        clean = worker.drain(timeout_s=args.drain_s)
+        worker.stop(timeout_s=1.0)
+        stats = worker.stats()
+        print(
+            "stopped"
+            + (" (drain deadline hit; leases will expire)" if not clean else "")
+            + f": done={stats['done']} retries={stats['retries']} "
+            f"dead_lettered={stats['dead_lettered']} reclaimed={stats['reclaimed_leases']}"
+        )
     return 0

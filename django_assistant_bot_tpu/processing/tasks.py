@@ -12,7 +12,7 @@ import asyncio
 import logging
 
 from ..storage.models import Document, WikiDocument, WikiDocumentProcessing
-from ..tasks.queue import CeleryQueues, group, task
+from ..tasks.queue import CeleryQueues, PermanentTaskError, group, task
 from .documents.processor import process_document
 from .wiki import split_wiki_document
 
@@ -26,8 +26,9 @@ def wiki_processing_task(wiki_document_id: int, **kwargs):
     logger.info("wiki processing task started for %s", wiki_document_id)
     wiki_document = WikiDocument.objects.get_or_none(id=wiki_document_id)
     if wiki_document is None:
-        logger.error("wiki document %s not found; aborting", wiki_document_id)
-        return
+        # a deleted source row is permanent: DLQ with the trail, not a silent
+        # return (and not 10 pointless retries)
+        raise PermanentTaskError(f"wiki document {wiki_document_id} not found")
     processing = asyncio.run(split_wiki_document(wiki_document))
     documents = Document.objects.filter(processing=processing).all()
     group(
@@ -40,7 +41,11 @@ def wiki_processing_task(wiki_document_id: int, **kwargs):
 @task(queue=CeleryQueues.PROCESSING.value, **_RETRY)
 def document_processing_task(document_id: int, **kwargs):
     logger.info("document processing task started for %s", document_id)
-    document = Document.objects.get(id=document_id)
+    document = Document.objects.get_or_none(id=document_id)
+    if document is None:
+        raise PermanentTaskError(f"document {document_id} not found")
+    # transient AI/backend errors inside process_document propagate: the
+    # queue's retry policy (backoff + DLQ) owns them
     asyncio.run(process_document(document))
     logger.info("document processing task finished for %s", document_id)
 
@@ -48,7 +53,9 @@ def document_processing_task(document_id: int, **kwargs):
 @task(queue=CeleryQueues.PROCESSING.value, **_RETRY)
 def finalize_document_processing_task(processing_id: int, **kwargs):
     logger.info("finalize processing task started for %s", processing_id)
-    processing = WikiDocumentProcessing.objects.get(id=processing_id)
+    processing = WikiDocumentProcessing.objects.get_or_none(id=processing_id)
+    if processing is None:
+        raise PermanentTaskError(f"processing {processing_id} not found")
     processing.status = WikiDocumentProcessing.COMPLETED
     processing.save()
     WikiDocumentProcessing.objects.filter(

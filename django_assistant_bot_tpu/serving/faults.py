@@ -31,6 +31,20 @@ Sites (the full set — unknown names are a config error, not a silent no-op):
                   re-route (the fleet-level analogue of ``tick_raise``)
 ``replica_slow``  router: the dispatch hop to a replica stalls ``delay_s``
                   (slow replica admission / network hop evidence)
+``task_raise``    task plane (tasks/queue.py): the task body raises before
+                  doing any work — transient, exercises the retry/backoff/DLQ
+                  ladder
+``task_worker_lost``  task plane: the executing worker "dies" — consulted
+                  before the body and after each delivered answer part
+                  (bot/tasks.py), the row is left RUNNING with its lease, and
+                  lease expiry + reclaim own the re-delivery (the exactly-once
+                  ledger's chaos case)
+``platform_http_429``  bot delivery: the platform answers flood control —
+                  raised as ``RetryLater(delay_s)`` so the queue honors the
+                  platform's pacing
+``platform_http_5xx``  bot delivery: the platform answers a transient 5xx-
+                  shaped connection error — exercises delivery re-raise +
+                  queue retry
 ================  ============================================================
 
 Each site's spec is either a bare float (fire probability) or a mapping with
@@ -63,7 +77,10 @@ HTTP_SITES = ("timeout", "conn_reset", "http_5xx")
 # consulted by the multi-replica EngineRouter (serving/router.py), never by an
 # engine: one spec can drive engine-, HTTP- and router-level chaos together
 ROUTER_SITES = ("replica_dead", "replica_slow")
-ALL_SITES = ENGINE_SITES + HTTP_SITES + ROUTER_SITES
+# consulted by the task plane (tasks/queue.py Worker.execute + bot/tasks.py
+# delivery) via the lazy global-injector discipline — no engine involved
+TASK_SITES = ("task_raise", "task_worker_lost", "platform_http_429", "platform_http_5xx")
+ALL_SITES = ENGINE_SITES + HTTP_SITES + ROUTER_SITES + TASK_SITES
 
 ENV_FAULTS = "DABT_FAULTS"
 ENV_SEED = "DABT_FAULT_SEED"

@@ -582,6 +582,52 @@ class EngineObs:
 
 
 # ------------------------------------------------------------------ /metrics
+# Task-plane stats provider (tasks/queue.py Worker.register_metrics): the
+# queue/bot/delivery plane lives in worker processes without engines, so it
+# publishes through a module-level hook instead of the engine registry.  The
+# provider is a plain callable returning the queue_stats() shape; a failing
+# provider must never break a scrape.
+_task_plane_provider: Optional[Callable[[], Dict[str, Any]]] = None
+
+
+def set_task_plane_provider(fn: Optional[Callable[[], Dict[str, Any]]]) -> None:
+    global _task_plane_provider
+    _task_plane_provider = fn
+
+
+def _render_task_plane(x: "_Exposition") -> None:
+    prov = _task_plane_provider
+    if prov is None:
+        return
+    try:
+        q = prov() or {}
+    except Exception:
+        logger.warning("task-plane stats provider failed", exc_info=True)
+        return
+    for qname, qs in sorted((q.get("queues") or {}).items()):
+        lab = {"queue": qname}
+        x.add("dabt_queue_depth", "gauge", "pending tasks (due + scheduled)", qs.get("pending"), lab)
+        x.add("dabt_queue_running", "gauge", "leased (executing) tasks", qs.get("running"), lab)
+        x.add("dabt_queue_oldest_pending_age_seconds", "gauge", "age of the oldest pending task", qs.get("oldest_pending_age_s"), lab)
+        x.add("dabt_queue_dead", "gauge", "dead-lettered tasks", qs.get("dead"), lab)
+    x.add("dabt_queue_dlq_size", "gauge", "dead-letter queue size across queues", q.get("dlq_size"))
+    w = q.get("worker") or {}
+    x.add("dabt_queue_claims_total", "counter", "task claims by this worker", w.get("claims"))
+    x.add("dabt_queue_executed_total", "counter", "task executions started", w.get("executed"))
+    x.add("dabt_queue_done_total", "counter", "tasks completed", w.get("done"))
+    x.add("dabt_queue_retries_total", "counter", "retries scheduled (backoff or RetryLater)", w.get("retries"))
+    x.add("dabt_queue_dead_letters_total", "counter", "tasks dead-lettered by this worker", w.get("dead_lettered"))
+    x.add("dabt_queue_reclaimed_leases_total", "counter", "expired leases reclaimed to pending", w.get("reclaimed_leases"))
+    x.add("dabt_queue_heartbeats_total", "counter", "lease heartbeat renewals", w.get("heartbeats"))
+    x.add("dabt_queue_leases_lost_total", "counter", "executions that lost their lease", w.get("leases_lost"))
+    x.add("dabt_queue_completions_discarded_total", "counter", "late completions discarded after a lease loss", w.get("completions_discarded"))
+    d = q.get("delivery") or {}
+    x.add("dabt_queue_delivery_deduped_total", "counter", "answer parts skipped by the delivery ledger", d.get("deduped_parts"))
+    x.add("dabt_queue_delivery_uncertain_total", "counter", "parts skipped after a mid-POST worker death", d.get("uncertain_parts_skipped"))
+    x.add("dabt_queue_turn_replays_skipped_total", "counter", "fully-delivered turns skipped on re-execution", d.get("turn_replays_skipped"))
+    x.add("dabt_queue_inbound_deduped_total", "counter", "duplicate platform update_ids not re-enqueued", d.get("inbound_updates_deduped"))
+
+
 def _engine_rows(registry: Any) -> List[Tuple[str, str, Any, Optional[Any]]]:
     """(model, replica, engine, router-or-None) rows for every generator.
 
@@ -725,6 +771,7 @@ def render_prometheus(registry: Any) -> str:
         lab = {"model": model}
         x.add("dabt_embed_queue_depth", "gauge", "embedding coalescer queue depth", emb._queue.qsize(), lab)
         x.add("dabt_embed_shed_total", "counter", "embedding requests shed", getattr(emb, "shed", 0), lab)
+    _render_task_plane(x)
     return x.render()
 
 

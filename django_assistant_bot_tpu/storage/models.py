@@ -108,6 +108,43 @@ class Message(Model):
     unique_together = (("dialog", "message_id"),)
 
 
+class DeliveredPart(Model):
+    """Delivery-ledger row: one outgoing answer part, the ``part=-1``
+    turn-complete marker, or the ``part=-2`` answer snapshot for an
+    idempotency scope.
+
+    The task plane records a part here BEFORE the platform POST and marks it
+    ``sent`` after, so an at-least-once re-execution (worker loss, webhook
+    redelivery) skips parts the user already received; the snapshot row
+    persists the GENERATED answer before delivery starts, so a partial-
+    delivery replay re-delivers the SAME answer instead of splicing a fresh
+    LLM generation onto already-sent parts — the exactly-once-effect half of
+    the queue's at-least-once contract (docs/RESILIENCE.md "Task plane").
+    Rows are TTL-pruned (bot/tasks.py) — dedup only needs to outlive the
+    platform's redelivery horizon."""
+
+    created_at = DateTimeField(auto_now_add=True, index=True)  # TTL-prune scan key
+    scope = TextField(null=False, index=True)  # e.g. "answer:<dialog>:<update_id>"
+    part = IntField(null=False, default=0)  # part index; -1 = complete, -2 = snapshot
+    state = TextField(default="inflight")  # inflight | sent | snapshot
+    payload = JSONField()  # part=-2: the serialized Answer
+    unique_together = (("scope", "part"),)
+
+
+class SeenUpdate(Model):
+    """Inbound dedup ledger: platform update_ids already ingested.
+
+    Telegram re-delivers a webhook update whenever the previous delivery
+    wasn't acknowledged in time; without this row a redelivered update
+    enqueues a SECOND answer_task for the same user message."""
+
+    created_at = DateTimeField(auto_now_add=True, index=True)  # TTL-prune scan key
+    platform = TextField(null=False)
+    bot_codename = TextField(null=False)
+    update_id = IntField(null=False)
+    unique_together = (("platform", "bot_codename", "update_id"),)
+
+
 # --------------------------------------------------------------- knowledge plane
 class WikiDocument(Model):
     """Source document tree (adjacency list; reference uses MPTT —

@@ -7,9 +7,13 @@ broker is the same sqlite substrate the framework already owns:
 
 - durable task rows with lease-based claiming — a worker that dies mid-task lets
   its lease expire and the row is re-dispatched (``acks_late`` +
-  ``reject_on_worker_lost`` semantics);
-- ``autoretry_for`` equivalents: per-task ``max_retries`` / ``retry_delay`` with
-  scheduled ``eta`` re-runs;
+  ``reject_on_worker_lost`` semantics), while the LIVE worker renews its lease
+  on a heartbeat so long tasks are never double-executed by lease expiry;
+- ``autoretry_for`` equivalents: per-task ``max_retries`` with capped
+  full-jitter exponential backoff (``retry_delay`` is the base), a
+  ``RetryLater`` escape hatch honoring platform ``Retry-After`` pacing, and a
+  ``PermanentTaskError`` fast path straight to the **dead-letter queue**
+  (``status="dead"`` + ``error_kind``; ``cli queue dlq list|requeue|purge``);
 - ``group`` + chord ``chain`` primitives (the ingestion fan-out uses them);
 - eager mode (``settings.TASK_ALWAYS_EAGER``) executing ``delay()`` inline — the
   reference tests use exactly this shape by invoking task bodies directly;
@@ -18,11 +22,16 @@ broker is the same sqlite substrate the framework already owns:
 
 from .queue import (  # noqa: F401
     CeleryQueues,
+    PermanentTaskError,
+    RetryLater,
     Task,
     TaskRecord,
     Worker,
+    backoff_delay,
+    current_task,
     get_task,
     group,
+    queue_stats,
     task,
 )
 from .beat import Beat  # noqa: F401

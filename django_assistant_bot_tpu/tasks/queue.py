@@ -1,19 +1,54 @@
-"""Durable sqlite task queue: registration, dispatch, leases, retries, chords."""
+"""Durable sqlite task queue: registration, dispatch, leases, retries, chords.
+
+At-least-once execution with an exactly-once-EFFECT discipline layered on top
+(docs/RESILIENCE.md "Task plane"):
+
+- **Error taxonomy.**  Task failures are *transient* (retry with capped
+  full-jitter exponential backoff), *permanent* (:class:`PermanentTaskError`
+  or an unknown task name — fail fast into the dead-letter queue instead of
+  burning the retry budget), or *platform-paced* (:class:`RetryLater`, the
+  Telegram flood-control ``Retry-After`` analog: retry at exactly the delay
+  the platform asked for).
+- **Dead-letter queue.**  Exhausted or permanently-failed rows land in
+  ``status="dead"`` with ``error_kind`` (``transient_exhausted`` /
+  ``permanent`` / ``unknown_task`` / ``worker_lost``) instead of dying
+  silently; ``cli queue dlq list|requeue|purge`` operates on them.
+- **Lease heartbeats.**  The executing worker renews its lease every
+  ``heartbeat_s`` (default ``lease_s / 3``) so a long-running task (an LLM
+  turn) is not double-executed by lease expiry; every terminal transition is
+  ownership-guarded (``lease_owner``), so a worker that *did* lose its lease
+  cannot overwrite the reclaiming worker's state.
+- **Worker-loss budget.**  The execution budget is exactly ``1 initial +
+  max_retries`` regardless of how attempts die (normal raise vs worker
+  loss); an expired-lease row that already consumed its budget dead-letters
+  at reclaim time rather than burning another claim cycle.
+- **Graceful drain.**  :meth:`Worker.drain` stops claiming, finishes
+  in-flight work, and releases any claimed-but-unstarted lease back to
+  ``pending``; :meth:`Worker.stop` drains first instead of abandoning
+  threads.
+
+Chaos sites (``task_raise``, ``task_worker_lost`` — serving/faults.py) are
+consulted through the same lazy sys.modules/env-gate discipline the HTTP
+provider client uses, so worker processes never import the jax-heavy serving
+package just to check a disabled injector.
+"""
 
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import datetime as _dt
 import enum
 import functools
 import inspect
 import json
 import logging
+import random
 import threading
 import time
 import traceback
 import uuid
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..conf import settings
 from ..storage.orm import (
@@ -27,6 +62,9 @@ from ..storage.orm import (
 
 logger = logging.getLogger(__name__)
 
+# ceiling for the jittered retry backoff; per-worker override via backoff_cap_s
+BACKOFF_CAP_S = 900.0
+
 
 class CeleryQueues(str, enum.Enum):
     """Queue names (reference: assistant/assistant/queue.py:4-7)."""
@@ -36,6 +74,26 @@ class CeleryQueues(str, enum.Enum):
     BROADCASTING = "broadcasting"
 
 
+class PermanentTaskError(Exception):
+    """A failure that retrying cannot fix (missing row, undecodable payload).
+
+    Task bodies raise it to route the record straight to the dead-letter
+    queue — one execution, full error trail, no retry burn."""
+
+
+class RetryLater(Exception):
+    """Retry after exactly ``delay_s`` — the platform told us when.
+
+    The Telegram flood-control path (HTTP 429 + ``retry_after``) maps to this
+    so the queue honors the platform's pacing instead of its own backoff.
+    Consumes a retry attempt like any transient failure (a platform that
+    flood-controls forever must still exhaust into the DLQ, not loop)."""
+
+    def __init__(self, delay_s: float, reason: str = ""):
+        super().__init__(reason or f"retry in {delay_s}s")
+        self.delay_s = max(0.0, float(delay_s))
+
+
 class TaskRecord(Model):
     """One enqueued invocation."""
 
@@ -43,20 +101,75 @@ class TaskRecord(Model):
     name = TextField(null=False)
     args = JSONField(default=list)
     kwargs = JSONField(default=dict)
-    status = TextField(default="pending", index=True)  # pending|running|done|failed
+    status = TextField(default="pending", index=True)  # pending|running|done|dead
     attempts = IntField(default=0)
     max_retries = IntField(default=3)
-    retry_delay = FloatField(default=60.0)
+    retry_delay = FloatField(default=60.0)  # backoff BASE (jittered, doubled, capped)
     eta = TextField(index=True)  # ISO ts; run at/after this time
     lease_expires = FloatField()  # unix ts while running
+    lease_owner = TextField()  # claiming Worker's id while running
     created_at = DateTimeField(auto_now_add=True)
     error = TextField()
+    error_kind = TextField(index=True)  # dead rows: transient_exhausted|permanent|unknown_task|worker_lost
+    dead_at = TextField()  # ISO ts of the dead-letter transition
     result = JSONField()
     group_id = TextField(index=True)
     chord_task = JSONField()  # {"name":..., "args":..., "kwargs":...} fired when group drains
 
 
 REGISTRY: Dict[str, "Task"] = {}
+
+# The record being executed by THIS worker thread (None outside execute()).
+# Task bodies read it for a stable per-invocation identity — the broadcast
+# delivery ledger keys on it (bot/tasks.py _send_answer_task).
+_current_task: contextvars.ContextVar[Optional[TaskRecord]] = contextvars.ContextVar(
+    "dabt_current_task", default=None
+)
+
+
+def current_task() -> Optional[TaskRecord]:
+    """The TaskRecord this (worker-executed) task body is running as."""
+    return _current_task.get()
+
+
+def _task_fault_injector():
+    """Chaos-plane injector via the lazy sys.modules/env-gate discipline
+    (ai/providers/http_service.py): never imports the jax-heavy serving
+    package unless chaos is actually armed."""
+    import os
+    import sys
+
+    mod = sys.modules.get("django_assistant_bot_tpu.serving.faults")
+    if mod is not None:
+        return mod.global_injector()
+    if os.environ.get("DABT_FAULTS", "").strip():
+        from ..serving.faults import global_injector
+
+        return global_injector()
+    return None
+
+
+def _is_worker_lost(exc: BaseException) -> bool:
+    """An injected ``task_worker_lost`` fault (duck-typed on ``site`` so the
+    serving package is never imported for the check)."""
+    return getattr(exc, "site", None) == "task_worker_lost"
+
+
+def backoff_delay(
+    base_s: float,
+    attempt: int,
+    *,
+    cap_s: float = BACKOFF_CAP_S,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Capped exponential backoff with FULL jitter: uniform in
+    ``[0, min(cap, base * 2^(attempt-1))]`` (``attempt`` is 1-based — the
+    attempt that just failed).  Full jitter decorrelates retry storms from
+    many workers hitting one sick dependency; the cap bounds the tail."""
+    if base_s <= 0.0:
+        return 0.0
+    ceiling = min(float(cap_s), float(base_s) * (2.0 ** max(0, int(attempt) - 1)))
+    return (rng or random).uniform(0.0, ceiling)
 
 
 class Task:
@@ -175,13 +288,53 @@ def _now_iso() -> str:
     return _dt.datetime.now(_dt.timezone.utc).isoformat()
 
 
+def _iso_at(ts: float) -> str:
+    """Unix seconds -> the queue's ISO timestamp format.  Worker-side stamps
+    (claim dueness, retry etas, dead_at) derive from the worker's injectable
+    clock through this, so fake-clock tests can drive backoff schedules."""
+    return _dt.datetime.fromtimestamp(ts, _dt.timezone.utc).isoformat()
+
+
+def queue_stats(*, clock: Callable[[], float] = time.time) -> Dict[str, Any]:
+    """Point-in-time queue gauges: per-queue depth / running / DLQ size and
+    the oldest-pending age.  DB-derived, so every worker (and the /metrics
+    exporter) sees one consistent view."""
+    from ..storage.db import get_database
+
+    db = get_database()
+    db.ensure_table(TaskRecord)
+    rows = db.query(
+        "SELECT queue, status, COUNT(*), MIN(created_at) FROM taskrecord "
+        "GROUP BY queue, status"
+    )
+    now_ts = clock()
+    queues: Dict[str, Dict[str, Any]] = {}
+    dlq = 0
+    for q, status, n, oldest in rows:
+        d = queues.setdefault(
+            q,
+            {"pending": 0, "running": 0, "done": 0, "dead": 0, "oldest_pending_age_s": None},
+        )
+        if status in d:
+            d[status] += n
+        if status == "dead":
+            dlq += n
+        if status == "pending" and oldest:
+            try:
+                age = now_ts - _dt.datetime.fromisoformat(oldest).timestamp()
+                d["oldest_pending_age_s"] = round(max(0.0, age), 3)
+            except ValueError:
+                pass
+    return {"queues": queues, "dlq_size": dlq}
+
+
 def group(
     invocations: Sequence[tuple],
     *,
     chord: Optional[tuple] = None,
 ) -> List[Optional[TaskRecord]]:
     """Enqueue ``[(task, args, kwargs), ...]`` as a group; when every member
-    finishes (done or exhausted retries), ``chord=(task, args, kwargs)`` fires —
+    finishes (done or dead-lettered), ``chord=(task, args, kwargs)`` fires —
     the celery ``chain(group(...), finalize)`` shape the ingestion pipeline uses
     (reference: assistant/processing/tasks.py:30-38)."""
     if settings.TASK_ALWAYS_EAGER:
@@ -220,8 +373,15 @@ def group(
 class Worker:
     """Polling worker: claims leases, executes, retries, fires chords.
 
-    At-least-once: a claim sets ``lease_expires``; rows whose lease lapsed (their
-    worker died) return to ``pending`` on the next poll.
+    At-least-once: a claim sets ``lease_expires`` + ``lease_owner``; rows
+    whose lease lapsed (their worker died) return to ``pending`` on the next
+    poll — or straight to the DLQ when the execution budget is spent.  The
+    executing worker renews its lease on a heartbeat, and every terminal
+    transition is conditional on still owning the lease, so a worker that
+    was presumed dead cannot clobber its replacement's state.
+
+    ``clock`` is wall-clock unix seconds (lease stamps live in the DB and
+    must be comparable across processes); injectable for tests.
     """
 
     def __init__(
@@ -231,6 +391,12 @@ class Worker:
         poll_s: float = 0.1,
         lease_s: float = 300.0,
         concurrency: int = 1,
+        heartbeat_s: Optional[float] = None,
+        max_task_lifetime_s: float = 3600.0,
+        backoff_cap_s: float = BACKOFF_CAP_S,
+        clock: Callable[[], float] = time.time,
+        rng: Optional[random.Random] = None,
+        flight: Optional[Any] = None,
     ):
         self.queues = [
             str(q.value if isinstance(q, CeleryQueues) else q)
@@ -239,15 +405,125 @@ class Worker:
         self.poll_s = poll_s
         self.lease_s = lease_s
         self.concurrency = concurrency
+        # default: renew 3x per lease window so one missed beat never loses a
+        # live lease; lease_s <= 0 (tests forcing instant expiry) disables
+        self.heartbeat_s = (
+            heartbeat_s if heartbeat_s is not None else (lease_s / 3.0 if lease_s > 0 else 0.0)
+        )
+        # heartbeats stop renewing past this task age: a HUNG body (provider
+        # call with no timeout) must eventually lose its lease and re-dispatch
+        # instead of wedging a worker slot forever — the ownership-guarded
+        # transitions discard whatever the zombie eventually returns
+        self.max_task_lifetime_s = max_task_lifetime_s
+        self.backoff_cap_s = backoff_cap_s
+        self.worker_id = uuid.uuid4().hex[:12]
+        self._clock = clock
+        self._rng = rng or random.Random()
+        # duck-typed flight recorder (serving.obs.FlightRecorder shape):
+        # dead-letter / worker-loss events land in the crash artifact trail
+        self._flight = flight
         self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._legacy_migrated = False
         self._threads: List[threading.Thread] = []
+        self._counter_lock = threading.Lock()
+        self._counters: Dict[str, int] = {
+            "claims": 0,
+            "executed": 0,
+            "done": 0,
+            "retries": 0,
+            "dead_lettered": 0,
+            "reclaimed_leases": 0,
+            "heartbeats": 0,
+            "heartbeats_capped": 0,
+            "leases_lost": 0,
+            "completions_discarded": 0,
+            "worker_lost_aborts": 0,
+            "drained_releases": 0,
+        }
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._counter_lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def stats(self) -> Dict[str, Any]:
+        with self._counter_lock:
+            out: Dict[str, Any] = dict(self._counters)
+        out.update(
+            worker_id=self.worker_id,
+            queues=list(self.queues),
+            lease_s=self.lease_s,
+            heartbeat_s=self.heartbeat_s,
+            draining=self._draining.is_set(),
+        )
+        return out
 
     # ------------------------------------------------------------------ claims
+    def _migrate_legacy_failed(self) -> None:
+        """One-shot upgrade sweep: rows a PRE-DLQ worker marked
+        ``status='failed'`` become ``dead`` so they are visible to the DLQ
+        surfaces and count as settled for their group's chord (a chord
+        waiting on a legacy-failed member would otherwise never fire)."""
+        from ..storage.db import get_database
+
+        cur = get_database().execute(
+            "UPDATE taskrecord SET status='dead', "
+            "error_kind=COALESCE(error_kind, 'transient_exhausted') "
+            "WHERE status='failed'"
+        )
+        if cur.rowcount:
+            logger.info("migrated %d legacy 'failed' task rows to the DLQ", cur.rowcount)
+
     def _reclaim_expired(self) -> None:
-        now = time.time()
-        TaskRecord.objects.filter(
-            status="running", lease_expires__lt=now
-        ).update(status="pending")
+        """Expired leases: requeue — or dead-letter when the execution budget
+        (1 initial + max_retries) is already spent, so an exhausted row never
+        burns another claim/increment cycle before reaching the DLQ."""
+        from ..storage.db import get_database
+
+        db = get_database()
+        db.ensure_table(TaskRecord)
+        if not self._legacy_migrated:
+            self._legacy_migrated = True
+            self._migrate_legacy_failed()
+        now = self._clock()
+        rows = db.query(
+            "SELECT id, attempts, max_retries FROM taskrecord "
+            "WHERE status='running' AND lease_expires IS NOT NULL AND lease_expires < ?",
+            [now],
+        )
+        for rid, attempts, max_retries in rows:
+            budget = (max_retries or 0) + 1
+            if (attempts or 0) >= budget:
+                cur = db.execute(
+                    "UPDATE taskrecord SET status='dead', error_kind='worker_lost', "
+                    "dead_at=?, lease_owner=NULL, "
+                    "error=COALESCE(error,'') || ? "
+                    "WHERE id=? AND status='running' AND lease_expires < ?",
+                    [_iso_at(now), "\nworker lost; retries exhausted", rid, now],
+                )
+                if cur.rowcount == 1:
+                    self._count("dead_lettered")
+                    record = TaskRecord.objects.get_or_none(id=rid)
+                    if record is not None:
+                        self._record_flight(
+                            "task_dead_letter", record, kind="worker_lost"
+                        )
+                        self._dump_flight("task_dead_letter", record)
+                        logger.error(
+                            "task %s (id=%s) dead-lettered: worker lost after %d attempts",
+                            record.name,
+                            rid,
+                            record.attempts,
+                        )
+                        self._maybe_fire_chord(record)
+            else:
+                cur = db.execute(
+                    "UPDATE taskrecord SET status='pending', lease_owner=NULL "
+                    "WHERE id=? AND status='running' AND lease_expires < ?",
+                    [rid, now],
+                )
+                if cur.rowcount == 1:
+                    self._count("reclaimed_leases")
 
     def claim(self) -> Optional[TaskRecord]:
         """Atomically claim one due pending row (sqlite UPDATE is serialized)."""
@@ -256,7 +532,7 @@ class Worker:
         self._reclaim_expired()
         db = get_database()
         db.ensure_table(TaskRecord)
-        now_iso = _now_iso()
+        now_iso = _iso_at(self._clock())
         placeholders = ",".join("?" * len(self.queues))
         row = db.query(
             f"SELECT id FROM taskrecord WHERE status='pending' AND queue IN ({placeholders}) "
@@ -267,13 +543,184 @@ class Worker:
             return None
         task_id = row[0][0]
         cur = db.execute(
-            "UPDATE taskrecord SET status='running', lease_expires=? "
+            "UPDATE taskrecord SET status='running', lease_expires=?, lease_owner=? "
             "WHERE id=? AND status='pending'",
-            [time.time() + self.lease_s, task_id],
+            [self._clock() + self.lease_s, self.worker_id, task_id],
         )
         if cur.rowcount != 1:
             return None  # lost the race to another worker
+        self._count("claims")
         return TaskRecord.objects.get(id=task_id)
+
+    def _release_claim(self, record: TaskRecord) -> None:
+        """Return a claimed-but-unstarted row to pending (drain path)."""
+        from ..storage.db import get_database
+
+        cur = get_database().execute(
+            "UPDATE taskrecord SET status='pending', lease_owner=NULL "
+            "WHERE id=? AND status='running' AND lease_owner=?",
+            [record.id, self.worker_id],
+        )
+        if cur.rowcount == 1:
+            self._count("drained_releases")
+
+    # ------------------------------------------------------- guarded transitions
+    def _owned_update(self, record: TaskRecord, **updates: Any) -> bool:
+        """UPDATE conditional on this worker still holding the lease.  A
+        worker whose lease was reclaimed mid-execution (heartbeat starved,
+        clock skew) must not overwrite its replacement's state transitions."""
+        from ..storage.db import get_database
+
+        sets, params = [], []
+        for key, value in updates.items():
+            f = TaskRecord._fields[key]
+            sets.append(f'"{key}" = ?')
+            params.append(f.to_db(value))
+        cur = get_database().execute(
+            f"UPDATE taskrecord SET {', '.join(sets)} "
+            "WHERE id=? AND status='running' AND lease_owner=?",
+            params + [record.id, self.worker_id],
+        )
+        if cur.rowcount != 1:
+            return False
+        for key, value in updates.items():
+            setattr(record, key, value)
+        return True
+
+    def _record_flight(self, event: str, record: TaskRecord, **fields: Any) -> None:
+        if self._flight is None:
+            return
+        try:
+            self._flight.record(
+                event,
+                task=record.name,
+                task_id=record.id,
+                queue=record.queue,
+                attempts=record.attempts,
+                **fields,
+            )
+        except Exception:  # the recorder must never break the queue
+            logger.debug("flight record failed", exc_info=True)
+
+    def _retry(self, record: TaskRecord, *, delay_s: float, err: str) -> None:
+        eta = _iso_at(self._clock() + max(0.0, delay_s))
+        if self._owned_update(
+            record, status="pending", eta=eta, error=err[-4000:], lease_owner=None
+        ):
+            self._count("retries")
+        else:
+            self._count("leases_lost")
+
+    def _dead_letter(self, record: TaskRecord, kind: str, err: str) -> None:
+        prior = (record.error + "\n") if record.error else ""
+        if self._owned_update(
+            record,
+            status="dead",
+            error_kind=kind,
+            dead_at=_iso_at(self._clock()),
+            error=(prior + err)[-4000:],
+            lease_owner=None,
+        ):
+            self._count("dead_lettered")
+            self._record_flight("task_dead_letter", record, kind=kind)
+            self._dump_flight("task_dead_letter", record)
+            logger.error(
+                "task %s (id=%s) dead-lettered (%s) after %d attempt(s)",
+                record.name,
+                record.id,
+                kind,
+                record.attempts,
+            )
+            self._maybe_fire_chord(record)
+        else:
+            self._count("leases_lost")
+
+    def _dump_flight(self, reason: str, record: TaskRecord) -> None:
+        """Dead letters are the task plane's crash artifacts: flush the event
+        ring to disk (serving.obs.FlightRecorder.dump shape) so what led up
+        to the death is diagnosable post-mortem.  Optional + fail-safe."""
+        dump = getattr(self._flight, "dump", None)
+        if not callable(dump):
+            return
+        try:
+            dump(reason, task=record.name, task_id=record.id, queue=record.queue)
+        except Exception:
+            logger.debug("flight dump failed", exc_info=True)
+
+    def _abandon(self, record: TaskRecord, where: str) -> None:
+        """Simulated worker death (``task_worker_lost``): walk away leaving
+        the row running with its lease — exactly what a SIGKILL leaves behind.
+        Lease expiry + reclaim own it from here."""
+        self._count("worker_lost_aborts")
+        self._record_flight("task_worker_lost", record, where=where)
+        logger.warning(
+            "task %s (id=%s): simulated worker loss (%s); lease left to expire",
+            record.name,
+            record.id,
+            where,
+        )
+
+    # -------------------------------------------------------------- heartbeat
+    def _start_heartbeat(self, record: TaskRecord) -> Optional[Tuple[threading.Event, threading.Thread]]:
+        if self.heartbeat_s <= 0 or self.lease_s <= 0:
+            return None
+        stop_evt = threading.Event()
+        started = self._clock()
+
+        def beat() -> None:
+            from ..storage.db import get_database
+
+            while not stop_evt.wait(self.heartbeat_s):
+                if self._clock() - started > self.max_task_lifetime_s:
+                    # a body running THIS long is presumed hung: stop renewing
+                    # so the lease expires and the task re-dispatches — the
+                    # pre-heartbeat plane bounded stuck executions at lease_s,
+                    # and an uncapped heartbeat would remove that bound
+                    self._count("heartbeats_capped")
+                    logger.error(
+                        "task %s (id=%s) exceeded max_task_lifetime_s=%gs; "
+                        "heartbeat stopped, lease will lapse",
+                        record.name,
+                        record.id,
+                        self.max_task_lifetime_s,
+                    )
+                    return
+                try:
+                    cur = get_database().execute(
+                        "UPDATE taskrecord SET lease_expires=? "
+                        "WHERE id=? AND status='running' AND lease_owner=?",
+                        [self._clock() + self.lease_s, record.id, self.worker_id],
+                    )
+                except Exception:
+                    # a transient DB error (busy writer, I/O blip) must not
+                    # kill the beat — a silently dead heartbeat re-opens the
+                    # double-execution window this thread exists to close
+                    logger.warning(
+                        "lease heartbeat for task id=%s failed; retrying",
+                        record.id,
+                        exc_info=True,
+                    )
+                    continue
+                if cur.rowcount == 1:
+                    self._count("heartbeats")
+                else:
+                    # reclaimed out from under us: the record has a new owner
+                    # (or finished elsewhere); stop renewing, let the guarded
+                    # terminal transition discard our result
+                    self._count("leases_lost")
+                    return
+
+        th = threading.Thread(target=beat, daemon=True, name=f"task-heartbeat-{record.id}")
+        th.start()
+        return stop_evt, th
+
+    @staticmethod
+    def _stop_heartbeat(hb: Optional[Tuple[threading.Event, threading.Thread]]) -> None:
+        if hb is None:
+            return
+        evt, th = hb
+        evt.set()
+        th.join(timeout=5.0)
 
     # --------------------------------------------------------------- execution
     def run_one(self) -> bool:
@@ -284,57 +731,101 @@ class Worker:
         return True
 
     def execute(self, record: TaskRecord) -> None:
-        t = get_task(record.name)
+        budget = (record.max_retries or 0) + 1  # 1 initial + max_retries
         # persist the attempt BEFORE running: a task that kills its worker (OOM,
         # SIGKILL) must still consume an attempt when the lease reclaim requeues
         # it, or a poison task loops forever past max_retries
         record.attempts += 1
-        record.save()
-        if record.attempts > record.max_retries + 1:
-            record.status = "failed"
-            record.error = (record.error or "") + "\nretries exhausted after worker loss"
-            record.save()
-            self._maybe_fire_chord(record)
+        if not self._owned_update(record, attempts=record.attempts):
+            self._count("leases_lost")
             return
+        if record.attempts > budget:
+            # defensive boundary: _reclaim_expired dead-letters exhausted rows
+            # at reclaim time, so this only fires on races/legacy rows
+            self._dead_letter(record, "worker_lost", "retries exhausted after worker loss")
+            return
+        t = get_task(record.name)
         if t is None:
-            record.status = "failed"
-            record.error = f"unknown task {record.name}"
-            record.save()
-            self._maybe_fire_chord(record)
+            # permanent by taxonomy: no amount of retrying registers the task
+            self._dead_letter(record, "unknown_task", f"unknown task {record.name}")
             return
+        self._count("executed")
+        inj = _task_fault_injector()
+        hb = self._start_heartbeat(record)
+        token = _current_task.set(record)
         try:
-            result = t.apply(*record.args, **(record.kwargs or {}))
-            record.status = "done"
+            if inj is not None:
+                inj.maybe_raise("task_raise")  # transient: an exploding body
+                if inj.should_fire("task_worker_lost"):
+                    self._abandon(record, "pre-execution")
+                    return
             try:
-                json.dumps(result)
-                record.result = result
-            except (TypeError, ValueError):
-                record.result = None
-            record.error = None
-            record.save()
-            self._maybe_fire_chord(record)
+                result = t.apply(*record.args, **(record.kwargs or {}))
+            except BaseException as e:
+                if _is_worker_lost(e):
+                    # fired mid-body (e.g. between answer-part posts): the
+                    # worker "dies" with the row still leased
+                    self._abandon(record, "mid-execution")
+                    return
+                raise
+        except RetryLater as e:
+            err = f"RetryLater({e.delay_s:g}s): {e}"
+            logger.warning("task %s asked to retry later: %s", record.name, err)
+            if record.attempts < budget:
+                self._retry(record, delay_s=e.delay_s, err=err)
+            else:
+                self._dead_letter(record, "transient_exhausted", err)
+        except PermanentTaskError:
+            logger.exception("task %s failed permanently", record.name)
+            self._dead_letter(record, "permanent", traceback.format_exc())
         except Exception:
             err = traceback.format_exc()
             logger.exception("task %s failed (attempt %d)", record.name, record.attempts)
-            if record.attempts <= record.max_retries:
-                eta = _dt.datetime.now(_dt.timezone.utc) + _dt.timedelta(
-                    seconds=record.retry_delay
+            if record.attempts < budget:
+                self._retry(
+                    record,
+                    delay_s=backoff_delay(
+                        record.retry_delay or 0.0,
+                        record.attempts,
+                        cap_s=self.backoff_cap_s,
+                        rng=self._rng,
+                    ),
+                    err=err,
                 )
-                record.status = "pending"
-                record.eta = eta.isoformat()
             else:
-                record.status = "failed"
-            record.error = err[-4000:]
-            record.save()
-            if record.status == "failed":
+                self._dead_letter(record, "transient_exhausted", err)
+        else:
+            try:
+                json.dumps(result)
+            except (TypeError, ValueError):
+                result = None
+            if self._owned_update(
+                record, status="done", result=result, error=None, lease_owner=None
+            ):
+                self._count("done")
                 self._maybe_fire_chord(record)
+            else:
+                # lease was reclaimed mid-run: another worker owns (or already
+                # settled) this record — our completion must not double-fire
+                # the chord or resurrect a superseded state
+                self._count("completions_discarded")
+                logger.warning(
+                    "task %s (id=%s) completed after losing its lease; result discarded",
+                    record.name,
+                    record.id,
+                )
+        finally:
+            _current_task.reset(token)
+            self._stop_heartbeat(hb)
 
     def _maybe_fire_chord(self, record: TaskRecord) -> None:
         if not record.group_id or not record.chord_task:
             return
         remaining = (
+            # "failed" is the pre-DLQ terminal status: counted as settled so a
+            # legacy row can never block a chord (claim() also migrates them)
             TaskRecord.objects.filter(group_id=record.group_id)
-            .exclude(status__in=["done", "failed"])
+            .exclude(status__in=["done", "dead", "failed"])
             .count()
         )
         if remaining:
@@ -366,24 +857,84 @@ class Worker:
         return n
 
     def _loop(self) -> None:
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not self._draining.is_set():
             try:
-                if not self.run_one():
+                record = self.claim()
+                if record is None:
                     self._stop.wait(self.poll_s)
+                    continue
+                if self._stop.is_set() or self._draining.is_set():
+                    # claimed inside the drain window and not yet started:
+                    # release the lease so another worker takes it NOW instead
+                    # of waiting out lease_s
+                    self._release_claim(record)
+                    break
+                self.execute(record)
             except Exception:
                 logger.exception("worker loop error")
                 self._stop.wait(1.0)
 
     def start(self) -> "Worker":
         self._stop.clear()
+        self._draining.clear()
         for i in range(self.concurrency):
             th = threading.Thread(target=self._loop, daemon=True, name=f"task-worker-{i}")
             th.start()
             self._threads.append(th)
         return self
 
-    def stop(self) -> None:
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: stop claiming, finish in-flight executions,
+        release claimed-but-unstarted leases.  Returns True when every worker
+        thread exited within the deadline."""
+        self._draining.set()
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        for th in self._threads:
+            th.join(timeout=max(0.0, deadline - time.monotonic()))
+        alive = [th for th in self._threads if th.is_alive()]
+        if alive:
+            logger.warning(
+                "worker drain deadline (%gs) passed with %d execution(s) still in flight",
+                timeout_s,
+                len(alive),
+            )
+        return not alive
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        """Drain first (in-flight tasks finish), then stop.  A thread still
+        alive past the deadline is abandoned — its lease heartbeat keeps the
+        task single-owner, and the guarded transitions keep a late completion
+        from clobbering a reclaim."""
+        self.drain(timeout_s=timeout_s)
         self._stop.set()
         for th in self._threads:
-            th.join(timeout=5)
-        self._threads.clear()
+            th.join(timeout=1.0)
+        self._threads = [th for th in self._threads if th.is_alive()]
+        if not self._threads:
+            self._draining.clear()
+
+    # -------------------------------------------------------------- observability
+    def register_metrics(self) -> bool:
+        """Publish task-plane stats as ``dabt_queue_*`` on ``GET /metrics``
+        (serving/obs.py).  Imports the serving package lazily — a worker that
+        cannot import it (no jax in a stripped image) keeps running, just
+        unscraped."""
+        try:
+            from ..serving import obs
+        except Exception:
+            logger.warning("serving.obs unavailable; task-plane metrics not exported")
+            return False
+
+        def provider() -> Dict[str, Any]:
+            out = queue_stats(clock=self._clock)
+            out["worker"] = self.stats()
+            try:
+                from ..bot import tasks as bot_tasks
+
+                out["delivery"] = dict(bot_tasks.DELIVERY_STATS)
+            except Exception:
+                pass
+            return out
+
+        obs.set_task_plane_provider(provider)
+        return True

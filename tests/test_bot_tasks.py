@@ -1,5 +1,10 @@
 """Answer task plane + Telegram adapter: the reference's test_answer_task shape —
-the worker coroutine is driven in-process with a fake platform (SURVEY.md §4)."""
+the worker coroutine is driven in-process with a fake platform (SURVEY.md §4).
+
+Exactly-once-effect delivery coverage: the per-part ledger (skip re-posts on
+re-execution), the turn-complete replay skip, the mid-answer worker-kill chaos
+case, inbound update_id dedup, flood-control → RetryLater mapping, and
+send_answer_task's permanent/transient honesty."""
 
 import asyncio
 
@@ -8,6 +13,7 @@ import pytest
 from django_assistant_bot_tpu.bot.domain import (
     BotPlatform,
     Button,
+    MultiPartAnswer,
     SingleAnswer,
     Update,
     User,
@@ -16,10 +22,12 @@ from django_assistant_bot_tpu.bot.domain import (
 from django_assistant_bot_tpu.bot.platforms.telegram.api import (
     TelegramBadRequest,
     TelegramForbidden,
+    TelegramRetryAfter,
 )
 from django_assistant_bot_tpu.bot.platforms.telegram.platform import TelegramBotPlatform
-from django_assistant_bot_tpu.bot.tasks import _answer_task, _send_answer_task
+from django_assistant_bot_tpu.bot.tasks import _answer_task, _post_answer, _send_answer_task
 from django_assistant_bot_tpu.storage import models
+from django_assistant_bot_tpu.tasks.queue import PermanentTaskError, RetryLater, TaskRecord, Worker
 
 
 class RecordingPlatform(BotPlatform):
@@ -86,9 +94,10 @@ def seeded(tmp_db, monkeypatch):
     return bot, instance, dialog
 
 
-def _update_dict(message_id=1, text="hello"):
+def _update_dict(message_id=1, text="hello", update_id=None):
     return Update(
-        chat_id="u1", message_id=message_id, text=text, user=User(id="u1")
+        chat_id="u1", message_id=message_id, text=text, user=User(id="u1"),
+        update_id=update_id,
     ).to_dict()
 
 
@@ -212,3 +221,331 @@ def test_inline_keyboard_markup():
     asyncio.run(platform.post_answer("1", answer))
     markup = api.calls[0][4]
     assert markup == {"inline_keyboard": [[{"text": "Go", "callback_data": "/go"}]]}
+
+
+# ---------------------------------------------------- exactly-once delivery
+def _three_parts():
+    return MultiPartAnswer(parts=[SingleAnswer(text=f"part {i}") for i in range(3)])
+
+
+def test_post_answer_ledger_skips_sent_parts(seeded):
+    platform = RecordingPlatform()
+    asyncio.run(_post_answer(platform, "u1", _three_parts(), ledger_scope="answer:1:9"))
+    assert [a.text for _, a in platform.posted] == ["part 0", "part 1", "part 2"]
+    # re-execution (worker loss replay): every part is already in the ledger
+    asyncio.run(_post_answer(platform, "u1", _three_parts(), ledger_scope="answer:1:9"))
+    assert len(platform.posted) == 3  # zero duplicates
+    # a DIFFERENT scope posts fresh
+    asyncio.run(_post_answer(platform, "u1", _three_parts(), ledger_scope="answer:1:10"))
+    assert len(platform.posted) == 6
+
+
+def test_post_answer_clean_failure_releases_ledger_claim(seeded):
+    """A part whose POST fails in our frame must NOT stay claimed: the retry
+    re-posts it (only a worker death mid-POST leaves an uncertain row)."""
+
+    class FlakyPlatform(RecordingPlatform):
+        def __init__(self):
+            super().__init__()
+            self.failures_left = 1
+
+        async def post_answer(self, chat_id, answer):
+            if answer.text == "part 1" and self.failures_left:
+                self.failures_left -= 1
+                raise ConnectionError("platform blip")
+            await super().post_answer(chat_id, answer)
+
+    platform = FlakyPlatform()
+    with pytest.raises(ConnectionError):
+        asyncio.run(_post_answer(platform, "u1", _three_parts(), ledger_scope="answer:2:1"))
+    assert [a.text for _, a in platform.posted] == ["part 0"]
+    # the retry: part 0 deduped, parts 1-2 delivered
+    asyncio.run(_post_answer(platform, "u1", _three_parts(), ledger_scope="answer:2:1"))
+    assert [a.text for _, a in platform.posted] == ["part 0", "part 1", "part 2"]
+
+
+def test_flood_control_maps_to_retry_later(seeded):
+    api = FakeAPI(
+        errors=[TelegramRetryAfter(429, "Too Many Requests: retry after 17", 17.0)]
+    )
+    platform = TelegramBotPlatform("tok", api=api)
+    with pytest.raises(RetryLater) as ei:
+        asyncio.run(_post_answer(platform, "1", SingleAnswer(text="x")))
+    assert ei.value.delay_s == 17.0
+
+
+def test_answer_task_replay_skips_completed_turn(seeded):
+    bot, instance, dialog = seeded
+    from django_assistant_bot_tpu.bot.services.dialog_service import create_user_message
+
+    create_user_message(dialog, 1, "hello")
+    platform = RecordingPlatform()
+    upd = _update_dict(update_id=501)
+    asyncio.run(_answer_task("tb", dialog.id, "telegram", upd, platform=platform))
+    assert len(platform.posted) == 1
+    msgs_after_first = models.Message.objects.filter(dialog=dialog).count()
+    # the at-least-once replay (worker died between delivery and done): the
+    # turn-complete marker skips the WHOLE pipeline — no second LLM turn, no
+    # duplicate post, no duplicate history row
+    asyncio.run(_answer_task("tb", dialog.id, "telegram", upd, platform=platform))
+    assert len(platform.posted) == 1
+    assert models.Message.objects.filter(dialog=dialog).count() == msgs_after_first
+
+
+def test_answer_task_reraises_transient_delivery_errors(seeded):
+    """Transient delivery failures are the QUEUE's to retry — swallowing them
+    into a log line (the seed behavior) silently dropped the user's answer."""
+    bot, instance, dialog = seeded
+    from django_assistant_bot_tpu.bot.services.dialog_service import create_user_message
+
+    create_user_message(dialog, 1, "hello")
+    platform = RecordingPlatform(fail_with=ConnectionError("telegram down"))
+    with pytest.raises(ConnectionError):
+        asyncio.run(
+            _answer_task("tb", dialog.id, "telegram", _update_dict(update_id=502),
+                         platform=platform)
+        )
+
+
+def test_answer_task_missing_dialog_is_permanent(seeded):
+    with pytest.raises(PermanentTaskError):
+        asyncio.run(_answer_task("tb", 999999, "telegram", _update_dict(update_id=503)))
+
+
+class _FakeClock:
+    def __init__(self, t=None):
+        import time as _time
+
+        # slightly ahead of wall time so real-clock delay() etas are due
+        self.t = _time.time() + 60.0 if t is None else t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt_s):
+        self.t += dt_s
+
+
+def test_worker_kill_mid_answer_delivers_exactly_once(seeded, monkeypatch):
+    """THE chaos case (ISSUE 13 acceptance): a worker killed after delivering
+    part 1 of 3; lease expiry re-dispatches the task; the re-execution must
+    deliver the REMAINING parts only.  The seed plane re-posted everything."""
+    from django_assistant_bot_tpu.bot.assistant_bot import AssistantBot
+    from django_assistant_bot_tpu.serving.faults import (
+        FaultInjector,
+        reset_global_injector,
+        set_global_injector,
+    )
+
+    bot, instance, dialog = seeded
+    from django_assistant_bot_tpu.bot.services.dialog_service import create_user_message
+
+    create_user_message(dialog, 1, "hello")
+    generations = []
+
+    async def fake_multi(self, messages, debug_info, do_interrupt):
+        generations.append(1)
+        return _three_parts()
+
+    monkeypatch.setattr(AssistantBot, "get_answer_to_messages", fake_multi)
+    platform = RecordingPlatform()
+    monkeypatch.setattr(
+        "django_assistant_bot_tpu.bot.tasks.get_bot_platform", lambda *a: platform
+    )
+    from django_assistant_bot_tpu.bot.tasks import answer_task
+
+    # the worker_lost site is consulted once pre-body (Worker.execute) and
+    # once per DELIVERED part (_post_answer): call 3 = right after "part 1"
+    # went out
+    inj = FaultInjector({"task_worker_lost": {"fire_on": [3]}})
+    set_global_injector(inj)
+    clk = _FakeClock()
+    try:
+        rec = answer_task.delay("tb", dialog.id, "telegram", _update_dict(update_id=601))
+        w = Worker(["query"], lease_s=10.0, heartbeat_s=0, clock=clk)
+        w.run_one()
+        rec.refresh()
+        assert rec.status == "running"  # the "dead" worker left its lease
+        assert [a.text for _, a in platform.posted] == ["part 0", "part 1"]
+        clk.advance(11.0)  # lease expires; reclaim re-dispatches
+        w.run_one()
+        rec.refresh()
+        assert rec.status == "done"
+        # every part delivered EXACTLY once — the re-execution skipped 0 and 1
+        assert [a.text for _, a in platform.posted] == ["part 0", "part 1", "part 2"]
+        # and it delivered from the persisted SNAPSHOT: one LLM generation
+        # total, so the delivered parts all belong to one answer
+        assert len(generations) == 1
+        assert w.stats()["worker_lost_aborts"] == 1
+    finally:
+        reset_global_injector()
+
+
+def test_partial_replay_redelivers_snapshot_not_a_fresh_generation(seeded, monkeypatch):
+    """The answer is persisted before delivery starts: a replay after a
+    partial delivery re-sends the SAME answer's remaining parts even when
+    the model would now generate something different (no spliced answers)."""
+    from django_assistant_bot_tpu.bot.assistant_bot import AssistantBot
+
+    bot, instance, dialog = seeded
+    from django_assistant_bot_tpu.bot.services.dialog_service import create_user_message
+
+    create_user_message(dialog, 1, "hello")
+    generations = []
+
+    async def nondeterministic(self, messages, debug_info, do_interrupt):
+        generations.append(1)
+        n = len(generations)
+        return MultiPartAnswer(
+            parts=[SingleAnswer(text=f"gen{n} part {i}") for i in range(2)]
+        )
+
+    monkeypatch.setattr(AssistantBot, "get_answer_to_messages", nondeterministic)
+
+    class DieOnPart1(RecordingPlatform):
+        def __init__(self):
+            super().__init__()
+            self.deaths_left = 1
+
+        async def post_answer(self, chat_id, answer):
+            if answer.text.endswith("part 1") and self.deaths_left:
+                self.deaths_left -= 1
+                raise ConnectionError("blip before part 1 lands")
+            await super().post_answer(chat_id, answer)
+
+    platform = DieOnPart1()
+    upd = _update_dict(update_id=602)
+    with pytest.raises(ConnectionError):
+        asyncio.run(_answer_task("tb", dialog.id, "telegram", upd, platform=platform))
+    assert [a.text for _, a in platform.posted] == ["gen1 part 0"]
+    # the retry: no second generation — the snapshot is re-delivered, so the
+    # user gets gen1's part 1, not gen2's
+    asyncio.run(_answer_task("tb", dialog.id, "telegram", upd, platform=platform))
+    assert [a.text for _, a in platform.posted] == ["gen1 part 0", "gen1 part 1"]
+    assert len(generations) == 1
+
+
+def test_ledger_prune_removes_expired_rows(seeded):
+    import datetime as dt
+
+    from django_assistant_bot_tpu.bot import tasks as bot_tasks
+
+    old = dt.datetime.now(dt.timezone.utc) - dt.timedelta(days=30)
+    models.DeliveredPart.objects.create(scope="ancient:1", part=0, state="sent", created_at=old)
+    models.SeenUpdate.objects.create(platform="telegram", bot_codename="tb", update_id=1, created_at=old)
+    models.DeliveredPart.objects.create(scope="fresh:1", part=0, state="sent")
+    bot_tasks._last_prune[0] = 0.0
+    pruned = bot_tasks._maybe_prune_ledgers()
+    assert pruned == 2
+    assert models.DeliveredPart.objects.filter(scope="ancient:1").count() == 0
+    assert models.DeliveredPart.objects.filter(scope="fresh:1").count() == 1
+    # rate-gated: an immediate second call is a no-op
+    models.DeliveredPart.objects.create(scope="ancient:2", part=0, created_at=old)
+    assert bot_tasks._maybe_prune_ledgers() == 0
+    # ...but the beat-scheduled maintenance task FORCES the sweep (it runs on
+    # the worker's cadence, never the webhook request path)
+    rec = bot_tasks.prune_ledgers_task.delay()
+    Worker(["query"]).run_until_idle()
+    rec.refresh()
+    assert rec.status == "done" and rec.result == 1
+    assert models.DeliveredPart.objects.filter(scope="ancient:2").count() == 0
+
+
+def test_send_answer_task_bad_payload_dead_letters(seeded, monkeypatch):
+    platform = RecordingPlatform()
+    monkeypatch.setattr(
+        "django_assistant_bot_tpu.bot.tasks.get_bot_platform", lambda *a: platform
+    )
+    from django_assistant_bot_tpu.bot.tasks import send_answer_task
+
+    rec = send_answer_task.delay("tb", "telegram", "u1", {"audio": "not-base64!!", "text": None})
+    Worker(["query"]).run_until_idle()
+    rec.refresh()
+    assert rec.status == "dead" and rec.error_kind == "permanent"
+    assert "deserialize" in rec.error
+    assert platform.posted == []
+
+
+def test_send_answer_task_reraises_transient(seeded):
+    platform = RecordingPlatform(fail_with=ConnectionError("telegram down"))
+    with pytest.raises(ConnectionError):
+        asyncio.run(
+            _send_answer_task(
+                "tb", "telegram", "u1", SingleAnswer(text="bcast").to_dict(),
+                platform=platform,
+            )
+        )
+
+
+def test_queued_send_answer_dedups_parts_across_retry(seeded, monkeypatch):
+    """A broadcast send that dies mid-delivery dedups by its TaskRecord id."""
+
+    class DieAfterFirst(RecordingPlatform):
+        def __init__(self):
+            super().__init__()
+            self.deaths_left = 1
+
+        async def post_answer(self, chat_id, answer):
+            await super().post_answer(chat_id, answer)
+            if answer.text == "part 0" and self.deaths_left:
+                self.deaths_left -= 1
+                err = RuntimeError("worker dies now")
+                err.site = "task_worker_lost"
+                raise err
+
+    platform = DieAfterFirst()
+    monkeypatch.setattr(
+        "django_assistant_bot_tpu.bot.tasks.get_bot_platform", lambda *a: platform
+    )
+    from django_assistant_bot_tpu.bot.tasks import send_answer_task
+
+    clk = _FakeClock()
+    rec = send_answer_task.delay("tb", "telegram", "u1", _three_parts().to_dict())
+    w = Worker(["query"], lease_s=10.0, heartbeat_s=0, clock=clk)
+    w.run_one()
+    clk.advance(11.0)
+    w.run_one()
+    rec.refresh()
+    assert rec.status == "done"
+    assert [a.text for _, a in platform.posted] == ["part 0", "part 1", "part 2"]
+
+
+# ------------------------------------------------------------- inbound dedup
+def test_ingest_dedups_platform_update_ids(seeded):
+    from django_assistant_bot_tpu.bot.services.ingest_service import ingest_update
+
+    upd = Update(chat_id="u1", message_id=5, text="hi", user=User(id="u1"), update_id=42)
+    _, r1 = ingest_update("tb", "telegram", upd)
+    _, r2 = ingest_update("tb", "telegram", upd)  # webhook redelivery
+    assert r1 is not None and r2 is None
+    assert TaskRecord.objects.filter(name__contains="answer_task").count() == 1
+    # a NEW update enqueues normally
+    upd2 = Update(chat_id="u1", message_id=6, text="more", user=User(id="u1"), update_id=43)
+    _, r3 = ingest_update("tb", "telegram", upd2)
+    assert r3 is not None
+    # updates WITHOUT an update_id (API-driven, tests) never dedup
+    upd3 = Update(chat_id="u1", message_id=7, text="again", user=User(id="u1"))
+    _, r4 = ingest_update("tb", "telegram", upd3)
+    _, r5 = ingest_update("tb", "telegram", upd3)
+    assert r4 is not None and r5 is not None
+
+
+def test_convert_update_carries_update_id():
+    platform = TelegramBotPlatform("tok", api=FakeAPI())
+    data = {
+        "update_id": 990011,
+        "message": {
+            "message_id": 7,
+            "chat": {"id": 123},
+            "text": "hi",
+            "from": {"id": 42},
+        },
+    }
+    upd = asyncio.run(platform.get_update(data))
+    assert upd.update_id == 990011
+    # queue transport round-trip keeps it
+    assert Update.from_dict(upd.to_dict()).update_id == 990011
+    # pre-ledger payloads (no update_id key) still parse
+    legacy = upd.to_dict()
+    legacy.pop("update_id")
+    assert Update.from_dict(legacy).update_id is None

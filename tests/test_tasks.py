@@ -2,14 +2,56 @@
 
 The reference tests its Celery path by invoking task bodies directly (SURVEY.md
 §4); here the broker is in-process sqlite so the REAL dispatch path runs in tests.
+
+Exactly-once-effect coverage (docs/RESILIENCE.md "Task plane"): error
+taxonomy (permanent vs transient vs RetryLater), dead-letter queue + CLI,
+full-jitter backoff, lease heartbeats + ownership-guarded transitions, the
+worker-loss attempt-budget boundary, graceful drain, queue stats/metrics.
 """
 
+import datetime as dt
+import random
+import threading
 import time
 
 import pytest
 
 from django_assistant_bot_tpu.conf import settings
-from django_assistant_bot_tpu.tasks import Beat, TaskRecord, Worker, group, task
+from django_assistant_bot_tpu.tasks import (
+    Beat,
+    PermanentTaskError,
+    RetryLater,
+    TaskRecord,
+    Worker,
+    backoff_delay,
+    group,
+    queue_stats,
+    task,
+)
+
+
+class FakeClock:
+    """Injectable wall clock for lease/reclaim/backoff determinism.
+
+    Starts slightly AHEAD of real wall time so rows enqueued with real-clock
+    etas (Task.delay) are due immediately under the fake clock."""
+
+    def __init__(self, t: float = None):
+        self.t = time.time() + 60.0 if t is None else t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt_s: float) -> None:
+        self.t += dt_s
+
+
+class FakeWorkerLost(RuntimeError):
+    """Duck-typed stand-in for FaultInjected(site='task_worker_lost') — the
+    worker-death simulation without importing the serving package."""
+
+    site = "task_worker_lost"
+
 
 calls = []
 
@@ -76,15 +118,18 @@ def test_retry_then_success():
     assert len(calls) == 3  # 2 failures + 1 success
 
 
-def test_retries_exhausted_marks_failed():
+def test_retries_exhausted_dead_letters():
     rec = flaky_task.delay(99)
     w = Worker(["processing"])
     for _ in range(6):
         w.run_until_idle()
     rec.refresh()
-    assert rec.status == "failed"
+    assert rec.status == "dead"
+    assert rec.error_kind == "transient_exhausted"
+    assert rec.dead_at is not None
     assert "boom" in rec.error
     assert len(calls) == 3  # initial + 2 retries
+    assert w.stats()["dead_lettered"] == 1
 
 
 def test_lease_reclaim_on_worker_death():
@@ -191,3 +236,491 @@ def test_beat_enqueues_on_cadence():
     assert beat.tick(now + 1) == 0  # not due
     assert beat.tick(now + 1001) == 1
     assert TaskRecord.objects.filter(name=add_task.name).count() == 2
+
+
+# ------------------------------------------------------------- error taxonomy
+@task(queue="tax", max_retries=5, retry_delay=0.0)
+def permanent_task():
+    calls.append(("permanent",))
+    raise PermanentTaskError("this row will never exist")
+
+
+@task(queue="tax", max_retries=3, retry_delay=0.0)
+def flood_task():
+    calls.append(("flood",))
+    if len([c for c in calls if c == ("flood",)]) == 1:
+        raise RetryLater(30.0, "platform says wait")
+    return "ok"
+
+
+def test_permanent_error_dead_letters_without_retry_burn():
+    """Permanent failures skip the whole retry budget: one execution, DLQ."""
+    rec = permanent_task.delay()
+    w = Worker(["tax"])
+    for _ in range(3):
+        w.run_until_idle()
+    rec.refresh()
+    assert rec.status == "dead" and rec.error_kind == "permanent"
+    assert rec.attempts == 1 and len(calls) == 1
+    assert "never exist" in rec.error
+
+
+def test_unknown_task_dead_letters():
+    rec = TaskRecord.objects.create(queue="tax", name="nowhere.no_such_task", eta=None)
+    Worker(["tax"]).run_until_idle()
+    rec.refresh()
+    assert rec.status == "dead" and rec.error_kind == "unknown_task"
+    assert "unknown task" in rec.error
+
+
+def test_retry_later_honors_platform_delay():
+    """RetryLater(30) re-schedules at exactly clock+30 (the platform's
+    pacing, not the backoff curve) and does not run before the eta — driven
+    end to end on the worker's injectable clock."""
+    clk = FakeClock()
+    rec = flood_task.delay()
+    w = Worker(["tax"], clock=clk)
+    w.run_until_idle()
+    rec.refresh()
+    assert rec.status == "pending" and len(calls) == 1
+    eta_ts = dt.datetime.fromisoformat(rec.eta).timestamp()
+    assert abs(eta_ts - (clk() + 30.0)) < 1e-3
+    w.run_until_idle()  # not due yet
+    assert len(calls) == 1
+    clk.advance(29.0)
+    w.run_until_idle()  # still not due
+    assert len(calls) == 1
+    clk.advance(2.0)
+    w.run_until_idle()
+    rec.refresh()
+    assert rec.status == "done" and rec.result == "ok"
+
+
+def test_backoff_full_jitter_capped():
+    rng = random.Random(0)
+    # attempt 1: uniform in [0, base]
+    ds = [backoff_delay(60.0, 1, rng=rng) for _ in range(200)]
+    assert all(0.0 <= d <= 60.0 for d in ds)
+    assert max(ds) > 30.0  # actually jittered, not collapsed
+    # deep attempts: ceiling is the cap, not base * 2^n
+    ds = [backoff_delay(60.0, 20, cap_s=900.0, rng=rng) for _ in range(200)]
+    assert all(0.0 <= d <= 900.0 for d in ds)
+    assert max(ds) > 600.0
+    # zero base (tests / immediate-retry tasks) stays zero
+    assert backoff_delay(0.0, 3, rng=rng) == 0.0
+
+
+# --------------------------------------------------- worker-loss budget boundary
+loss_runs = []
+
+
+@task(queue="loss", max_retries=2, retry_delay=0.0)
+def lossy_task():
+    loss_runs.append(1)
+    raise FakeWorkerLost()
+
+
+@task(queue="loss", max_retries=2, retry_delay=0.0)
+def mixed_loss_task():
+    loss_runs.append(1)
+    if len(loss_runs) == 1:
+        raise FakeWorkerLost()
+    raise RuntimeError("boom after the loss")
+
+
+def _drive_losses(rec, w, clk, rounds=8):
+    for _ in range(rounds):
+        w.run_one()
+        clk.advance(w.lease_s + 1.0)  # expire whatever lease the "death" left
+    rec.refresh()
+    return rec
+
+
+def test_worker_loss_budget_is_exactly_initial_plus_retries():
+    """Pure worker loss: exactly 1 + max_retries executions, then the DLQ —
+    and the exhausted row dead-letters AT RECLAIM (no extra claim cycle)."""
+    loss_runs.clear()
+    clk = FakeClock()
+    rec = lossy_task.delay()
+    w = Worker(["loss"], lease_s=10.0, heartbeat_s=0, clock=clk)
+    _drive_losses(rec, w, clk)
+    assert len(loss_runs) == 3  # 1 initial + 2 retries, not one more
+    assert rec.status == "dead" and rec.error_kind == "worker_lost"
+    assert rec.attempts == 3  # the DLQ transition consumed NO extra attempt
+    s = w.stats()
+    assert s["worker_lost_aborts"] == 3
+    assert s["reclaimed_leases"] == 2  # losses 1..2 requeued; loss 3 dead at reclaim
+    assert s["dead_lettered"] == 1
+
+
+def test_worker_loss_mixed_with_normal_failures_shares_budget():
+    loss_runs.clear()
+    clk = FakeClock()
+    rec = mixed_loss_task.delay()
+    w = Worker(["loss"], lease_s=10.0, heartbeat_s=0, clock=clk)
+    _drive_losses(rec, w, clk)
+    assert len(loss_runs) == 3
+    assert rec.status == "dead" and rec.error_kind == "transient_exhausted"
+
+
+def test_worker_loss_zero_retries_edge():
+    loss_runs.clear()
+
+    @task(queue="loss", max_retries=0, retry_delay=0.0, name="loss.zero")
+    def zero_retry_lossy():
+        loss_runs.append(1)
+        raise FakeWorkerLost()
+
+    clk = FakeClock()
+    rec = zero_retry_lossy.delay()
+    w = Worker(["loss"], lease_s=10.0, heartbeat_s=0, clock=clk)
+    _drive_losses(rec, w, clk, rounds=4)
+    assert len(loss_runs) == 1
+    assert rec.status == "dead" and rec.error_kind == "worker_lost"
+
+
+# --------------------------------------------------------- heartbeats + leases
+def test_lease_heartbeat_outlives_short_lease():
+    """A task running LONGER than its lease is not double-executed: the
+    executing worker renews the lease on a heartbeat, so a concurrent worker
+    never reclaims it (the seed plane double-executed here)."""
+    ran = []
+
+    @task(queue="hb", name="hb.slow")
+    def slow_hb_task():
+        ran.append(1)
+        time.sleep(2.2)
+        return "slow done"
+
+    rec = slow_hb_task.delay()
+    w = Worker(["hb"], lease_s=1.0, heartbeat_s=0.25)
+    rival = Worker(["hb"], lease_s=1.0, heartbeat_s=0.25)
+    th = threading.Thread(target=w.run_one)
+    th.start()
+    try:
+        # let w win the initial claim before the rival starts poaching
+        deadline = time.time() + 4.0
+        while time.time() < deadline:
+            rec.refresh()
+            if rec.status == "running":
+                break
+            time.sleep(0.02)
+        assert rec.status == "running"
+        stolen = 0
+        while th.is_alive() and time.time() < deadline:
+            if rival.claim() is not None:
+                stolen += 1
+            time.sleep(0.1)
+    finally:
+        th.join(timeout=10)
+    rec.refresh()
+    assert stolen == 0  # the heartbeat kept the lease warm the whole run
+    assert ran == [1]
+    assert rec.status == "done" and rec.result == "slow done"
+    assert w.stats()["heartbeats"] >= 2
+
+
+def test_lost_lease_completion_is_discarded():
+    """A worker that lost its lease mid-run must not clobber the reclaiming
+    owner's state with its late completion (ownership-guarded transitions)."""
+    gate = threading.Event()
+
+    @task(queue="steal", name="steal.gated")
+    def gated_task():
+        gate.wait(10)
+        return "late"
+
+    rec = gated_task.delay()
+    w = Worker(["steal"], lease_s=300.0, heartbeat_s=0)
+    th = threading.Thread(target=w.run_one)
+    th.start()
+    try:
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            rec.refresh()
+            if rec.status == "running":
+                break
+            time.sleep(0.02)
+        assert rec.status == "running"
+        # simulate a reclaim: another worker now owns the row
+        TaskRecord.objects.filter(id=rec.id).update(lease_owner="thief")
+    finally:
+        gate.set()
+        th.join(timeout=10)
+    rec.refresh()
+    assert rec.lease_owner == "thief" and rec.status == "running"
+    assert rec.result is None  # the late "done" write was discarded
+    assert w.stats()["completions_discarded"] == 1
+
+
+# ------------------------------------------------------------------ drain/stop
+def test_drain_finishes_inflight_and_stops_claiming():
+    gate = threading.Event()
+    finished = []
+
+    @task(queue="drainq", name="drainq.slow")
+    def drain_slow_task():
+        gate.wait(10)
+        finished.append(1)
+
+    drain_slow_task.delay()
+    for i in range(3):
+        add_task.delay(i, i)  # queued behind, on another queue name? no: drainq only
+    pending_before = TaskRecord.objects.filter(status="pending").count()
+    w = Worker(["drainq"], poll_s=0.01).start()
+    deadline = time.time() + 5.0
+    while not TaskRecord.objects.filter(status="running").count() and time.time() < deadline:
+        time.sleep(0.02)
+    result: list = []
+    t = threading.Thread(target=lambda: result.append(w.drain(timeout_s=10.0)))
+    t.start()
+    time.sleep(0.3)
+    assert not result  # drain WAITS for the in-flight task
+    gate.set()
+    t.join(timeout=10)
+    assert result == [True]
+    assert finished == [1]
+    w.stop(timeout_s=1.0)
+    # the add_task rows (other queue) were never claimed by this worker
+    assert TaskRecord.objects.filter(status="pending").count() == pending_before - 1
+
+
+def test_release_claim_returns_row_to_pending():
+    rec = add_task.delay(5, 6)
+    w = Worker(["query"])
+    claimed = w.claim()
+    assert claimed.id == rec.id
+    w._release_claim(claimed)
+    rec.refresh()
+    assert rec.status == "pending" and rec.lease_owner is None
+    # and it still executes normally afterwards
+    Worker(["query"]).run_until_idle()
+    rec.refresh()
+    assert rec.status == "done"
+
+
+# ------------------------------------------------------------- chords with DLQ
+@task(queue="processing")
+def poison_member(n):
+    calls.append(("poison", n))
+    raise PermanentTaskError("bad member")
+
+
+def test_legacy_failed_rows_migrate_and_never_block_chords():
+    """A DB written by the pre-DLQ plane may hold terminal status='failed'
+    rows: they must count as settled for their chord and surface in the DLQ
+    (claim()'s one-shot migration), not zombie forever."""
+    records = group(
+        [(member_task, (1,), {}), (member_task, (2,), {})],
+        chord=(finalize_task, (), {}),
+    )
+    # simulate the old plane having exhausted member 1 before the upgrade
+    TaskRecord.objects.filter(id=records[0].id).update(status="failed")
+    w = Worker(["processing"])
+    for _ in range(3):
+        w.run_until_idle()
+    finals = [c for c in calls if c[0] == "finalize"]
+    assert len(finals) == 1  # the legacy-failed member did not wedge the chord
+    legacy = TaskRecord.objects.get(id=records[0].id)
+    assert legacy.status == "dead"  # migrated: visible to dlq list/requeue
+    assert legacy.error_kind == "transient_exhausted"
+
+
+def test_chord_fires_once_when_member_dead_letters():
+    group(
+        [
+            (member_task, (1,), {}),
+            (poison_member, (2,), {}),
+            (member_task, (3,), {}),
+        ],
+        chord=(finalize_task, (), {}),
+    )
+    w = Worker(["processing"])
+    for _ in range(3):
+        w.run_until_idle()
+    finals = [c for c in calls if c[0] == "finalize"]
+    assert len(finals) == 1  # dead member counts as settled; chord fires once
+    dead = TaskRecord.objects.filter(status="dead").all()
+    assert len(dead) == 1 and dead[0].error_kind == "permanent"
+
+
+# ----------------------------------------------------------- stats + DLQ CLI
+def test_queue_stats_shape():
+    add_task.delay(1, 1)
+    permanent_task.delay()
+    Worker(["tax"]).run_until_idle()
+    stats = queue_stats()
+    assert stats["dlq_size"] == 1
+    assert stats["queues"]["query"]["pending"] == 1
+    assert stats["queues"]["query"]["oldest_pending_age_s"] is not None
+    assert stats["queues"]["query"]["oldest_pending_age_s"] >= 0.0
+    assert stats["queues"]["tax"]["dead"] == 1
+
+
+def test_dlq_cli_list_requeue_purge(capsys):
+    from types import SimpleNamespace
+
+    from django_assistant_bot_tpu.cli import queue_cmd
+
+    rec = permanent_task.delay()
+    Worker(["tax"]).run_until_idle()
+    rec.refresh()
+    assert rec.status == "dead"
+
+    def ns(**kw):
+        base = dict(
+            action="dlq", subaction="list", queue=None, id=None, status=None, all=False
+        )
+        base.update(kw)
+        return SimpleNamespace(**base)
+
+    assert queue_cmd.run(ns()) == 0
+    out = capsys.readouterr().out
+    assert "permanent" in out and "permanent_task" in out
+
+    # requeue needs --id or --all
+    assert queue_cmd.run(ns(subaction="requeue")) == 1
+    assert queue_cmd.run(ns(subaction="requeue", id=rec.id)) == 0
+    rec.refresh()
+    assert rec.status == "pending" and rec.attempts == 0 and rec.error_kind is None
+
+    Worker(["tax"]).run_until_idle()  # it is permanent: dead again
+    rec.refresh()
+    assert rec.status == "dead"
+    assert queue_cmd.run(ns(subaction="purge")) == 0
+    assert TaskRecord.objects.filter(status="dead").count() == 0
+
+
+# -------------------------------------------------------- chaos sites + metrics
+def test_task_raise_site_retries_through_backoff():
+    from django_assistant_bot_tpu.serving.faults import (
+        FaultInjector,
+        reset_global_injector,
+        set_global_injector,
+    )
+
+    inj = FaultInjector({"task_raise": {"fire_on": [1]}})
+    set_global_injector(inj)
+    try:
+        rec = add_task.delay(20, 22)
+        w = Worker(["query"])
+        w.run_until_idle()
+        w.run_until_idle()
+        rec.refresh()
+        assert rec.status == "done" and rec.result == 42
+        assert rec.attempts == 2  # injected fault burned exactly one attempt
+        assert inj.stats()["task_raise"]["fires"] == 1
+    finally:
+        reset_global_injector()
+
+
+def test_injected_worker_lost_site_abandons_then_recovers():
+    from django_assistant_bot_tpu.serving.faults import (
+        FaultInjector,
+        reset_global_injector,
+        set_global_injector,
+    )
+
+    inj = FaultInjector({"task_worker_lost": {"fire_on": [1]}})
+    set_global_injector(inj)
+    clk = FakeClock()
+    try:
+        rec = add_task.delay(7, 8)
+        w = Worker(["query"], lease_s=10.0, heartbeat_s=0, clock=clk)
+        w.run_one()
+        rec.refresh()
+        assert rec.status == "running"  # abandoned with its lease intact
+        clk.advance(11.0)
+        w.run_one()
+        rec.refresh()
+        assert rec.status == "done" and rec.result == 15
+        assert w.stats()["worker_lost_aborts"] == 1
+        assert w.stats()["reclaimed_leases"] == 1
+    finally:
+        reset_global_injector()
+
+
+def test_task_plane_metrics_render_and_parse():
+    from types import SimpleNamespace
+
+    from django_assistant_bot_tpu.serving import obs
+
+    add_task.delay(1, 2)
+    permanent_task.delay()
+    w = Worker(["query", "tax"])
+    w.run_until_idle()
+    assert w.register_metrics()
+    try:
+        text = obs.render_prometheus(
+            SimpleNamespace(generators={}, autoscalers={}, embedders={})
+        )
+        fams = obs.parse_prometheus_text(text)
+        assert "dabt_queue_depth" in fams
+        assert "dabt_queue_dlq_size" in fams
+        dlq = [v for n, _, v in fams["dabt_queue_dlq_size"]["samples"]]
+        assert dlq == [1.0]
+        done = [v for n, _, v in fams["dabt_queue_done_total"]["samples"]]
+        assert done == [1.0]
+        assert "dabt_queue_dead_letters_total" in fams
+    finally:
+        obs.set_task_plane_provider(None)
+
+
+def test_dead_letter_records_and_dumps_flight_event():
+    class MiniFlight:
+        def __init__(self):
+            self.events = []
+            self.dumps = []
+
+        def record(self, event, **fields):
+            self.events.append((event, fields))
+
+        def dump(self, reason, **context):
+            self.dumps.append((reason, context))
+
+    flight = MiniFlight()
+    rec = permanent_task.delay()
+    Worker(["tax"], flight=flight).run_until_idle()
+    rec.refresh()
+    assert rec.status == "dead"
+    kinds = [(e, f.get("kind")) for e, f in flight.events]
+    assert ("task_dead_letter", "permanent") in kinds
+    # a dead letter is a crash artifact: the ring is flushed to disk
+    assert len(flight.dumps) == 1
+    reason, ctx = flight.dumps[0]
+    assert reason == "task_dead_letter" and ctx["task_id"] == rec.id
+
+
+def test_heartbeat_stops_at_max_task_lifetime():
+    """A HUNG body must not keep its lease alive forever: past
+    max_task_lifetime_s the heartbeat stands down, the lease lapses, and a
+    rival worker can reclaim — the pre-heartbeat bound, restored."""
+    gate = threading.Event()
+
+    @task(queue="hang", name="hang.stuck")
+    def stuck_task():
+        gate.wait(15)
+        return "zombie result"
+
+    rec = stuck_task.delay()
+    w = Worker(["hang"], lease_s=0.5, heartbeat_s=0.1, max_task_lifetime_s=0.2)
+    rival = Worker(["hang"], lease_s=300.0, heartbeat_s=0)
+    th = threading.Thread(target=w.run_one)
+    th.start()
+    try:
+        # heartbeats cap at ~0.2s, the last renewed lease lapses by ~0.8s
+        deadline = time.time() + 6.0
+        reclaimed = None
+        while time.time() < deadline and reclaimed is None:
+            time.sleep(0.15)
+            reclaimed = rival.claim()
+        assert reclaimed is not None and reclaimed.id == rec.id
+        assert w.stats()["heartbeats_capped"] == 1
+    finally:
+        gate.set()
+        th.join(timeout=10)
+    # the zombie's completion was discarded (rival owns the lease)
+    rec.refresh()
+    assert rec.lease_owner == rival.worker_id
+    assert rec.result is None
+    assert w.stats()["completions_discarded"] == 1
