@@ -206,6 +206,7 @@ def _build_gen_engine(
     speculative=0,
     scheduler=None,
     obs=True,
+    decode_steps=None,
 ):
     max_slots = max_slots or SLOTS
     import jax
@@ -222,12 +223,16 @@ def _build_gen_engine(
     elif quantize == "int8_device_full":
         # embed/head int8 too: kills the 2-byte lm_head stream in decode
         params = llama.init_int8(cfg, jax.random.PRNGKey(0), quantize_embed=True)
+    elif quantize == "int4_device":
+        # grouped int4, packed two-per-byte, synthesized in HBM — 0.5
+        # bytes/weight on the decode read path (ops/quant.py QTensor4)
+        params = llama.init_int4(cfg, jax.random.PRNGKey(0))
     else:
         params = llama.init(cfg, jax.random.PRNGKey(0))
-    if quantize == "int8":
+    if quantize in ("int8", "int4"):
         from django_assistant_bot_tpu.ops.quant import quantize_decoder_params
 
-        params = quantize_decoder_params(params)
+        params = quantize_decoder_params(params, fmt=quantize)
     mesh = get_mesh()
     with mesh:
         params = shard_pytree(params, llama.logical_axes(cfg), mesh)
@@ -245,6 +250,7 @@ def _build_gen_engine(
         speculative=speculative,
         scheduler=scheduler,
         obs=obs,
+        decode_steps=decode_steps,
     )
     # compile every (batch, seq) prefill shape BEFORE measuring; the decode-only
     # engines are built with just the bucket their prompts hit (same bucket the
@@ -285,9 +291,13 @@ def bench_decode(eng) -> dict:
     total_new = sum(r.completion_tokens for r in results)
     ttfts = sorted(r.ttft_s for r in results)
     p99_idx = min(len(ttfts) - 1, max(0, math.ceil(0.99 * len(ttfts)) - 1))
+    from django_assistant_bot_tpu.ops.quant import num_weights
+
     leaves = jax.tree.leaves(eng.params)
     param_bytes = sum(l.nbytes for l in leaves)
-    n_params = sum(l.size for l in leaves)
+    # packed formats count UNPACKED weights (QTensor4 holds two per byte) and
+    # scales are excluded — the honest MFU numerator (2 FLOPs/weight/token)
+    n_params = num_weights(eng.params)
     tok_s = total_new / wall
     # Pure on-device step cost (no prefill wave, no host loop): the roofline
     # denominator.  steady tok/s = slots/step; HBM floor counts one full weight
@@ -331,6 +341,21 @@ def bench_decode(eng) -> dict:
         "decode_pure_step_ms": round(step_s * 1e3, 3),
         "decode_steady_tokens_per_s": round(steady_tok_s, 2),
         "decode_steady_hbm_gbps": round(param_bytes / step_s / 1e9, 1),
+        # byte-ledger roofline at the steady rate: MFU as a FRACTION (the
+        # compact record's per-arm keys — prose percentages drift) and the
+        # HBM GB/s the ledger's per-step bytes imply at the measured step
+        # time (weights + head + the page/chunk-rounded KV read)
+        "decode_mfu_frac": round(steady_tok_s * 2 * n_params / 197e12, 6),
+        "decode_hbm_gbps": round(
+            decode_byte_ledger(
+                eng, fill_len=DECODE_PROMPT_LEN + DECODE_NEW_TOKENS
+            )["total_gb_per_step"]
+            / step_s,
+            2,
+        ),
+        "decode_steps": eng.decode_steps,
+        "decode_upload_overlap_frac": stats.get("upload_overlap_frac", 0.0),
+        "decode_weight_bits": eng.weight_bits,
         "decode_hbm_stream_probe_gbps": round(ceiling_gbps, 1),
         "decode_tick_issue_ms": stats["issue_ms"],
         "decode_tick_block_ms": stats["block_ms"],
@@ -1102,6 +1127,8 @@ def bench_int8() -> dict:
                 "decode_int8_pure_step_ms": q8["decode_pure_step_ms"],
                 "decode_int8_steady_tokens_per_s": q8["decode_steady_tokens_per_s"],
                 "decode_int8_kv_read_frac": q8["decode_kv_read_frac"],
+                "decode_int8_mfu_frac": q8["decode_mfu_frac"],
+                "decode_int8_hbm_gbps": q8["decode_hbm_gbps"],
                 "decode_int8_ledger": decode_byte_ledger(eng, fill_len=fill),
             }
         )
@@ -1200,6 +1227,140 @@ def bench_slots_ab(trials: int = 3) -> dict:
         ],
         "decode_int8_slots_b": slots_b,
     }
+
+
+def bench_fused_int4(trials: int = 3) -> dict:
+    """fused_*/int4_* section (docs/QUANT.md): the roofline decode levers.
+
+    Three INTERLEAVED probe arms at the same geometry / KV byte ledger, so
+    chip-rate drift hits every arm equally (the bench_slots_ab discipline):
+
+    - **unfused**  — int8 weights, decode_steps=1 (the baseline every claim
+      is against);
+    - **fused**    — int8 weights, decode_steps=N (one jit spans N chained
+      decode steps: dispatch + host bookkeeping amortize over N tokens);
+    - **int4**     — grouped int4 weights (0.5 bytes/weight packed),
+      decode_steps=N (both levers together).
+
+    Per arm: median-of-trials pure step time, steady tok/s, and the byte
+    ledger's MFU fraction + achieved HBM GB/s — every throughput claim
+    carries its bytes.  The accuracy cost is a NUMBER, not a vibe:
+    ``int4_logit_err_rel`` quantizes one shared bf16 weight set at tiny
+    geometry (the quantizer's error is a property of format x group size,
+    not of the big arms' synthetic random weights) and reports max logit
+    error vs the bf16 forward, alongside int8's, plus the in-dot vs
+    dequantized-reference kernel-identity error (which must be ~0: the
+    grouped dot IS the dequantized dot, reassociated).
+    """
+    import jax
+    import numpy as np
+
+    from django_assistant_bot_tpu.models import DecoderConfig, llama
+    from django_assistant_bot_tpu.ops.quant import (
+        INT4_GROUP_SIZE,
+        deq,
+        num_weights,
+        quantize_decoder_params,
+    )
+
+    n_steps = int(os.environ.get("BENCH_DECODE_STEPS", "8"))
+    fill = DECODE_PROMPT_LEN + DECODE_NEW_TOKENS
+    arms = {
+        "unfused": dict(quantize="int8_device", decode_steps=1),
+        "fused": dict(quantize="int8_device", decode_steps=n_steps),
+        "int4": dict(quantize="int4_device", decode_steps=n_steps),
+    }
+    engines: dict = {}
+    out: dict = {"fused_decode_steps": n_steps}
+    try:
+        for arm, kw in arms.items():
+            engines[arm], _ = _build_gen_engine(
+                buckets=(_decode_bucket(),), prefix_cache=0, **kw
+            )
+        samples: dict = {arm: [] for arm in arms}
+        for _ in range(trials):
+            for arm in arms:  # interleaved: U F I U F I ...
+                samples[arm].append(
+                    engines[arm].probe_decode(iters=8, fill_len=fill)
+                )
+        for arm, ss in samples.items():
+            eng = engines[arm]
+            med = statistics.median(ss)
+            steady = eng.max_slots / med
+            ledger = decode_byte_ledger(eng, fill_len=fill)
+            n_w = num_weights(eng.params)
+            prefix = {"unfused": "decode_unfused", "fused": "fused", "int4": "int4"}[arm]
+            out[f"{prefix}_step_ms"] = round(med * 1e3, 3)
+            out[f"{prefix}_steady_tokens_per_s"] = round(steady, 2)
+            out[f"{prefix}_mfu_frac"] = round(steady * 2 * n_w / 197e12, 6)
+            out[f"{prefix}_hbm_gbps"] = round(
+                ledger["total_gb_per_step"] / med, 2
+            )
+            out[f"{prefix}_ledger"] = ledger
+        out["fused_vs_unfused_speedup"] = round(
+            out["fused_steady_tokens_per_s"]
+            / max(out["decode_unfused_steady_tokens_per_s"], 1e-9),
+            3,
+        )
+        out["int4_vs_unfused_speedup"] = round(
+            out["int4_steady_tokens_per_s"]
+            / max(out["decode_unfused_steady_tokens_per_s"], 1e-9),
+            3,
+        )
+        out["int4_vs_fused_speedup"] = round(
+            out["int4_steady_tokens_per_s"]
+            / max(out["fused_steady_tokens_per_s"], 1e-9),
+            3,
+        )
+        # upload double-buffering evidence rides the fused arm's wall-clock
+        # trace (the probe path bypasses the loop's prestage hook)
+        rng = np.random.default_rng(3)
+        futs = [
+            engines["fused"].submit(
+                rng.integers(1, 255, DECODE_PROMPT_LEN).tolist(),
+                max_tokens=16 + 8 * (i % 3),
+                temperature=0.8,
+            )
+            for i in range(engines["fused"].max_slots)
+        ]
+        for f in futs:
+            f.result(timeout=600)
+        out["fused_upload_overlap_frac"] = engines["fused"].upload_overlap_frac()
+        out["fused_decode_steps_effective"] = engines[
+            "fused"
+        ].tick_stats()["decode_steps_effective"]
+    finally:
+        for eng in engines.values():
+            eng.stop()
+    # accuracy bound at tiny geometry from ONE shared bf16 weight set — the
+    # quantizer-error methodology (docs/QUANT.md), cheap at any bench scale
+    cfg_t = DecoderConfig.tiny()
+    params_t = llama.init(cfg_t, jax.random.PRNGKey(7))
+    ids = jax.numpy.asarray(
+        np.random.default_rng(11).integers(1, 200, (2, 16)), jax.numpy.int32
+    )
+    ref = np.asarray(llama.forward(params_t, cfg_t, ids))
+    denom = max(float(np.abs(ref).max()), 1e-6)
+    q8_t = quantize_decoder_params(params_t, fmt="int8")
+    q4_t = quantize_decoder_params(params_t, fmt="int4")
+    l8 = np.asarray(llama.forward(q8_t, cfg_t, ids))
+    l4 = np.asarray(llama.forward(q4_t, cfg_t, ids))
+    dq4 = dict(q4_t)
+    dq4["layers"] = {
+        k: deq(v, cfg_t.dtype) for k, v in q4_t["layers"].items()
+    }
+    l4_ref = np.asarray(llama.forward(dq4, cfg_t, ids))
+    out["int8_logit_err_rel"] = round(float(np.abs(l8 - ref).max()) / denom, 5)
+    out["int4_logit_err_rel"] = round(float(np.abs(l4 - ref).max()) / denom, 5)
+    out["int4_indot_vs_deq_err_rel"] = round(
+        float(np.abs(l4 - l4_ref).max()) / max(float(np.abs(l4_ref).max()), 1e-6),
+        6,
+    )
+    # the group size the arms and the accuracy probe ACTUALLY quantized at
+    # (both use the quantizer default), so the recorded error bound can
+    # never be attributed to a stale hardcoded number
+    out["int4_group_size"] = INT4_GROUP_SIZE
+    return out
 
 
 def bench_paged() -> dict:
@@ -1365,6 +1526,13 @@ import json
 import bench
 
 print(json.dumps(bench.bench_ingest_only()))
+"""
+
+_FUSED_INT4_SNIPPET = """
+import json
+import bench
+
+print(json.dumps(bench.bench_fused_int4()))
 """
 
 _PAGED_SNIPPET = """
@@ -3206,6 +3374,23 @@ _COMPACT_KEYS = (
     "decode_steady_tokens_per_s",
     "decode_kv_read_frac",
     "decode_int8_steady_tokens_per_s",
+    "decode_mfu_frac",
+    "decode_hbm_gbps",
+    "decode_int8_mfu_frac",
+    "decode_int8_hbm_gbps",
+    "decode_unfused_steady_tokens_per_s",
+    "fused_steady_tokens_per_s",
+    "int4_steady_tokens_per_s",
+    "fused_decode_steps",
+    "fused_vs_unfused_speedup",
+    "int4_vs_unfused_speedup",
+    "fused_mfu_frac",
+    "int4_mfu_frac",
+    "fused_hbm_gbps",
+    "int4_hbm_gbps",
+    "int4_logit_err_rel",
+    "int8_logit_err_rel",
+    "fused_upload_overlap_frac",
     "decode_int8_slots_b_steady_tokens_per_s",
     "decode_int8_slots_b",
     "slots_ab_winner",
@@ -3360,6 +3545,7 @@ def main() -> None:
         baseline_thread.start()
         extras.update(bench_core())
         extras.update(bench_int8())
+        extras.update(bench_fused_int4())
         extras.update(bench_paged())
         extras.update(bench_longctx_decode(slots=4))
         moe_eng, _ = _build_gen_engine(_moe_cfg(), buckets=(_decode_bucket(),))
@@ -3417,6 +3603,10 @@ def main() -> None:
     # 3) config 2b: int8 weight-only decode at 1B (halves decode HBM reads)
     #    + the interleaved 16-vs-32 slot A/B/A trials
     run("int8", _INT8_SNIPPET, cap_s=900)
+    # 3a) roofline decode push: interleaved unfused-int8 / fused-int8 /
+    #     fused-int4 probe arms with per-arm byte-ledger MFU + HBM GB/s and
+    #     the int4 logit-error bound (docs/QUANT.md evidence)
+    run("fused_int4", _FUSED_INT4_SNIPPET, cap_s=700)
     # 3a') paged KV plane: slots-at-fixed-HBM A/B (legacy vs paged on the
     #      same byte ledger) + prefix-hit TTFT vs the r4 prefix cache
     run("paged", _PAGED_SNIPPET, cap_s=600)

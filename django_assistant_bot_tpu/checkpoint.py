@@ -203,8 +203,41 @@ def read_manifest(path: str) -> Mapping[str, Any]:
         return json.load(f)
 
 
+def _restore_quant_leaves(node: Any) -> Any:
+    """Convert rebuilt ``{"@q": ..., "@scale": ...}`` attr-dicts back into
+    QTensor/QTensor4 (the only NamedTuples that appear in model params —
+    optax state restores through ``like=``, which needs no rebuild)."""
+    if isinstance(node, dict):
+        if node and all(isinstance(k, str) and k.startswith("@") for k in node):
+            fields = {k[1:]: v for k, v in node.items()}
+            if set(fields) == {"q", "scale"}:
+                from .ops.quant import QTensor, QTensor4
+
+                cls = (
+                    QTensor4
+                    if np.asarray(fields["q"]).dtype == np.dtype(np.uint8)
+                    else QTensor
+                )
+                return cls(q=fields["q"], scale=fields["scale"])
+            raise ValueError(
+                f"cannot rebuild namedtuple leaf with fields {sorted(fields)}; "
+                "restore with a `like=` template"
+            )
+        return {k: _restore_quant_leaves(v) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_restore_quant_leaves(v) for v in node]
+    return node
+
+
 def _rebuild_tree(entries, values):
-    """Rebuild a nested dict/list tree from jax keystr paths (model-params case)."""
+    """Rebuild a nested dict/list tree from jax keystr paths (model-params case).
+
+    Attribute path segments (keystr renders a NamedTuple field as ``.q``)
+    collect under ``"@<field>"`` dict keys and convert back to their
+    quantized-tensor types afterwards — without this, a quantized
+    checkpoint's ``wq`` silently collapsed onto whichever field restored
+    LAST (the scale array overwrote the int8 weights: a converted
+    ``--quantize int8`` checkpoint was unservable)."""
     root: Any = None
 
     def ensure(container, token, nxt):
@@ -218,10 +251,13 @@ def _rebuild_tree(entries, values):
             container[token] = nxt
         return container[token]
 
-    token_re = re.compile(r"\['([^']*)'\]|\[(\d+)\]")
+    token_re = re.compile(r"\['([^']*)'\]|\[(\d+)\]|\.([A-Za-z_][A-Za-z0-9_]*)")
     for entry, value in zip(entries, values):
         raw = token_re.findall(entry["key"])
-        tokens = [t[0] if t[0] != "" else int(t[1]) for t in raw]
+        tokens = [
+            t[0] if t[0] != "" else (int(t[1]) if t[1] != "" else "@" + t[2])
+            for t in raw
+        ]
         if not tokens:
             return value  # single-leaf tree
         if root is None:
@@ -236,7 +272,7 @@ def _rebuild_tree(entries, values):
             node[last] = value
         else:
             node[last] = value
-    return root
+    return _restore_quant_leaves(root)
 
 
 def restore_checkpoint(
@@ -374,10 +410,22 @@ def load_model(path: str, *, dtype=None):
     cfg = _config_from_dict(kind, cfg_d)
     params, _, _ = restore_checkpoint(path)
     if dtype is not None:
-        params = jax.tree.map(
-            lambda a: a.astype(np.dtype(dtype)) if np.issubdtype(a.dtype, np.floating)
-            or a.dtype == np.dtype("bfloat16")
-            else a,
-            params,
-        )
+        from .ops.quant import QTensor, QTensor4
+
+        def _is_q(x):
+            return isinstance(x, (QTensor, QTensor4))
+
+        def cast(a):
+            if _is_q(a):
+                # quantized leaves keep their contract: integer payload +
+                # f32 scales (a bf16-cast scale would silently degrade the
+                # dequant everywhere the format promises f32 precision)
+                return a
+            if np.issubdtype(a.dtype, np.floating) or a.dtype == np.dtype(
+                "bfloat16"
+            ):
+                return a.astype(np.dtype(dtype))
+            return a
+
+        params = jax.tree.map(cast, params, is_leaf=_is_q)
     return kind, cfg, params, manifest["meta"]

@@ -113,14 +113,17 @@ def convert_one(src_dir: str, out_dir: str, *, kind: str, quantize: Optional[str
         cfg, params = load_encoder(src_dir)
     else:
         cfg, params = load_decoder(src_dir)
-        if quantize == "int8":
+        if quantize in ("int8", "int4"):
             from ..ops.quant import quantize_decoder_params
 
-            params = quantize_decoder_params(params)
+            params = quantize_decoder_params(params, fmt=quantize)
         elif quantize:
             raise SystemExit(f"unknown --quantize {quantize!r}")
     path = save_model(out_dir, kind, cfg, params, meta={"tokenizer": src_dir})
-    print(f"{src_dir}: converted ({kind}{', int8' if quantize else ''}) -> {path}")
+    print(
+        f"{src_dir}: converted ({kind}{', ' + quantize if quantize else ''}) "
+        f"-> {path}"
+    )
     return path
 
 
@@ -198,9 +201,10 @@ def add_parser(sub):
     )
     p.add_argument(
         "--quantize",
-        choices=("int8",),
+        choices=("int8", "int4"),
         default=None,
-        help="pre-quantize decoder weights during --convert",
+        help="pre-quantize decoder weights during --convert (int4 = grouped, "
+        "packed two-per-byte — docs/QUANT.md)",
     )
     return p
 

@@ -22,6 +22,30 @@ def add_parser(sub):
         "warmup_json is set per model in the config file)",
     )
     p.add_argument(
+        "--autotune",
+        action="store_true",
+        help="byte-ledger geometry autotune (docs/QUANT.md): for every "
+        "decoder entry, sweep kv_page_size x max_slots x decode_steps "
+        "through the decode byte ledger and print the recommended config "
+        "as JSON, then exit without starting the server.  Pure config "
+        "arithmetic — no weights load.  Standalone twin: tools/autotune.py",
+    )
+    p.add_argument(
+        "--autotune-hbm-gb",
+        type=float,
+        default=None,
+        metavar="GB",
+        help="HBM byte budget for --autotune (default 16.0)",
+    )
+    p.add_argument(
+        "--autotune-hbm-gbps",
+        type=float,
+        default=None,
+        metavar="GBPS",
+        help="assumed achieved HBM bandwidth for --autotune (default 819; "
+        "feed the bench's measured decode_hbm_gbps for a calibrated sweep)",
+    )
+    p.add_argument(
         "--replicas",
         type=int,
         default=None,
@@ -302,6 +326,77 @@ def run(args) -> int:
             name: {**spec, **(sched_overrides if spec.get("kind") == "decoder" else {})}
             for name, spec in config.items()
         }
+    if getattr(args, "autotune", False):
+        # geometry planning mode: sweep the decode byte ledger per decoder
+        # and print the recommended {kv_page_size, max_slots, decode_steps}
+        # — no weights load, no server start (docs/QUANT.md "Autotuning")
+        import dataclasses as _dc
+        import json as _json
+
+        from ..models import DecoderConfig
+        from ..serving.autotune import recommend_for_spec
+        from ..serving.registry import ModelSpec
+
+        overrides = {}
+        if getattr(args, "autotune_hbm_gb", None) is not None:
+            overrides["hbm_budget_gb"] = args.autotune_hbm_gb
+        if getattr(args, "autotune_hbm_gbps", None) is not None:
+            overrides["hbm_gbps"] = args.autotune_hbm_gbps
+        results = []
+        for name, d in config.items():
+            if d.get("kind") != "decoder":
+                continue
+            spec = ModelSpec.from_dict(name.lower(), d)
+            model_overrides = dict(overrides)  # per-model (manifest bits)
+            try:
+                if spec.checkpoint:
+                    # the native manifest carries the full model config as
+                    # JSON — geometry without any weight load
+                    from ..checkpoint import _config_from_dict, read_manifest
+
+                    manifest = read_manifest(spec.checkpoint)
+                    meta = manifest["meta"]
+                    cfg = _config_from_dict(
+                        meta["kind"], dict(meta["config"])
+                    )
+                    if not spec.quantize:
+                        # pre-quantized checkpoints declare themselves via
+                        # their packed-weight leaf dtypes (".q" fields)
+                        qd = {
+                            e.get("dtype")
+                            for e in manifest.get("leaves", [])
+                            if str(e.get("key", "")).endswith(".q")
+                        }
+                        if "uint8" in qd:
+                            model_overrides.setdefault("weight_bits", 4)
+                        elif "int8" in qd:
+                            model_overrides.setdefault("weight_bits", 8)
+                elif spec.path:
+                    from ..models.hf_loader import read_hf_config
+
+                    cfg = DecoderConfig.from_hf(read_hf_config(spec.path))
+                elif spec.tiny:
+                    cfg = DecoderConfig.tiny(num_experts=spec.num_experts)
+                    if spec.max_seq_len and spec.max_seq_len > cfg.max_seq_len:
+                        cfg = _dc.replace(
+                            cfg, max_seq_len=int(spec.max_seq_len)
+                        )
+                else:
+                    results.append(
+                        {
+                            "model": name,
+                            "skipped": "autotune needs a tiny, path-, or "
+                            "checkpoint-backed decoder",
+                        }
+                    )
+                    continue
+            except Exception as e:  # noqa: BLE001 - planning mode reports
+                results.append({"model": name, "error": str(e)})
+                continue
+            results.append(recommend_for_spec(spec, cfg, **model_overrides))
+        print(_json.dumps({"autotune": results}, indent=2))
+        return 0
+
     registry = ModelRegistry.from_config(config)
     # SIGTERM-triggered graceful drain (whole-router when --replicas > 1):
     # run_server's shutdown handler stops admission, waits for in-flight

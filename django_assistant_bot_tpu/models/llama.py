@@ -36,7 +36,7 @@ from ..ops.attention import (
     paged_tree_attention,
 )
 from ..ops.norms import rms_norm
-from ..ops.quant import QTensor, qeinsum
+from ..ops.quant import INT4_GROUP_SIZE, QTensor, qeinsum
 from ..ops.rope import apply_rope, rope_frequencies
 from ..parallel.sharding import with_constraint
 from .config import DecoderConfig
@@ -217,6 +217,151 @@ def init(cfg: DecoderConfig, rng: jax.Array) -> Params:
     return params
 
 
+def _synth_quant_params(
+    cfg: DecoderConfig,
+    rng: jax.Array,
+    *,
+    proj_fmt: str,
+    group_size: int = INT4_GROUP_SIZE,
+    quantize_embed: bool = False,
+    host_rng: bool = False,
+) -> Params:
+    """Shared scaffolding of :func:`init_int8` / :func:`init_int4`: draw the
+    random integer payloads directly into HBM (one fused program per shape —
+    run eagerly, every leaf's transient would coexist under async dispatch:
+    ~2x the whole model, the 8B init that "randomly" OOM'd a chip with 12 GB
+    free), set constant scales so dequantized magnitudes match :func:`init`'s
+    normal(0, E^-0.5), and assemble the same params skeleton.  Only the
+    projection constructor differs between the two formats — everything else
+    lives ONCE here so the int8 and int4 synthetic recipes cannot drift.
+
+    ``host_rng`` draws the random bytes with numpy on the host instead of
+    on-device threefry.  On a real chip the device draw wins (no transfer);
+    on the virtual CPU mesh threefry runs on the same cores it's "offloading"
+    to and is ~100x slower than numpy — the 8B/Mixtral dryrun stages spent
+    minutes of their budget inside it (r4's multichip timeout).
+    """
+    from ..ops.quant import QTensor, QTensor4, _int4_group
+
+    E, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    H, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    s = E ** -0.5
+    # uniform int8 has std ~127/sqrt(3); uniform int4 in [-8, 7] has std
+    # sqrt((16^2 - 1) / 12); the constant scale recovers the target std
+    UNIFORM8_STD = 127.0 / (3.0 ** 0.5)
+    UNIFORM4_STD = (255.0 / 12.0) ** 0.5
+    keys = iter(jax.random.split(rng, 16))
+
+    @functools.partial(jax.jit, static_argnums=(1, 2))
+    def _gen_bits(key, shape, to_int8):
+        # the uint8 draw converts (int8) or stays raw (int4 packed — one
+        # random byte IS two uniform nibbles) INSIDE the jit, so XLA writes
+        # the final dtype directly with a transient of the result's size
+        bits = jax.random.bits(key, shape, jnp.uint8)
+        return bits.astype(jnp.int8) if to_int8 else bits
+
+    host = (
+        np.random.default_rng(int(np.asarray(jax.random.key_data(rng)).ravel()[-1]))
+        if host_rng
+        else None
+    )
+
+    def qdense8(shape, target_std=None):
+        if host is not None:
+            q = jnp.asarray(host.integers(-127, 128, shape, np.int8))
+        else:
+            q = _gen_bits(next(keys), shape, True)
+            q.block_until_ready()  # serialize: peak transient = one leaf, not all
+        scale_shape = shape[:-2] + (1, shape[-1])
+        scale = jnp.full(scale_shape, (target_std or s) / UNIFORM8_STD, jnp.float32)
+        return QTensor(q=q, scale=scale)
+
+    def qdense4(shape, target_std=None):
+        *lead, dim, out_dim = shape
+        g = _int4_group(dim, group_size)
+        packed_shape = tuple(lead) + (dim // 2, out_dim)
+        if host is not None:
+            q = jnp.asarray(
+                host.integers(0, 256, packed_shape, np.uint8, endpoint=False)
+            )
+        else:
+            q = _gen_bits(next(keys), packed_shape, False)
+            q.block_until_ready()
+        scale_shape = tuple(lead) + (dim // g, out_dim)
+        scale = jnp.full(
+            scale_shape, (target_std or s) / UNIFORM4_STD, jnp.float32
+        )
+        return QTensor4(q=q, scale=scale)
+
+    def ndense(shape, scale=1.0):
+        # dense (non-quantized) leaves: embeddings/head/router
+        if host is not None:
+            arr = host.standard_normal(shape, np.float32) * scale
+            return jnp.asarray(arr).astype(cfg.dtype)
+        return jax.random.normal(next(keys), shape, cfg.dtype) * jnp.asarray(
+            scale, cfg.dtype
+        )
+
+    qdense = qdense4 if proj_fmt == "int4" else qdense8
+    layers: Dict[str, Any] = {
+        "attn_norm": jnp.ones((L, E), cfg.dtype),
+        "wq": qdense((L, E, H * D)),
+        "wk": qdense((L, E, KH * D)),
+        "wv": qdense((L, E, KH * D)),
+        "wo": qdense((L, H * D, E)),
+        "mlp_norm": jnp.ones((L, E), cfg.dtype),
+    }
+    if cfg.attn_bias:
+        layers.update(
+            {
+                "bq": jnp.zeros((L, H * D), cfg.dtype),
+                "bk": jnp.zeros((L, KH * D), cfg.dtype),
+                "bv": jnp.zeros((L, KH * D), cfg.dtype),
+            }
+        )
+    if cfg.is_moe:
+        X = cfg.num_experts
+        layers.update(
+            {
+                # the router stays dense: moe_mlp reads it in f32 (and
+                # quantize_decoder_params leaves it out too — tiny + routing
+                # quality is disproportionately sensitive)
+                "router": ndense((L, E, X), s),
+                "w_gate": qdense((L, X, E, F)),
+                "w_up": qdense((L, X, E, F)),
+                "w_down": qdense((L, X, F, E), target_std=F ** -0.5),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": qdense((L, E, F)),
+                "w_up": qdense((L, E, F)),
+                "w_down": qdense((L, F, E), target_std=F ** -0.5),
+            }
+        )
+    # embed/head quantize as INT8 in both formats: the row gather dequantizes
+    # only the gathered slice, and per-channel int8 is the established
+    # embedding format here (embedding/head quality is disproportionately
+    # sensitive — 4-bit tables buy little and cost much)
+    params: Params = {
+        "tok_embed": (
+            qdense8((cfg.vocab_size, E), target_std=1.0)
+            if quantize_embed
+            else ndense((cfg.vocab_size, E))
+        ),
+        "final_norm": jnp.ones((E,), cfg.dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = (
+            qdense8((E, cfg.vocab_size))
+            if quantize_embed
+            else ndense((E, cfg.vocab_size), s)
+        )
+    return params
+
+
 def init_int8(
     cfg: DecoderConfig,
     rng: jax.Array,
@@ -241,111 +386,52 @@ def init_int8(
     Layer projections become :class:`~..ops.quant.QTensor` (int8 + per-output
     -channel f32 scales, contraction dim -2 = 1) exactly like
     ``quantize_decoder_params`` output; norms/embeddings/head stay in
-    ``cfg.dtype``.  ``random.bits`` at uint8 keeps the transient generation
-    buffer ~1x the result (randint would stage an int32 intermediate, 4x).
-
-    ``host_rng`` draws the int8 bytes with numpy on the host instead of
-    on-device threefry.  On a real chip the device draw wins (no transfer);
-    on the virtual CPU mesh threefry runs on the same cores it's "offloading"
-    to and is ~100x slower than numpy — the 8B/Mixtral dryrun stages spent
-    minutes of their budget inside it (r4's multichip timeout).
+    ``cfg.dtype``.  Shared scaffolding (incl. the ``host_rng`` virtual-mesh
+    escape hatch): :func:`_synth_quant_params`.
     """
-    from ..ops.quant import QTensor
-
-    E, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
-    H, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    s = E ** -0.5
-    # uniform int8 has std ~127/sqrt(3); scale recovers the target std
-    UNIFORM_STD = 127.0 / (3.0 ** 0.5)
-    keys = iter(jax.random.split(rng, 16))
-
-    @functools.partial(jax.jit, static_argnums=(1,))
-    def _gen_q(key, shape):
-        # one fused program per shape: the uint8 draw converts to int8 inside
-        # the jit, so XLA writes int8 directly — run EAGERLY this is two
-        # materialized buffers per leaf, and with async dispatch every leaf's
-        # transient coexists (~2x the whole model: the 8B init that "randomly"
-        # OOM'd a chip with 12 GB free)
-        return jax.random.bits(key, shape, jnp.uint8).astype(jnp.int8)
-
-    host = (
-        np.random.default_rng(int(np.asarray(jax.random.key_data(rng)).ravel()[-1]))
-        if host_rng
-        else None
+    return _synth_quant_params(
+        cfg,
+        rng,
+        proj_fmt="int8",
+        quantize_embed=quantize_embed,
+        host_rng=host_rng,
     )
 
-    def qdense(shape, target_std=None):
-        if host is not None:
-            q = jnp.asarray(host.integers(-127, 128, shape, np.int8))
-        else:
-            q = _gen_q(next(keys), shape)
-            q.block_until_ready()  # serialize: peak transient = one leaf, not all
-        scale_shape = shape[:-2] + (1, shape[-1])
-        scale = jnp.full(scale_shape, (target_std or s) / UNIFORM_STD, jnp.float32)
-        return QTensor(q=q, scale=scale)
 
-    layers: Dict[str, Any] = {
-        "attn_norm": jnp.ones((L, E), cfg.dtype),
-        "wq": qdense((L, E, H * D)),
-        "wk": qdense((L, E, KH * D)),
-        "wv": qdense((L, E, KH * D)),
-        "wo": qdense((L, H * D, E)),
-        "mlp_norm": jnp.ones((L, E), cfg.dtype),
-    }
-    if cfg.attn_bias:
-        layers.update(
-            {
-                "bq": jnp.zeros((L, H * D), cfg.dtype),
-                "bk": jnp.zeros((L, KH * D), cfg.dtype),
-                "bv": jnp.zeros((L, KH * D), cfg.dtype),
-            }
-        )
-    def ndense(shape, scale=1.0):
-        # dense (non-quantized) leaves: embeddings/head/router
-        if host is not None:
-            arr = host.standard_normal(shape, np.float32) * scale
-            return jnp.asarray(arr).astype(cfg.dtype)
-        return jax.random.normal(next(keys), shape, cfg.dtype) * jnp.asarray(
-            scale, cfg.dtype
-        )
+def init_int4(
+    cfg: DecoderConfig,
+    rng: jax.Array,
+    *,
+    group_size: int = INT4_GROUP_SIZE,
+    quantize_embed: bool = False,
+    host_rng: bool = False,
+) -> Params:
+    """Synthetic grouped-int4 params generated ON DEVICE (docs/QUANT.md).
 
-    if cfg.is_moe:
-        X = cfg.num_experts
-        layers.update(
-            {
-                # the router stays dense: moe_mlp reads it in f32 (and
-                # quantize_decoder_params leaves it out too — tiny + routing
-                # quality is disproportionately sensitive)
-                "router": ndense((L, E, X), s),
-                "w_gate": qdense((L, X, E, F)),
-                "w_up": qdense((L, X, E, F)),
-                "w_down": qdense((L, X, F, E), target_std=F ** -0.5),
-            }
-        )
-    else:
-        layers.update(
-            {
-                "w_gate": qdense((L, E, F)),
-                "w_up": qdense((L, E, F)),
-                "w_down": qdense((L, F, E), target_std=F ** -0.5),
-            }
-        )
-    params: Params = {
-        "tok_embed": (
-            qdense((cfg.vocab_size, E), target_std=1.0)
-            if quantize_embed
-            else ndense((cfg.vocab_size, E))
-        ),
-        "final_norm": jnp.ones((E,), cfg.dtype),
-        "layers": layers,
-    }
-    if not cfg.tie_embeddings:
-        params["lm_head"] = (
-            qdense((E, cfg.vocab_size))
-            if quantize_embed
-            else ndense((E, cfg.vocab_size), s)
-        )
-    return params
+    The int4 analog of :func:`init_int8`: layer projections become
+    :class:`~..ops.quant.QTensor4` (two values packed per byte along the
+    contraction axis + per-(group, channel) f32 scales) exactly like
+    ``quantize_decoder_params(..., fmt="int4")`` output — 0.5 bytes/weight of
+    HBM read on the decode path vs int8's 1 and bf16's 2.  One random uint8
+    draw IS two uniform int4 nibbles, so the packed weights are drawn
+    directly into HBM with a transient of exactly the result's size; scales
+    are set so dequantized magnitudes match :func:`init`'s normal(0, E^-0.5)
+    (uniform [-8, 7] has std sqrt(255/12) ~ 4.61), keeping the bench
+    weight-value independent like the int8 path.
+
+    ``quantize_embed`` opts the embedding/head tables into INT8 (not int4 —
+    see :func:`_synth_quant_params`), and ``host_rng`` mirrors
+    :func:`init_int8`'s virtual-CPU-mesh escape hatch; the whole skeleton is
+    shared with the int8 recipe so the two cannot drift.
+    """
+    return _synth_quant_params(
+        cfg,
+        rng,
+        proj_fmt="int4",
+        group_size=group_size,
+        quantize_embed=quantize_embed,
+        host_rng=host_rng,
+    )
 
 
 def _embed(params: Params, cfg: DecoderConfig, ids: jnp.ndarray) -> jnp.ndarray:

@@ -1,12 +1,13 @@
-"""Weight-only int8 quantization for the decode path.
+"""Weight-only int8 / grouped int4 quantization for the decode path.
 
 Decode is HBM-bandwidth-bound: every generated token re-reads all layer
 weights, so halving the bytes (bf16 -> int8 + per-channel f32 scales) nearly
 doubles the decode roofline on real hardware and halves host->HBM transfer at
-load.  The reference has no quantization (torch fp16 generate,
+load — and int4 halves it again (0.5 bytes/weight packed).  The reference has
+no quantization (torch fp16 generate,
 assistant/ai/providers/transformers.py:22-29); this is a TPU-first extra.
 
-Scheme: symmetric per-output-channel.  Every projection weight in this
+Scheme (int8): symmetric per-output-channel.  Every projection weight in this
 codebase is laid out ``[..., in, out]`` with the contraction on axis -2
 (layer-stacked: wq/wk/wv [L,E,O], wo [L,O,E], MLP [L,(X,)E,F] / [L,(X,)F,E]),
 so one rule quantizes them all: ``scale = max|w| over axis -2 / 127``.
@@ -16,9 +17,21 @@ weight's rank with the contracted dim = 1, so it scans along the layer axis
 with the weights AND accepts the same PartitionSpec — ``shard_pytree``'s
 sharding tree applies to a QTensor node as a pytree prefix, no rule changes.
 
-Dequantization sits inside the einsum callsites (:func:`deq`); XLA fuses the
-convert-multiply into the dot, so the bf16 weights are never materialized in
-HBM — int8 is what gets read.
+Scheme (int4, docs/QUANT.md): symmetric per-GROUP — 4 bits cannot carry a
+whole channel's dynamic range, so the contraction axis is cut into groups of
+``group_size`` and each (group, output-channel) pair gets its own f32 scale.
+Values pack two-per-byte along the contraction axis (``QTensor4.q`` is uint8
+``[..., in/2, out]``, low nibble = even index); the scale is
+``[..., in/group, out]`` — same rank as the weight, so the same pytree-prefix
+sharding trick applies (group count replaces the contracted dim).
+
+Dequantization sits inside the einsum callsites (:func:`deq` /
+:func:`qeinsum`); XLA fuses the convert-multiply into the dot, so the bf16
+weights are never materialized in HBM — the packed integers are what gets
+read.  For int4 the per-group scales do NOT commute past the whole dot, but
+they commute past each group's partial dot: ``qeinsum`` contracts group-wise
+and applies the scale to the [..., G, out] partials before the final
+group-sum, keeping the weight operand an integer load end to end.
 """
 
 from __future__ import annotations
@@ -30,10 +43,34 @@ import numpy as np
 
 QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
+# default int4 group width along the contraction axis: small enough that one
+# outlier cannot wash out a whole channel's resolution, large enough that the
+# f32 scales stay < 7% of the packed weight bytes (64 groups -> 4/64 bytes of
+# scale per 0.5-byte weight)
+INT4_GROUP_SIZE = 64
+
 
 class QTensor(NamedTuple):
     q: jnp.ndarray      # int8, original shape
     scale: jnp.ndarray  # f32, same rank, contracted (-2) dim = 1
+
+
+class QTensor4(NamedTuple):
+    """Group-quantized int4 weight: two values per byte along axis -2.
+
+    ``q``: uint8 ``[..., in/2, out]`` — the low nibble holds the even
+    contraction index, the high nibble the odd one, each a two's-complement
+    4-bit value in [-8, 7].  ``scale``: f32 ``[..., in/group_size, out]`` —
+    one scale per (contraction group, output channel).  The group size is
+    derived from the shapes (``2 * q.shape[-2] // scale.shape[-2]``), so the
+    tuple stays a pure-array pytree (scans/shards like QTensor)."""
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+    @property
+    def group_size(self) -> int:
+        return 2 * self.q.shape[-2] // self.scale.shape[-2]
 
 
 def quantize_tensor(w) -> QTensor:
@@ -50,40 +87,181 @@ def quantize_tensor(w) -> QTensor:
     return QTensor(q=q, scale=scale)
 
 
+def _int4_group(dim: int, group_size: int) -> int:
+    """Concrete group width for a contraction dim: the largest even divisor
+    of ``dim`` that is <= ``group_size`` (scales must tile the axis exactly,
+    and an odd group would split a packed byte across two groups)."""
+    g = max(2, min(int(group_size), dim))
+    while dim % g or g % 2:
+        g -= 1
+        if g < 2:
+            raise ValueError(
+                f"int4 needs an even contraction dim with an even divisor "
+                f"group size; got dim={dim}, group_size={group_size}"
+            )
+    return g
+
+
+def pack_int4(vals: np.ndarray) -> np.ndarray:
+    """Pack int values in [-8, 7] two-per-byte along axis -2 -> uint8 with
+    half the axis length.  Low nibble = even index, high nibble = odd."""
+    if vals.shape[-2] % 2:
+        raise ValueError(f"contraction dim {vals.shape[-2]} must be even to pack")
+    u = (np.asarray(vals).astype(np.int16) & 0xF).astype(np.uint8)
+    return (u[..., 0::2, :] | (u[..., 1::2, :] << 4)).astype(np.uint8)
+
+
+def unpack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """uint8 ``[..., P, O]`` -> int8 ``[..., 2P, O]`` (sign-extended nibbles).
+
+    Pure elementwise bit ops — XLA fuses them into the consuming dot's
+    operand load, so HBM traffic stays at the packed 0.5 bytes/weight."""
+    lo = jnp.bitwise_and(q, jnp.uint8(0xF)).astype(jnp.int8)
+    hi = jnp.right_shift(q, jnp.uint8(4)).astype(jnp.int8)
+    lo = (lo ^ jnp.int8(8)) - jnp.int8(8)  # two's-complement nibble decode
+    hi = (hi ^ jnp.int8(8)) - jnp.int8(8)
+    both = jnp.stack([lo, hi], axis=-2)  # [..., P, 2, O]
+    return both.reshape(q.shape[:-2] + (2 * q.shape[-2], q.shape[-1]))
+
+
+def quantize_tensor_int4(w, group_size: int = INT4_GROUP_SIZE) -> QTensor4:
+    """Symmetric per-(group, output-channel) int4 over contraction axis -2.
+
+    HOST numpy like :func:`quantize_tensor` (same no-device-staging
+    rationale); ``shard_pytree`` transfers the packed result afterwards."""
+    wf = np.asarray(w, np.float32)
+    dim, out_dim = wf.shape[-2], wf.shape[-1]
+    g = _int4_group(dim, group_size)
+    grp = wf.reshape(wf.shape[:-2] + (dim // g, g, out_dim))
+    amax = np.max(np.abs(grp), axis=-2, keepdims=True)  # [..., G, 1, O]
+    scale = np.maximum(amax / 7.0, 1e-12).astype(np.float32)
+    q = np.clip(np.rint(grp / scale), -8, 7).astype(np.int8)
+    return QTensor4(
+        q=jnp.asarray(pack_int4(q.reshape(wf.shape))),
+        scale=jnp.asarray(np.squeeze(scale, axis=-2)),
+    )
+
+
 def deq(w: Any, dtype) -> jnp.ndarray:
     """Dequantize at the einsum callsite (fused by XLA); pass-through otherwise."""
     if isinstance(w, QTensor):
         return (w.q.astype(jnp.float32) * w.scale).astype(dtype)
+    if isinstance(w, QTensor4):
+        vals = unpack_int4(w.q).astype(jnp.float32)  # [..., dim, O]
+        dim, out_dim = vals.shape[-2], vals.shape[-1]
+        G = w.scale.shape[-2]
+        grp = vals.reshape(vals.shape[:-2] + (G, dim // G, out_dim))
+        grp = grp * w.scale[..., :, None, :]
+        return grp.reshape(vals.shape).astype(dtype)
     return w
 
 
 def qeinsum(pattern: str, x: jnp.ndarray, w: Any, dtype) -> jnp.ndarray:
     """``einsum(pattern, x, w)`` with the dequant moved PAST the dot.
 
-    Per-output-channel scales commute with the contraction:
+    int8: per-output-channel scales commute with the contraction:
     ``x @ (q * scale) == (x @ q) * scale`` exactly (scale is constant along
     the contracted axis).  The matmul's weight operand is then a PURE int8->
     dtype convert, which XLA folds into the dot's operand load — whereas the
     convert-*multiply* producer of :func:`deq` can materialize a full-width
     dequantized copy and drag the int8 path back to bf16 byte traffic.
 
-    Valid whenever ``w``'s last axis is the einsum output's last axis (true
-    for every dense projection in models/llama.py).  Non-quantized weights
-    pass straight through to a plain einsum.
+    int4 (grouped): the scale varies along the contraction, so it commutes
+    only past each GROUP's partial dot — the contraction splits as
+    ``x[..., G, g] . q[..., G, g, O] -> partial[..., G, O]``, the per-group
+    scale multiplies the partials, and the group axis sums last.  Exactly
+    equal (up to float reassociation) to the dequantized dot, with the
+    weight operand still an integer load.
+
+    Valid whenever ``w``'s contraction axis is -2 and its last axis is the
+    einsum output's last axis (true for every dense projection in
+    models/llama.py).  Non-quantized weights pass straight through.
     """
+    if isinstance(w, QTensor4):
+        xs, rest = pattern.split(",")
+        ws, os_ = rest.split("->")
+        if not (xs[-1] == ws[-2] and ws[-1] == os_[-1]):
+            # pattern outside the [..., in, out] contract: fall back to the
+            # dequantized reference (correct, just not integer-read)
+            return jnp.einsum(pattern, x, deq(w, dtype))
+        vals = unpack_int4(w.q).astype(dtype)  # [..., dim, O]
+        dim, out_dim = vals.shape[-2], vals.shape[-1]
+        G = w.scale.shape[-2]
+        grp_w = vals.reshape(vals.shape[:-2] + (G, dim // G, out_dim))
+        grp_x = x.reshape(x.shape[:-1] + (G, dim // G))
+        # 'G'/'z' are free letters: model patterns only use lowercase b/s/e/
+        # f/o/v/x/c.  partial: contract within each group; then scale+sum G.
+        partial = jnp.einsum(
+            f"{xs[:-1]}Gz,{ws[:-2]}Gz{ws[-1]}->{os_[:-1]}G{os_[-1]}",
+            grp_x,
+            grp_w,
+        )
+        return jnp.einsum(
+            f"{os_[:-1]}G{os_[-1]},{ws[:-2]}G{ws[-1]}->{os_}",
+            partial,
+            w.scale.astype(dtype),
+        )
     if not isinstance(w, QTensor):
         return jnp.einsum(pattern, x, w)
     y = jnp.einsum(pattern, x, w.q.astype(dtype))
     return y * jnp.squeeze(w.scale, axis=-2).astype(dtype)
 
 
-def quantize_decoder_params(params: Dict[str, Any]) -> Dict[str, Any]:
+def quantize_decoder_params(
+    params: Dict[str, Any],
+    fmt: str = "int8",
+    group_size: int = INT4_GROUP_SIZE,
+) -> Dict[str, Any]:
     """Quantize every layer projection; norms/biases/embeddings/head stay bf16
-    (tiny, and embedding/head quality is disproportionately sensitive)."""
+    (tiny, and embedding/head quality is disproportionately sensitive).
+
+    ``fmt``: "int8" (per-channel, the default/back-compat path) or "int4"
+    (per-group, ``group_size`` along the contraction axis)."""
+    if fmt not in ("int8", "int4"):
+        raise ValueError(f"unknown quantization format {fmt!r}")
     layers = dict(params["layers"])
     for key in QUANTIZABLE:
         if key in layers:
-            layers[key] = quantize_tensor(layers[key])
+            layers[key] = (
+                quantize_tensor_int4(layers[key], group_size)
+                if fmt == "int4"
+                else quantize_tensor(layers[key])
+            )
     out = dict(params)
     out["layers"] = layers
     return out
+
+
+def num_weights(params: Any) -> int:
+    """Model weight count with packed formats unpacked (QTensor4 packs two
+    weights per stored byte) and quantization scales excluded — the honest
+    denominator-free N for MFU math (2 FLOPs/weight/token)."""
+    import jax
+
+    total = 0
+
+    def is_q(x):
+        return isinstance(x, (QTensor, QTensor4))
+
+    for leaf in jax.tree.leaves(params, is_leaf=is_q):
+        if isinstance(leaf, QTensor4):
+            total += 2 * leaf.q.size
+        elif isinstance(leaf, QTensor):
+            total += leaf.q.size
+        else:
+            total += leaf.size
+    return total
+
+
+def weight_bits(params: Any) -> int:
+    """Dominant layer-projection weight width in bits (4 / 8 / 16) — the
+    operator gauge behind ``tick_stats``/``/metrics`` ``weight_bits``."""
+    layers = params.get("layers", params) if isinstance(params, dict) else params
+    leaves = layers.values() if isinstance(layers, dict) else [layers]
+    bits = 16
+    for leaf in leaves:
+        if isinstance(leaf, QTensor4):
+            return 4
+        if isinstance(leaf, QTensor):
+            bits = 8
+    return bits

@@ -9,6 +9,7 @@ recipe: pick a mesh, annotate shardings, let XLA insert the collectives.
 from __future__ import annotations
 
 import contextlib
+import logging
 import threading
 from typing import Any, Mapping, Optional
 
@@ -16,6 +17,17 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS
+
+_logger = logging.getLogger(__name__)
+
+
+def _is_quantized(subtree: Any) -> bool:
+    """True when a logical-annotation position covers a quantized-weight
+    subtree (QTensor / QTensor4) — the only case where :func:`shard_pytree`
+    relaxes non-dividing dims to replication instead of failing loudly."""
+    from ..ops.quant import QTensor, QTensor4
+
+    return isinstance(subtree, (QTensor, QTensor4))
 
 # Default logical→mesh mapping.  "heads"/"mlp"/"vocab_out" shard over the TP axis;
 # "expert" over EP; "batch" over DP; "length" over SP.  Everything else replicates.
@@ -106,7 +118,7 @@ def shard_pytree(
     collapsed to 1 rides the same annotation as its weight.
     """
 
-    def leaf_sharding(axes: tuple, arr) -> NamedSharding:
+    def leaf_sharding(axes: tuple, arr, lenient: bool) -> NamedSharding:
         spec = list(logical_to_pspec(axes, rules))
         shape = getattr(arr, "shape", ())
         if len(shape) != len(spec):
@@ -117,12 +129,34 @@ def shard_pytree(
                 f"shape {tuple(shape)}"
             )
         spec = [None if shape[i] == 1 else s for i, s in enumerate(spec)]
+        if lenient:
+            # quantized-subtree leaves only: int4-packed weights halve the
+            # contraction dim and their grouped scales shrink it to n_groups,
+            # either of which can stop dividing a TP axis the full-width
+            # weight divided (docs/QUANT.md) — replicate that dim, loudly.
+            # Plain weights keep the fail-loudly contract: a silent
+            # replicate there would mask a mis-sharded config as N-fold HBM.
+            for i, s in enumerate(spec):
+                if s is not None and shape[i] % mesh.shape[s] != 0:
+                    _logger.warning(
+                        "quantized leaf dim %d (size %d) no longer divides "
+                        "mesh axis %r (%d): replicating that dim",
+                        i,
+                        shape[i],
+                        s,
+                        mesh.shape[s],
+                    )
+                    spec[i] = None
         return NamedSharding(mesh, P(*spec))
 
+    def subtree_shardings(axes: tuple, subtree):
+        lenient = _is_quantized(subtree)
+        return jax.tree.map(
+            lambda arr: leaf_sharding(axes, arr, lenient), subtree
+        )
+
     shardings = jax.tree.map(
-        lambda axes, subtree: jax.tree.map(
-            lambda arr: leaf_sharding(axes, arr), subtree
-        ),
+        subtree_shardings,
         logical_tree,
         params,
         is_leaf=lambda x: isinstance(x, tuple) and all(

@@ -1,0 +1,322 @@
+"""Byte-ledger decode geometry autotuner (docs/QUANT.md "Autotuning").
+
+Decode is HBM-bandwidth-bound, so its steady-state rate is predictable from
+bytes alone: every step reads the (quantized) weights once for the whole
+batch plus each live slot's KV window, and every tick pays a host dispatch
+overhead that ``decode_steps`` amortizes.  This module sweeps
+``kv_page_size x max_slots x decode_steps`` through that ledger — the same
+byte model ``bench.decode_byte_ledger`` reports against measurements — and
+emits the config that maximizes modeled tok/s under an HBM byte budget.
+
+Pure arithmetic over plain ints/floats: no jax import, so the standalone
+``tools/autotune.py`` wrapper runs it anywhere and ``cli serve --autotune``
+runs it before any weight load.  The model is a RANKING device, not a
+prophecy — absolute tok/s depends on the chip's achieved bandwidth, which is
+why the recommendation records the assumptions (``hbm_gbps``,
+``host_overhead_us``) alongside the ranking, and why the bench's interleaved
+A/B arms stay the ground truth for any claim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, List, Mapping, Optional, Sequence
+
+# v5e-ish defaults; override from measurements (tick_stats issue_ms, the
+# bench's decode_hbm_stream_probe_gbps) when you have them
+DEFAULT_HBM_GBPS = 819.0
+DEFAULT_HOST_OVERHEAD_US = 150.0
+DEFAULT_HBM_BUDGET_GB = 16.0
+
+WEIGHT_SCALE_BYTES = 4  # f32 quantization scales
+
+
+@dataclasses.dataclass(frozen=True)
+class Geometry:
+    """The decoder shape the ledger needs — constructible from a
+    DecoderConfig (``from_decoder_config``) or raw ints (the tools/ CLI)."""
+
+    num_layers: int
+    hidden_size: int
+    intermediate_size: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    vocab_size: int
+    num_experts: int = 0
+    tie_embeddings: bool = False
+    dtype_bytes: int = 2  # bf16
+
+    @classmethod
+    def from_decoder_config(cls, cfg: Any) -> "Geometry":
+        import jax.numpy as jnp
+
+        return cls(
+            num_layers=cfg.num_layers,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim,
+            vocab_size=cfg.vocab_size,
+            num_experts=getattr(cfg, "num_experts", 0) or 0,
+            tie_embeddings=bool(cfg.tie_embeddings),
+            dtype_bytes=jnp.dtype(cfg.dtype).itemsize,
+        )
+
+    def projection_weights(self) -> int:
+        """Layer-projection weight count (the quantizable set)."""
+        E, F = self.hidden_size, self.intermediate_size
+        H, KH, D = self.num_heads, self.num_kv_heads, self.head_dim
+        attn = E * H * D + 2 * E * KH * D + H * D * E
+        mlp = 3 * E * F
+        if self.num_experts:
+            mlp *= self.num_experts
+        return self.num_layers * (attn + mlp)
+
+    def head_weights(self) -> int:
+        # tied models read the embedding table as the head
+        return self.hidden_size * self.vocab_size
+
+    def weight_read_bytes(self, weight_bits: int, group_size: int = 64) -> int:
+        """Bytes one decode step streams for weights: packed projections +
+        their scales (int8: one f32/channel; int4: one f32 per group x
+        channel) + the bf16 head/embedding read."""
+        proj = self.projection_weights()
+        if weight_bits == 4:
+            b = proj // 2 + (proj // max(2, group_size)) * WEIGHT_SCALE_BYTES
+        elif weight_bits == 8:
+            # per-output-channel scales: ~proj / contraction_dim entries —
+            # approximate with E as the typical contraction width
+            b = proj + (proj // max(1, self.hidden_size)) * WEIGHT_SCALE_BYTES
+        else:
+            b = proj * self.dtype_bytes
+        return b + self.head_weights() * self.dtype_bytes
+
+    def resident_weight_bytes(self, weight_bits: int, group_size: int = 64) -> int:
+        """HBM bytes the weights OCCUPY (the feasibility side): the per-step
+        read plus, for untied models, the second embedding table — decode
+        streams only the head, but tok_embed sits in HBM regardless (at
+        8B/128k vocab that second bf16 table is ~1 GB the budget must
+        charge)."""
+        b = self.weight_read_bytes(weight_bits, group_size)
+        if not self.tie_embeddings:
+            b += self.head_weights() * self.dtype_bytes
+        return b
+
+    def kv_row_bytes_per_token(self, kv_itemsize: int) -> int:
+        return self.num_layers * self.num_kv_heads * self.head_dim * 2 * kv_itemsize
+
+
+@dataclasses.dataclass
+class Candidate:
+    kv_page_size: int
+    max_slots: int
+    decode_steps: int
+    est_tokens_per_s: float
+    est_step_ms: float
+    step_read_gb: float
+    kv_alloc_gb: float
+    hbm_total_gb: float
+
+    def as_dict(self) -> dict:
+        return {k: round(v, 4) if isinstance(v, float) else v
+                for k, v in dataclasses.asdict(self).items()}
+
+
+def _page_candidates(max_seq_len: int, pages: Sequence[int]) -> List[int]:
+    return [p for p in pages if max_seq_len % p == 0 and max_seq_len // p >= 2]
+
+
+def sweep(
+    geom: Geometry,
+    *,
+    max_seq_len: int,
+    fill_len: Optional[int] = None,
+    weight_bits: int = 16,
+    group_size: int = 64,
+    kv_itemsize: Optional[int] = None,
+    hbm_budget_gb: float = DEFAULT_HBM_BUDGET_GB,
+    hbm_gbps: float = DEFAULT_HBM_GBPS,
+    host_overhead_us: float = DEFAULT_HOST_OVERHEAD_US,
+    page_sizes: Sequence[int] = (32, 64, 128, 256, 512),
+    slots: Sequence[int] = (4, 8, 16, 32, 64, 128),
+    decode_steps: Sequence[int] = (1, 2, 4, 8, 16),
+) -> List[Candidate]:
+    """Rank every feasible (page, slots, steps) triple by modeled tok/s.
+
+    Model, per decode step: ``bytes = weight_read + slots * kv_row_bytes *
+    covered(fill, page)`` where ``covered`` rounds the fill up to page
+    granularity (the paged read is inherently page-chunked); ``device_step_s
+    = bytes / hbm_gbps``; one tick of N steps costs ``N * device_step_s +
+    host_overhead`` so ``tok/s = slots * N / tick_s``.  Feasibility: weights
+    + byte-parity page pool (slots x max_seq_len) must fit ``hbm_budget_gb``.
+    """
+    kv_itemsize = kv_itemsize or geom.dtype_bytes
+    fill = min(int(fill_len) if fill_len else max_seq_len, max_seq_len)
+    w_read = geom.weight_read_bytes(weight_bits, group_size)
+    # resident weight bytes (pool feasibility): the read set plus the untied
+    # embedding table that decode never streams but HBM must hold
+    w_resident = geom.resident_weight_bytes(weight_bits, group_size)
+    row_b = geom.kv_row_bytes_per_token(kv_itemsize)
+    overhead_s = host_overhead_us / 1e6
+    bw = hbm_gbps * 1e9
+    out: List[Candidate] = []
+    for page in _page_candidates(max_seq_len, page_sizes):
+        covered = min(max_seq_len, ((max(1, fill) - 1) // page + 1) * page)
+        for n_slots in slots:
+            kv_alloc = n_slots * max_seq_len * row_b
+            total = w_resident + kv_alloc
+            if total > hbm_budget_gb * 1e9:
+                continue
+            step_bytes = w_read + n_slots * row_b * covered
+            dev_step_s = step_bytes / bw
+            for n_steps in decode_steps:
+                tick_s = n_steps * dev_step_s + overhead_s
+                tok_s = n_slots * n_steps / tick_s
+                out.append(
+                    Candidate(
+                        kv_page_size=page,
+                        max_slots=n_slots,
+                        decode_steps=n_steps,
+                        est_tokens_per_s=tok_s,
+                        est_step_ms=tick_s / n_steps * 1e3,
+                        step_read_gb=step_bytes / 1e9,
+                        kv_alloc_gb=kv_alloc / 1e9,
+                        hbm_total_gb=total / 1e9,
+                    )
+                )
+    out.sort(key=lambda c: -c.est_tokens_per_s)
+    return out
+
+
+def recommend(
+    geom: Geometry,
+    *,
+    max_seq_len: int,
+    **kwargs: Any,
+) -> dict:
+    """The sweep's winner as a ModelSpec-shaped knob dict plus the modeling
+    assumptions and the top alternatives — what ``serve --autotune`` prints."""
+    cands = sweep(geom, max_seq_len=max_seq_len, **kwargs)
+    if not cands:
+        return {
+            "error": "no feasible geometry under the HBM budget",
+            "assumptions": _assumptions(kwargs),
+        }
+    best = cands[0]
+    return {
+        "recommended": {
+            "kv_page_size": best.kv_page_size,
+            "max_slots": best.max_slots,
+            "decode_steps": best.decode_steps,
+        },
+        "est_tokens_per_s": round(best.est_tokens_per_s, 1),
+        "est_step_ms": round(best.est_step_ms, 4),
+        "hbm_total_gb": round(best.hbm_total_gb, 3),
+        "assumptions": _assumptions(kwargs),
+        "top": [c.as_dict() for c in cands[:8]],
+    }
+
+
+def _assumptions(kwargs: Mapping[str, Any]) -> dict:
+    return {
+        "hbm_gbps": kwargs.get("hbm_gbps", DEFAULT_HBM_GBPS),
+        "host_overhead_us": kwargs.get(
+            "host_overhead_us", DEFAULT_HOST_OVERHEAD_US
+        ),
+        "hbm_budget_gb": kwargs.get("hbm_budget_gb", DEFAULT_HBM_BUDGET_GB),
+        "weight_bits": kwargs.get("weight_bits", 16),
+        "note": "byte-ledger model — a ranking device; verify any claim "
+        "with the bench's interleaved A/B arms",
+    }
+
+
+def recommend_for_spec(spec: Any, cfg: Any, **overrides: Any) -> dict:
+    """Autotune one decoder ModelSpec against its (already-parsed) model
+    config — the ``cli serve --autotune`` entry point."""
+    import jax.numpy as jnp
+
+    geom = Geometry.from_decoder_config(cfg)
+    weight_bits = {"int8": 8, "int4": 4}.get(spec.quantize or "", 16)
+    kv_itemsize = (
+        1
+        if (spec.kv_cache_dtype or "").startswith("fp8")
+        else jnp.dtype(cfg.dtype).itemsize
+    )
+    kwargs = {
+        "fill_len": None,
+        "weight_bits": weight_bits,
+        "group_size": getattr(spec, "quant_group_size", 64),
+        "kv_itemsize": kv_itemsize,
+        **overrides,
+    }
+    if getattr(spec, "speculative", 0):
+        # decode_steps > 1 is rejected at load on speculative decoders
+        # (docs/SPECULATIVE.md) — never recommend a config that cannot boot
+        kwargs.setdefault("decode_steps", (1,))
+    max_seq_len = int(
+        min(spec.max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
+    )
+    out = recommend(geom, max_seq_len=max_seq_len, **kwargs)
+    out["model"] = spec.name
+    out["max_seq_len"] = max_seq_len
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """Standalone CLI body (``python tools/autotune.py`` delegates here)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="byte-ledger decode geometry autotuner (docs/QUANT.md)"
+    )
+    ap.add_argument("--layers", type=int, required=True)
+    ap.add_argument("--hidden", type=int, required=True)
+    ap.add_argument("--intermediate", type=int, required=True)
+    ap.add_argument("--heads", type=int, required=True)
+    ap.add_argument("--kv-heads", type=int, required=True)
+    ap.add_argument("--head-dim", type=int, required=True)
+    ap.add_argument("--vocab", type=int, required=True)
+    ap.add_argument("--max-seq-len", type=int, required=True)
+    ap.add_argument("--experts", type=int, default=0)
+    ap.add_argument(
+        "--tied",
+        action="store_true",
+        help="embeddings tied to the head (one table resident, not two)",
+    )
+    ap.add_argument("--fill-len", type=int, default=None)
+    ap.add_argument("--weight-bits", type=int, default=16, choices=(4, 8, 16))
+    ap.add_argument("--group-size", type=int, default=64)
+    ap.add_argument("--kv-itemsize", type=int, default=2)
+    ap.add_argument("--hbm-budget-gb", type=float, default=DEFAULT_HBM_BUDGET_GB)
+    ap.add_argument("--hbm-gbps", type=float, default=DEFAULT_HBM_GBPS)
+    ap.add_argument(
+        "--host-overhead-us", type=float, default=DEFAULT_HOST_OVERHEAD_US
+    )
+    args = ap.parse_args(argv)
+    geom = Geometry(
+        num_layers=args.layers,
+        hidden_size=args.hidden,
+        intermediate_size=args.intermediate,
+        num_heads=args.heads,
+        num_kv_heads=args.kv_heads,
+        head_dim=args.head_dim,
+        vocab_size=args.vocab,
+        num_experts=args.experts,
+        tie_embeddings=args.tied,
+    )
+    out = recommend(
+        geom,
+        max_seq_len=args.max_seq_len,
+        fill_len=args.fill_len,
+        weight_bits=args.weight_bits,
+        group_size=args.group_size,
+        kv_itemsize=args.kv_itemsize,
+        hbm_budget_gb=args.hbm_budget_gb,
+        hbm_gbps=args.hbm_gbps,
+        host_overhead_us=args.host_overhead_us,
+    )
+    print(json.dumps(out, indent=2))
+    return 0

@@ -208,6 +208,11 @@ class _Slot:
     generated: List[int] = dataclasses.field(default_factory=list)
     # host arrival time of the previous token (inter-token-latency samples)
     last_token_at: Optional[float] = None
+    # decode steps this slot sat through (fused ticks advance it by the tick's
+    # step count even when EOS lands mid-tick) — the per-token denominator the
+    # scheduler's service-time EMA needs so N-step ticks don't inflate the
+    # predicted queue wait (docs/SCHEDULING.md)
+    resident_steps: int = 0
 
 
 @dataclasses.dataclass
@@ -269,6 +274,7 @@ class GenerationEngine:
         chunk_size: int = 512,
         lookahead: int = 3,
         burst: int = 8,
+        decode_steps: Optional[int] = None,
         prefix_cache_size: int = 8,
         prefix_min_tokens: int = 32,
         prefix_cache_max_bytes: int = 1 << 30,
@@ -340,15 +346,36 @@ class GenerationEngine:
         # removes a blocking sync per token.  Cost: up to `lookahead` speculative
         # ticks per finished request (their tokens are dropped via slot epochs).
         self.lookahead = max(0, int(lookahead))
-        # Burst decode: one jit call advances every live slot `burst` tokens via
-        # a lax.scan over decode steps, so the per-dispatch overhead (the decode
-        # bottleneck once ticks are pipelined — each dispatch is an RPC under a
-        # remote-device tunnel and a host round trip locally) is amortised over
-        # `burst` tokens.  Cost: finished slots decode garbage for the rest of
-        # their burst (dropped via slot epochs), and admission waits for the
-        # burst in flight — bounded by burst * per-step time, same order as a
-        # prefill chunk.
-        self.burst = max(1, int(burst))
+        # Fused multi-token decode tick: one jit call advances every live slot
+        # `decode_steps` tokens via a lax.scan over chained decode steps
+        # (gather -> attention -> MLP -> sample, donated cache chain), so host
+        # bookkeeping, sampling-array uploads, and per-dispatch overhead (the
+        # decode bottleneck once ticks are pipelined — each dispatch is an RPC
+        # under a remote-device tunnel and a host round trip locally) amortise
+        # over N tokens.  `decode_steps` is the canonical knob (docs/QUANT.md
+        # roofline notes); `burst` is its historical alias and keeps working.
+        # Costs: finished slots decode garbage for the rest of their tick
+        # (dropped via slot epochs), admission waits for the tick in flight
+        # (bounded by N * per-step time, same order as a prefill chunk), and
+        # deadline/cancel reaping happens at tick granularity — a reaped slot
+        # can burn up to N-1 extra garbage steps before it freezes.
+        # JSON-constrained (json_fsm) slots disable fusion: while any json
+        # slot is live the engine issues SINGLE-step ticks (the json tick
+        # program is built with steps=1), so FSM semantics never depend on a
+        # multi-step scan — `decode_steps_effective` in tick_stats shows
+        # which path is active.
+        if decode_steps is not None and int(decode_steps) < 1:
+            raise ValueError(f"decode_steps must be >= 1 (got {decode_steps})")
+        if decode_steps is not None and int(decode_steps) > 1 and speculative:
+            # mutually exclusive initially (docs/SPECULATIVE.md): a spec tick
+            # already advances up to K+1 tokens and the tree draft consumes
+            # the chained token state the fused scan would own
+            raise ValueError(
+                "decode_steps > 1 is incompatible with speculative decoding "
+                "(the speculative tick is itself the multi-token fast path); "
+                "drop one of the two knobs"
+            )
+        self.burst = max(1, int(decode_steps if decode_steps is not None else burst))
         # Tree-verified prompt-lookup speculative decoding
         # (ops/speculative.py): per tick, the on-device n-gram drafter emits
         # the top-`spec_width` distinct continuations of depth `speculative`
@@ -378,6 +405,27 @@ class GenerationEngine:
                     f"keep K <= max_seq_len // 4 ({self.max_seq_len // 4})"
                 )
             self.burst = 1
+        # canonical alias for the fused-tick depth (== burst after the
+        # speculative clamp) + the operator gauges behind tick_stats /
+        # /healthz / /metrics (`decode_steps_effective`, `weight_bits`,
+        # `upload_overlap_frac`): which decode fast path is ACTUALLY active
+        self.decode_steps = self.burst
+        self._decode_steps_effective = self.burst
+        self._json_downgraded_ticks = 0
+        # double-buffered host->device uploads: sampling/block-table arrays
+        # re-staged at end-of-iteration while `lookahead` ticks are still in
+        # flight, so the next tick's dispatch finds them already committed
+        # instead of paying the upload enqueue on the issue path
+        self._uploads_prestaged = 0
+        self._uploads_issue = 0
+        # dominant layer-projection weight width (16/8/4) — int4 grouped
+        # quantization (ops/quant.py QTensor4) reads 0.5 bytes/weight
+        from ..ops.quant import weight_bits as _weight_bits
+
+        try:
+            self.weight_bits = _weight_bits(params)
+        except Exception:
+            self.weight_bits = 16
         self.spec_drafted = 0  # draft tokens proposed (greedy rows only)
         self.spec_accepted = 0  # draft tokens accepted
         self.spec_ticks_issued = 0  # speculative ticks dispatched
@@ -882,17 +930,24 @@ class GenerationEngine:
             out = None
         return jax.jit(act, out_shardings=out, static_argnames=("initial",))
 
-    def _make_decode_tick(self, json_mode: bool):
-        """Build the jitted burst tick: `burst` chained decode steps in one
+    def _make_decode_tick(self, json_mode: bool, steps: Optional[int] = None):
+        """Build the jitted fused tick: ``steps`` chained decode steps in one
         dispatch -> (toks [K,B], last tokens [B], cache[, fsm states]).
 
-        One body serves both variants; ``json_mode`` adds the grammar mask
-        before sampling and the FSM advance after it (trace-time branches, so
-        the plain path pays nothing for them).  The cache (argnum 2) is donated
-        — in-place HBM update, no copy."""
+        ``steps`` defaults to the engine's ``decode_steps``; the JSON variant
+        is built with ``steps=1`` — fused ticks are disabled while json_fsm
+        slots are live (the FSM advance stays on-device either way, but
+        keeping constrained decoding on the single-step program means its
+        semantics never ride a multi-step scan and a mixed batch degrades
+        predictably — ``decode_steps_effective`` reports the downgrade).
+        ``json_mode`` adds the grammar mask before sampling and the FSM
+        advance after it (trace-time branches, so the plain path pays nothing
+        for them).  The cache (argnum 2) is donated — in-place HBM update,
+        no copy."""
         from ..ops.attention import NEG_INF
 
-        cfg_c, top_k_c, burst_c = self.cfg, self.top_k, self.burst
+        cfg_c, top_k_c = self.cfg, self.top_k
+        burst_c = int(steps) if steps is not None else self.burst
         kv_chunk_c = self.decode_kv_chunk
         paged_c = self.paged
 
@@ -978,7 +1033,9 @@ class GenerationEngine:
         self._fsm_allowed_dev = jax.device_put(allowed, rep)
         self._fsm_next_dev = jax.device_put(nxt, rep)
         self._fsm_init_row_dev = jax.device_put(allowed[fsm.initial], rep)
-        self._decode_tick_json = self._make_decode_tick(json_mode=True)
+        # json ticks are single-step: fused (N-step) decoding is disabled
+        # whenever a json_fsm slot is live (see _make_decode_tick)
+        self._decode_tick_json = self._make_decode_tick(json_mode=True, steps=1)
         self._activate_fn_json = self._make_activate(json_mode=True)
 
     def _fresh_rng(self, seed: int) -> jnp.ndarray:
@@ -1621,6 +1678,10 @@ class GenerationEngine:
                             or self.num_active == 0
                         ):
                             self._process_tick()
+                        # double-buffer next tick's sampling/block-table
+                        # uploads against the ticks still in flight (the
+                        # finishes above are what dirtied the arrays)
+                        self._prestage_uploads()
                     # a clean iteration closes any failure streak (the restart
                     # backoff escalates over CONSECUTIVE failures only)
                     self._consecutive_failures = 0
@@ -2690,13 +2751,19 @@ class GenerationEngine:
             _TickRef(nxt=first, slots=ref_slots, first=True, offset=pad)
         )
 
-    def _refresh_sampling(self):
+    def _upload_dirty(self) -> bool:
+        """Stage any dirty sampling/block-table arrays to the device; returns
+        True when something was actually uploaded (the shared body of the
+        issue-path :meth:`_refresh_sampling` and the overlapped
+        :meth:`_prestage_uploads`)."""
+        did = False
         if self._sampling_dirty:
             self._active_dev = jnp.asarray([s is not None for s in self._slots])
             self._temps_dev = jnp.asarray(self._temps)
             self._top_ps_dev = jnp.asarray(self._top_ps)
             self._json_dev = jnp.asarray(self._json)
             self._sampling_dirty = False
+            did = True
         if self._bt_dirty:
             # [max_slots, n_blocks] int32 — a few KB, re-sent only when an
             # admission or free actually changed a block table
@@ -2705,6 +2772,35 @@ class GenerationEngine:
                 _replicated(self.mesh) if self.mesh is not None else None,
             )
             self._bt_dirty = False
+            did = True
+        return did
+
+    def _refresh_sampling(self):
+        if self._upload_dirty():
+            # paid on the issue path: the upload enqueue sat between this
+            # tick's bookkeeping and its dispatch instead of overlapping the
+            # previous tick's device time
+            self._uploads_issue += 1
+
+    def _prestage_uploads(self):
+        """Double-buffer the host->device sampling/block-table uploads against
+        the in-flight tick: called at the END of a loop iteration — after
+        :meth:`_process_tick` freed finished slots (dirtying the arrays) and
+        while up to ``lookahead`` ticks are still executing on device — so
+        the next tick's arrays are already committed when its
+        :meth:`_issue_tick` runs.  Uploads superseded by a later admission
+        are re-staged on the issue path (counted there), standard
+        double-buffer cost.  ``upload_overlap_frac`` in tick_stats is the
+        fraction of upload cycles this path absorbed."""
+        if self._inflight and (self._sampling_dirty or self._bt_dirty):
+            if self._upload_dirty():
+                self._uploads_prestaged += 1
+
+    def upload_overlap_frac(self) -> float:
+        """Fraction of sampling/block-table upload cycles dispatched while a
+        tick was in flight (double-buffered) rather than on the issue path."""
+        total = self._uploads_prestaged + self._uploads_issue
+        return round(self._uploads_prestaged / total, 4) if total else 0.0
 
     def tick_stats(self) -> dict:
         """Aggregate per-tick wall breakdown (ms/tick).  `block` near zero means
@@ -2723,6 +2819,11 @@ class GenerationEngine:
             if self._ticks_issued
             else 1.0,
         }
+        # decode-path gauges (docs/QUANT.md): which fast path is ACTUALLY
+        # active — the configured fused depth vs what the last tick ran
+        # (json_fsm slots downgrade to 1), the weight format's bit width,
+        # and how much of the upload traffic the double-buffer absorbed
+        out.update(self.decode_path_stats())
         if self.speculative:
             out.update(self.spec_stats())
         # KV memory plane gauges: pool occupancy, sharing fraction, allocator
@@ -2736,6 +2837,24 @@ class GenerationEngine:
             # queue-pressure snapshot: depth/pressure/shed/wait percentiles
             out["sched"] = self.scheduler.stats()
         return out
+
+    def decode_path_stats(self) -> dict:
+        """Decode fast-path gauges for tick_stats / /healthz / /metrics:
+        ``decode_steps`` (configured fused depth), ``decode_steps_effective``
+        (what the last plain tick actually ran — 1 while json_fsm slots are
+        live), ``json_downgraded_ticks``, ``upload_overlap_frac`` (fraction
+        of sampling/block-table upload cycles double-buffered against an
+        in-flight tick), and ``weight_bits`` (16/8/4 — the weight format the
+        decode dot is reading).  Same operator pattern as PR 7's
+        ``kv_layout_effective``: the active configuration is a gauge, not a
+        boot log line."""
+        return {
+            "decode_steps": self.decode_steps,
+            "decode_steps_effective": self._decode_steps_effective,
+            "json_downgraded_ticks": self._json_downgraded_ticks,
+            "upload_overlap_frac": self.upload_overlap_frac(),
+            "weight_bits": self.weight_bits,
+        }
 
     def spec_stats(self) -> Optional[dict]:
         """Speculation gauges for tick_stats / healthz, or None on a
@@ -3083,8 +3202,15 @@ class GenerationEngine:
         # (a load- or acceptance-disabled speculative engine falls through to
         # the plain tick: burst is pinned to 1 there, so _decode_tick is the
         # single-step program and the cache/token chaining is identical)
+        json_live = bool(self._json.any())
+        issued_steps = 1 if json_live else self.burst
+        if json_live and self.burst > 1:
+            # fused ticks are disabled while json_fsm slots are live: the
+            # whole batch rides the single-step json program this tick
+            self._json_downgraded_ticks += 1
+        self._decode_steps_effective = issued_steps
         with self._mesh_scope():
-            if self._json.any():
+            if json_live:
                 toks, last, self._cache, self._rng, self._fsm_states_dev = (
                     self._decode_tick_json(
                         self.params,
@@ -3117,7 +3243,7 @@ class GenerationEngine:
         except AttributeError:  # backend without async host copies
             pass
         self._tokens_dev = last
-        self.steps += self.burst
+        self.steps += issued_steps
         self._tick_issue_s += self._clock() - t0
         self._ticks_issued += 1
         self._kv_frac_sum += self._kv_read_frac()
@@ -3206,6 +3332,7 @@ class GenerationEngine:
                 s = self._slots[slot]
                 if s is None or self._slot_epoch[slot] != epoch:
                     continue
+                s.resident_steps += 1
                 self._consume_token(slot, s, int(vals[ref.offset + j]), now)
             return
         if ref.n_new is not None:  # speculative tick: variable tokens/slot
@@ -3218,6 +3345,10 @@ class GenerationEngine:
                 if s is None or self._slot_epoch[slot] != epoch:
                     continue
                 n = int(counts[slot])
+                # a spec tick advances 1..K+1 tokens in ~one (costlier) step;
+                # charging the tokens committed keeps the per-token service
+                # rate honest on speculative engines too
+                s.resident_steps += max(1, n)
                 # greedy rows proposed K drafts and n-1 were accepted
                 if s.request.temperature <= 0:
                     self.spec_drafted += K
@@ -3237,7 +3368,15 @@ class GenerationEngine:
                 if self.obs is not None:
                     self.obs.on_spec_tick(tick_accepted, K * greedy_rows)
             return
-        for k in range(vals.shape[0]):  # burst steps, oldest first
+        for slot, epoch in ref.slots:
+            # a fused tick occupies the slot for ALL its steps even when EOS
+            # lands mid-tick — charge the full tick so per-token residency
+            # (the scheduler's service EMA denominator) reflects the real
+            # tick-granularity occupancy
+            s = self._slots[slot]
+            if s is not None and self._slot_epoch[slot] == epoch:
+                s.resident_steps += vals.shape[0]
+        for k in range(vals.shape[0]):  # fused-tick steps, oldest first
             for slot, epoch in ref.slots:
                 s = self._slots[slot]
                 if s is None or self._slot_epoch[slot] != epoch:
@@ -3354,9 +3493,15 @@ class GenerationEngine:
             # feed the estimated-wait admission model with true service time:
             # slot residency from prefill start (latency minus queue wait) —
             # first_token_at would omit the prefill, and under long-prompt
-            # traffic prefill is the dominant component
+            # traffic prefill is the dominant component.  `tokens` is the
+            # decode steps the slot actually sat through (fused ticks charge
+            # their full N even when EOS lands mid-tick), so the scheduler
+            # can model service per TOKEN and a decode_steps=N engine doesn't
+            # inflate predicted queue waits by the tick-quantized lookahead
+            # lag a short request pays (docs/SCHEDULING.md)
             self.scheduler.note_service(
-                now - (req.started_at or req.first_token_at or now)
+                now - (req.started_at or req.first_token_at or now),
+                tokens=max(1, s.resident_steps),
             )
         if self.obs is not None:
             # close the request's span trace from the host timestamps the

@@ -672,6 +672,18 @@ def render_prometheus(registry: Any) -> str:
         x.add("dabt_engine_circuit_trips_total", "counter", "restart-circuit trips", sup["circuit_trips"], lab)
         x.add("dabt_engine_restart_resubmitted_total", "counter", "token-less requests salvaged across restarts", sup["restarted_requests_resubmitted"], lab)
         x.add("dabt_engine_reclaimed_slots_total", "counter", "slots reclaimed before finish (deadline/cancel)", eng.reclaimed_slots, lab)
+        dec_fn = getattr(eng, "decode_path_stats", None)
+        if callable(dec_fn):
+            # decode fast-path gauges (docs/QUANT.md): configured vs
+            # effective fused-tick depth, weight format bits, and the
+            # double-buffered upload fraction — the operator evidence that
+            # the roofline knobs are actually engaged
+            dec = dec_fn()
+            x.add("dabt_decode_steps", "gauge", "configured fused decode-tick depth", dec.get("decode_steps"), lab)
+            x.add("dabt_decode_steps_effective", "gauge", "decode steps the last tick actually ran (1 = json downgrade)", dec.get("decode_steps_effective"), lab)
+            x.add("dabt_decode_json_downgraded_ticks_total", "counter", "fused ticks downgraded to single-step by live json slots", dec.get("json_downgraded_ticks"), lab)
+            x.add("dabt_upload_overlap_frac", "gauge", "sampling/block-table upload cycles overlapped with an in-flight tick", dec.get("upload_overlap_frac"), lab)
+            x.add("dabt_weight_bits", "gauge", "decode weight format width in bits (16/8/4)", dec.get("weight_bits"), lab)
         sched = getattr(eng, "scheduler", None)
         if sched is not None:
             st = sched.stats()

@@ -15,6 +15,8 @@ from typing import Any, Dict, Mapping, Optional
 
 import jax
 
+from ..ops.quant import INT4_GROUP_SIZE
+
 logger = logging.getLogger(__name__)
 
 
@@ -33,9 +35,26 @@ class ModelSpec:
     # keep these in step with the GenerationEngine defaults
     lookahead: int = 3
     burst: int = 8
-    # weight-only quantization for decoders: None | "int8" (ops/quant.py) —
-    # halves HBM reads on the bandwidth-bound decode path
+    # fused multi-token decode tick depth (docs/QUANT.md roofline notes): one
+    # jit call advances every live slot N tokens, amortizing host
+    # bookkeeping, sampling-array uploads, and dispatch overhead over N.
+    # 0 = inherit `burst` (the historical alias — same machinery); >= 1 is
+    # the canonical knob and the one-flag rollback is decode_steps=1.
+    # json_fsm slots downgrade live ticks to single-step
+    # (decode_steps_effective in tick_stats); incompatible with
+    # speculative > 0 (the spec tick is itself the multi-token fast path —
+    # docs/SPECULATIVE.md)
+    decode_steps: int = 0
+    # weight-only quantization for decoders: None | "int8" (per-channel) |
+    # "int4" (per-group, packed two-per-byte — 0.5 bytes/weight of HBM read;
+    # ops/quant.py, docs/QUANT.md) — decode is bandwidth-bound, so bytes are
+    # the roofline
     quantize: Optional[str] = None
+    # int4 group width along the contraction axis (accuracy knob: smaller
+    # groups -> tighter scales -> lower logit error, more scale bytes);
+    # default IS ops.quant.INT4_GROUP_SIZE — the single source the synthetic
+    # inits and the bench arms also read
+    quant_group_size: int = INT4_GROUP_SIZE
     # prefix KV cache: LRU size for shared prompt-prefix K/V (system + RAG
     # context) reused across requests; 0 disables (serving/engine.py)
     prefix_cache: int = 8
@@ -245,8 +264,27 @@ class ModelRegistry:
                 f"model {name}: quantize={spec.quantize!r} is decoder-only "
                 "(encoders are compute-bound, not weight-read-bound)"
             )
-        if spec.quantize and spec.quantize != "int8":
+        if spec.quantize and spec.quantize not in ("int8", "int4"):
             raise ValueError(f"model {name}: unknown quantize={spec.quantize!r}")
+        if spec.quant_group_size < 2 or spec.quant_group_size % 2:
+            raise ValueError(
+                f"model {name}: quant_group_size must be an even int >= 2 "
+                f"(got {spec.quant_group_size})"
+            )
+        if spec.decode_steps < 0:
+            raise ValueError(
+                f"model {name}: decode_steps must be >= 1 (or 0 = inherit "
+                f"burst); got {spec.decode_steps}"
+            )
+        if spec.decode_steps > 1 and spec.speculative:
+            raise ValueError(
+                f"model {name}: decode_steps={spec.decode_steps} is "
+                "incompatible with speculative decoding — the speculative "
+                "tick is itself the multi-token fast path "
+                "(docs/SPECULATIVE.md); drop one of the two knobs"
+            )
+        if spec.decode_steps and spec.kind == "encoder":
+            raise ValueError(f"model {name}: decode_steps is decoder-only")
         if spec.warmup_json and spec.kind == "encoder":
             raise ValueError(f"model {name}: warmup_json is decoder-only")
         if spec.speculative and spec.kind == "encoder":
@@ -359,12 +397,63 @@ class ModelRegistry:
                 params = llama.init(cfg, jax.random.key(0))
             else:
                 raise ValueError(f"model {name}: need path, checkpoint, or tiny=true")
-            if spec.quantize == "int8":
-                # quantize BEFORE device placement: int8 is what transfers and
-                # shards (QTensor rides the same sharding tree as a prefix)
-                from ..ops.quant import quantize_decoder_params
+            if spec.quantize in ("int8", "int4"):
+                # quantize BEFORE device placement: the packed integers are
+                # what transfers and shards (QTensor/QTensor4 ride the same
+                # sharding tree as a pytree prefix)
+                from ..ops.quant import quantize_decoder_params, weight_bits
 
-                params = quantize_decoder_params(params)
+                bits = weight_bits(params)
+                want = {"int8": 8, "int4": 4}[spec.quantize]
+                if bits != 16:
+                    # a converted checkpoint arrives pre-quantized: feeding
+                    # QTensor leaves back through the quantizer dies with an
+                    # opaque numpy shape error — match is a no-op, mismatch
+                    # is a config error worth naming
+                    if bits == want:
+                        logger.info(
+                            "model %s: checkpoint is already %s-quantized; "
+                            "quantize=%r is a no-op",
+                            name,
+                            spec.quantize,
+                            spec.quantize,
+                        )
+                        if want == 4:
+                            # the accuracy knob cannot re-group a packed
+                            # checkpoint — say so instead of silently serving
+                            # a different group size than the spec believes
+                            from ..ops.quant import QTensor4
+
+                            ck_groups = {
+                                leaf.group_size
+                                for leaf in params["layers"].values()
+                                if isinstance(leaf, QTensor4)
+                            }
+                            if ck_groups and ck_groups != {
+                                spec.quant_group_size
+                            }:
+                                logger.warning(
+                                    "model %s: quant_group_size=%d has no "
+                                    "effect — the checkpoint was packed at "
+                                    "group size(s) %s; re-convert to change "
+                                    "it",
+                                    name,
+                                    spec.quant_group_size,
+                                    sorted(ck_groups),
+                                )
+                    else:
+                        raise ValueError(
+                            f"model {name}: checkpoint is already quantized "
+                            f"(int{bits}) but the spec asks for "
+                            f"quantize={spec.quantize!r}; re-convert the "
+                            "checkpoint in the desired format or drop the knob"
+                        )
+                else:
+                    params = quantize_decoder_params(
+                        params,
+                        fmt=spec.quantize,
+                        group_size=spec.quant_group_size,
+                    )
             with self.mesh:
                 params = shard_pytree(params, llama.logical_axes(cfg), self.mesh)
             from .faults import FaultInjector
@@ -422,6 +511,7 @@ class ModelRegistry:
                     chunk_size=spec.chunk_size,
                     lookahead=spec.lookahead,
                     burst=spec.burst,
+                    decode_steps=spec.decode_steps or None,
                     prefix_cache_size=spec.prefix_cache,
                     prefix_min_tokens=spec.prefix_min_tokens,
                     prefix_cache_max_bytes=spec.prefix_cache_max_bytes,

@@ -1301,6 +1301,30 @@ class EngineRouter:
                 )
         return out
 
+    def decode_path_stats(self) -> dict:
+        """Fleet decode fast-path gauges (docs/QUANT.md): fused depth /
+        weight bits from the replicas (uniform by construction — every
+        replica is built from the same spec), effective depth as the MIN
+        across replicas (one json-downgraded replica is what an operator
+        must see), counters summed, per-replica blocks attached."""
+        per = [rep.engine.decode_path_stats() for rep in list(self.replicas)]
+        if not per:
+            return {}
+        return {
+            "decode_steps": per[0]["decode_steps"],
+            "decode_steps_effective": min(
+                p["decode_steps_effective"] for p in per
+            ),
+            "json_downgraded_ticks": sum(
+                p["json_downgraded_ticks"] for p in per
+            ),
+            "upload_overlap_frac": round(
+                sum(p["upload_overlap_frac"] for p in per) / len(per), 4
+            ),
+            "weight_bits": per[0]["weight_bits"],
+            "replicas": per,
+        }
+
     def supervision_stats(self) -> dict:
         """Aggregate supervision: healthy only when EVERY replica is (one
         dead replica of N is exactly what an operator must see as degraded),

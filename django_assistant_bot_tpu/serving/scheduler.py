@@ -173,6 +173,11 @@ class RequestScheduler:
         # makes the engine skip speculative verify forwards
         self._degrade_forced: Optional[int] = None
         self._service_ema_s = float(self.cfg.service_time_init)
+        # per-token service model (note_service with tokens > 0): rate EMA x
+        # tokens-per-request EMA replaces the raw per-request EMA once warm,
+        # so fused N-step decode ticks don't inflate predicted queue waits
+        self._service_per_token_ema_s: Optional[float] = None
+        self._service_tokens_ema = 0.0
         # per-class counters (created lazily so new classes just appear)
         self.submitted: Dict[str, int] = collections.defaultdict(int)
         self.admitted: Dict[str, int] = collections.defaultdict(int)
@@ -289,6 +294,16 @@ class RequestScheduler:
         with self._lock:
             self._queued_kv_pages = max(0, self._queued_kv_pages - max(0, pages))
 
+    def _service_s_locked(self) -> float:
+        """Expected per-request service time: the per-token model (rate EMA x
+        tokens-per-request EMA) once the engine has fed token counts, else
+        the raw per-request EMA — see :meth:`note_service`."""
+        if self._service_per_token_ema_s is not None:
+            return self._service_per_token_ema_s * max(
+                1.0, self._service_tokens_ema
+            )
+        return self._service_ema_s
+
     def _est_wait_s_locked(self, extra: int = 0, hist_q: Optional[float] = None) -> float:
         """Predicted time until a newly queued request could START.
 
@@ -297,7 +312,7 @@ class RequestScheduler:
         queue-wait histogram quantile, computed by the caller outside the
         lock — floors it with the measured tail of realized waits, which the
         point EMA systematically underestimates under service-time variance."""
-        model = (self._depth + extra) * self._service_ema_s / self._slots
+        model = (self._depth + extra) * self._service_s_locked() / self._slots
         if hist_q is not None and self._depth + extra > 0:
             return max(model, hist_q)
         return model
@@ -350,7 +365,7 @@ class RequestScheduler:
                 kv_wait = (
                     (self._queued_kv_pages + kv_pages)
                     / self._kv_total
-                    * self._service_ema_s
+                    * self._service_s_locked()
                 )
                 if kv_pages > avail and kv_wait > cfg.admit_max_wait_s:
                     self.shed["kv_pressure"] += 1
@@ -586,14 +601,53 @@ class RequestScheduler:
         )
 
     # ------------------------------------------------------------- telemetry
-    def note_service(self, seconds: float) -> None:
+    def note_service(self, seconds: float, tokens: int = 0) -> None:
         """Fold one finished request's service time into the EMA driving the
-        estimated-wait admission test."""
+        estimated-wait admission test.
+
+        With ``tokens > 0`` (the decode steps the request's slot actually sat
+        through — the engine charges fused N-step ticks their full N even
+        when EOS lands mid-tick), the model becomes PER-TOKEN: a per-token
+        rate EMA and a tokens-per-request EMA whose product replaces the raw
+        per-request EMA in :meth:`_est_wait_s_locked`.  Why: a
+        ``decode_steps=N`` engine delivers residency in N-step quanta and the
+        host sees finishes ``lookahead`` ticks late, so short requests'
+        measured residency inflates by up to ``lookahead * (N-1)`` steps —
+        feeding that directly into the per-request EMA inflates every
+        predicted queue wait (and therefore 429 Retry-After hints and the
+        autoscaler's backlog signal).  Normalizing by the steps the slot
+        really occupied keeps the rate honest; the tokens EMA restores the
+        per-request scale.  Calls without ``tokens`` keep the legacy
+        per-request EMA behavior byte-for-byte (and that EMA keeps updating
+        regardless, as the cold-start fallback)."""
         a = self.cfg.service_time_alpha
         with self._lock:
             self._service_ema_s = (1 - a) * self._service_ema_s + a * max(
                 0.0, float(seconds)
             )
+            if tokens > 0:
+                per_tok = max(0.0, float(seconds)) / int(tokens)
+                if self._service_per_token_ema_s is None:
+                    self._service_per_token_ema_s = per_tok
+                    self._service_tokens_ema = float(tokens)
+                else:
+                    self._service_per_token_ema_s = (
+                        (1 - a) * self._service_per_token_ema_s + a * per_tok
+                    )
+                    self._service_tokens_ema = (
+                        (1 - a) * self._service_tokens_ema + a * float(tokens)
+                    )
+            elif self._service_per_token_ema_s is not None:
+                # token-less evidence after the model warmed (a test harness
+                # or non-engine caller): fold it in at the learned
+                # tokens-per-request so it still moves the effective model —
+                # the tokens EMA itself carries no new information here
+                per_tok = max(0.0, float(seconds)) / max(
+                    1.0, self._service_tokens_ema
+                )
+                self._service_per_token_ema_s = (
+                    (1 - a) * self._service_per_token_ema_s + a * per_tok
+                )
 
     def note_expired_running(self, priority: str) -> None:
         with self._lock:
@@ -653,6 +707,16 @@ class RequestScheduler:
                 "est_wait_source": "histogram" if hist_q is not None else "ema",
                 "wait_hist_q_s": round(hist_q, 4) if hist_q is not None else None,
                 "service_ema_s": round(self._service_ema_s, 4),
+                # the per-token model actually driving est_wait once warm
+                # (None until the engine feeds token counts): rate x
+                # tokens-per-request — see note_service
+                "service_model_s": round(self._service_s_locked(), 4),
+                "service_per_token_ema_ms": (
+                    round(self._service_per_token_ema_s * 1e3, 4)
+                    if self._service_per_token_ema_s is not None
+                    else None
+                ),
+                "service_tokens_ema": round(self._service_tokens_ema, 2),
                 "degraded": self._degrade_forced is not None
                 or (
                     self.cfg.degrade_at < 1.0

@@ -412,6 +412,13 @@ def create_app(
                 # KV memory plane gauges: pool occupancy, shared-page
                 # fraction, allocator eviction/COW counters (docs/KV_PAGING.md)
                 g["kv"] = kv()
+            dec = getattr(eng, "decode_path_stats", None)
+            if callable(dec):
+                # decode fast-path gauges (docs/QUANT.md): fused-tick depth
+                # configured vs effective (json downgrade), weight bits, and
+                # the double-buffered upload fraction — which fast path is
+                # ACTUALLY active, same pattern as kv_layout_effective
+                g["decode"] = dec()
             spec = getattr(eng, "spec_stats", None)
             if callable(spec):
                 # speculative-decoding gauges: accept rate/EMA (per tree

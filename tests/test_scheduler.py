@@ -139,6 +139,39 @@ def test_estimated_wait_admission_test():
     assert s.try_admit("interactive", deadline_s=0.5).ok
 
 
+def test_per_token_service_model_drives_estimated_wait():
+    """note_service with tokens engages the per-token model (rate EMA x
+    tokens-per-request EMA) — fused N-step ticks deliver residency in
+    tick-quantized quanta, and normalizing by the steps the slot actually
+    sat through keeps predicted queue waits honest (docs/SCHEDULING.md)."""
+    s = RequestScheduler(
+        SchedulerConfig(max_queue=100, service_time_init=2.0), slots=1
+    )
+    # legacy calls keep the raw per-request EMA behavior byte-for-byte
+    s.note_service(1.0)
+    st = s.stats()
+    assert st["service_per_token_ema_ms"] is None
+    assert st["service_model_s"] == st["service_ema_s"]
+    # token-fed calls: first sample seeds rate=0.1 s/tok, tokens=10
+    s.note_service(1.0, tokens=10)
+    st = s.stats()
+    assert st["service_per_token_ema_ms"] == pytest.approx(100.0)
+    assert st["service_tokens_ema"] == pytest.approx(10.0)
+    assert st["service_model_s"] == pytest.approx(1.0)
+    # the est-wait model consumes the per-token product, not the raw EMA:
+    # depth 1 * model / 1 slot
+    _admit_and_enqueue(s, "interactive")
+    assert s.est_wait_s() == pytest.approx(st["service_model_s"], rel=1e-6)
+    # a short request padded to a full fused tick (0.8 s residency for 8
+    # charged steps) keeps the same per-token rate — the model stays ~1 s
+    # while the raw per-request EMA is dragged toward the padded residency
+    for _ in range(50):
+        s.note_service(0.8, tokens=8)
+    st = s.stats()
+    assert st["service_per_token_ema_ms"] == pytest.approx(100.0, rel=0.02)
+    assert st["service_model_s"] == pytest.approx(0.8, rel=0.05)
+
+
 def test_deadline_expiry_reaped_at_queue_head():
     s = RequestScheduler(SchedulerConfig())
     dead = _stub(deadline_at=time.monotonic() - 0.01)

@@ -1,0 +1,27 @@
+#!/usr/bin/env python3
+"""Standalone wrapper for the byte-ledger decode geometry autotuner.
+
+Usage (pure arithmetic — no jax, no weights, runs anywhere):
+
+    python tools/autotune.py --layers 32 --hidden 4096 --intermediate 14336 \
+        --heads 32 --kv-heads 8 --head-dim 128 --vocab 128256 \
+        --max-seq-len 8192 --weight-bits 4 --hbm-budget-gb 16
+
+Prints the recommended {kv_page_size, max_slots, decode_steps} plus the
+modeled tok/s ranking and the assumptions behind it.  The in-server variant
+is ``dabt serve --autotune`` (reads geometry from the model config); the
+model itself lives in django_assistant_bot_tpu/serving/autotune.py and is
+documented in docs/QUANT.md.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+from django_assistant_bot_tpu.serving.autotune import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -44,6 +44,20 @@ HOT_PATH_PATTERNS: Tuple[str, ...] = (
     "insert_sequences_paged",
     "prefill_suffix_paged",
     "prefill_chunk_paged",
+    # fused multi-step decode tick (decode_steps > 1): the N-step scan body
+    # and its builder — a host sync inside would stall ALL N steps of every
+    # tick, so the builder closure tree is a root in its own right
+    "*._make_decode_tick*",
+    # double-buffered host->device uploads: runs between ticks while device
+    # work is in flight; a sync here would serialize the overlap away
+    "*._upload_dirty",
+    "*._prestage_uploads",
+    "*._refresh_sampling",
+    # quantized in-dot dequant (int8 per-channel / int4 grouped): the weight
+    # read path of every decode/prefill/verify dot
+    "qeinsum",
+    "*.qeinsum",
+    "unpack_int4",
     # observability recorder entry points (serving/obs.py): called from the
     # tick path's host bookkeeping, so metric recording can never silently
     # add a device sync — roots in their own right, independent of whether
