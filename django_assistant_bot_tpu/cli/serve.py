@@ -35,7 +35,11 @@ def add_parser(sub):
         type=float,
         default=None,
         metavar="GB",
-        help="HBM byte budget for --autotune (default 16.0)",
+        help="per-DEVICE HBM budget for --autotune (default 16.0).  The "
+        "effective budget is per-device x one replica's devices: its slice "
+        "(--replica-devices / replica_devices in the config) on a sliced "
+        "fleet, the whole host otherwise — so the recommendation matches "
+        "what a sliced replica can actually hold (docs/MULTICHIP.md)",
     )
     p.add_argument(
         "--autotune-hbm-gbps",
@@ -55,6 +59,19 @@ def add_parser(sub):
         "token-less re-route (serving/router.py; docs/RESILIENCE.md).  1 "
         "(the default) keeps the single-engine path byte-identical to "
         "before — no router object exists at all",
+    )
+    p.add_argument(
+        "--replica-devices",
+        type=int,
+        default=None,
+        metavar="N",
+        help="mesh-sliced fleet (docs/MULTICHIP.md): pin every decoder "
+        "replica to its OWN disjoint slice of N devices (tensor-parallel "
+        "inside the slice), so weights, KV pool, and compiled ticks live "
+        "only on that slice and aggregate tok/s scales with chips — e.g. 8 "
+        "devices at N=2 serve up to 4 replicas x TP-2.  Scale-up past the "
+        "last free slice is an honest no_capacity rejection.  0/unset = all "
+        "replicas share the one global mesh (the pre-slicing behavior)",
     )
     p.add_argument(
         "--autoscale",
@@ -263,6 +280,8 @@ def run(args) -> int:
     # --autoscale turns the controller on per decoder
     if getattr(args, "min_replicas", None) is not None:
         sched_overrides["replicas"] = args.min_replicas
+    if getattr(args, "replica_devices", None) is not None:
+        sched_overrides["replica_devices"] = args.replica_devices
     if getattr(args, "max_replicas", None) is not None:
         sched_overrides["max_replicas"] = args.max_replicas
     if getattr(args, "autoscale", False):
@@ -338,10 +357,32 @@ def run(args) -> int:
         from ..serving.registry import ModelSpec
 
         overrides = {}
-        if getattr(args, "autotune_hbm_gb", None) is not None:
-            overrides["hbm_budget_gb"] = args.autotune_hbm_gb
         if getattr(args, "autotune_hbm_gbps", None) is not None:
             overrides["hbm_gbps"] = args.autotune_hbm_gbps
+        # slice-aware budget (docs/MULTICHIP.md): the sweep is bounded by
+        # what ONE replica's devices can hold — its slice on a sliced
+        # fleet, the whole host otherwise — never the global device count
+        # for a replica that only spans a slice of it.  The host query is
+        # LAZY and fallible: planning mode promises "no weights load, no
+        # server start", and only an UNSLICED spec needs the host device
+        # count — initializing the backend for a sliced sweep (e.g. while a
+        # live server holds the TPU runtime lock) would crash planning mode
+        # for nothing.
+        _host_n: list = []
+
+        def _n_host_devices():
+            if not _host_n:
+                try:
+                    import jax as _jax
+
+                    _host_n.append(len(_jax.devices()))
+                except Exception as e:  # noqa: BLE001 - planning mode
+                    print(
+                        "warning: could not query the device count "
+                        f"({type(e).__name__}: {e}); budgeting for 1 device"
+                    )
+                    _host_n.append(1)
+            return _host_n[0]
         results = []
         for name, d in config.items():
             if d.get("kind") != "decoder":
@@ -393,7 +434,17 @@ def run(args) -> int:
             except Exception as e:  # noqa: BLE001 - planning mode reports
                 results.append({"model": name, "error": str(e)})
                 continue
-            results.append(recommend_for_spec(spec, cfg, **model_overrides))
+            results.append(
+                recommend_for_spec(
+                    spec,
+                    cfg,
+                    n_host_devices=(
+                        None if spec.replica_devices else _n_host_devices()
+                    ),
+                    hbm_gb_per_device=getattr(args, "autotune_hbm_gb", None),
+                    **model_overrides,
+                )
+            )
         print(_json.dumps({"autotune": results}, indent=2))
         return 0
 

@@ -20,6 +20,11 @@ from .sharding import (  # noqa: F401
     shard_pytree,
     with_constraint,
 )
+from .slicing import (  # noqa: F401
+    DeviceSlice,
+    MeshPlanner,
+    NoCapacity,
+)
 from .distributed import (  # noqa: F401
     initialize_cluster,
     is_primary,

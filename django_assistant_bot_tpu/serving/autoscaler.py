@@ -146,6 +146,23 @@ class SLOAutoscaler:
         self.scale_ups = 0
         self.scale_downs = 0
         self.scale_up_failures = 0
+        # WHY a wanted scale-up did not happen, by reason — so an operator
+        # can tell "at hardware limit" (no_capacity: the mesh planner has no
+        # free device slice, docs/MULTICHIP.md) from "flap-damped"
+        # (cooldown) and "at the configured ceiling" (bounds).  Counted every
+        # overloaded tick the actuator was held back; the flight ring gets
+        # one event per reason TRANSITION, not per tick.
+        self.scale_up_skipped: dict = {
+            "cooldown": 0,
+            "bounds": 0,
+            "no_capacity": 0,
+        }
+        self.last_skip_reason: Optional[str] = None
+        # sticky until a scale event changes capacity: while set, the
+        # overload band engages degradation exactly as at the configured
+        # ceiling — shaping load is the only actuator left at the hardware
+        # limit
+        self._no_capacity = False
         # warm-state durability: what scale-downs preserved vs dropped
         self.warm_entries_migrated = 0
         self.warm_pages_migrated = 0
@@ -282,12 +299,40 @@ class SLOAutoscaler:
         if overload and self._up_ticks >= cfg.up_consecutive:
             if n < cfg.max_replicas and now >= self._up_ok_at:
                 decision = self._scale_up(now)
-            elif burn >= cfg.degrade_burn and not self.degrade_active:
-                decision = self._set_degrade(True)
-            elif n >= cfg.max_replicas and not self.degrade_active:
-                # at the ceiling with the overload band held: shaping load is
-                # the only actuator left, whatever the burn level
-                decision = self._set_degrade(True)
+                if decision == "no_capacity" and not self.degrade_active:
+                    # the refused spawn was not an actuation: at the
+                    # hardware limit fall through to degradation on the
+                    # SAME tick, exactly as the max_replicas branch —
+                    # otherwise a short cooldown could turn every
+                    # qualifying tick into another refused probe and load
+                    # shaping would never engage on a saturated host
+                    self._set_degrade(True)
+                    decision = "no_capacity+degrade_on"
+            else:
+                # a scale-up was WANTED and held back — record why, so "at
+                # hardware limit" is distinguishable from bounds/cooldown on
+                # the stats surface.  While the no-capacity flag is sticky
+                # (nothing freed a slice since the last refused attempt) the
+                # cooldown is incidental — the holdback IS the hardware
+                # limit, and attributing it to "cooldown" would read as
+                # flap-damping on a saturated host.
+                if n >= cfg.max_replicas:
+                    reason = "bounds"
+                elif self._no_capacity:
+                    reason = "no_capacity"
+                else:
+                    reason = "cooldown"
+                self._note_skip(reason, sig)
+                if burn >= cfg.degrade_burn and not self.degrade_active:
+                    decision = self._set_degrade(True)
+                elif (
+                    n >= cfg.max_replicas or self._no_capacity
+                ) and not self.degrade_active:
+                    # at the ceiling — configured (max_replicas) or hardware
+                    # (no free device slice) — with the overload band held:
+                    # shaping load is the only actuator left, whatever the
+                    # burn level
+                    decision = self._set_degrade(True)
         elif trough and self._down_ticks >= cfg.down_consecutive:
             if self.degrade_active and burn_released:
                 decision = self._set_degrade(False)
@@ -306,10 +351,65 @@ class SLOAutoscaler:
         return record
 
     # ----------------------------------------------------------- actuators
+    def _note_skip(
+        self, reason: str, sig: Optional[dict] = None, *, record: bool = True
+    ) -> bool:
+        """Count a held-back scale-up by reason; flight-record only on a
+        reason TRANSITION (the counters carry the per-tick evidence — one
+        ring event per band entry keeps the crash artifact readable).
+        Returns whether the reason changed."""
+        sig = sig or {}
+        with self._lock:
+            self.scale_up_skipped[reason] = (
+                self.scale_up_skipped.get(reason, 0) + 1
+            )
+            changed = self.last_skip_reason != reason
+            self.last_skip_reason = reason
+        if record and changed:
+            self.flight.record(
+                "scale_up_skipped",
+                reason=reason,
+                replicas=sig.get("replicas"),
+                burn=sig.get("burn"),
+                shed_rate=sig.get("shed_rate"),
+            )
+        return changed
+
     def _scale_up(self, now: float) -> str:
         try:
             name = self.router.add_replica()
         except Exception as e:
+            from ..parallel.slicing import NoCapacity
+
+            if isinstance(e, NoCapacity):
+                # slices exhausted: an HONEST "at hardware limit" decision,
+                # distinct from a failed spawn — the fleet holds its size, no
+                # same-chip cache clone is ever created, and the overload
+                # band falls through to degradation on later ticks.  The
+                # cooldown still applies so a saturated host is not probed
+                # every control tick; a scale-down frees a slice and clears
+                # the sticky flag.  The shared skip ledger counts the tick;
+                # the richer event rides the ring only on the LIMIT
+                # TRANSITION (repeat refusals are counter evidence, not ring
+                # spam).
+                first = not self._no_capacity
+                self._no_capacity = True
+                self._note_skip("no_capacity", record=False)
+                # cooldown, but NO _up_ticks reset: a refusal is not an
+                # actuation — the overload band stays armed so degradation
+                # (tick()'s fall-through) engages immediately instead of
+                # waiting out a fresh hysteresis window per refused probe
+                self._up_ok_at = now + self.cfg.up_cooldown_s
+                if first:
+                    self.flight.record(
+                        "scale_up_no_capacity",
+                        reason="no_capacity",
+                        slices_total=getattr(e, "slices_total", 0),
+                        replica_devices=getattr(e, "replica_devices", 0),
+                        error=str(e),
+                    )
+                logger.warning("autoscaler: scale-up skipped — %s", e)
+                return "no_capacity"
             # a failed spawn (OOM, factory error) must not kill the control
             # loop: count it, leave the cooldown untouched so the next tick
             # can retry
@@ -320,6 +420,8 @@ class SLOAutoscaler:
             return "scale_up_failed"
         with self._lock:
             self.scale_ups += 1
+            self.last_skip_reason = None
+        self._no_capacity = False
         self._up_ok_at = now + self.cfg.up_cooldown_s
         self._up_ticks = 0
         if self.degrade_active:
@@ -356,6 +458,9 @@ class SLOAutoscaler:
             return "hold"
         with self._lock:
             self.scale_downs += 1
+            # a detach released capacity (on a sliced fleet, a device slice):
+            # the next wanted scale-up gets a fresh verdict
+            self._no_capacity = False
             # warm-state durability accounting (docs/KV_PAGING.md "Tiered
             # KV"): a scale-down is no longer a silent cache wipe — the
             # migration result rides in the detach report, accumulates
@@ -461,6 +566,13 @@ class SLOAutoscaler:
                 "scale_ups": self.scale_ups,
                 "scale_downs": self.scale_downs,
                 "scale_up_failures": self.scale_up_failures,
+                # held-back scale-ups by reason: "at hardware limit"
+                # (no_capacity — the mesh planner has no free slice) is
+                # distinct from cooldown (flap damping) and bounds (the
+                # configured max_replicas ceiling)
+                "scale_up_skipped": dict(self.scale_up_skipped),
+                "last_skip_reason": self.last_skip_reason,
+                "at_hardware_limit": self._no_capacity,
                 "warm_entries_migrated": self.warm_entries_migrated,
                 "warm_pages_migrated": self.warm_pages_migrated,
                 "warm_pages_lost": self.warm_pages_lost,

@@ -233,9 +233,28 @@ def _assumptions(kwargs: Mapping[str, Any]) -> dict:
     }
 
 
-def recommend_for_spec(spec: Any, cfg: Any, **overrides: Any) -> dict:
+def recommend_for_spec(
+    spec: Any,
+    cfg: Any,
+    *,
+    n_host_devices: Optional[int] = None,
+    hbm_gb_per_device: Optional[float] = None,
+    **overrides: Any,
+) -> dict:
     """Autotune one decoder ModelSpec against its (already-parsed) model
-    config — the ``cli serve --autotune`` entry point."""
+    config — the ``cli serve --autotune`` entry point.
+
+    Slice awareness (docs/MULTICHIP.md): on a mesh-sliced fleet
+    (``spec.replica_devices > 0``) the budget that matters is what ONE
+    replica's slice can hold — ``replica_devices`` chips — not the whole
+    host; a whole-host budget would recommend a geometry a sliced replica
+    cannot place.  ``hbm_gb_per_device`` is the per-chip HBM (default
+    :data:`DEFAULT_HBM_BUDGET_GB`); the effective budget is per-chip x
+    slice devices.  Unsliced specs keep the historical semantics (the
+    budget names one replica's whole mesh — all of ``n_host_devices`` when
+    given, else the single-chip default).  An explicit ``hbm_budget_gb``
+    override wins over both.
+    """
     import jax.numpy as jnp
 
     geom = Geometry.from_decoder_config(cfg)
@@ -245,6 +264,8 @@ def recommend_for_spec(spec: Any, cfg: Any, **overrides: Any) -> dict:
         if (spec.kv_cache_dtype or "").startswith("fp8")
         else jnp.dtype(cfg.dtype).itemsize
     )
+    replica_devices = int(getattr(spec, "replica_devices", 0) or 0)
+    slice_devices = replica_devices or int(n_host_devices or 1)
     kwargs = {
         "fill_len": None,
         "weight_bits": weight_bits,
@@ -252,6 +273,15 @@ def recommend_for_spec(spec: Any, cfg: Any, **overrides: Any) -> dict:
         "kv_itemsize": kv_itemsize,
         **overrides,
     }
+    if "hbm_budget_gb" not in kwargs and (
+        replica_devices or hbm_gb_per_device is not None or n_host_devices
+    ):
+        per_chip = (
+            hbm_gb_per_device
+            if hbm_gb_per_device is not None
+            else DEFAULT_HBM_BUDGET_GB
+        )
+        kwargs["hbm_budget_gb"] = per_chip * slice_devices
     if getattr(spec, "speculative", 0):
         # decode_steps > 1 is rejected at load on speculative decoders
         # (docs/SPECULATIVE.md) — never recommend a config that cannot boot
@@ -262,6 +292,10 @@ def recommend_for_spec(spec: Any, cfg: Any, **overrides: Any) -> dict:
     out = recommend(geom, max_seq_len=max_seq_len, **kwargs)
     out["model"] = spec.name
     out["max_seq_len"] = max_seq_len
+    # what the budget was sized FOR: one replica's devices (its slice on a
+    # sliced fleet, the whole mesh otherwise)
+    out["slice_devices"] = slice_devices
+    out["sliced"] = bool(replica_devices)
     return out
 
 

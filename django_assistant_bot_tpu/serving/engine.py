@@ -71,6 +71,22 @@ class EngineUnavailable(RuntimeError):
         self.retry_after_s = float(retry_after_s)
 
 
+def _resident_bytes(tree) -> int:
+    """Device-RESIDENT bytes of a pytree: one charge per addressable shard,
+    so an array replicated across a mesh axis is charged per copy and a
+    sharded array is charged exactly once in total.  Host (numpy) leaves
+    charge their plain nbytes.  Init-time accounting only (the per-slice HBM
+    ledger, docs/MULTICHIP.md) — reads array METADATA, never device memory."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            total += sum(int(s.data.nbytes) for s in shards)
+        else:
+            total += int(getattr(leaf, "nbytes", 0))
+    return total
+
+
 def _replicated(mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -680,6 +696,24 @@ class GenerationEngine:
             )
         else:
             self._cache_shardings = None
+        # --- mesh-sliced fleet identity (parallel/slicing.py;
+        # docs/MULTICHIP.md) ------------------------------------------------
+        # slice_id/release_slice are set by the registry when this replica is
+        # pinned to its own device slice; slice_devices is derived from
+        # whatever mesh THIS engine actually traces onto, so the gauge can
+        # never disagree with placement.  The per-slice HBM ledger below is
+        # the operator evidence that a replica's footprint lives only on its
+        # slice: device-RESIDENT bytes (one entry per addressable shard, so
+        # replication across mesh axes is charged, sharding is not
+        # double-charged), computed once here — weights never move and the
+        # cache/pool allocation is fixed for the engine's lifetime.
+        self.slice_id: Optional[int] = None
+        self.release_slice: Optional[Callable[[], None]] = None
+        if mesh is not None:
+            self.slice_devices = [d.id for d in np.asarray(mesh.devices).flatten()]
+        else:
+            self.slice_devices = []
+        self.hbm_weight_bytes = _resident_bytes(params)
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._pending: "collections.deque[_Request]" = collections.deque()
@@ -691,6 +725,10 @@ class GenerationEngine:
         self._slot_epoch = [0] * max_slots
         self._inflight: "collections.deque[_TickRef]" = collections.deque()
         self._cache = self._fresh_cache()
+        # KV side of the per-slice HBM ledger: the paged pool or legacy slot
+        # cache allocation (fixed for the engine's lifetime — restarts
+        # rebuild the same shape on the same devices)
+        self.hbm_kv_bytes = _resident_bytes(self._cache)
         # per-slot block tables (host-owned, paged layout): logical block ->
         # physical page, with n_pages as the "unallocated" sentinel.  Uploaded
         # lazily like the sampling arrays (committed replicated array, re-sent
@@ -2830,6 +2868,8 @@ class GenerationEngine:
         # eviction/COW counters (paged), or the pinned-prefix footprint (legacy)
         out["kv"] = self.kv_stats()
         out["reclaimed_slots"] = self.reclaimed_slots
+        # device-slice identity + per-slice HBM ledger (docs/MULTICHIP.md)
+        out["slice"] = self.slice_stats()
         # restart/quarantine/circuit counters + loop heartbeat (supervision)
         out["supervision"] = self.supervision_stats()
         out.update(self.latency_stats())
@@ -2854,6 +2894,25 @@ class GenerationEngine:
             "json_downgraded_ticks": self._json_downgraded_ticks,
             "upload_overlap_frac": self.upload_overlap_frac(),
             "weight_bits": self.weight_bits,
+        }
+
+    def slice_stats(self) -> dict:
+        """Device-slice identity + HBM ledger for tick_stats / /healthz /
+        /metrics (docs/MULTICHIP.md): which devices this replica's mesh
+        actually spans, the slice id when the registry pinned it to one
+        (None on the global-mesh path), and the device-resident byte
+        footprint — weights plus the KV pool/cache allocation.  On an
+        UNSLICED multi-replica fleet the weights are shared, so every
+        replica's ``hbm_weight_bytes`` reports the same shared allocation;
+        with slicing each replica's numbers are exclusively its own slice's
+        (what makes the per-slice ledgers summable)."""
+        return {
+            "slice_id": self.slice_id,
+            "devices": list(self.slice_devices),
+            "sliced": self.slice_id is not None,
+            "hbm_weight_bytes": self.hbm_weight_bytes,
+            "hbm_kv_bytes": self.hbm_kv_bytes,
+            "hbm_bytes": self.hbm_weight_bytes + self.hbm_kv_bytes,
         }
 
     def spec_stats(self) -> Optional[dict]:

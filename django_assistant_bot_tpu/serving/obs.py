@@ -684,6 +684,20 @@ def render_prometheus(registry: Any) -> str:
             x.add("dabt_decode_json_downgraded_ticks_total", "counter", "fused ticks downgraded to single-step by live json slots", dec.get("json_downgraded_ticks"), lab)
             x.add("dabt_upload_overlap_frac", "gauge", "sampling/block-table upload cycles overlapped with an in-flight tick", dec.get("upload_overlap_frac"), lab)
             x.add("dabt_weight_bits", "gauge", "decode weight format width in bits (16/8/4)", dec.get("weight_bits"), lab)
+        sl_fn = getattr(eng, "slice_stats", None)
+        if callable(sl_fn):
+            # mesh-sliced fleet (docs/MULTICHIP.md): which devices this
+            # replica's mesh spans and its device-resident HBM ledger — the
+            # operator evidence that a replica's footprint lives only on its
+            # slice (per-slice ledgers sum to the fleet footprint)
+            sl = sl_fn()
+            if sl.get("devices"):
+                x.add("dabt_slice_devices", "gauge", "devices in this replica's mesh (its slice when pinned)", len(sl["devices"]), lab)
+                x.add("dabt_slice_hbm_bytes", "gauge", "device-resident bytes on this replica's devices (weights + KV pool)", sl.get("hbm_bytes"), lab)
+                x.add("dabt_slice_hbm_weight_bytes", "gauge", "device-resident weight bytes", sl.get("hbm_weight_bytes"), lab)
+                x.add("dabt_slice_hbm_kv_bytes", "gauge", "device-resident KV pool/cache bytes", sl.get("hbm_kv_bytes"), lab)
+            if sl.get("slice_id") is not None:
+                x.add("dabt_slice_id", "gauge", "device-slice id this replica is pinned to", sl["slice_id"], lab)
         sched = getattr(eng, "scheduler", None)
         if sched is not None:
             st = sched.stats()
@@ -742,6 +756,12 @@ def render_prometheus(registry: Any) -> str:
             x.add("dabt_router_replicas_removed_total", "counter", "replicas drained and detached (scale-down)", rs.get("replicas_removed"), rlab)
             x.add("dabt_router_replica_restarts_total", "counter", "replica restarts (operator or drain-restart)", rs.get("replica_restarts"), rlab)
             x.add("dabt_router_affinity_hit_rate", "gauge", "prefix-affinity dispatch hit rate", rs["affinity_hit_rate"], rlab)
+            if "slices_total" in rs:
+                # sliced-fleet capacity: free slices == honest scale-up
+                # headroom (0 free -> add_replica is a no_capacity rejection)
+                x.add("dabt_router_slices_total", "gauge", "device slices planned on this host", rs["slices_total"], rlab)
+                x.add("dabt_router_slices_free", "gauge", "device slices not pinned to a replica", rs["slices_free"], rlab)
+                x.add("dabt_router_replica_devices", "gauge", "devices per replica slice", rs["replica_devices"], rlab)
             # fleet warm-state durability (scale-down migration; the
             # pages_lost counter is the pre-migration visibility satellite)
             x.add("dabt_kv_tier_pages_lost_at_detach_total", "counter", "warm KV pages dropped by replica detaches", rs.get("pages_lost_at_detach"), rlab)
@@ -770,6 +790,12 @@ def render_prometheus(registry: Any) -> str:
         x.add("dabt_autoscale_scale_ups_total", "counter", "replicas added by the controller", st["scale_ups"], lab)
         x.add("dabt_autoscale_scale_downs_total", "counter", "replicas removed by the controller", st["scale_downs"], lab)
         x.add("dabt_autoscale_scale_up_failures_total", "counter", "failed scale-up attempts", st["scale_up_failures"], lab)
+        for reason, n in sorted(st.get("scale_up_skipped", {}).items()):
+            # WHY a wanted scale-up was held back: no_capacity (slices
+            # exhausted — at the hardware limit) vs cooldown (flap-damped)
+            # vs bounds (the configured max_replicas ceiling)
+            x.add("dabt_autoscale_scale_up_skipped_total", "counter", "overloaded ticks whose scale-up was held back, by reason", n, {**lab, "reason": reason})
+        x.add("dabt_autoscale_at_hardware_limit", "gauge", "last scale-up attempt found no free device slice", st.get("at_hardware_limit"), lab)
         x.add("dabt_autoscale_degrade_active", "gauge", "load-adaptive degradation engaged", st["degrade_active"], lab)
         x.add("dabt_autoscale_degrade_engaged_total", "counter", "degradation band engagements", st["degrade_engaged"], lab)
         x.add("dabt_autoscale_replica_seconds_total", "counter", "integral of fleet size over time", st["replica_seconds"], lab)

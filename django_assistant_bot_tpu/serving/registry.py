@@ -181,6 +181,17 @@ class ModelSpec:
     # (max_replicas above this, or autoscale on) this is the INITIAL and
     # MINIMUM size, not a fixed count.
     replicas: int = 1
+    # --- mesh-sliced fleet (parallel/slicing.py; docs/MULTICHIP.md) ---------
+    # devices per replica: > 0 pins every replica to its OWN disjoint device
+    # slice (len(jax.devices()) // replica_devices slices, tensor-parallel
+    # INSIDE each slice), so weights, KV pool, and compiled ticks live only
+    # on that slice and aggregate tok/s scales with chips — e.g. 8 devices at
+    # replica_devices=2 -> up to 4 replicas x TP-2.  Scale-up past the last
+    # free slice is an honest `no_capacity` rejection instead of another
+    # cache clone on the same chips.  0 (default) = every replica traces onto
+    # the registry's one global mesh (the pre-slicing behavior, and the bench
+    # A/B baseline arm).
+    replica_devices: int = 0
     # ceiling for the dynamic fleet: the router's add_replica/remove_replica
     # (and the autoscaler driving them) keep the fleet within
     # [replicas, max_replicas].  0 = fixed fleet at `replicas` exactly.
@@ -340,6 +351,13 @@ class ModelRegistry:
             raise ValueError(
                 f"model {name}: max_replicas/autoscale are decoder-only"
             )
+        if spec.replica_devices < 0:
+            raise ValueError(f"model {name}: replica_devices must be >= 0")
+        if spec.replica_devices and spec.kind == "encoder":
+            raise ValueError(
+                f"model {name}: replica_devices is decoder-only (the "
+                "embedding coalescer runs one engine on the global mesh)"
+            )
         tokenizer_path = spec.path
         logger.info("loading model %r (%s, tiny=%s)", name, spec.kind, spec.tiny)
 
@@ -454,8 +472,44 @@ class ModelRegistry:
                         fmt=spec.quantize,
                         group_size=spec.quant_group_size,
                     )
-            with self.mesh:
-                params = shard_pytree(params, llama.logical_axes(cfg), self.mesh)
+            # --- device placement (docs/MULTICHIP.md weight-placement
+            # contract) -------------------------------------------------
+            # Global-mesh path: ONE device_put shards the weights over the
+            # whole mesh and every replica shares them read-only.  Sliced
+            # path (replica_devices > 0): `params` stays the SHARED HOST
+            # COPY — each replica's build does its own one-time device_put
+            # onto its slice, so a replica's weights live ONLY on its slice
+            # and a scale-up transfers exactly one slice's worth of bytes.
+            planner = None
+            if spec.replica_devices:
+                import numpy as _np
+
+                from ..parallel import MeshPlanner
+
+                mesh_devices = list(_np.asarray(self.mesh.devices).flatten())
+                if spec.replica_devices > len(mesh_devices):
+                    raise ValueError(
+                        f"model {name}: replica_devices="
+                        f"{spec.replica_devices} exceeds the mesh's "
+                        f"{len(mesh_devices)} device(s)"
+                    )
+                planner = MeshPlanner(
+                    spec.replica_devices, devices=mesh_devices
+                )
+                if spec.replicas > planner.n_slices:
+                    raise ValueError(
+                        f"model {name}: replicas={spec.replicas} needs more "
+                        f"device slices than the host has "
+                        f"({planner.n_slices} slice(s) of "
+                        f"{spec.replica_devices} device(s))"
+                    )
+                logical_tree = llama.logical_axes(cfg)
+                host_params = params
+            else:
+                with self.mesh:
+                    params = shard_pytree(
+                        params, llama.logical_axes(cfg), self.mesh
+                    )
             from .faults import FaultInjector
 
             def _build_sched():
@@ -501,11 +555,68 @@ class ModelRegistry:
                 """Replica ``i`` from the SHARED weight tree — used for the
                 initial fleet and as the router's scale-up factory (the
                 autoscaler spawns replicas through this exact closure, so a
-                scaled-up replica is indistinguishable from a boot-time one)."""
-                eng = GenerationEngine(
+                scaled-up replica is indistinguishable from a boot-time one).
+
+                With slicing on, the replica first acquires its own device
+                slice from the planner (NoCapacity propagates — the router/
+                autoscaler turn it into the honest `no_capacity` decision)
+                and places the shared host weights onto THAT slice only."""
+                rep_slice = None
+                rep_mesh = self.mesh
+                rep_params = params
+                if planner is not None:
+                    rep_slice = planner.acquire()
+                    rep_mesh = rep_slice.mesh
+                    try:
+                        with rep_mesh:
+                            rep_params = shard_pytree(
+                                host_params, logical_tree, rep_mesh
+                            )
+                    except Exception:
+                        planner.release(rep_slice)
+                        raise
+                try:
+                    eng = _construct(i, rep_params, rep_mesh)
+                except Exception:
+                    if rep_slice is not None:
+                        planner.release(rep_slice)
+                    raise
+                if rep_slice is not None:
+                    eng.slice_id = rep_slice.slice_id
+                    # detach epilogue hook: the router releases the slice
+                    # AFTER the replica is stopped (idempotent in the planner)
+                    eng.release_slice = (
+                        lambda _p=planner, _s=rep_slice: _p.release(_s)
+                    )
+                try:
+                    if spec.warmup or spec.warmup_json:
+                        # the persistent XLA compile cache makes replica
+                        # 2..N's warmup a cache replay, not a recompile
+                        eng.warmup(json=spec.warmup_json)
+                    eng.start()
+                except Exception:
+                    # a failed warmup/start (transient compile error, OOM)
+                    # must not LEAK the slice: this engine never joins the
+                    # fleet, so the detach epilogue will never release it —
+                    # a leaked slice would shrink hardware capacity for the
+                    # life of the process (every later scale-up NoCapacity
+                    # on free chips)
+                    try:
+                        eng.stop(drain_timeout_s=1.0)
+                    except Exception:  # pragma: no cover - teardown belt
+                        logger.exception(
+                            "model %s: half-built replica stop failed", name
+                        )
+                    if rep_slice is not None:
+                        planner.release(rep_slice)
+                    raise
+                return eng
+
+            def _construct(i: int, rep_params, rep_mesh):
+                return GenerationEngine(
                     cfg,
-                    params,  # weights are read-only: every replica shares them
-                    tokenizer,
+                    rep_params,  # read-only: shared fleet-wide (global mesh)
+                    tokenizer,  # or this slice's exclusive copy (sliced)
                     max_slots=spec.max_slots,
                     max_seq_len=spec.max_seq_len,
                     chunk_size=spec.chunk_size,
@@ -542,14 +653,8 @@ class ModelRegistry:
                     name=f"{name}/r{i}" if fleet else name,
                     obs=spec.obs,
                     obs_dump_dir=spec.obs_dump_dir,
-                    mesh=self.mesh,
+                    mesh=rep_mesh,
                 )
-                if spec.warmup or spec.warmup_json:
-                    # the persistent XLA compile cache makes replica 2..N's
-                    # warmup a cache replay, not a recompile
-                    eng.warmup(json=spec.warmup_json)
-                eng.start()
-                return eng
 
             engines = [_build_engine(i) for i in range(spec.replicas)]
             if not fleet:
@@ -568,6 +673,9 @@ class ModelRegistry:
                     faults=_build_faults(len(engines)),
                     replica_factory=_build_engine,
                 )
+                # slice topology surface: /healthz + /metrics read free/total
+                # slice gauges off the router (None on an unsliced fleet)
+                router.mesh_planner = planner
                 self.generators[name] = router
                 if spec.autoscale:
                     from .autoscaler import AutoscalerConfig, SLOAutoscaler

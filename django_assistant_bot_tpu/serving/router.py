@@ -373,6 +373,10 @@ class EngineRouter:
         # monotonic spawn counter: replica names are never reused, so flight
         # artifacts and /metrics labels stay unambiguous across scale cycles
         self._spawned = len(engines)
+        # mesh-sliced fleet (parallel/slicing.py): the registry attaches its
+        # MeshPlanner here so /healthz + /metrics can report slice capacity
+        # next to the fleet gauges; None on an unsliced fleet
+        self.mesh_planner = None
         # one request survives at most this many replica hops — the same
         # budget the engines' own crash-restart salvage enforces per replica
         self.max_reroutes = (
@@ -847,6 +851,18 @@ class EngineRouter:
         # stop fails anything the deadline forced (token-less victims
         # re-route through their done-callbacks, same as a replica death)
         rep.engine.stop(drain_timeout_s=1.0)
+        # sliced fleet: return the replica's device slice to the planner so
+        # a later scale-up can reuse those chips (idempotent release; the
+        # hook exists only on slice-pinned engines).  AFTER stop(): the
+        # engine must never tick on a slice another replica could acquire.
+        release = getattr(rep.engine, "release_slice", None)
+        if callable(release):
+            try:
+                release()
+            except Exception:  # pragma: no cover - planner release is leaf
+                logger.exception(
+                    "router: slice release failed for %s", rep.name
+                )
         self.prefix_registry.drop_replica(rep.name)
         with self._lock:
             if rep in self.replicas:
@@ -856,6 +872,7 @@ class EngineRouter:
         report = {
             "replica": rep.name,
             "died_mid_drain": died,
+            "slice_id": getattr(rep.engine, "slice_id", None),
             **wait,
             **migration,
         }
@@ -1253,9 +1270,39 @@ class EngineRouter:
                 "healthy": self._healthy(rep),
                 "dispatched": rep.dispatched,
                 "completed_ok": rep.completed_ok,
+                "slice_id": getattr(rep.engine, "slice_id", None),
             }
             for rep in reps
         ]
+        # slice capacity (sliced fleets): total/free slices next to the
+        # fleet size, so "at hardware limit" is readable off one surface
+        if self.mesh_planner is not None:
+            ps = self.mesh_planner.stats()
+            out["slices_total"] = ps["slices_total"]
+            out["slices_free"] = ps["slices_free"]
+            out["replica_devices"] = ps["replica_devices"]
+        return out
+
+    def slice_stats(self) -> dict:
+        """Fleet slice topology for /healthz (docs/MULTICHIP.md): the
+        planner's capacity snapshot plus each replica's slice identity and
+        per-slice HBM ledger (engines without the surface — stubs — are
+        skipped)."""
+        out: dict = {
+            "planner": (
+                self.mesh_planner.stats()
+                if self.mesh_planner is not None
+                else None
+            ),
+        }
+        per = []
+        for rep in list(self.replicas):
+            fn = getattr(rep.engine, "slice_stats", None)
+            if callable(fn):
+                s = fn()
+                s["name"] = rep.name
+                per.append(s)
+        out["replicas"] = per
         return out
 
     def latency_stats(self) -> dict:

@@ -412,6 +412,12 @@ def create_app(
                 # KV memory plane gauges: pool occupancy, shared-page
                 # fraction, allocator eviction/COW counters (docs/KV_PAGING.md)
                 g["kv"] = kv()
+            sl = getattr(eng, "slice_stats", None)
+            if callable(sl):
+                # mesh-sliced fleet (docs/MULTICHIP.md): slice identity +
+                # per-slice HBM ledger per replica; routers add the planner's
+                # total/free slice capacity (scale-up headroom)
+                g["slices"] = sl()
             dec = getattr(eng, "decode_path_stats", None)
             if callable(dec):
                 # decode fast-path gauges (docs/QUANT.md): fused-tick depth

@@ -1,0 +1,633 @@
+"""Mesh-sliced fleet (parallel/slicing.py + serving/registry.py;
+docs/MULTICHIP.md): each replica pinned to its OWN disjoint device slice —
+weights placed per-slice from one shared host copy, KV pool and compiled
+ticks living only on the slice, scale-up past the last free slice an honest
+``no_capacity`` rejection, and slice-pinned decode bit-identical to the
+global-mesh engine.
+
+Everything runs on the suite's forced 8-device CPU mesh (tests/conftest.py)
+with tiny random models; chaos is exact (armed fault schedules), no
+sleep-and-hope.
+"""
+
+import time
+
+import pytest
+
+import jax
+
+from django_assistant_bot_tpu.models import DecoderConfig, llama
+from django_assistant_bot_tpu.parallel import (
+    MeshPlanner,
+    NoCapacity,
+    best_mesh_shape,
+    make_mesh,
+    shard_pytree,
+)
+from django_assistant_bot_tpu.serving import (
+    AutoscalerConfig,
+    ByteTokenizer,
+    GenerationEngine,
+    ModelRegistry,
+    ModelSpec,
+    SLOAutoscaler,
+    parse_prometheus_text,
+    render_prometheus,
+)
+
+
+def _leaf_device_ids(tree) -> set:
+    out = set()
+    for leaf in jax.tree.leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            out |= {d.id for d in sharding.device_set}
+    return out
+
+
+# ------------------------------------------------------------------ planner
+def test_mesh_planner_partitions_disjoint_slices():
+    pl = MeshPlanner(2)
+    assert pl.n_slices == 4
+    seen = set()
+    for sl in pl.slices:
+        ids = set(sl.device_ids)
+        assert len(ids) == 2
+        assert not (ids & seen)  # disjoint
+        seen |= ids
+        # TP inside the slice: `model` spans the whole slice by default
+        assert dict(sl.mesh.shape)["model"] == 2
+    assert seen == {d.id for d in jax.devices()}
+
+    # acquire hands out the lowest free slice; exhausting raises NoCapacity
+    got = [pl.acquire() for _ in range(4)]
+    assert [s.slice_id for s in got] == [0, 1, 2, 3]
+    assert pl.free_slices() == 0
+    with pytest.raises(NoCapacity) as ei:
+        pl.acquire()
+    assert ei.value.slices_total == 4
+    assert ei.value.replica_devices == 2
+    # release is idempotent, and a freed slice is reused lowest-first
+    pl.release(got[1])
+    pl.release(got[1])
+    assert pl.free_slices() == 1
+    assert pl.acquire().slice_id == 1
+    stats = pl.stats()
+    assert stats["slices_total"] == 4 and stats["slices_free"] == 0
+    assert stats["slice_axes"]["model"] == 2
+
+
+def test_mesh_planner_validation_and_leftover_devices():
+    with pytest.raises(ValueError):
+        MeshPlanner(0)
+    with pytest.raises(ValueError):
+        MeshPlanner(16)  # more devices per replica than the host has
+    # a non-dividing knob leaves devices idle (warned) but still plans
+    pl = MeshPlanner(3)
+    assert pl.n_slices == 2
+    used = set()
+    for sl in pl.slices:
+        used |= set(sl.device_ids)
+    assert len(used) == 6  # 2 of 8 devices unused
+
+
+def test_registry_rejects_invalid_slicing_specs():
+    with pytest.raises(ValueError, match="decoder-only"):
+        ModelRegistry(
+            {
+                "e": ModelSpec(
+                    name="e", kind="encoder", tiny=True, replica_devices=2
+                )
+            }
+        )
+    with pytest.raises(ValueError, match="replica_devices must be >= 0"):
+        ModelRegistry(
+            {
+                "m": ModelSpec(
+                    name="m", kind="decoder", tiny=True, replica_devices=-1
+                )
+            }
+        )
+    # more initial replicas than the host has slices is a load-time error,
+    # not a surprise at first scale-up
+    with pytest.raises(ValueError, match="device slices"):
+        ModelRegistry(
+            {
+                "m": ModelSpec(
+                    name="m",
+                    kind="decoder",
+                    tiny=True,
+                    replicas=5,
+                    replica_devices=2,
+                )
+            }
+        )
+    with pytest.raises(ValueError, match="exceeds"):
+        ModelRegistry(
+            {
+                "m": ModelSpec(
+                    name="m",
+                    kind="decoder",
+                    tiny=True,
+                    replica_devices=9,
+                )
+            }
+        )
+
+
+# ------------------------------------------------------- placement + fleet
+def test_sliced_fleet_placement_capacity_and_slice_reuse():
+    """The tentpole acceptance walk on one registry: per-slice weight
+    placement from the shared host copy, disjoint slices, per-slice HBM
+    ledger, add_replica to the last slice, ``no_capacity`` past it (no
+    same-chip cache clone), and a detach releasing its slice for reuse."""
+    reg = ModelRegistry(
+        {
+            "m": ModelSpec(
+                name="m",
+                kind="decoder",
+                tiny=True,
+                replicas=2,
+                max_replicas=4,
+                replica_devices=2,
+                max_slots=2,
+                max_seq_len=64,
+                lookahead=0,
+                burst=1,
+            )
+        }
+    )
+    try:
+        r = reg.get_generator("m")
+        assert r.mesh_planner is not None
+        assert r.mesh_planner.n_slices == 4
+        # every replica's weights live ONLY on its own slice, slices disjoint
+        slice_ids = []
+        seen_devices: set = set()
+        for rep in r.replicas:
+            eng = rep.engine
+            ids = set(eng.slice_devices)
+            assert len(ids) == 2
+            assert _leaf_device_ids(eng.params) <= ids
+            assert _leaf_device_ids(eng._cache) <= ids
+            assert not (ids & seen_devices)
+            seen_devices |= ids
+            slice_ids.append(eng.slice_id)
+            sl = eng.slice_stats()
+            assert sl["sliced"] is True
+            assert sl["hbm_weight_bytes"] > 0
+            assert sl["hbm_kv_bytes"] > 0
+            assert sl["hbm_bytes"] == (
+                sl["hbm_weight_bytes"] + sl["hbm_kv_bytes"]
+            )
+        assert slice_ids == [0, 1]
+        # the fleet serves through the router surface unchanged
+        tok = r.tokenizer
+        futs = [
+            r.submit(tok.encode(f"slice {i}"), max_tokens=4, temperature=0.0)
+            for i in range(4)
+        ]
+        for f in futs:
+            assert len(f.result(timeout=120).token_ids) == 4
+        # per-slice ledgers are exclusive, so they SUM: fleet footprint ==
+        # sum of slices (each replica's weights + pool on its own chips)
+        per = [rep.engine.slice_stats()["hbm_bytes"] for rep in r.replicas]
+        fleet_bytes = sum(per)
+        assert fleet_bytes == pytest.approx(per[0] * len(per))
+        # scale to the last free slice
+        r.add_replica()
+        r.add_replica()
+        assert len(r.replicas) == 4
+        assert {rep.engine.slice_id for rep in r.replicas} == {0, 1, 2, 3}
+        assert r.mesh_planner.free_slices() == 0
+        # past the last slice: an honest rejection, fleet size held, and no
+        # replica ever lands on an already-pinned slice
+        with pytest.raises(NoCapacity):
+            r.add_replica()
+        assert len(r.replicas) == 4
+        rs = r.router_stats()
+        assert rs["slices_total"] == 4 and rs["slices_free"] == 0
+        assert {p["slice_id"] for p in rs["replicas"]} == {0, 1, 2, 3}
+        # detach releases the slice; the next scale-up reuses it
+        report = r.remove_replica(3, deadline_s=5.0)
+        assert report["slice_id"] == 3
+        assert r.mesh_planner.free_slices() == 1
+        name = r.add_replica()
+        assert name.endswith("r5")  # spawn indices never reuse names
+        assert r.replicas[-1].engine.slice_id == 3  # ... but slices recycle
+        # /metrics: per-replica slice gauges + fleet slice capacity
+        fams = parse_prometheus_text(render_prometheus(reg))
+        slice_bytes = fams["dabt_slice_hbm_bytes"]["samples"]
+        assert len(slice_bytes) == 4  # one per live replica
+        assert all(v > 0 for _, _, v in slice_bytes)
+        assert [v for _, _, v in fams["dabt_router_slices_total"]["samples"]] == [4.0]
+        assert [v for _, _, v in fams["dabt_router_slices_free"]["samples"]] == [0.0]
+        assert sorted(
+            v for _, _, v in fams["dabt_slice_id"]["samples"]
+        ) == [0.0, 1.0, 2.0, 3.0]
+        # fleet healthz surface: planner block + per-replica slice blocks
+        ss = r.slice_stats()
+        assert ss["planner"]["slices_total"] == 4
+        assert {b["slice_id"] for b in ss["replicas"]} == {0, 1, 2, 3}
+    finally:
+        reg.stop()
+
+
+def test_failed_replica_spawn_releases_its_slice(monkeypatch):
+    """A scale-up whose engine fails to warm/start must NOT leak its slice:
+    the half-built replica never joins the fleet (no detach epilogue), so
+    the factory itself returns the slice — otherwise every failed spawn
+    would shrink hardware capacity for the life of the process."""
+    reg = ModelRegistry(
+        {
+            "m": ModelSpec(
+                name="m",
+                kind="decoder",
+                tiny=True,
+                replicas=1,
+                max_replicas=4,
+                replica_devices=2,
+                max_slots=2,
+                max_seq_len=64,
+            )
+        }
+    )
+    try:
+        r = reg.get_generator("m")
+        assert r.mesh_planner.free_slices() == 3
+
+        def boom(self):
+            raise RuntimeError("spawn failed")
+
+        monkeypatch.setattr(GenerationEngine, "start", boom)
+        with pytest.raises(RuntimeError, match="spawn failed"):
+            r.add_replica()
+        assert len(r.replicas) == 1
+        assert r.mesh_planner.free_slices() == 3  # the slice came back
+        monkeypatch.undo()
+        r.add_replica()  # ... and is usable again
+        assert len(r.replicas) == 2
+        assert r.mesh_planner.free_slices() == 2
+    finally:
+        reg.stop()
+
+
+def test_slice_pinned_engine_bit_identical_to_global_mesh():
+    """Acceptance: greedy decode on a slice-pinned TP-2 engine is
+    bit-identical to the same weights served on the 8-device global mesh —
+    slicing changes placement, never output."""
+    cfg = DecoderConfig.tiny()
+    host = llama.init(cfg, jax.random.key(7))
+    tok = ByteTokenizer()
+    prompts = ["the quick brown fox", "hello world", "mesh sliced serving"]
+
+    def run(mesh, params):
+        eng = GenerationEngine(
+            cfg,
+            params,
+            tok,
+            max_slots=2,
+            max_seq_len=64,
+            lookahead=0,
+            burst=1,
+            prefix_cache_size=0,
+            mesh=mesh,
+        ).start()
+        try:
+            futs = [
+                eng.submit(tok.encode(p), max_tokens=8, temperature=0.0)
+                for p in prompts
+            ]
+            return [f.result(timeout=120).token_ids for f in futs]
+        finally:
+            eng.stop()
+
+    gmesh = make_mesh(best_mesh_shape(8, want_model=2))
+    with gmesh:
+        gparams = shard_pytree(host, llama.logical_axes(cfg), gmesh)
+    global_ids = run(gmesh, gparams)
+
+    sl = MeshPlanner(2).acquire()
+    with sl.mesh:
+        sparams = shard_pytree(host, llama.logical_axes(cfg), sl.mesh)
+    slice_ids = run(sl.mesh, sparams)
+    assert slice_ids == global_ids
+
+
+# ----------------------------------------------------------------- chaos
+def _stall(engine, delay_s=0.1, fires=16):
+    """Arm slow_tick so the engine's loop holds work in flight (requests
+    stay client-token-less — the re-route eligibility window)."""
+    inj = engine._faults
+    assert inj is not None
+    inj.arm("slow_tick", fires)
+    with inj._lock:
+        inj._sites["slow_tick"].delay_s = delay_s
+
+
+def test_replica_death_on_sliced_fleet_reroutes_to_other_slice():
+    """Chaos acceptance: a replica dies mid-trace on a 4-slice fleet — the
+    re-route lands on a DIFFERENT slice, goodput is 1.0, and the restarted
+    replica rebuilds only its own slice's pool (other slices' warm KV,
+    registered prefixes included, is untouched)."""
+    reg = ModelRegistry(
+        {
+            "m": ModelSpec(
+                name="m",
+                kind="decoder",
+                tiny=True,
+                replicas=4,
+                replica_devices=1,
+                max_slots=2,
+                max_seq_len=64,
+                prefix_min_tokens=8,
+                # probability-0 site: never fires on its own, but gives every
+                # replica an injector the test can arm (from_spec({}) is None)
+                faults={"slow_tick": 0.0},
+                router_breaker_threshold=2,
+            )
+        }
+    )
+    try:
+        r = reg.get_generator("m")
+        assert len(r.replicas) == 4
+        assert len({rep.engine.slice_id for rep in r.replicas}) == 4
+        # warm a DISTINCT prefix into each survivor's pool by pinning
+        # dispatch (drain flags route around the others, like the affinity
+        # suite does)
+        prefixes = {}
+        for i in range(1, 4):
+            for j, rep in enumerate(r.replicas):
+                rep.draining = j != i
+            pfx = list(range(10 * i, 10 * i + 12))  # 12 >= prefix_min_tokens
+            r.submit(
+                pfx + [99], max_tokens=2, temperature=0.0, prefix_len=12
+            ).result(timeout=120)
+            prefixes[i] = pfx
+            assert r.replicas[i].engine.holds_prefix(pfx + [1], 12)
+        for rep in r.replicas:
+            rep.draining = False
+        # warm replica 0 too (compile out of the way), then kill it with
+        # token-less work in flight
+        for rep in r.replicas[1:]:
+            rep.draining = True
+        r.submit([1, 2, 3], max_tokens=2, temperature=0.0).result(timeout=120)
+        for rep in r.replicas:
+            rep.draining = False
+        for rep in r.replicas:
+            _stall(rep.engine)
+        futs = [
+            r.submit([5, 6, 7 + i], max_tokens=6, temperature=0.0)
+            for i in range(8)
+        ]
+        time.sleep(0.05)  # inside the stalled first ticks: no host tokens
+        dead_slice = r.replicas[0].engine.slice_id
+        r.kill_replica(0)
+        for f in futs:
+            assert len(f.result(timeout=120).token_ids) == 6  # goodput 1.0
+        assert r.reroutes > 0
+        assert r.rerouted_failed == 0
+        assert r.failed_past_first_token == 0
+        # every survivor that finished work sits on a DIFFERENT slice
+        for rep in r.replicas[1:]:
+            assert rep.engine.slice_id != dead_slice
+        # restart rebuilds ONLY the dead replica's pool: the survivors'
+        # registered prefixes (their slices' warm KV) are untouched
+        r.restart_replica(0)
+        assert r.replicas[0].engine.slice_id == dead_slice  # slice kept
+        for i in range(1, 4):
+            assert r.replicas[i].engine.holds_prefix(prefixes[i] + [1], 12)
+        assert (
+            len(
+                r.submit([9, 9, 9], max_tokens=3, temperature=0.0)
+                .result(timeout=120)
+                .token_ids
+            )
+            == 3
+        )
+        assert r.supervision_stats()["healthy"] is True
+    finally:
+        reg.stop()
+
+
+# ------------------------------------------------------------- autoscaler
+# minimal controller-facing fleet stub (the test_autoscaler discipline:
+# exactly the read/actuate surface the controller touches, nothing more)
+class _StubSched:
+    def __init__(self):
+        self.degrade_clamp = None
+
+    def stats(self):
+        return {"shed": {}, "est_wait_s": 0.0}
+
+    def set_degrade(self, clamp):
+        self.degrade_clamp = clamp
+
+
+class _StubEngine:
+    def __init__(self):
+        self.scheduler = _StubSched()
+        self.max_slots = 4
+        self.active = 0
+
+    def queued_depth(self):
+        return 0
+
+    @property
+    def num_active(self):
+        return self.active
+
+
+class _StubRep:
+    def __init__(self):
+        self.engine = _StubEngine()
+        self.draining = False
+
+
+class _StubFleet:
+    def __init__(self, n=1):
+        self.replicas = [_StubRep() for _ in range(n)]
+        self.ttft_p95_s = 0.0
+        self.added = 0
+
+    def latency_stats(self):
+        return {"ttft_p95_ms": self.ttft_p95_s * 1e3, "ttft_n": 64}
+
+    def kv_stats(self):
+        return {"kv_pages_total": 100, "kv_pages_used": 0}
+
+    def add_replica(self):
+        self.replicas.append(_StubRep())
+        self.added += 1
+        return f"stub/r{len(self.replicas) - 1}"
+
+    def remove_replica(self, idx, *, deadline_s=30.0):
+        self.replicas.pop(idx)
+        return {"replica": "stub", "drained": True, "forced_failures": 0,
+                "died_mid_drain": False, "waited_s": 0.0}
+
+
+class _NoCapacityFleet(_StubFleet):
+    """A router whose device slices are exhausted: add_replica raises
+    NoCapacity until ``no_capacity`` is cleared (a slice freed)."""
+
+    def __init__(self, n=1):
+        super().__init__(n)
+        self.no_capacity = True
+
+    def add_replica(self):
+        if self.no_capacity:
+            raise NoCapacity(
+                "all 4 device slice(s) of 2 device(s) are pinned",
+                slices_total=4,
+                replica_devices=2,
+            )
+        return super().add_replica()
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_autoscaler_no_capacity_reason_distinct_from_cooldown_and_bounds():
+    """Satellite: a scale-up skipped because the slices are exhausted is
+    recorded as ``no_capacity`` — distinct from cooldown (flap damping) and
+    bounds (the configured ceiling) — so operators can tell "at hardware
+    limit" from "flap-damped"."""
+    clock = _Clock()
+    fleet = _NoCapacityFleet(1)
+    asc = SLOAutoscaler(
+        fleet,
+        AutoscalerConfig(
+            min_replicas=1,
+            max_replicas=3,
+            up_consecutive=2,
+            up_cooldown_s=5.0,
+        ),
+        clock=clock,
+    )
+    fleet.replicas[0].engine.active = 1
+    fleet.ttft_p95_s = 1.2  # over the SLO, below degrade_burn
+    recs = []
+    for _ in range(2):
+        clock.advance(1.0)
+        recs.append(asc.tick())
+    # the refused spawn is not an actuation: the SAME tick falls through to
+    # degradation (shaping load is the only actuator left at the hardware
+    # limit, whatever the burn level — exactly the max_replicas behavior)
+    assert recs[-1]["decision"] == "no_capacity+degrade_on"
+    assert asc.degrade_active is True
+    st = asc.stats()
+    assert st["scale_up_skipped"]["no_capacity"] == 1
+    assert st["last_skip_reason"] == "no_capacity"
+    assert st["at_hardware_limit"] is True
+    assert st["scale_up_failures"] == 0  # hardware limit is not a fault
+    assert st["replicas"] == 1  # fleet size held
+    # the flight ring carries the named event
+    events = [e["event"] for e in asc.flight.events()]
+    assert "scale_up_no_capacity" in events
+    # while the limit is sticky, held-back ticks keep attributing to
+    # no_capacity (the cooldown is incidental on a saturated host — calling
+    # it "cooldown" would read as flap damping); the band stays armed (a
+    # refusal never resets hysteresis)
+    for _ in range(2):
+        clock.advance(1.0)
+        asc.tick()
+    st = asc.stats()
+    assert st["scale_up_skipped"]["no_capacity"] >= 2
+    assert st["scale_up_skipped"]["cooldown"] == 0
+    assert st["last_skip_reason"] == "no_capacity"
+    # the limit transition rides the flight ring ONCE (repeat refusals are
+    # counter evidence, not ring spam)
+    events = [e["event"] for e in asc.flight.events()]
+    assert events.count("scale_up_no_capacity") == 1
+    # capacity frees (a slice released): the sticky flag clears on the next
+    # successful scale event
+    fleet.no_capacity = False
+    fleet.ttft_p95_s = 1.2
+    for _ in range(8):
+        clock.advance(2.0)
+        if asc.tick()["decision"] == "scale_up":
+            break
+    assert asc.stats()["at_hardware_limit"] is False
+    assert asc.stats()["last_skip_reason"] is None
+    # with capacity back, a held-back scale-up is honestly "cooldown" again
+    for _ in range(3):
+        clock.advance(1.0)
+        asc.tick()
+    assert asc.stats()["scale_up_skipped"]["cooldown"] >= 1
+    assert asc.stats()["last_skip_reason"] == "cooldown"
+
+    # bounds: a fleet AT max_replicas records "bounds", never "no_capacity"
+    clock2 = _Clock()
+    fleet2 = _StubFleet(3)
+    asc2 = SLOAutoscaler(
+        fleet2,
+        AutoscalerConfig(min_replicas=1, max_replicas=3, up_consecutive=2),
+        clock=clock2,
+    )
+    fleet2.replicas[0].engine.active = 1
+    fleet2.ttft_p95_s = 1.2
+    for _ in range(3):
+        clock2.advance(1.0)
+        asc2.tick()
+    st2 = asc2.stats()
+    assert st2["scale_up_skipped"]["bounds"] >= 1
+    assert st2["scale_up_skipped"]["no_capacity"] == 0
+    assert st2["last_skip_reason"] == "bounds"
+
+    # the skip ledger is scrapeable next to the scale counters
+    class _Reg:
+        generators: dict = {}
+        embedders: dict = {}
+        autoscalers = {"m": asc}
+
+    fams = parse_prometheus_text(render_prometheus(_Reg()))
+    skipped = fams["dabt_autoscale_scale_up_skipped_total"]["samples"]
+    nc = [
+        v for _, labels, v in skipped if labels.get("reason") == "no_capacity"
+    ]
+    assert len(nc) == 1 and nc[0] >= 2.0
+    assert [
+        v for _, _, v in fams["dabt_autoscale_at_hardware_limit"]["samples"]
+    ] == [0.0]
+
+
+# --------------------------------------------------------------- autotune
+def test_autotune_budget_is_slice_aware():
+    """Satellite: --autotune's HBM budget covers ONE replica's devices — its
+    slice on a sliced fleet — not the whole host, so the recommendation
+    matches what a sliced replica can actually hold."""
+    from django_assistant_bot_tpu.serving.autotune import recommend_for_spec
+
+    cfg = DecoderConfig.tiny()
+    sliced = ModelSpec(
+        name="s", kind="decoder", tiny=True, replica_devices=2
+    )
+    out = recommend_for_spec(
+        sliced, cfg, n_host_devices=8, hbm_gb_per_device=4.0
+    )
+    assert out["sliced"] is True
+    assert out["slice_devices"] == 2
+    assert out["assumptions"]["hbm_budget_gb"] == pytest.approx(8.0)
+    # unsliced: the replica's mesh IS the whole host
+    flat = ModelSpec(name="f", kind="decoder", tiny=True)
+    out = recommend_for_spec(flat, cfg, n_host_devices=8, hbm_gb_per_device=4.0)
+    assert out["sliced"] is False
+    assert out["slice_devices"] == 8
+    assert out["assumptions"]["hbm_budget_gb"] == pytest.approx(32.0)
+    # no topology hints at all: the historical single-chip default
+    out = recommend_for_spec(flat, cfg)
+    assert out["assumptions"]["hbm_budget_gb"] == pytest.approx(16.0)
+    # an explicit total budget override always wins
+    out = recommend_for_spec(
+        sliced, cfg, n_host_devices=8, hbm_gb_per_device=4.0, hbm_budget_gb=1.0
+    )
+    assert out["assumptions"]["hbm_budget_gb"] == pytest.approx(1.0)
