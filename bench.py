@@ -207,6 +207,11 @@ def _build_gen_engine(
     scheduler=None,
     obs=True,
     decode_steps=None,
+    chunk_size=None,
+    prefill_piggyback=True,
+    attn_fp8=False,
+    spec_width=4,
+    spec_probe_every=64,
 ):
     max_slots = max_slots or SLOTS
     import jax
@@ -243,14 +248,18 @@ def _build_gen_engine(
         max_slots=max_slots,  # default 16 = bench concurrency: one decode wave
         max_seq_len=min(1024, cfg.max_seq_len),
         prefill_buckets=buckets,
-        chunk_size=buckets[-1],
+        chunk_size=chunk_size or buckets[-1],
         mesh=mesh,
         prefix_cache_size=prefix_cache,
         kv_cache_dtype=kv_dtype,
         speculative=speculative,
+        spec_width=spec_width,
+        spec_probe_every=spec_probe_every,
         scheduler=scheduler,
         obs=obs,
         decode_steps=decode_steps,
+        prefill_piggyback=prefill_piggyback,
+        attn_fp8=attn_fp8,
     )
     # compile every (batch, seq) prefill shape BEFORE measuring; the decode-only
     # engines are built with just the bucket their prompts hit (same bucket the
@@ -1363,6 +1372,285 @@ def bench_fused_int4(trials: int = 3) -> dict:
     return out
 
 
+def bench_contbatch(trials: int = 2) -> dict:
+    """contbatch_* section (round 15, docs/QUANT.md + docs/SPECULATIVE.md):
+    true continuous batching — three decode-plane levers, each behind its own
+    ModelSpec knob, each measured as its own arm.
+
+    (a) **Piggybacked chunked prefill** — decode p95 inter-token latency on
+      resident chat streams while long-context prompts chunk-prefill through
+      the same engine, piggyback ON vs OFF on the SAME greedy trace
+      (interleaved trials, best arm each).  OFF runs every prefill chunk as
+      its own dispatch that displaces the decode tick; ON folds chunk + N
+      decode steps into ONE program, so the weights stream from HBM once per
+      loop iteration instead of twice.  Outputs must be token-identical (the
+      piggyback program is bit-identical by construction —
+      tests/test_contbatch.py) and the displacement gauge records exactly
+      what the fusion removed.
+
+    (b) **Spec x fused** — single-stream greedy tok/s on the trained copy
+      task (the spec section's methodology: acceptance is a property of a
+      model that CAN quote): fused-only (decode_steps=N), spec-only
+      (speculative=K, one verify pass per tick), and the composed
+      spec x fused engine, interleaved.  The composition's claim is
+      >= the better parent.
+
+    (c) **fp8 in-dot attention** — pure decode step time at fp8 KV with the
+      attention QK dot reading the cache operand as stored vs dequantizing
+      to the compute dtype first, plus the ops-level max attention-output
+      error vs the dequant reference (tests/test_contbatch.py bounds it at
+      0.15; the number here is the measured value, not the bound).
+
+    Every throughput arm carries its byte ledger (MFU frac + achieved HBM
+    GB/s) — same discipline as bench_fused_int4.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+
+    from django_assistant_bot_tpu.ops.attention import chunked_gqa_decode_attention
+    from django_assistant_bot_tpu.ops.quant import num_weights
+    from django_assistant_bot_tpu.serving import (
+        ByteTokenizer,
+        GenerationEngine,
+        TokenStream,
+    )
+    from django_assistant_bot_tpu.training import copy_task_config, fit_copy_model
+
+    out: dict = {}
+    fill = DECODE_PROMPT_LEN + DECODE_NEW_TOKENS
+    msl = min(1024, _decoder_cfg().max_seq_len)
+    chunk = max(32, msl // 8)
+    long_len = chunk * 3 + chunk // 2  # 3 piggybackable chunks + the final one
+    n_chat, n_long = 4, 6
+    n_new = min(96, msl - 24)
+    rng = np.random.default_rng(15)
+    chat_prompts = [rng.integers(1, 255, 16).tolist() for _ in range(n_chat)]
+    long_prompts = [
+        rng.integers(1, 255, long_len).tolist() for _ in range(n_long)
+    ]
+
+    # ---- (a) piggyback A/B: chat ITL under chunked-prefill pressure
+    engines: dict = {}
+    try:
+        for arm, pig in (("on", True), ("off", False)):
+            engines[arm], _ = _build_gen_engine(
+                buckets=(chunk,),
+                chunk_size=chunk,
+                max_slots=8,
+                prefill_piggyback=pig,
+            )
+
+        async def trace(eng):
+            loop = asyncio.get_running_loop()
+            streams = [
+                TokenStream().bind(loop, capacity=n_new + 2)
+                for _ in chat_prompts
+            ]
+
+            async def drain(st):
+                times = []
+                async for kind, _payload in st:
+                    if kind == "token":
+                        times.append(time.perf_counter())
+                return times
+
+            futs = [
+                eng.submit(p, max_tokens=n_new, temperature=0.0, stream=st)
+                for p, st in zip(chat_prompts, streams)
+            ]
+            drains = [asyncio.ensure_future(drain(st)) for st in streams]
+            # the long-context pressure arrives while the chat slots decode:
+            # each prompt chunk-prefills through the SAME engine loop
+            futs += [
+                eng.submit(p, max_tokens=8, temperature=0.0)
+                for p in long_prompts
+            ]
+            results = [await asyncio.wrap_future(f) for f in futs]
+            times = await asyncio.gather(*drains)
+            gaps = [b - a for ts in times for a, b in zip(ts, ts[1:])]
+            return gaps, [r.token_ids for r in results]
+
+        def p95(gaps):
+            return sorted(gaps)[max(0, int(len(gaps) * 0.95) - 1)]
+
+        itl = {"on": [], "off": []}
+        ids_first: dict = {}
+        for t in range(trials):
+            for arm in ("on", "off"):  # interleaved: on off on off
+                gaps, ids = asyncio.run(trace(engines[arm]))
+                itl[arm].append(p95(gaps) * 1e3)
+                if t == 0:
+                    ids_first[arm] = ids
+        out["contbatch_itl_p95_on_ms"] = round(min(itl["on"]), 3)
+        out["contbatch_itl_p95_off_ms"] = round(min(itl["off"]), 3)
+        out["contbatch_itl_improvement_frac"] = round(
+            1.0
+            - out["contbatch_itl_p95_on_ms"]
+            / max(out["contbatch_itl_p95_off_ms"], 1e-9),
+            4,
+        )
+        out["contbatch_outputs_identical"] = ids_first["on"] == ids_first["off"]
+        out["contbatch_chunk"] = chunk
+        out["contbatch_long_prompt_len"] = long_len
+        for arm in ("on", "off"):
+            dec = engines[arm].decode_path_stats()
+            out[f"contbatch_displacement_frac_{arm}"] = dec[
+                "prefill_displacement_frac"
+            ]
+            out[f"contbatch_chunks_piggybacked_{arm}"] = dec[
+                "prefill_chunks_piggybacked"
+            ]
+        # byte ledger on the shared pure-decode step (the decode program is
+        # identical across arms — piggybacking changes dispatch count, not
+        # the step), so the ITL claim above carries its bytes
+        step_s = engines["on"].probe_decode(iters=8, fill_len=fill)
+        ledger = decode_byte_ledger(engines["on"], fill_len=fill)
+        n_w = num_weights(engines["on"].params)
+        steady = engines["on"].max_slots / step_s
+        out["contbatch_step_ms"] = round(step_s * 1e3, 3)
+        out["contbatch_mfu_frac"] = round(steady * 2 * n_w / 197e12, 6)
+        out["contbatch_hbm_gbps"] = round(
+            ledger["total_gb_per_step"] / step_s, 2
+        )
+    finally:
+        for eng in engines.values():
+            eng.stop()
+
+    # ---- (b) spec x fused vs its two parents, single stream, trained quoter
+    ccfg = copy_task_config(hidden_size=128)
+    cparams, ccfg, fit = fit_copy_model(ccfg, seq_len=128, batch=16, seed=0)
+    crng = np.random.default_rng(1)
+    M = 64  # trained copy span
+    ctx = crng.integers(3, ccfg.vocab_size, M).tolist()
+    prompt = ctx + ctx[:8]
+    mt = M - 8
+    n_steps = 4
+
+    def spec_engine(**kw):
+        eng = GenerationEngine(
+            ccfg,
+            cparams,
+            ByteTokenizer(),
+            max_slots=2,
+            max_seq_len=ccfg.max_seq_len,
+            prefill_buckets=(128,),
+            prefix_cache_size=0,
+            lookahead=3,
+            **kw,
+        )
+        eng.warmup()
+        eng.start()
+        return eng
+
+    sengines: dict = {}
+    try:
+        sengines["fused"] = spec_engine(decode_steps=n_steps)
+        sengines["spec"] = spec_engine(
+            speculative=6, spec_width=4, spec_probe_every=4
+        )
+        sengines["specfused"] = spec_engine(
+            speculative=6, spec_width=4, spec_probe_every=4,
+            decode_steps=n_steps,
+        )
+        for eng in sengines.values():  # warm every program shape
+            eng.submit(prompt, max_tokens=mt, temperature=0.0).result(
+                timeout=600
+            )
+        rates: dict = {a: [] for a in sengines}
+        ids = {}
+        for _ in range(trials):
+            for arm, eng in sengines.items():  # interleaved F S X F S X
+                t0 = time.perf_counter()
+                tot = 0
+                for _ in range(3):  # single stream
+                    r = eng.submit(
+                        prompt, max_tokens=mt, temperature=0.0
+                    ).result(timeout=600)
+                    tot += r.completion_tokens
+                    ids[arm] = r.token_ids
+                rates[arm].append(tot / (time.perf_counter() - t0))
+        f_tok = max(rates["fused"])
+        s_tok = max(rates["spec"])
+        x_tok = max(rates["specfused"])
+        st = sengines["specfused"].tick_stats()
+        out["fusedonly_tokens_per_s"] = round(f_tok, 2)
+        out["speconly_tokens_per_s"] = round(s_tok, 2)
+        out["specfused_tokens_per_s"] = round(x_tok, 2)
+        out["specfused_vs_fused_speedup"] = round(x_tok / max(f_tok, 1e-9), 3)
+        out["specfused_vs_spec_speedup"] = round(x_tok / max(s_tok, 1e-9), 3)
+        out["specfused_vs_best_parent_speedup"] = round(
+            x_tok / max(f_tok, s_tok, 1e-9), 3
+        )
+        out["specfused_accept_rate"] = st.get("spec_accept_rate", 0.0)
+        out["specfused_drafted"] = st.get("spec_drafted", 0)
+        out["specfused_decode_steps"] = n_steps
+        out["specfused_quote_accuracy"] = round(fit["quote_accuracy"], 4)
+        out["specfused_outputs_identical"] = (
+            ids["fused"] == ids["spec"] == ids["specfused"]
+        )
+    finally:
+        for eng in sengines.values():
+            eng.stop()
+
+    # ---- (c) fp8 in-dot attention A/B at fp8 KV, interleaved probes
+    fengines: dict = {}
+    try:
+        for arm, indot in (("attn_fp8_dequant", False), ("attn_fp8", True)):
+            fengines[arm], _ = _build_gen_engine(
+                buckets=(_decode_bucket(),),
+                kv_dtype="fp8",
+                attn_fp8=indot,
+                max_slots=8,
+            )
+        samples: dict = {a: [] for a in fengines}
+        for _ in range(trials + 1):
+            for arm, eng in fengines.items():  # interleaved D I D I ...
+                samples[arm].append(eng.probe_decode(iters=8, fill_len=fill))
+        for arm, eng in fengines.items():
+            med = statistics.median(samples[arm])
+            steady = eng.max_slots / med
+            ledger = decode_byte_ledger(eng, fill_len=fill)
+            n_w = num_weights(eng.params)
+            out[f"{arm}_step_ms"] = round(med * 1e3, 3)
+            out[f"{arm}_steady_tokens_per_s"] = round(steady, 2)
+            out[f"{arm}_mfu_frac"] = round(steady * 2 * n_w / 197e12, 6)
+            out[f"{arm}_hbm_gbps"] = round(
+                ledger["total_gb_per_step"] / med, 2
+            )
+        out["attn_fp8_step_speedup"] = round(
+            out["attn_fp8_dequant_step_ms"]
+            / max(out["attn_fp8_step_ms"], 1e-9),
+            3,
+        )
+    finally:
+        for eng in fengines.values():
+            eng.stop()
+    # ops-level accuracy number at tiny geometry (cheap at any bench scale,
+    # the bench_fused_int4 quantizer-error methodology): in-dot vs the
+    # dequant reference on unit-scale operands
+    erng = np.random.default_rng(0)
+    q = jnp.asarray(erng.standard_normal((2, 4, 1, 16)), jnp.bfloat16)
+    k8 = jnp.asarray(
+        erng.standard_normal((2, 2, 64, 16)) * 0.5, jnp.float32
+    ).astype(jnp.float8_e4m3fn)
+    v8 = jnp.asarray(
+        erng.standard_normal((2, 2, 64, 16)) * 0.5, jnp.float32
+    ).astype(jnp.float8_e4m3fn)
+    positions = jnp.asarray([63, 21], jnp.int32)
+    ref = chunked_gqa_decode_attention(q, k8, v8, positions, chunk=16)
+    got = chunked_gqa_decode_attention(
+        q, k8, v8, positions, chunk=16, fp8_dot=True
+    )
+    out["attn_fp8_indot_max_abs_err"] = round(
+        float(
+            jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32)))
+        ),
+        5,
+    )
+    out["attn_fp8_indot_err_bound"] = 0.15  # tests/test_contbatch.py contract
+    return out
+
+
 def bench_paged() -> dict:
     """paged_* section (docs/KV_PAGING.md): the paged KV plane's two claims.
 
@@ -1540,6 +1828,13 @@ import json
 import bench
 
 print(json.dumps(bench.bench_paged()))
+"""
+
+_CONTBATCH_SNIPPET = """
+import json
+import bench
+
+print(json.dumps(bench.bench_contbatch()))
 """
 
 
@@ -3509,6 +3804,25 @@ _COMPACT_KEYS = (
     "int4_logit_err_rel",
     "int8_logit_err_rel",
     "fused_upload_overlap_frac",
+    "contbatch_itl_p95_on_ms",
+    "contbatch_itl_p95_off_ms",
+    "contbatch_itl_improvement_frac",
+    "contbatch_outputs_identical",
+    "contbatch_displacement_frac_off",
+    "contbatch_displacement_frac_on",
+    "contbatch_chunks_piggybacked_on",
+    "specfused_tokens_per_s",
+    "specfused_vs_best_parent_speedup",
+    "specfused_vs_fused_speedup",
+    "specfused_vs_spec_speedup",
+    "specfused_accept_rate",
+    "attn_fp8_step_ms",
+    "attn_fp8_step_speedup",
+    "attn_fp8_indot_max_abs_err",
+    "contbatch_mfu_frac",
+    "contbatch_hbm_gbps",
+    "attn_fp8_mfu_frac",
+    "attn_fp8_hbm_gbps",
     "decode_int8_slots_b_steady_tokens_per_s",
     "decode_int8_slots_b",
     "slots_ab_winner",
@@ -3673,6 +3987,7 @@ def main() -> None:
         extras.update(bench_int8())
         extras.update(bench_fused_int4())
         extras.update(bench_paged())
+        extras.update(bench_contbatch())
         extras.update(bench_longctx_decode(slots=4))
         moe_eng, _ = _build_gen_engine(_moe_cfg(), buckets=(_decode_bucket(),))
         try:
@@ -3737,6 +4052,10 @@ def main() -> None:
     # 3a') paged KV plane: slots-at-fixed-HBM A/B (legacy vs paged on the
     #      same byte ledger) + prefix-hit TTFT vs the r4 prefix cache
     run("paged", _PAGED_SNIPPET, cap_s=600)
+    # 3a'') continuous batching: piggybacked-chunked-prefill ITL A/B,
+    #       spec x fused vs both parents, fp8 in-dot attention step + error
+    #       (serving/engine.py round-15 evidence, tests/test_contbatch.py)
+    run("contbatch", _CONTBATCH_SNIPPET, cap_s=600)
     # 3b) long-context DECODE: 16k-allocated cache at 8 slots, bucketed KV
     #     read vs full-cache read (the tentpole's canonical evidence)
     run("longctx_decode", _LONGCTX_DECODE_SNIPPET, cap_s=700)

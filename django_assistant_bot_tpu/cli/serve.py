@@ -4,6 +4,66 @@
 from __future__ import annotations
 
 
+def _probe_engine_factory(spec, cfg):
+    """One weight load for ``serve --autotune --measure``; the returned
+    closure builds a throwaway probe engine per ledger candidate dict
+    ({kv_page_size, max_slots, decode_steps}) sharing those weights.
+    Probe engines carry the spec's quantize/KV/speculative knobs so the
+    measured step cost is the cost of the program the operator would run."""
+    import jax
+
+    from ..models import llama
+    from ..serving.engine import GenerationEngine
+    from ..serving.tokenizer import load_tokenizer
+
+    if spec.checkpoint:
+        from ..checkpoint import load_model
+
+        kind, cfg, params, meta = load_model(spec.checkpoint)
+        if kind != "decoder":
+            raise ValueError(f"{spec.name}: checkpoint is a {kind}")
+        tok = load_tokenizer(spec.path or meta.get("tokenizer"))
+    elif spec.path:
+        from ..models.hf_loader import load_decoder
+
+        cfg, params = load_decoder(spec.path)
+        tok = load_tokenizer(spec.path)
+    else:  # tiny (validated by the caller's config resolution)
+        params = llama.init(cfg, jax.random.key(0))
+        tok = load_tokenizer(None)
+    if spec.quantize in ("int8", "int4"):
+        from ..ops.quant import quantize_decoder_params, weight_bits
+
+        if weight_bits(params) == 16:
+            params = quantize_decoder_params(
+                params, fmt=spec.quantize, group_size=spec.quant_group_size
+            )
+
+    def factory(cand):
+        return GenerationEngine(
+            cfg,
+            params,
+            tok,
+            max_slots=int(cand["max_slots"]),
+            max_seq_len=spec.max_seq_len,
+            chunk_size=spec.chunk_size,
+            decode_steps=int(cand["decode_steps"]),
+            kv_cache_dtype=spec.kv_cache_dtype,
+            speculative=spec.speculative,
+            spec_width=spec.spec_width,
+            prefill_piggyback=spec.prefill_piggyback,
+            attn_fp8=spec.attn_fp8,
+            kv_layout=spec.kv_layout,
+            kv_page_size=int(cand["kv_page_size"]),
+            prefix_cache_size=0,
+            scheduler=None,
+            obs=False,
+            name=f"{spec.name}/probe",
+        )
+
+    return factory
+
+
 def add_parser(sub):
     p = sub.add_parser("serve", help="run the TPU model server")
     p.add_argument("--config", help="TOML/JSON model config file", default=None)
@@ -48,6 +108,23 @@ def add_parser(sub):
         metavar="GBPS",
         help="assumed achieved HBM bandwidth for --autotune (default 819; "
         "feed the bench's measured decode_hbm_gbps for a calibrated sweep)",
+    )
+    p.add_argument(
+        "--measure",
+        action="store_true",
+        help="with --autotune: load weights once per decoder, compile and "
+        "micro-probe the top-k ledger-ranked candidates on the live device "
+        "(probe_decode: idle-locked burst ticks, seconds/step) and re-rank "
+        "by measured step time.  The report keeps both rankings so "
+        "ledger-vs-measured disagreement is a visible artifact",
+    )
+    p.add_argument(
+        "--measure-top-k",
+        type=int,
+        default=3,
+        metavar="K",
+        help="how many ledger-ranked candidates --measure probes (default 3; "
+        "each costs one engine construction + tick compile)",
     )
     p.add_argument(
         "--replicas",
@@ -434,17 +511,32 @@ def run(args) -> int:
             except Exception as e:  # noqa: BLE001 - planning mode reports
                 results.append({"model": name, "error": str(e)})
                 continue
-            results.append(
-                recommend_for_spec(
-                    spec,
-                    cfg,
-                    n_host_devices=(
-                        None if spec.replica_devices else _n_host_devices()
-                    ),
-                    hbm_gb_per_device=getattr(args, "autotune_hbm_gb", None),
-                    **model_overrides,
-                )
+            rep = recommend_for_spec(
+                spec,
+                cfg,
+                n_host_devices=(
+                    None if spec.replica_devices else _n_host_devices()
+                ),
+                hbm_gb_per_device=getattr(args, "autotune_hbm_gb", None),
+                **model_overrides,
             )
+            if getattr(args, "measure", False) and rep.get("top"):
+                # measured-cost re-rank: ONE weight load for this decoder,
+                # then an engine construction + probe per candidate.  The
+                # probe is idle-locked by construction (fresh engine, no
+                # traffic) — compile cost is the price of ground truth.
+                from ..serving.autotune import measure_report
+
+                try:
+                    factory = _probe_engine_factory(spec, cfg)
+                    measure_report(
+                        rep,
+                        factory,
+                        top_k=max(1, int(getattr(args, "measure_top_k", 3))),
+                    )
+                except Exception as e:  # noqa: BLE001 - planning mode
+                    rep["measure_error"] = f"{type(e).__name__}: {e}"
+            results.append(rep)
         print(_json.dumps({"autotune": results}, indent=2))
         return 0
 

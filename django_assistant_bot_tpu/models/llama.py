@@ -1235,6 +1235,7 @@ def decode_step_paged(
     block_tables: jnp.ndarray,  # [B, NB] int32
     *,
     active: Optional[jnp.ndarray] = None,  # [B] bool; inactive slots are frozen
+    attn_fp8: bool = False,  # static: fp8 in-dot attention (requires fp8 pool)
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Paged :func:`decode_step`: one autoregressive step for every active
     slot against the page pool -> (logits [B,V] f32, cache).
@@ -1293,7 +1294,7 @@ def decode_step_paged(
             )
             o = paged_gqa_decode_attention(
                 q, k_pool, v_pool, block_tables, positions,
-                active=active, window=window,
+                active=active, window=window, fp8_dot=attn_fp8,
             )  # [B,H,1,D]
             o = o.transpose(0, 2, 1, 3).reshape(B, 1, -1)
             x = x + qeinsum("bso,oe->bse", o, p["wo"], cfg.dtype)
@@ -1592,6 +1593,7 @@ def decode_step(
     *,
     active: Optional[jnp.ndarray] = None,  # [B] bool; inactive slots are frozen
     kv_chunk: Optional[int] = None,  # static: chunked length-aware KV read
+    attn_fp8: bool = False,  # static: fp8 in-dot attention (needs kv_chunk + fp8 cache)
 ) -> tuple[jnp.ndarray, KVCache]:
     """One autoregressive step for every active slot -> (logits [B,V] f32, cache).
 
@@ -1601,6 +1603,10 @@ def decode_step(
     whole allocated ``max_len`` every step — the decode-side analog of the
     prefill flash kernel's chunked-KV discipline.  Must divide ``max_len``;
     ``None`` (or a chunk >= ``max_len``) keeps the full-cache read.
+
+    ``attn_fp8`` (static) keeps the fp8 cache operand at storage width
+    through the attention dots (docs/QUANT.md "fp8 in-dot").  Only the
+    chunked read implements the in-dot scheme, so it requires ``kv_chunk``.
     """
     B = tokens.shape[0]
     if active is None:
@@ -1622,6 +1628,11 @@ def decode_step(
             "(or be None / >= max_len for the full-cache read)"
         )
     chunked = kv_chunk is not None and kv_chunk < S
+    if attn_fp8 and not chunked:
+        raise ValueError(
+            "attn_fp8 requires the chunked KV read (set decode_kv_chunk) — "
+            "the full-cache gqa path has no in-dot fp8 scheme"
+        )
     kpos = jnp.arange(S)[None, :]
     causal_keep = (kpos <= positions[:, None])[:, None, None, :]  # [B,1,1,S]
 
@@ -1660,6 +1671,7 @@ def decode_step(
                 o = chunked_gqa_decode_attention(
                     q, k_cache, v_cache, positions,
                     chunk=kv_chunk, active=active, window=window,
+                    fp8_dot=attn_fp8,
                 )  # [B,H,1,D]
             else:
                 o = gqa_dot_product_attention(q, k_cache, v_cache, mask=attn_mask)  # [B,H,1,D]

@@ -24,7 +24,19 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .quant import quantize_fp8
+
 NEG_INF = -1e30
+
+_FP8_DTYPES = (jnp.float8_e4m3fn, jnp.float8_e5m2)
+
+
+def _check_fp8_dot(kv_dtype, site: str) -> None:
+    if not any(jnp.dtype(kv_dtype) == jnp.dtype(t) for t in _FP8_DTYPES):
+        raise ValueError(
+            f"{site}: fp8_dot=True requires an fp8 KV cache "
+            f"(float8_e4m3fn / float8_e5m2), got {kv_dtype}"
+        )
 
 
 def dot_product_attention(
@@ -103,6 +115,7 @@ def chunked_gqa_decode_attention(
     chunk: int,
     active: Optional[jnp.ndarray] = None,  # [B] bool; inactive rows don't widen the read
     window: Optional[int] = None,
+    fp8_dot: bool = False,
 ) -> jnp.ndarray:
     """Length-aware decode attention: read the slot cache in fixed ``chunk``-wide
     slices and SKIP every chunk past the batch's maximum valid position.
@@ -128,6 +141,16 @@ def chunked_gqa_decode_attention(
     starts past the first processed chunk self-corrects: its all-masked chunks
     contribute with ``m = -inf`` and are zeroed by ``alpha = exp(-inf - m_new)``
     once a live chunk arrives.
+
+    ``fp8_dot`` (docs/QUANT.md "fp8 in-dot"): keep the fp8 cache operand in
+    its storage dtype THROUGH the QK dot instead of upcasting first.  The
+    query is quantized to the cache's fp8 format once, outside the loop, and
+    its per-(kv-head, group) f32 scale multiplies the f32 score partials —
+    the same scale-on-partials discipline as the int4 ``qeinsum`` (the cache
+    side's per-page scale is 1.0 by the storage contract, so only the query
+    scale appears).  The PV dot likewise runs with fp8 probabilities against
+    the fp8 values; the softmax normalizer ``l`` stays computed from the f32
+    probabilities, matching the baseline's discipline.
     """
     B, H, Sq, D = q.shape
     if Sq != 1:
@@ -141,6 +164,11 @@ def chunked_gqa_decode_attention(
     if active is None:
         active = jnp.ones((B,), bool)
     qg = q.reshape(B, KH, G, D)
+    if fp8_dot:
+        _check_fp8_dot(k.dtype, "chunked_gqa_decode_attention")
+        # quantize the query once, outside the chunk loop: [B, KH, G, D] fp8
+        # plus a [B, KH, G, 1] f32 scale that rides on the score partials
+        qg_q, qg_s = quantize_fp8(qg, axis=-1, dtype=k.dtype)
 
     # chunks [lo, hi) cover every active row's valid keys; inactive rows are
     # excluded so one stale long slot can't widen a short batch's read window
@@ -158,14 +186,22 @@ def chunked_gqa_decode_attention(
         start = ci * chunk
         k_blk = jax.lax.dynamic_slice(k, (0, 0, start, 0), (B, KH, chunk, D))
         v_blk = jax.lax.dynamic_slice(v, (0, 0, start, 0), (B, KH, chunk, D))
-        if k_blk.dtype != q.dtype:
-            # per-chunk dequant: a pure convert on the sliced operand, fused
-            # into the dot — the cache streams from HBM at its own width
-            k_blk = k_blk.astype(q.dtype)
-            v_blk = v_blk.astype(q.dtype)
-        s = jnp.einsum(
-            "bkgd,bksd->bkgs", qg, k_blk, preferred_element_type=jnp.float32
-        ) * scale  # [B, KH, G, chunk]
+        if fp8_dot:
+            # in-dot fp8: both operands stay at storage width through the
+            # MXU; the query's f32 scale multiplies the f32 partials
+            s = jnp.einsum(
+                "bkgd,bksd->bkgs", qg_q, k_blk,
+                preferred_element_type=jnp.float32,
+            ) * (qg_s * scale)  # [B, KH, G, chunk]
+        else:
+            if k_blk.dtype != q.dtype:
+                # per-chunk dequant: a pure convert on the sliced operand,
+                # fused into the dot — the cache streams at its own width
+                k_blk = k_blk.astype(q.dtype)
+                v_blk = v_blk.astype(q.dtype)
+            s = jnp.einsum(
+                "bkgd,bksd->bkgs", qg, k_blk, preferred_element_type=jnp.float32
+            ) * scale  # [B, KH, G, chunk]
         kpos = start + jnp.arange(chunk)
         keep = kpos[None, :] <= positions[:, None]  # [B, chunk]
         if window is not None:
@@ -199,6 +235,7 @@ def paged_gqa_decode_attention(
     *,
     active: Optional[jnp.ndarray] = None,  # [B] bool; inactive rows don't widen the read
     window: Optional[int] = None,
+    fp8_dot: bool = False,
 ) -> jnp.ndarray:
     """Block-table variant of :func:`chunked_gqa_decode_attention`: the KV
     "row" of a slot is a chain of fixed-size pages scattered through a shared
@@ -215,6 +252,14 @@ def paged_gqa_decode_attention(
     Reduced-precision pools dequantize PER PAGE: the ``astype`` sits on the
     gathered operand, so the pool streams from HBM at its own width — same
     placement as the contiguous path's per-chunk dequant.
+
+    ``fp8_dot``: in-dot fp8 compute, exactly the contiguous path's scheme —
+    the query is quantized to the pool's fp8 format once outside the page
+    loop and its f32 scale multiplies the f32 score partials (per-page pool
+    scale is 1.0 by the storage contract); the PV dot runs fp8 x fp8.
+    ``paged_tree_attention`` deliberately keeps the dequant read: the verify
+    forward is one tick amortized over K+1 tokens, so its attention dot is
+    not the bandwidth bottleneck the per-step decode dot is.
     """
     B, H, Sq, D = q.shape
     if Sq != 1:
@@ -227,6 +272,9 @@ def paged_gqa_decode_attention(
     if active is None:
         active = jnp.ones((B,), bool)
     qg = q.reshape(B, KH, G, D)
+    if fp8_dot:
+        _check_fp8_dot(k_pool.dtype, "paged_gqa_decode_attention")
+        qg_q, qg_s = quantize_fp8(qg, axis=-1, dtype=k_pool.dtype)
 
     act_pos = jnp.where(active, positions, 0)
     hi = jnp.minimum(jnp.max(act_pos) // page + 1, NB)
@@ -242,12 +290,18 @@ def paged_gqa_decode_attention(
         phys = jnp.clip(phys, 0, P - 1)  # sentinel rows read a live page, masked below
         k_blk = jnp.take(k_pool, phys, axis=0)  # [B, KH, page, D]
         v_blk = jnp.take(v_pool, phys, axis=0)
-        if k_blk.dtype != q.dtype:
-            k_blk = k_blk.astype(q.dtype)
-            v_blk = v_blk.astype(q.dtype)
-        s = jnp.einsum(
-            "bkgd,bksd->bkgs", qg, k_blk, preferred_element_type=jnp.float32
-        ) * scale  # [B, KH, G, page]
+        if fp8_dot:
+            s = jnp.einsum(
+                "bkgd,bksd->bkgs", qg_q, k_blk,
+                preferred_element_type=jnp.float32,
+            ) * (qg_s * scale)  # [B, KH, G, page]
+        else:
+            if k_blk.dtype != q.dtype:
+                k_blk = k_blk.astype(q.dtype)
+                v_blk = v_blk.astype(q.dtype)
+            s = jnp.einsum(
+                "bkgd,bksd->bkgs", qg, k_blk, preferred_element_type=jnp.float32
+            ) * scale  # [B, KH, G, page]
         kpos = ci * page + jnp.arange(page)
         keep = kpos[None, :] <= positions[:, None]  # [B, page]
         if window is not None:

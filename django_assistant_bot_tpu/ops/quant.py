@@ -39,6 +39,7 @@ from __future__ import annotations
 from typing import Any, Dict, NamedTuple
 
 import jax.numpy as jnp
+import ml_dtypes
 import numpy as np
 
 QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
@@ -205,6 +206,24 @@ def qeinsum(pattern: str, x: jnp.ndarray, w: Any, dtype) -> jnp.ndarray:
         return jnp.einsum(pattern, x, w)
     y = jnp.einsum(pattern, x, w.q.astype(dtype))
     return y * jnp.squeeze(w.scale, axis=-2).astype(dtype)
+
+
+def quantize_fp8(x: jnp.ndarray, axis: int = -1, dtype=jnp.float8_e4m3fn):
+    """Symmetric per-vector fp8 quantization along ``axis`` -> ``(q, scale)``.
+
+    Used by the fp8 in-dot attention path (ops/attention.py ``fp8_dot``) to
+    bring the QUERY operand down to the KV pool's storage width so the QK dot
+    runs fp8 x fp8 with f32 accumulation — the same scale-on-partials
+    discipline as :func:`qeinsum`: the f32 scale multiplies the dot's f32
+    output, never the fp8 operand.  ``scale`` keeps the reduced axis with
+    size 1 so it broadcasts back over the partials."""
+    # host-side format constant (finfo is dtype metadata, not a device value;
+    # np.finfo rejects the fp8 classes, ml_dtypes.finfo covers them)
+    fmax = float(ml_dtypes.finfo(dtype).max)
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax / fmax, 1e-12).astype(jnp.float32)
+    q = (x.astype(jnp.float32) / scale).astype(dtype)
+    return q, scale
 
 
 def quantize_decoder_params(

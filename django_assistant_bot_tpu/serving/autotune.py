@@ -283,9 +283,16 @@ def recommend_for_spec(
         )
         kwargs["hbm_budget_gb"] = per_chip * slice_devices
     if getattr(spec, "speculative", 0):
-        # decode_steps > 1 is rejected at load on speculative decoders
-        # (docs/SPECULATIVE.md) — never recommend a config that cannot boot
-        kwargs.setdefault("decode_steps", (1,))
+        # spec x fused: decode_steps now scans N verify passes per dispatch
+        # (docs/SPECULATIVE.md "Spec x fused"), so the sweep covers it — but
+        # the engine bounds decode_steps * (K+1) against max_seq_len // 4, so
+        # drop depths a speculative engine would refuse to boot at
+        max_sl = int(min(spec.max_seq_len or cfg.max_seq_len, cfg.max_seq_len))
+        k1 = int(spec.speculative) + 1
+        feasible = tuple(
+            n for n in (1, 2, 4, 8, 16) if n * k1 <= max_sl // 4
+        ) or (1,)
+        kwargs.setdefault("decode_steps", feasible)
     max_seq_len = int(
         min(spec.max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
     )
@@ -297,6 +304,75 @@ def recommend_for_spec(
     out["slice_devices"] = slice_devices
     out["sliced"] = bool(replica_devices)
     return out
+
+
+def measure_report(
+    report: dict,
+    engine_factory: Any,
+    *,
+    top_k: int = 3,
+    iters: int = 16,
+    fill_len: Optional[int] = None,
+) -> dict:
+    """Measured-cost re-ranking (``serve --autotune --measure``).
+
+    Compiles and micro-probes the ``top_k`` ledger-ranked candidates from a
+    :func:`recommend`/:func:`recommend_for_spec` report on the live device.
+    ``engine_factory(candidate_dict)`` must return a constructed engine
+    exposing ``probe_decode(iters=, fill_len=)`` -> seconds/step and
+    ``stop()`` — the GenerationEngine probe runs idle-locked burst ticks
+    with device-chained state, so the measurement IS the compiled program's
+    per-step device cost at that geometry, not the ledger's guess.
+
+    The report keeps BOTH rankings: ``recommended`` becomes the measured
+    winner, the ledger's pick moves to ``ledger_recommended``, and
+    ``measured_agrees_with_ledger`` makes disagreement a visible artifact
+    (the ledger is a ranking device; the probe is ground truth for step
+    cost — the bench's interleaved arms remain ground truth for end-to-end
+    claims).  A candidate whose compile/probe fails is recorded with
+    ``probe_error`` and excluded from the re-rank instead of failing the
+    whole measurement.
+    """
+    top = list(report.get("top") or [])
+    if not top:
+        report["measure_error"] = "no feasible candidates to probe"
+        return report
+    probed: List[dict] = []
+    for rank, cand in enumerate(top[: max(1, int(top_k))]):
+        row = dict(cand)
+        row["ledger_rank"] = rank
+        eng = None
+        try:
+            eng = engine_factory(cand)
+            step_s = float(eng.probe_decode(iters=iters, fill_len=fill_len))
+            row["measured_step_ms"] = round(step_s * 1e3, 4)
+            # every probed step advances all max_slots rows one token
+            row["measured_tokens_per_s"] = round(cand["max_slots"] / step_s, 1)
+        except Exception as e:  # record, don't abort the sweep
+            row["probe_error"] = f"{type(e).__name__}: {e}"
+        finally:
+            if eng is not None:
+                try:
+                    eng.stop(drain_timeout_s=1.0)
+                except Exception:  # pragma: no cover - teardown belt
+                    pass
+        probed.append(row)
+    ok = [r for r in probed if "measured_tokens_per_s" in r]
+    report["measured"] = sorted(
+        probed, key=lambda r: -r.get("measured_tokens_per_s", -1.0)
+    )
+    if not ok:
+        report["measure_error"] = "every candidate probe failed"
+        return report
+    best = max(ok, key=lambda r: r["measured_tokens_per_s"])
+    report["ledger_recommended"] = dict(report.get("recommended") or {})
+    report["recommended"] = {
+        "kv_page_size": best["kv_page_size"],
+        "max_slots": best["max_slots"],
+        "decode_steps": best["decode_steps"],
+    }
+    report["measured_agrees_with_ledger"] = bool(best["ledger_rank"] == 0)
+    return report
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

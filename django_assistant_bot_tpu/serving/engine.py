@@ -229,6 +229,11 @@ class _Slot:
     # scheduler's service-time EMA needs so N-step ticks don't inflate the
     # predicted queue wait (docs/SCHEDULING.md)
     resident_steps: int = 0
+    # prefill chunk dispatches this request consumed before activation —
+    # charged to the scheduler's per-token service model alongside
+    # resident_steps so piggybacked (continuous-batching) prefill work
+    # doesn't vanish from the predicted queue wait / Retry-After math
+    prefill_chunks: int = 0
 
 
 @dataclasses.dataclass
@@ -300,6 +305,8 @@ class GenerationEngine:
         spec_probe_every: int = 64,
         spec_explore_every: int = 32,
         decode_kv_chunk: Optional[int] = 0,
+        prefill_piggyback: bool = True,
+        attn_fp8: bool = False,
         kv_layout: str = "paged",
         kv_page_size: int = 0,
         kv_pages: int = 0,
@@ -382,16 +389,18 @@ class GenerationEngine:
         # which path is active.
         if decode_steps is not None and int(decode_steps) < 1:
             raise ValueError(f"decode_steps must be >= 1 (got {decode_steps})")
-        if decode_steps is not None and int(decode_steps) > 1 and speculative:
-            # mutually exclusive initially (docs/SPECULATIVE.md): a spec tick
-            # already advances up to K+1 tokens and the tree draft consumes
-            # the chained token state the fused scan would own
-            raise ValueError(
-                "decode_steps > 1 is incompatible with speculative decoding "
-                "(the speculative tick is itself the multi-token fast path); "
-                "drop one of the two knobs"
-            )
-        self.burst = max(1, int(decode_steps if decode_steps is not None else burst))
+        # Spec x fused composition (docs/SPECULATIVE.md): a tree-verify step
+        # IS a multi-token tick, so `decode_steps` now scans N verify steps
+        # into one speculative dispatch instead of being rejected.  A
+        # speculative engine still defaults to ONE verify step per tick
+        # unless decode_steps is set explicitly — the historical `burst`
+        # default (8) describes plain-decode dispatch amortization and would
+        # silently 8x the per-tick token budget of every existing spec
+        # deployment.
+        if decode_steps is not None:
+            self.burst = max(1, int(decode_steps))
+        else:
+            self.burst = 1 if speculative else max(1, int(burst))
         # Tree-verified prompt-lookup speculative decoding
         # (ops/speculative.py): per tick, the on-device n-gram drafter emits
         # the top-`spec_width` distinct continuations of depth `speculative`
@@ -409,22 +418,24 @@ class GenerationEngine:
         self.speculative = max(0, int(speculative))
         self.spec_width = max(1, int(spec_width)) if self.speculative else 0
         if self.speculative:
-            # the commit writes K+1 positions and _should_finish reserves
-            # K tokens of headroom — a K near max_seq_len would crash the
-            # jitted tick (opaquely) or instantly length-limit every request;
-            # fail at load with the same clarity as the other config knobs
-            if self.speculative > self.max_seq_len // 4:
+            # each scanned verify step writes K+1 positions and
+            # _should_finish reserves N*(K+1)-1 tokens of headroom — a
+            # budget near max_seq_len would crash the jitted tick (opaquely)
+            # or instantly length-limit every request; fail at load with the
+            # same clarity as the other config knobs
+            if self.burst * (self.speculative + 1) > self.max_seq_len // 4:
                 raise ValueError(
-                    f"speculative={self.speculative} too large for "
-                    f"max_seq_len={self.max_seq_len}: each tick writes K+1 "
-                    f"positions and K tokens of finish headroom are reserved; "
-                    f"keep K <= max_seq_len // 4 ({self.max_seq_len // 4})"
+                    f"speculative={self.speculative} x decode_steps="
+                    f"{self.burst} too large for max_seq_len="
+                    f"{self.max_seq_len}: each tick writes up to "
+                    f"decode_steps*(K+1) positions and that many tokens of "
+                    f"finish headroom are reserved; keep decode_steps*(K+1) "
+                    f"<= max_seq_len // 4 ({self.max_seq_len // 4})"
                 )
-            self.burst = 1
-        # canonical alias for the fused-tick depth (== burst after the
-        # speculative clamp) + the operator gauges behind tick_stats /
-        # /healthz / /metrics (`decode_steps_effective`, `weight_bits`,
-        # `upload_overlap_frac`): which decode fast path is ACTUALLY active
+        # canonical alias for the fused-tick depth + the operator gauges
+        # behind tick_stats / /healthz / /metrics
+        # (`decode_steps_effective`, `weight_bits`, `upload_overlap_frac`):
+        # which decode fast path is ACTUALLY active
         self.decode_steps = self.burst
         self._decode_steps_effective = self.burst
         self._json_downgraded_ticks = 0
@@ -613,6 +624,35 @@ class GenerationEngine:
                 if host_tier is not None:
                     host_tier.on_event = self._on_kv_tier_event
                 self._kv_sentinel = n_pages  # block-table "unallocated" marker
+        # --- continuous batching: piggybacked chunked prefill ----------------
+        # One jitted program runs a bounded prefill chunk for the admitting
+        # slot AND the fused decode scan for resident slots per dispatch, so
+        # a long prompt stops displacing decode ticks (ROADMAP item 2).
+        # Token-identical to the sequential chunk-then-tick path: the chunk
+        # consumes no rng, writes only its own slot's pages/rows, and runs
+        # before the decode scan inside the program — the same order the
+        # sequential loop executes them.  prefill_piggyback=False is the
+        # one-flag rollback (and the bench A/B off-arm).
+        self.prefill_piggyback = bool(prefill_piggyback)
+        # fp8 in-dot attention (docs/QUANT.md): keep the fp8 KV read operand
+        # at storage width through the decode attention dots.  Requires an
+        # fp8 cache; the legacy layout additionally needs the chunked read
+        # (the full-cache gqa path has no in-dot scheme).
+        self.attn_fp8 = bool(attn_fp8)
+        if self.attn_fp8:
+            import jax.numpy as _jnp
+
+            kv_dt = self.kv_cache_dtype
+            if kv_dt is None or _jnp.dtype(kv_dt).itemsize != 1:
+                raise ValueError(
+                    "attn_fp8=True requires an fp8 KV cache "
+                    "(kv_cache_dtype='fp8' or 'fp8_e5m2')"
+                )
+            if not self.paged and not self.decode_kv_chunk:
+                raise ValueError(
+                    "attn_fp8=True on the legacy KV layout requires the "
+                    "chunked decode read (decode_kv_chunk != None)"
+                )
         # Admission-controlled scheduling (serving/scheduler.py): when present,
         # submit() runs its admission test (bounded queue, estimated wait) and
         # _admit pulls requests in weighted-fair-share order instead of FIFO.
@@ -792,6 +832,17 @@ class GenerationEngine:
 
         cfg_c = cfg
         self._decode_tick = self._make_decode_tick(json_mode=False)
+        # continuous-batching program: prefill chunk + decode scan fused into
+        # one dispatch.  Speculative engines keep sequential chunking (the
+        # spec tick owns the token/history chain the piggyback scan would
+        # fork); the knob is the rollback/A-B flag.
+        self._piggyback_tick = (
+            self._make_piggyback_tick()
+            if self.prefill_piggyback and not self.speculative
+            else None
+        )
+        self._prefill_displaced_ticks = 0
+        self._prefill_chunks_piggybacked = 0
         self._activate_fn = self._make_activate(json_mode=False)
         self._activate_fn_json = None  # built in _ensure_fsm
         self._spec_ticks: Dict[tuple, Any] = {}
@@ -988,6 +1039,7 @@ class GenerationEngine:
         burst_c = int(steps) if steps is not None else self.burst
         kv_chunk_c = self.decode_kv_chunk
         paged_c = self.paged
+        fp8_c = self.attn_fp8
 
         def tick(params, tokens, cache, active, bt, temps, top_ps, rng,
                  fsm_s=None, jmask=None, next_tab=None, allowed_tab=None):
@@ -1007,11 +1059,13 @@ class GenerationEngine:
                 rng, sub = jax.random.split(rng)
                 if paged_c:
                     logits, cache = llama.decode_step_paged(
-                        p, cfg_c, tokens, cache, bt, active=active
+                        p, cfg_c, tokens, cache, bt, active=active,
+                        attn_fp8=fp8_c,
                     )
                 else:
                     logits, cache = llama.decode_step(
-                        p, cfg_c, tokens, cache, active=active, kv_chunk=kv_chunk_c
+                        p, cfg_c, tokens, cache, active=active,
+                        kv_chunk=kv_chunk_c, attn_fp8=fp8_c,
                     )
                 if json_mode:
                     ok = allowed_tab[fsm_s]  # [B, V]
@@ -1047,6 +1101,82 @@ class GenerationEngine:
         if self.mesh is not None:
             rep = _replicated(self.mesh)
             out = (rep, rep, self._cache_shardings, rep) + ((rep,) if json_mode else ())
+        else:
+            out = None
+        return jax.jit(tick, donate_argnums=(2,), out_shardings=out)
+
+    def _make_piggyback_tick(self):
+        """Continuous-batching tick: ONE jitted program runs a bounded prefill
+        chunk for the admitting slot AND the fused ``decode_steps`` scan for
+        the resident slots (ROADMAP item 2 "chunked prefill piggybacked into
+        the fused decode tick").
+
+        Token-identity with the sequential chunk-then-tick path holds by
+        construction: the chunk runs FIRST inside the program (the order the
+        sequential loop executes them), consumes no rng, and touches only the
+        admitting slot's pages/row — which the decode reads never visit (the
+        admitting slot is not yet active, and shared prefix pages are never
+        in the chunk's write window: the chunk starts past the shared prefix,
+        boundary page COW-cloned at admission).  The decode scan body is the
+        same computation as :meth:`_make_decode_tick`'s over the same
+        operands, so sampled ids match bit-for-bit (pinned by
+        tests/test_contbatch.py).  JSON-constrained and speculative ticks
+        never piggyback (host-side gate in the loop)."""
+        from ..ops.attention import NEG_INF  # noqa: F401 (parity with decode tick)
+
+        cfg_c, top_k_c = self.cfg, self.top_k
+        burst_c = self.burst
+        kv_chunk_c = self.decode_kv_chunk
+        paged_c = self.paged
+        fp8_c = self.attn_fp8
+
+        def tick(params, tokens, cache, active, bt, temps, top_ps, rng,
+                 c_ids, c_slot, c_start, c_valid):
+            # --- the piggybacked prefill chunk (admitting slot only) -------
+            if paged_c:
+                bt_row = jax.lax.dynamic_index_in_dim(bt, c_slot, 0, keepdims=False)
+                _, cache = llama.prefill_chunk_paged(
+                    params, cfg_c, c_ids, cache, bt_row, c_slot, c_start, c_valid
+                )
+            else:
+                _, cache = llama.prefill_chunk(
+                    params, cfg_c, c_ids, cache, c_slot, c_start, c_valid
+                )
+
+            # --- the fused decode scan (resident slots) --------------------
+            def body(carry, _):
+                tokens, cache, rng = carry
+                p = jax.lax.optimization_barrier(params) if burst_c > 1 else params
+                rng, sub = jax.random.split(rng)
+                if paged_c:
+                    logits, cache = llama.decode_step_paged(
+                        p, cfg_c, tokens, cache, bt, active=active,
+                        attn_fp8=fp8_c,
+                    )
+                else:
+                    logits, cache = llama.decode_step(
+                        p, cfg_c, tokens, cache, active=active,
+                        kv_chunk=kv_chunk_c, attn_fp8=fp8_c,
+                    )
+                nxt = sample_logits(
+                    logits, sub, temperature=temps, top_k=top_k_c, top_p=top_ps
+                )
+                return (nxt, cache, rng), nxt
+
+            carry = (tokens, cache, rng)
+            if burst_c == 1:
+                carry, tok = body(carry, None)
+                tokens, cache, rng = carry
+                toks = tok[None]
+            else:
+                (tokens, cache, rng), toks = jax.lax.scan(
+                    body, carry, None, length=burst_c
+                )
+            return toks, tokens, cache, rng
+
+        if self.mesh is not None:
+            rep = _replicated(self.mesh)
+            out = (rep, rep, self._cache_shardings, rep)
         else:
             out = None
         return jax.jit(tick, donate_argnums=(2,), out_shardings=out)
@@ -1102,7 +1232,7 @@ class GenerationEngine:
             return jax.device_put(z, _replicated(self.mesh))
         return jax.device_put(z)
 
-    def _make_spec_tick(self, width: int, depth: int):
+    def _make_spec_tick(self, width: int, depth: int, steps: Optional[int] = None):
         """Fused tree-speculative tick for one (width, depth) rung: on-device
         n-gram TREE draft -> one read-only verify forward over every node
         (ancestor-masked) -> longest root-to-leaf acceptance -> accepted-path
@@ -1110,7 +1240,16 @@ class GenerationEngine:
         block-table scatter on the paged plane) -> history/length update —
         all chained device state (lookahead-compatible; zero host round trips
         per tick).  See ops/speculative.py for the acceptance semantics and
-        models/llama.verify_tree_step for the forward."""
+        models/llama.verify_tree_step for the forward.
+
+        Spec x fused composition (docs/SPECULATIVE.md): a verify step IS a
+        multi-token tick, so ``decode_steps`` scans N whole
+        draft->verify->accept->commit passes into ONE dispatch — the same
+        program family (and the same optimization-barrier discipline) as the
+        plain fused tick, with the rung ladder choosing the tree shape per
+        dispatch.  Outputs are stacked per step: ``toks [N, K+1, B]`` /
+        ``n_new [N, B]`` (N = 1 included, so the host consumer has one
+        shape contract)."""
         from ..ops.speculative import (
             accept_tree,
             build_tree_draft,
@@ -1121,63 +1260,85 @@ class GenerationEngine:
         cfg_c, top_k_c, K = self.cfg, self.top_k, int(depth)
         N = int(width)
         S = self.max_seq_len
+        steps_c = int(steps) if steps is not None else self.burst
         spec = make_tree_spec(N, K)
         depths_c = jnp.asarray(spec.depths)
         anc_c = jnp.asarray(spec.anc_mask)
         paged_c = self.paged
 
         def tick(params, tokens, history, cache, bt, active, temps, top_ps, rng):
-            draft = build_tree_draft(history, cache.lengths, tokens, N, K)
-            tree = flatten_tree(tokens, draft)  # [B, 1 + N*K]
-            if paged_c:
-                logits, tks, tvs = llama.verify_tree_step_paged(
-                    params, cfg_c, tree, cache, bt, depths_c, anc_c
+            def body(carry, _):
+                tokens, history, cache, rng = carry
+                # same anti-hoisting barrier as the fused decode scan: keep
+                # the weights' dequantization inside the scanned body
+                p = jax.lax.optimization_barrier(params) if steps_c > 1 else params
+                draft = build_tree_draft(history, cache.lengths, tokens, N, K)
+                tree = flatten_tree(tokens, draft)  # [B, 1 + N*K]
+                if paged_c:
+                    logits, tks, tvs = llama.verify_tree_step_paged(
+                        p, cfg_c, tree, cache, bt, depths_c, anc_c
+                    )
+                else:
+                    logits, tks, tvs = llama.verify_tree_step(
+                        p, cfg_c, tree, cache, depths_c, anc_c
+                    )
+                out, n_new, bonus, path_idx, rng = accept_tree(
+                    logits, tree, spec, rng,
+                    temperature=temps, top_k=top_k_c, top_p=top_ps,
                 )
+                n_new = jnp.where(active, n_new, 0)
+                if paged_c:
+                    # accepted-prefix-only commit: everything past the
+                    # accepted run (and every inactive row) drops at the page
+                    # sentinel — a paged garbage write could land in a page
+                    # since handed to another request, so masking is part of
+                    # the contract
+                    cache = llama.commit_tree_path_paged(
+                        cache, tks, tvs, path_idx, bt, n_new, active
+                    )
+                else:
+                    # contiguous rows tolerate the rejected tail: it sits
+                    # past the new valid length, masked/overwritten like all
+                    # garbage
+                    cache = llama.commit_tree_path(cache, tks, tvs, path_idx)
+                # persist this step's input token + accepted tokens into the
+                # history at sequence positions lengths..lengths+K+1;
+                # positions beyond the accepted run hold garbage that later
+                # steps overwrite (exactly the KV-cache discipline), and the
+                # draft search never reads past the valid length
+                row_tokens = jnp.concatenate([tokens[:, None], out], axis=1)
+                # gather+where instead of a vmapped dynamic_update_slice: the
+                # per-row scatter that vmap lowers to trips this jaxlib's HLO
+                # verifier (broadcast rank RET_CHECK) on CPU; the masked
+                # gather writes the identical window and lowers everywhere
+                pos = jnp.minimum(cache.lengths, S - (K + 2))  # [B]
+                rel = jnp.arange(S)[None, :] - pos[:, None]  # [B,S]
+                in_window = (rel >= 0) & (rel < K + 2)
+                gathered = jnp.take_along_axis(
+                    row_tokens, jnp.clip(rel, 0, K + 1), axis=1
+                )
+                upd = jnp.where(in_window, gathered, history)
+                history = jnp.where(active[:, None], upd, history)
+                new_len = jnp.where(
+                    active, jnp.minimum(cache.lengths + n_new, S), cache.lengths
+                )
+                cache = cache._replace(lengths=new_len.astype(cache.lengths.dtype))
+                tokens = jnp.where(active, bonus, tokens)
+                return (tokens, history, cache, rng), (out.T, n_new)
+
+            carry = (tokens, history, cache, rng)
+            if steps_c == 1:
+                # no scan wrapper at depth 1 (the OOM discipline of
+                # _make_decode_tick): unrolled, then stacked to the [1, ...]
+                # shape contract
+                carry, (tok, n_new) = body(carry, None)
+                tokens, history, cache, rng = carry
+                toks, n_news = tok[None], n_new[None]
             else:
-                logits, tks, tvs = llama.verify_tree_step(
-                    params, cfg_c, tree, cache, depths_c, anc_c
+                (tokens, history, cache, rng), (toks, n_news) = jax.lax.scan(
+                    body, carry, None, length=steps_c
                 )
-            out, n_new, bonus, path_idx, rng = accept_tree(
-                logits, tree, spec, rng,
-                temperature=temps, top_k=top_k_c, top_p=top_ps,
-            )
-            n_new = jnp.where(active, n_new, 0)
-            if paged_c:
-                # accepted-prefix-only commit: everything past the accepted
-                # run (and every inactive row) drops at the page sentinel —
-                # a paged garbage write could land in a page since handed to
-                # another request, so masking is part of the contract
-                cache = llama.commit_tree_path_paged(
-                    cache, tks, tvs, path_idx, bt, n_new, active
-                )
-            else:
-                # contiguous rows tolerate the rejected tail: it sits past
-                # the new valid length, masked/overwritten like all garbage
-                cache = llama.commit_tree_path(cache, tks, tvs, path_idx)
-            # persist this tick's input token + accepted tokens into the
-            # history at sequence positions lengths..lengths+K+1; positions
-            # beyond the accepted run hold garbage that later ticks overwrite
-            # (exactly the KV-cache discipline), and the draft search never
-            # reads past the valid length
-            row_tokens = jnp.concatenate([tokens[:, None], out], axis=1)
-            # gather+where instead of a vmapped dynamic_update_slice: the
-            # per-row scatter that vmap lowers to trips this jaxlib's HLO
-            # verifier (broadcast rank RET_CHECK) on CPU; the masked gather
-            # writes the identical window and lowers everywhere
-            pos = jnp.minimum(cache.lengths, S - (K + 2))  # [B]
-            rel = jnp.arange(S)[None, :] - pos[:, None]  # [B,S]
-            in_window = (rel >= 0) & (rel < K + 2)
-            gathered = jnp.take_along_axis(
-                row_tokens, jnp.clip(rel, 0, K + 1), axis=1
-            )
-            upd = jnp.where(in_window, gathered, history)
-            history = jnp.where(active[:, None], upd, history)
-            new_len = jnp.where(
-                active, jnp.minimum(cache.lengths + n_new, S), cache.lengths
-            )
-            cache = cache._replace(lengths=new_len.astype(cache.lengths.dtype))
-            tokens = jnp.where(active, bonus, tokens)
-            return out.T, n_new, tokens, history, cache, rng
+            return toks, n_news, tokens, history, cache, rng
 
         if self.mesh is not None:
             rep = _replicated(self.mesh)
@@ -1693,6 +1854,57 @@ class GenerationEngine:
         busy = {self._chunking.slot} if self._chunking is not None else set()
         return [i for i, s in enumerate(self._slots) if s is None and i not in busy]
 
+    def _loop_iteration(self) -> bool:
+        """ONE engine-loop iteration under ``_iter_lock``: reap, admit, run a
+        prefill chunk (piggybacked into the decode tick when possible) and/or
+        a decode tick, then drain results ``lookahead`` ticks behind.
+        Returns whether any admission/chunk progress was made (the loop's
+        idle predicate).  Factored out of :meth:`_loop` so deterministic
+        tests can crank iterations single-threaded (tests/test_contbatch.py's
+        lockstep bit-identity rig)."""
+        with self._iter_lock:  # excludes probe_decode (see there)
+            self._reap_dead_slots()
+            admitted = self._admit()
+            ticked = False
+            if self._chunking is not None:
+                if (
+                    self._piggyback_tick is not None
+                    and self.num_active > 0
+                    and not self._json.any()
+                    and self._chunking.step < len(self._chunking.starts) - 1
+                ):
+                    # continuous batching: fold this chunk into the decode
+                    # tick — resident slots advance decode_steps tokens in
+                    # the SAME dispatch instead of waiting a chunk out.  The
+                    # final chunk always runs sequentially: its logits feed
+                    # the activation (first-token sample), which is its own
+                    # program.
+                    self._piggyback_step()
+                    ticked = True
+                else:
+                    if self.num_active > 0:
+                        # decode waited a full dispatch on this prefill
+                        # chunk — the displacement the piggybacked path
+                        # exists to remove (prefill_displacement_frac)
+                        self._prefill_displaced_ticks += 1
+                    self._chunk_step()
+                admitted = True
+            if self.num_active > 0 and not ticked:
+                self._issue_tick()
+            # process results `lookahead` ticks behind; drain fully
+            # when no slot is live (remaining in-flight ticks carry
+            # final tokens)
+            while self._inflight and (
+                len(self._inflight) > self.lookahead
+                or self.num_active == 0
+            ):
+                self._process_tick()
+            # double-buffer next tick's sampling/block-table
+            # uploads against the ticks still in flight (the
+            # finishes above are what dirtied the arrays)
+            self._prestage_uploads()
+        return admitted
+
     def _loop(self):
         try:
             while self._running:
@@ -1700,26 +1912,7 @@ class GenerationEngine:
                 if self._degraded_until is not None and not self._degraded_wait():
                     continue
                 try:
-                    with self._iter_lock:  # excludes probe_decode (see there)
-                        self._reap_dead_slots()
-                        admitted = self._admit()
-                        if self._chunking is not None:
-                            self._chunk_step()
-                            admitted = True
-                        if self.num_active > 0:
-                            self._issue_tick()
-                        # process results `lookahead` ticks behind; drain fully
-                        # when no slot is live (remaining in-flight ticks carry
-                        # final tokens)
-                        while self._inflight and (
-                            len(self._inflight) > self.lookahead
-                            or self.num_active == 0
-                        ):
-                            self._process_tick()
-                        # double-buffer next tick's sampling/block-table
-                        # uploads against the ticks still in flight (the
-                        # finishes above are what dirtied the arrays)
-                        self._prestage_uploads()
+                    admitted = self._loop_iteration()
                     # a clean iteration closes any failure streak (the restart
                     # backoff escalates over CONSECUTIVE failures only)
                     self._consecutive_failures = 0
@@ -2286,6 +2479,26 @@ class GenerationEngine:
                         jnp.asarray(0, jnp.int32),
                         jnp.asarray(0, jnp.int32),
                     )
+                if self._piggyback_tick is not None:
+                    # the continuous-batching program (chunk + decode scan):
+                    # valid=0 drops every chunk write, all-False active
+                    # freezes every decode row — warm is a pure compile
+                    _, _pg_last, self._cache, self._rng = (
+                        self._piggyback_tick(
+                            self.params,
+                            self._tokens_dev,
+                            self._cache,
+                            jnp.zeros((self.max_slots,), bool),
+                            self._bt_dev,
+                            jnp.asarray(self._temps),
+                            jnp.asarray(self._top_ps),
+                            self._rng,
+                            jnp.zeros((1, self.chunk_size), jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                        )
+                    )
             if self.prefix_cache_size > 0 and self.paged:
                 # paged prefix path: the batched suffix prefill per (batch,
                 # seq) bucket plus the COW page clone — sentinel targets, so
@@ -2713,6 +2926,86 @@ class GenerationEngine:
             self._starting_batch = [(st.slot, st.request)]
             self._activate(st.slot, st.request, logits)
             self._starting_batch = None
+            s = self._slots[st.slot]
+            if s is not None:
+                # service-model charge: every chunk dispatch (sequential or
+                # piggybacked) was a unit of engine service this request
+                # consumed before its first decode step
+                s.prefill_chunks = st.step
+
+    def _piggyback_step(self):
+        """One continuous-batching dispatch: the admitting slot's next prefill
+        chunk AND a fused decode tick for the resident slots, in ONE jitted
+        program (:meth:`_make_piggyback_tick`).  Combines :meth:`_issue_tick`'s
+        dispatch/pipeline bookkeeping with :meth:`_chunk_step`'s chunk
+        bookkeeping; the gate in :meth:`_loop_iteration` guarantees this is
+        never the FINAL chunk (whose logits feed the activation) and that no
+        json/speculative state is live."""
+        st = self._chunking
+        assert st is not None and self._piggyback_tick is not None
+        t0 = self._clock()
+        if self._faults is not None:
+            # same chaos sites as the plain tick: a raise here is engine-
+            # fatal mid-piggyback (the chaos case tests/test_contbatch.py
+            # pins: restart must leave the page pool clean)
+            self._faults.maybe_raise("tick_raise", "device step")
+            delay = self._faults.sleep_s("slow_tick")
+            if delay:
+                self._sleep(delay)
+        self._refresh_sampling()
+        self._decode_steps_effective = self.burst
+        j = st.step
+        with self._mesh_scope():
+            toks, last, self._cache, self._rng = self._piggyback_tick(
+                self.params,
+                self._tokens_dev,
+                self._cache,
+                self._active_dev,
+                self._bt_dev,
+                self._temps_dev,
+                self._top_ps_dev,
+                self._rng,
+                jnp.asarray(st.ids[j : j + 1]),
+                jnp.asarray(st.slot, jnp.int32),
+                jnp.asarray(st.starts[j], jnp.int32),
+                jnp.asarray(self.chunk_size, jnp.int32),
+            )
+        try:
+            toks.copy_to_host_async()
+        except AttributeError:  # backend without async host copies
+            pass
+        self._tokens_dev = last
+        self.steps += self.burst
+        self._tick_issue_s += self._clock() - t0
+        self._ticks_issued += 1
+        self._kv_frac_sum += self._kv_read_frac()
+        live = [
+            (i, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None
+        ]
+        self._inflight.append(_TickRef(nxt=toks, slots=live))
+        st.step += 1
+        self._prefill_chunks_piggybacked += 1
+        # the same mid-prefill reaping as _chunk_step (the decode side of the
+        # dispatch needs none of this — its slots reap via _reap_dead_slots)
+        if st.request.future.cancelled():
+            self.reclaimed_slots += 1
+            self.cancelled_slots += 1
+            self._drop_restore_inflight(st.request)
+            self._free_slot_pages(st.slot)
+            self._chunking = None
+            return
+        dl = st.request.deadline_at
+        if dl is not None and self._clock() >= dl:
+            self.reclaimed_slots += 1
+            if self.scheduler is not None:
+                self.scheduler.note_expired_running(st.request.priority)
+            _safe_resolve(
+                st.request.future,
+                exc=DeadlineExceeded("deadline expired during chunked prefill"),
+            )
+            self._drop_restore_inflight(st.request)
+            self._free_slot_pages(st.slot)
+            self._chunking = None
 
     def _activate(self, slot: int, req: _Request, logits):
         self._activate_batch([slot], [req], logits, pad=0)
@@ -2894,6 +3187,19 @@ class GenerationEngine:
             "json_downgraded_ticks": self._json_downgraded_ticks,
             "upload_overlap_frac": self.upload_overlap_frac(),
             "weight_bits": self.weight_bits,
+            # continuous batching (docs/SCHEDULING.md "Continuous batching"):
+            # is the piggyback program armed, how many chunks rode a decode
+            # tick, and what fraction of dispatches decode still spent
+            # waiting on a sequential prefill chunk — the displacement the
+            # tentpole removes (0.0 with piggyback on and no json traffic)
+            "prefill_piggyback": bool(self._piggyback_tick is not None),
+            "prefill_chunks_piggybacked": self._prefill_chunks_piggybacked,
+            "prefill_displacement_frac": round(
+                self._prefill_displaced_ticks / max(1, self._ticks_issued), 4
+            ),
+            # fp8 in-dot attention (docs/QUANT.md): whether the decode
+            # attention dots read the KV operand at fp8 storage width
+            "attn_fp8": self.attn_fp8,
         }
 
     def slice_stats(self) -> dict:
@@ -3259,8 +3565,8 @@ class GenerationEngine:
                     self._issue_spec_tick(t0, rung)
                     return
         # (a load- or acceptance-disabled speculative engine falls through to
-        # the plain tick: burst is pinned to 1 there, so _decode_tick is the
-        # single-step program and the cache/token chaining is identical)
+        # the plain tick: _decode_tick is built at the same decode_steps
+        # depth, so the cache/token chaining is identical either way)
         json_live = bool(self._json.any())
         issued_steps = 1 if json_live else self.burst
         if json_live and self.burst > 1:
@@ -3315,7 +3621,8 @@ class GenerationEngine:
         """Dispatch one fused tree-speculative tick at the controller's
         current (width, depth) rung (draft + verify + accept + commit on
         device, chained state — same pipelining discipline as the burst
-        tick, but each tick advances a variable 1..depth+1 tokens/slot)."""
+        tick, but each of its ``decode_steps`` scanned verify steps advances
+        a variable 1..depth+1 tokens/slot)."""
         with self._mesh_scope():
             toks, n_new, last, self._history_dev, self._cache, self._rng = (
                 self._spec_ticks[rung](
@@ -3336,7 +3643,8 @@ class GenerationEngine:
             except AttributeError:
                 pass
         self._tokens_dev = last
-        self.steps += 1
+        self.steps += self.burst
+        self._decode_steps_effective = self.burst
         self.spec_ticks_issued += 1
         self._tick_issue_s += self._clock() - t0
         self._ticks_issued += 1
@@ -3395,37 +3703,39 @@ class GenerationEngine:
                 self._consume_token(slot, s, int(vals[ref.offset + j]), now)
             return
         if ref.n_new is not None:  # speculative tick: variable tokens/slot
-            counts = np.asarray(ref.n_new)
+            counts = np.asarray(ref.n_new)  # [N, B] — one row per verify step
             K = ref.spec_rung[1] if ref.spec_rung else self.speculative
-            greedy_rows = 0
+            greedy_row_steps = 0
             tick_accepted = 0
-            for slot, epoch in ref.slots:
-                s = self._slots[slot]
-                if s is None or self._slot_epoch[slot] != epoch:
-                    continue
-                n = int(counts[slot])
-                # a spec tick advances 1..K+1 tokens in ~one (costlier) step;
-                # charging the tokens committed keeps the per-token service
-                # rate honest on speculative engines too
-                s.resident_steps += max(1, n)
-                # greedy rows proposed K drafts and n-1 were accepted
-                if s.request.temperature <= 0:
-                    self.spec_drafted += K
-                    self.spec_accepted += max(0, n - 1)
-                    greedy_rows += 1
-                    tick_accepted += max(0, n - 1)
-                for k in range(n):
-                    if self._consume_token(slot, s, int(vals[k, slot]), now):
-                        break  # remaining accepted tokens are post-EOS garbage
-            if self._spec_ctl is not None and greedy_rows:
+            for step in range(counts.shape[0]):  # scanned steps, oldest first
+                for slot, epoch in ref.slots:
+                    s = self._slots[slot]
+                    if s is None or self._slot_epoch[slot] != epoch:
+                        continue  # finished by an earlier step; drafts dropped
+                    n = int(counts[step, slot])
+                    # a verify step advances 1..K+1 tokens in ~one (costlier)
+                    # step; charging the tokens committed keeps the per-token
+                    # service rate honest on speculative engines too
+                    s.resident_steps += max(1, n)
+                    # greedy rows proposed K drafts and n-1 were accepted
+                    if s.request.temperature <= 0:
+                        self.spec_drafted += K
+                        self.spec_accepted += max(0, n - 1)
+                        greedy_row_steps += 1
+                        tick_accepted += max(0, n - 1)
+                    for k in range(n):
+                        if self._consume_token(slot, s, int(vals[step, k, slot]), now):
+                            break  # remaining accepted tokens are post-EOS garbage
+            if self._spec_ctl is not None and greedy_row_steps:
                 # acceptance evidence for the adaptive controller — greedy
                 # rows only (sampled rows never accept, by design), credited
-                # to the rung that actually drafted this tick
+                # per verify STEP to the rung that drafted this tick (the
+                # rate normalizer is rows x steps x depth)
                 self._spec_ctl.note_tick(
-                    tick_accepted, K, greedy_rows, rung=ref.spec_rung
+                    tick_accepted, K, greedy_row_steps, rung=ref.spec_rung
                 )
                 if self.obs is not None:
-                    self.obs.on_spec_tick(tick_accepted, K * greedy_rows)
+                    self.obs.on_spec_tick(tick_accepted, K * greedy_row_steps)
             return
         for slot, epoch in ref.slots:
             # a fused tick occupies the slot for ALL its steps even when EOS
@@ -3497,13 +3807,17 @@ class GenerationEngine:
         if len(s.generated) >= s.request.max_tokens:
             return True
         # cache full -> decode_step freezes the slot; finish as length-limited.
-        # Speculative mode leaves K tokens of headroom: a tick commits up to
-        # K+1 accepted-path positions, so live rows must always fit them
-        # (commit_tree_path docstring) — those last K tokens would have been
-        # length_limited a tick later anyway.
+        # Speculative mode leaves N*(K+1)-1 tokens of headroom: one tick's N
+        # scanned verify steps commit up to N*(K+1) accepted-path positions,
+        # so live rows must always fit them (commit_tree_path docstring) —
+        # those last tokens would have been length_limited a tick later
+        # anyway.  (N=1 reduces to the historical K-token headroom.)
+        headroom = (
+            self.burst * (self.speculative + 1) - 1 if self.speculative else 0
+        )
         if (
             len(s.request.prompt_ids) + len(s.generated)
-            >= self.max_seq_len - self.speculative
+            >= self.max_seq_len - headroom
         ):
             return True
         return False
@@ -3557,10 +3871,14 @@ class GenerationEngine:
             # their full N even when EOS lands mid-tick), so the scheduler
             # can model service per TOKEN and a decode_steps=N engine doesn't
             # inflate predicted queue waits by the tick-quantized lookahead
-            # lag a short request pays (docs/SCHEDULING.md)
+            # lag a short request pays (docs/SCHEDULING.md).  Prefill chunk
+            # dispatches count too: piggybacked chunks ride decode ticks, so
+            # without the charge a long-prompt request would look like pure
+            # decode service and skew predicted waits / Retry-After /
+            # autoscaler backlog optimistic.
             self.scheduler.note_service(
                 now - (req.started_at or req.first_token_at or now),
-                tokens=max(1, s.resident_steps),
+                tokens=max(1, s.resident_steps + s.prefill_chunks),
             )
         if self.obs is not None:
             # close the request's span trace from the host timestamps the

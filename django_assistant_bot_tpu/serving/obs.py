@@ -684,6 +684,13 @@ def render_prometheus(registry: Any) -> str:
             x.add("dabt_decode_json_downgraded_ticks_total", "counter", "fused ticks downgraded to single-step by live json slots", dec.get("json_downgraded_ticks"), lab)
             x.add("dabt_upload_overlap_frac", "gauge", "sampling/block-table upload cycles overlapped with an in-flight tick", dec.get("upload_overlap_frac"), lab)
             x.add("dabt_weight_bits", "gauge", "decode weight format width in bits (16/8/4)", dec.get("weight_bits"), lab)
+            # continuous batching (docs/QUANT.md "Continuous batching"):
+            # how often decode still waits on a sequential prefill chunk,
+            # and how many chunks rode inside fused ticks instead
+            x.add("dabt_prefill_displacement_frac", "gauge", "fraction of decode ticks displaced by a sequential prefill chunk", dec.get("prefill_displacement_frac"), lab)
+            x.add("dabt_prefill_chunks_piggybacked_total", "counter", "prefill chunks run inside a fused decode tick", dec.get("prefill_chunks_piggybacked"), lab)
+            x.add("dabt_prefill_piggyback", "gauge", "piggybacked-prefill program compiled for this engine", dec.get("prefill_piggyback"), lab)
+            x.add("dabt_attn_fp8", "gauge", "fp8 in-dot decode attention engaged", dec.get("attn_fp8"), lab)
         sl_fn = getattr(eng, "slice_stats", None)
         if callable(sl_fn):
             # mesh-sliced fleet (docs/MULTICHIP.md): which devices this
@@ -733,6 +740,12 @@ def render_prometheus(registry: Any) -> str:
             x.add("dabt_spec_drafted_total", "counter", "speculative tokens drafted", spec["spec_drafted"], lab)
             x.add("dabt_spec_accepted_total", "counter", "speculative tokens accepted", spec["spec_accepted"], lab)
             x.add("dabt_spec_accept_rate", "gauge", "cumulative speculative accept rate", spec["spec_accept_rate"], lab)
+            # spec x fused: the controller's live rung and the scanned
+            # verify depth — effective tokens/dispatch ceiling is
+            # steps * (depth + 1) on a fully-accepting greedy row
+            x.add("dabt_spec_tree_width", "gauge", "speculative tree width the controller currently issues", spec.get("spec_tree_width"), lab)
+            x.add("dabt_spec_tree_depth", "gauge", "speculative tree depth (K) the controller currently issues", spec.get("spec_tree_depth"), lab)
+            x.add("dabt_spec_verify_steps", "gauge", "scanned verify passes per speculative tick (decode_steps)", getattr(eng, "burst", 1), lab)
         obs = getattr(eng, "obs", None)
         if obs is not None:
             x.add_histogram("dabt_ttft_seconds", "time to first token (submit -> first host token)", obs.ttft_s, lab)

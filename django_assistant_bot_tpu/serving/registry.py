@@ -41,10 +41,26 @@ class ModelSpec:
     # 0 = inherit `burst` (the historical alias — same machinery); >= 1 is
     # the canonical knob and the one-flag rollback is decode_steps=1.
     # json_fsm slots downgrade live ticks to single-step
-    # (decode_steps_effective in tick_stats); incompatible with
-    # speculative > 0 (the spec tick is itself the multi-token fast path —
-    # docs/SPECULATIVE.md)
+    # (decode_steps_effective in tick_stats).  Composes with speculative > 0:
+    # the spec tick scans decode_steps full draft->verify->commit passes per
+    # dispatch, so a greedy slot can advance up to decode_steps * (K+1)
+    # tokens per dispatch (docs/SPECULATIVE.md "Spec x fused").  NOTE: a
+    # speculative engine defaults to ONE verify pass per tick unless
+    # decode_steps is set explicitly — `burst` is not inherited there.
     decode_steps: int = 0
+    # chunked prefill piggybacked into the fused decode tick (continuous
+    # batching): while one slot is mid-chunked-prefill, each dispatch runs
+    # ONE bounded prefill chunk AND the full N-step decode scan for resident
+    # slots, so a long admit no longer displaces decode ticks
+    # (prefill_displacement_frac in tick_stats).  Token-identical to the
+    # sequential path; False is the one-flag rollback (sequential chunking).
+    prefill_piggyback: bool = True
+    # fp8 in-dot decode attention: keep the fp8 KV read operand in fp8
+    # through the QK/PV dots (per-block scales applied to the f32 partials,
+    # mirroring the int4 in-dot discipline) instead of dequantizing to bf16
+    # first.  Requires kv_cache_dtype fp8/fp8_e5m2 and the chunked or paged
+    # KV read; lossy — see docs/QUANT.md for the measured logit-error bound.
+    attn_fp8: bool = False
     # weight-only quantization for decoders: None | "int8" (per-channel) |
     # "int4" (per-group, packed two-per-byte — 0.5 bytes/weight of HBM read;
     # ops/quant.py, docs/QUANT.md) — decode is bandwidth-bound, so bytes are
@@ -287,13 +303,6 @@ class ModelRegistry:
                 f"model {name}: decode_steps must be >= 1 (or 0 = inherit "
                 f"burst); got {spec.decode_steps}"
             )
-        if spec.decode_steps > 1 and spec.speculative:
-            raise ValueError(
-                f"model {name}: decode_steps={spec.decode_steps} is "
-                "incompatible with speculative decoding — the speculative "
-                "tick is itself the multi-token fast path "
-                "(docs/SPECULATIVE.md); drop one of the two knobs"
-            )
         if spec.decode_steps and spec.kind == "encoder":
             raise ValueError(f"model {name}: decode_steps is decoder-only")
         if spec.warmup_json and spec.kind == "encoder":
@@ -316,6 +325,15 @@ class ModelRegistry:
             raise ValueError(
                 f"model {name}: unknown kv_cache_dtype={spec.kv_cache_dtype!r}; "
                 f"expected one of {sorted(k for k in KV_CACHE_DTYPES if k)}"
+            )
+        if spec.attn_fp8 and spec.kind == "encoder":
+            raise ValueError(f"model {name}: attn_fp8 is decoder-only")
+        if spec.attn_fp8 and spec.kv_cache_dtype not in ("fp8", "fp8_e5m2"):
+            raise ValueError(
+                f"model {name}: attn_fp8 requires an fp8 KV cache "
+                f"(kv_cache_dtype='fp8' or 'fp8_e5m2', got "
+                f"{spec.kv_cache_dtype!r}) — the in-dot scheme consumes the "
+                "stored fp8 operand directly (docs/QUANT.md)"
             )
         if spec.kv_host_bytes < 0:
             raise ValueError(f"model {name}: kv_host_bytes must be >= 0")
@@ -633,6 +651,8 @@ class ModelRegistry:
                         None if spec.decode_kv_chunk in (None, "off")
                         else int(spec.decode_kv_chunk)
                     ),
+                    prefill_piggyback=spec.prefill_piggyback,
+                    attn_fp8=spec.attn_fp8,
                     kv_layout=spec.kv_layout,
                     kv_page_size=spec.kv_page_size,
                     kv_pages=spec.kv_pages,

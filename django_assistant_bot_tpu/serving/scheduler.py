@@ -607,7 +607,8 @@ class RequestScheduler:
 
         With ``tokens > 0`` (the decode steps the request's slot actually sat
         through — the engine charges fused N-step ticks their full N even
-        when EOS lands mid-tick), the model becomes PER-TOKEN: a per-token
+        when EOS lands mid-tick, plus one unit per chunked-prefill dispatch,
+        sequential or piggybacked), the model becomes PER-TOKEN: a per-token
         rate EMA and a tokens-per-request EMA whose product replaces the raw
         per-request EMA in :meth:`_est_wait_s_locked`.  Why: a
         ``decode_steps=N`` engine delivers residency in N-step quanta and the

@@ -151,12 +151,27 @@ def test_json_slots_downgrade_fused_tick_to_single_step():
         eng.stop()
 
 
-def test_speculative_excludes_decode_steps():
+def test_speculative_composes_with_decode_steps():
+    """Spec x fused: decode_steps now scans N verify passes per dispatch
+    instead of being rejected; the engine reports both knobs and the
+    oversized product still fails loudly at construction."""
     cfg, params = _tiny()
-    with pytest.raises(ValueError, match="speculative"):
+    eng = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=128,
+        decode_steps=4, speculative=3,
+    )
+    assert eng.burst == 4 and eng.speculative == 3
+    # a spec engine WITHOUT an explicit decode_steps stays at one verify
+    # pass per tick — `burst` must not silently multiply existing deploys
+    eng1 = GenerationEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=128,
+        burst=8, speculative=3,
+    )
+    assert eng1.burst == 1
+    with pytest.raises(ValueError, match="too large"):
         GenerationEngine(
             cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=128,
-            decode_steps=4, speculative=3,
+            decode_steps=8, speculative=7,
         )
 
 
@@ -250,14 +265,21 @@ def test_decode_path_gauges_in_metrics_exposition():
     assert "dabt_upload_overlap_frac" in fams
 
 
-def test_registry_rejects_decode_steps_with_speculative():
+def test_registry_accepts_decode_steps_with_speculative():
+    """The registry-level mutual exclusion is gone: a spec x fused entry
+    loads and threads both knobs into the engine."""
     from django_assistant_bot_tpu.serving.registry import ModelRegistry, ModelSpec
 
     spec = ModelSpec(
-        name="m", kind="decoder", tiny=True, decode_steps=4, speculative=3
+        name="m", kind="decoder", tiny=True, decode_steps=2, speculative=3,
+        max_seq_len=128, scheduler=False,
     )
-    with pytest.raises(ValueError, match="decode_steps"):
-        ModelRegistry(specs={"m": spec})
+    reg = ModelRegistry(specs={"m": spec})
+    try:
+        eng = reg.generators["m"]
+        assert eng.burst == 2 and eng.speculative == 3
+    finally:
+        reg.stop()
 
 
 def test_registry_rejects_bad_quant_knobs():
@@ -391,6 +413,51 @@ def test_autotune_int4_reads_fewer_bytes_and_steps_amortize_overhead():
     assert by_steps[16] > by_steps[4] > by_steps[1]
 
 
+def test_measure_report_reranks_by_probe():
+    """`--measure` discipline: probe the top-k, keep BOTH rankings, make
+    ledger-vs-measured disagreement a visible artifact, and never let one
+    failed probe abort the sweep."""
+    from django_assistant_bot_tpu.serving.autotune import measure_report
+
+    class FakeEng:
+        def __init__(self, step_s):
+            self._s = step_s
+            self.stopped = False
+
+        def probe_decode(self, iters=16, fill_len=None):
+            if self._s is None:
+                raise RuntimeError("compile exploded")
+            return self._s
+
+        def stop(self, drain_timeout_s=None):
+            self.stopped = True
+
+    # ledger rank 0 probes SLOWER than rank 1, rank 2's probe dies
+    step_by_depth = {2: 0.010, 4: 0.004, 8: None}
+    built = []
+
+    def factory(cand):
+        eng = FakeEng(step_by_depth[cand["decode_steps"]])
+        built.append(eng)
+        return eng
+
+    report = {
+        "top": [
+            {"kv_page_size": 32, "max_slots": 8, "decode_steps": d}
+            for d in (2, 4, 8)
+        ],
+        "recommended": {"kv_page_size": 32, "max_slots": 8, "decode_steps": 2},
+    }
+    measure_report(report, factory, top_k=3)
+    assert report["ledger_recommended"]["decode_steps"] == 2
+    assert report["recommended"]["decode_steps"] == 4
+    assert report["measured_agrees_with_ledger"] is False
+    assert report["measured"][0]["measured_tokens_per_s"] == 8 / 0.004
+    errs = [r for r in report["measured"] if "probe_error" in r]
+    assert len(errs) == 1 and errs[0]["decode_steps"] == 8
+    assert all(e.stopped for e in built), "a probed engine leaked"
+
+
 def test_autotune_recommend_for_spec_tiny():
     import dataclasses
 
@@ -406,13 +473,16 @@ def test_autotune_recommend_for_spec_tiny():
     assert out["model"] == "t"
     assert out["assumptions"]["weight_bits"] == 4
     assert out["recommended"]["kv_page_size"] in (32, 64, 128)
-    # a speculative decoder must never be recommended decode_steps > 1 —
-    # the registry rejects that combination at boot
+    # spec x fused composition (round 15): the sweep covers every verify
+    # depth inside the construction bound n*(K+1) <= max_seq_len/4 instead
+    # of clamping a speculative decoder to decode_steps=1
     spec_s = ModelSpec(
         name="s", kind="decoder", tiny=True, speculative=3, max_seq_len=256
     )
     out_s = recommend_for_spec(spec_s, cfg)
-    assert out_s["recommended"]["decode_steps"] == 1
+    steps = {c["decode_steps"] for c in out_s["top"]}
+    assert steps - {1}, "spec sweep still clamped to decode_steps=1"
+    assert all(n * (3 + 1) <= 256 // 4 for n in steps)
 
 
 def test_shard_pytree_keeps_fail_loudly_for_plain_weights():
