@@ -2302,6 +2302,248 @@ print(json.dumps(bench.bench_router()))
 """
 
 
+def _serve_app_thread(app):
+    """Host an aiohttp app on its own thread's event loop; returns
+    ``(base_url, stop)``.  The fleet arms need REAL localhost HTTP peers —
+    the wire, the codec, and the re-route path are the things under test."""
+    import asyncio
+    import threading
+
+    from aiohttp import web
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state: dict = {}
+
+    def _run():
+        asyncio.set_event_loop(loop)
+
+        async def _up():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            state["runner"] = runner
+            state["port"] = runner.addresses[0][1]
+
+        loop.run_until_complete(_up())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    started.wait(60)
+
+    def _stop():
+        async def _down():
+            await state["runner"].cleanup()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_down(), loop).result(30)
+        except Exception:
+            pass
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(15)
+
+    return f"http://127.0.0.1:{state['port']}", _stop
+
+
+def _fleet_trace():
+    """ONE pinned mixed chat/longctx trace shared by every fleet arm (seed
+    pinned — same arrivals, shapes, and prefixes in each arm)."""
+    from django_assistant_bot_tpu.workload.generator import (
+        WorkloadConfig,
+        WorkloadGenerator,
+    )
+
+    return WorkloadGenerator(
+        WorkloadConfig(
+            seed=7,
+            duration_s=10.0,
+            base_rps=2.0,
+            shape="constant",
+            tenants=2,
+            background_frac=0.0,
+            longctx_frac=0.25,
+            chat_prompt_tokens=(8, 40),
+            chat_max_tokens=(4, 10),
+            longctx_prompt_tokens=(80, 160),
+            longctx_max_tokens=(6, 12),
+            prefix_frac=0.5,
+            prefix_tokens=16,
+        )
+    ).generate()
+
+
+# the identity probe: long enough that the disagg arm takes the
+# prefill-pool handoff path (suffix >= 64)
+_FLEET_IDENT_PROMPT = [11 + (i % 180) for i in range(100)]
+
+
+def bench_fleet() -> dict:
+    """fleet_* section (serving/fleet.py + docs/FLEET.md evidence): the
+    cross-process fleet plane measured over REAL localhost HTTP peers —
+    each peer a full serve stack (registry + engine + fleet plane + aiohttp
+    app) with its own KV pools, exactly the cross-host shape minus the DCN.
+
+    Three arms on the SAME pinned mixed chat/longctx trace:
+
+    - **unified**: two unified peers behind the FleetRouter (the baseline);
+    - **disagg**: one prefill-pool + one decode-pool peer — long prompts
+      prefill in the prefill pool, pages ship over ``/fleet/kv/put``, and
+      the decode pool serves the tokens; the identity probe asserts the
+      disaggregated output matches the unified arm bit-for-bit;
+    - **chaos**: two unified peers, one killed mid-trace — every token-less
+      request must re-route to the survivor (goodput 1.0, reroutes > 0).
+    """
+    from django_assistant_bot_tpu.serving.fleet import (
+        FleetPeer,
+        FleetPlane,
+        FleetRouter,
+    )
+    from django_assistant_bot_tpu.serving.registry import ModelRegistry
+    from django_assistant_bot_tpu.serving.server import create_app
+    from django_assistant_bot_tpu.workload.generator import prompt_ids_for
+
+    def _peer(pool):
+        reg = ModelRegistry.from_config(
+            {
+                "tiny-chat": {
+                    "kind": "decoder",
+                    "tiny": True,
+                    "max_slots": 4,
+                    "max_seq_len": 256,
+                    "kv_host_bytes": 1 << 26,
+                    "prefix_min_tokens": 16,
+                }
+            }
+        )
+        plane = FleetPlane(reg, name=f"bench-{pool}", pool=pool)
+        reg.fleet_plane = plane
+        url, stop = _serve_app_thread(create_app(reg))
+        return {"reg": reg, "plane": plane, "url": url, "stop": stop}
+
+    reqs = _fleet_trace()
+
+    def _arm(pools, *, chaos=False):
+        peers = [_peer(p) for p in pools]
+        for i, p in enumerate(peers):
+            p["plane"].peers = [
+                (f"bench{j}", q["url"]) for j, q in enumerate(peers) if j != i
+            ]
+        router = FleetRouter(
+            [
+                FleetPeer(f"bench{i}", p["url"], pool=pool, timeout_s=600.0)
+                for i, (p, pool) in enumerate(zip(peers, pools))
+            ],
+            model="tiny-chat",
+            refresh_interval_s=1e9,  # the arm drives refresh itself
+            request_timeout_s=600.0,
+        )
+        alive = [True] * len(peers)
+        out: dict = {}
+        try:
+            router.refresh()
+            router._last_refresh = router._clock()
+            # warm every peer's prefill/decode buckets off the clock
+            for p in peers:
+                for rep in router.peers:
+                    rep.draining = rep.base_url != p["url"]
+                for warm in ([3] * 12, _FLEET_IDENT_PROMPT):
+                    try:
+                        router.submit(
+                            list(warm), max_tokens=2, temperature=0.0
+                        ).result(timeout=600)
+                    except Exception:
+                        pass  # pool-role peers reject half the warmups
+            for rep in router.peers:
+                rep.draining = False
+            kill_at = len(reqs) // 3 if chaos else None
+            t0 = time.perf_counter()
+            futs = []
+            for i, r in enumerate(reqs):
+                if kill_at is not None and i == kill_at:
+                    peers[0]["stop"]()
+                    peers[0]["reg"].stop()
+                    alive[0] = False
+                futs.append(
+                    router.submit(
+                        prompt_ids_for(r),
+                        max_tokens=r.max_tokens,
+                        temperature=0.0,
+                        prefix_len=r.prefix_len,
+                        priority=r.priority,
+                        tenant=r.tenant,
+                    )
+                )
+            ok = failed = tokens = 0
+            for f in futs:
+                try:
+                    tokens += f.result(timeout=900).completion_tokens
+                    ok += 1
+                except Exception:
+                    failed += 1
+            wall = time.perf_counter() - t0
+            ident = None
+            if not chaos:
+                ident = router.submit(
+                    list(_FLEET_IDENT_PROMPT), max_tokens=8, temperature=0.0
+                ).result(timeout=600)
+            ttft = max(
+                p["reg"].generators["tiny-chat"].latency_stats()["ttft_p95_ms"]
+                for p, up in zip(peers, alive)
+                if up
+            )
+            out = {
+                "goodput_frac": round(ok / len(reqs), 4),
+                "failed": failed,
+                "agg_tok_s": round(tokens / wall, 2) if wall > 0 else 0.0,
+                "ttft_p95_ms": round(ttft, 2),
+                "reroutes": router.reroutes,
+                "handoffs": router.handoffs,
+                "pages_shipped": router.pages_shipped,
+                "handoff_fallbacks": router.handoff_fallbacks,
+                "ident_token_ids": ident.token_ids if ident else None,
+            }
+        finally:
+            router.close()
+            for p, up in zip(peers, alive):
+                if up:
+                    p["stop"]()
+                    p["reg"].stop()
+        return out
+
+    uni = _arm(("unified", "unified"))
+    dis = _arm(("prefill", "decode"))
+    cha = _arm(("unified", "unified"), chaos=True)
+    return {
+        "fleet_requests": len(reqs),
+        "fleet_unified_agg_tok_s": uni["agg_tok_s"],
+        "fleet_unified_ttft_p95_ms": uni["ttft_p95_ms"],
+        "fleet_unified_goodput_frac": uni["goodput_frac"],
+        "fleet_disagg_agg_tok_s": dis["agg_tok_s"],
+        "fleet_disagg_ttft_p95_ms": dis["ttft_p95_ms"],
+        "fleet_disagg_goodput_frac": dis["goodput_frac"],
+        "fleet_handoffs": dis["handoffs"],
+        "fleet_pages_shipped": dis["pages_shipped"],
+        "fleet_handoff_fallbacks": dis["handoff_fallbacks"],
+        "fleet_output_identical": bool(
+            uni["ident_token_ids"]
+            and uni["ident_token_ids"] == dis["ident_token_ids"]
+        ),
+        "fleet_chaos_goodput_frac": cha["goodput_frac"],
+        "fleet_chaos_failed": cha["failed"],
+        "fleet_reroutes": cha["reroutes"],
+    }
+
+
+_FLEET_SNIPPET = """
+import json
+import bench
+print(json.dumps(bench.bench_fleet()))
+"""
+
+
 def bench_autoscale() -> dict:
     """autoscale_* section (serving/autoscaler.py + workload/ evidence): the
     closed-loop A/B.  ONE seeded diurnal-ramp trace (workload/generator.py,
@@ -3863,6 +4105,15 @@ _COMPACT_KEYS = (
     "router_recovery_s",
     "router_reroutes",
     "router_drain_shed",
+    "fleet_unified_ttft_p95_ms",
+    "fleet_disagg_ttft_p95_ms",
+    "fleet_unified_agg_tok_s",
+    "fleet_disagg_agg_tok_s",
+    "fleet_chaos_goodput_frac",
+    "fleet_reroutes",
+    "fleet_output_identical",
+    "fleet_handoffs",
+    "fleet_pages_shipped",
     "multichip_agg_tok_s",
     "multichip_tok_s_1slice",
     "multichip_scaling_frac",
@@ -4000,6 +4251,7 @@ def main() -> None:
         extras.update(bench_overload())
         extras.update(bench_chaos())
         extras.update(bench_router())
+        extras.update(bench_fleet())
         extras.update(bench_multichip())
         extras.update(bench_autoscale())
         extras.update(bench_kv_tier())
@@ -4072,6 +4324,13 @@ def main() -> None:
     #       recovery-to-first-success on the restarted replica, and a
     #       rolling restart under live traffic (serving/router.py evidence)
     run("router", _ROUTER_SNIPPET, cap_s=400)
+    # 3c''+) fleet: the cross-process plane — disagg (prefill-pool ->
+    #        /fleet/kv/put -> decode-pool) vs unified over real localhost
+    #        HTTP peers on the same pinned mixed trace, greedy outputs
+    #        asserted identical, plus a peer-kill chaos arm (token-less
+    #        re-route goodput — serving/fleet.py + docs/FLEET.md evidence;
+    #        CPU-friendly tiny peers by design)
+    run("fleet", _FLEET_SNIPPET, cap_s=420)
     # 3c''a) multichip: the mesh-sliced fleet A/B — 4 replicas x TP-2 on
     #        disjoint slices of a forced-8-device host vs the 1-slice arm
     #        (per-slice steady rates, placement-asserted disjointness,

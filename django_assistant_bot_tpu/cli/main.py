@@ -21,6 +21,7 @@ COMMANDS = {
     "tester": ".tester",
     "fetch_models": ".fetch_models",
     "synth_checkpoint": ".synth_checkpoint",
+    "trace_export": ".trace_export",
 }
 
 

@@ -188,6 +188,50 @@ def add_parser(sub):
         "degradation",
     )
     p.add_argument(
+        "--pool",
+        choices=("unified", "prefill", "decode"),
+        default=None,
+        help="fleet pool role for every decoder (docs/FLEET.md): 'prefill' "
+        "serves chunked prefill only and pushes finished prefix pages to "
+        "the decode pool over /fleet/kv/put; 'decode' admits via warm-prefix "
+        "restore and sheds long prefill with reason 'pool_role' so the "
+        "FleetRouter hands it off; 'unified' (default) serves both",
+    )
+    p.add_argument(
+        "--fleet-name",
+        default=None,
+        metavar="NAME",
+        help="this process's name on the fleet wire (defaults to proc-<pid>; "
+        "also honors DABT_FLEET_SELF)",
+    )
+    p.add_argument(
+        "--fleet-peers",
+        default=None,
+        metavar="NAME=URL,...",
+        help="comma-separated fleet peers, e.g. "
+        "'a=http://10.0.0.1:11435,b=http://10.0.0.2:11435' — /fleet/healthz "
+        "probes them and degrades the fleet status when one is unreachable "
+        "(also honors DABT_FLEET_PEERS; docs/FLEET.md)",
+    )
+    p.add_argument(
+        "--decode-max-prefill-tokens",
+        type=int,
+        default=None,
+        metavar="N",
+        help="decode-pool admission bound: the longest un-restorable suffix a "
+        "decode process will prefill itself before shedding with "
+        "'pool_role' (default 64)",
+    )
+    p.add_argument(
+        "--slo-itl-p95-s",
+        type=float,
+        default=None,
+        metavar="S",
+        help="decode-pool autoscaling signal: scale up when p95 inter-token "
+        "latency burns past this (default 0.25; only read when --pool "
+        "decode — docs/FLEET.md)",
+    )
+    p.add_argument(
         "--log-json",
         action="store_true",
         help="structured JSON logging for the serving process: one JSON line "
@@ -375,6 +419,10 @@ def run(args) -> int:
             )
     if getattr(args, "slo_ttft_p95_s", None) is not None:
         sched_overrides["autoscale_slo_ttft_p95_s"] = args.slo_ttft_p95_s
+    if getattr(args, "pool", None) is not None:
+        sched_overrides["pool"] = args.pool
+    if getattr(args, "slo_itl_p95_s", None) is not None:
+        sched_overrides["autoscale_slo_itl_p95_s"] = args.slo_itl_p95_s
     if getattr(args, "kv_layout", None) is not None:
         sched_overrides["kv_layout"] = args.kv_layout
     if getattr(args, "kv_pages", None) is not None:
@@ -541,6 +589,23 @@ def run(args) -> int:
         return 0
 
     registry = ModelRegistry.from_config(config)
+    # cross-process fleet plane (serving/fleet.py; docs/FLEET.md): attach it
+    # HERE so create_app reuses the CLI-configured identity/pool/peer list
+    # instead of building a default unified plane
+    from ..parallel.distributed import fleet_peers_from_env, fleet_self_name
+    from ..serving.fleet import FleetPlane
+
+    peers = fleet_peers_from_env(getattr(args, "fleet_peers", None))
+    plane_kwargs = {}
+    if getattr(args, "decode_max_prefill_tokens", None) is not None:
+        plane_kwargs["decode_max_prefill_tokens"] = args.decode_max_prefill_tokens
+    registry.fleet_plane = FleetPlane(
+        registry,
+        name=fleet_self_name(getattr(args, "fleet_name", None)),
+        pool=getattr(args, "pool", None),
+        peers=peers,
+        **plane_kwargs,
+    )
     # SIGTERM-triggered graceful drain (whole-router when --replicas > 1):
     # run_server's shutdown handler stops admission, waits for in-flight
     # work within the deadline, then returns — and we exit 0, so rolling

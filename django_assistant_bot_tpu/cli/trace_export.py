@@ -1,0 +1,99 @@
+"""``trace_export`` — export live serving traces to a replayable workload.
+
+Pulls the obs plane's per-request trace ring (``GET /traces`` on a running
+serve process, or a flight-recorder dump file) and writes it as the
+workload JSONL format (:mod:`..workload.generator`), so real traffic
+replays through ``workload.replay`` against a candidate config — the
+capture half of the scenario engine (docs/FLEET.md "Trace export")."""
+
+from __future__ import annotations
+
+import json
+
+
+def add_parser(sub):
+    p = sub.add_parser(
+        "trace_export",
+        help="export obs traces from a running server (or a flight dump) "
+        "to workload JSONL for replay",
+    )
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument(
+        "--url",
+        metavar="URL",
+        help="base URL of a running serve process (fetches GET /traces), "
+        "e.g. http://127.0.0.1:11435",
+    )
+    src.add_argument(
+        "--input",
+        metavar="PATH",
+        help="read traces from a file instead: a GET /traces JSON body, a "
+        "flight-recorder dump, or JSONL of trace records",
+    )
+    p.add_argument(
+        "--output",
+        required=True,
+        metavar="PATH",
+        help="workload JSONL destination (one WorkloadRequest per line)",
+    )
+    p.add_argument(
+        "--longctx-threshold",
+        type=int,
+        default=None,
+        metavar="TOKENS",
+        help="prompt length at or past which a captured request is classed "
+        "'longctx' (default 96, the generator's longctx floor)",
+    )
+    return p
+
+
+def run(args) -> int:
+    from ..workload.capture import (
+        LONGCTX_PROMPT_TOKENS,
+        load_flight_dump,
+        requests_from_traces,
+    )
+    from ..workload.generator import save_trace
+
+    if args.url:
+        from ..serving.fleet import PeerClient, PeerHTTPError, PeerUnreachable
+
+        try:
+            body = PeerClient(args.url, timeout_s=30.0).get_json("/traces")
+        except (PeerUnreachable, PeerHTTPError) as e:
+            print(f"trace fetch failed: {e}")
+            return 1
+        traces = body.get("traces", [])
+    else:
+        try:
+            traces = load_flight_dump(args.input)
+        except OSError as e:
+            print(f"cannot read {args.input}: {e}")
+            return 1
+    reqs, skipped = requests_from_traces(
+        traces,
+        longctx_threshold=(
+            args.longctx_threshold
+            if args.longctx_threshold is not None
+            else LONGCTX_PROMPT_TOKENS
+        ),
+    )
+    if not reqs:
+        print(
+            json.dumps(
+                {"exported": 0, "skipped": skipped, "output": args.output}
+            )
+        )
+        return 1
+    n = save_trace(reqs, args.output)
+    print(
+        json.dumps(
+            {
+                "exported": n,
+                "skipped": skipped,
+                "span_s": round(reqs[-1].t_s, 3),
+                "output": args.output,
+            }
+        )
+    )
+    return 0

@@ -95,3 +95,39 @@ def multihost_mesh(
 def is_primary() -> bool:
     """True on the process that should write checkpoints / serve admin."""
     return jax.process_index() == 0
+
+
+# --------------------------------------------------------- fleet plane env
+# The serving fleet (serving/fleet.py; docs/FLEET.md) is a SEPARATE plane
+# from the jax.distributed compute cluster above: fleet peers are whole
+# serve processes talking HTTP, not devices sharing a mesh.  Same env-var
+# convention, though, so one launcher template configures both:
+#
+# - ``DABT_FLEET_SELF``  — this process's name on the fleet wire
+# - ``DABT_FLEET_PEERS`` — ``name=url,name=url`` peer list
+
+
+def fleet_self_name(explicit: Optional[str] = None) -> Optional[str]:
+    """This process's fleet-wire name: the explicit CLI value wins, then
+    DABT_FLEET_SELF, then None (FleetPlane defaults to proc-<pid>)."""
+    if explicit:
+        return explicit
+    return os.environ.get("DABT_FLEET_SELF") or None
+
+
+def fleet_peers_from_env(explicit: Optional[str] = None) -> list:
+    """Parse ``name=url,name=url`` (the --fleet-peers flag, falling back to
+    DABT_FLEET_PEERS) into ``[(name, url), ...]``.  A bare URL with no
+    ``name=`` gets an index-derived name; empty entries are skipped."""
+    raw = explicit if explicit is not None else os.environ.get("DABT_FLEET_PEERS", "")
+    peers = []
+    for i, part in enumerate(p.strip() for p in (raw or "").split(",")):
+        if not part:
+            continue
+        if "=" in part:
+            name, url = part.split("=", 1)
+            name = name.strip() or f"peer{i}"
+        else:
+            name, url = f"peer{i}", part
+        peers.append((name, url.strip()))
+    return peers

@@ -60,6 +60,11 @@ class AutoscalerConfig:
     up_shed_per_s: float = 0.5  # admission sheds per second over the window
     up_est_wait_frac: float = 0.5  # predicted queue wait / SLO
     up_kv_frac: float = 0.9  # KV pages used / total
+    # decode-pool signal (docs/FLEET.md): p95 inter-token latency at or past
+    # this fires the overload band.  None (default) keeps the controller
+    # TTFT-driven — the right signal for unified and prefill pools, where
+    # admission latency IS the SLO; a decode pool's latency is ITL.
+    up_itl_p95_s: Optional[float] = None
     up_consecutive: int = 2  # overloaded ticks before actuating (hysteresis)
     up_cooldown_s: float = 5.0
     # ---- scale-down triggers (ALL must hold for the trough band) -----------
@@ -183,6 +188,7 @@ class SLOAutoscaler:
         router = self.router
         lat = router.latency_stats()
         ttft_p95_s = float(lat.get("ttft_p95_ms", 0.0)) / 1e3
+        itl_p95_s = float(lat.get("itl_p95_ms", 0.0)) / 1e3
         shed_total = 0
         shed_delta = 0
         seen: dict = {}
@@ -225,6 +231,7 @@ class SLOAutoscaler:
         return {
             "replicas": len(router.replicas),
             "ttft_p95_s": round(ttft_p95_s, 4),
+            "itl_p95_s": round(itl_p95_s, 4),
             "ttft_n": lat.get("ttft_n", 0),
             "shed_total": shed_total,
             "shed_delta": shed_delta,
@@ -265,13 +272,26 @@ class SLOAutoscaler:
         # nor be blocked from scaling down / releasing degradation.
         busy = (sig["queued"] + sig["active"]) > 0
         sig["busy"] = busy
+        # decode pools scale on ITL, not TTFT (docs/FLEET.md): the same
+        # busy-gating applies — a stale rolling window must not hold the band
+        itl_hot = (
+            cfg.up_itl_p95_s is not None
+            and busy
+            and sig["itl_p95_s"] >= cfg.up_itl_p95_s
+        )
         overload = (
             (busy and burn >= cfg.up_burn)
+            or itl_hot
             or shed_rate >= cfg.up_shed_per_s
             or sig["est_wait_s"] >= cfg.up_est_wait_frac * cfg.slo_ttft_p95_s
             or sig["kv_frac"] >= cfg.up_kv_frac
         )
         burn_calm = not busy or burn <= cfg.down_burn
+        itl_calm = (
+            cfg.up_itl_p95_s is None
+            or not busy
+            or sig["itl_p95_s"] <= 0.5 * cfg.up_itl_p95_s
+        )
         burn_released = not busy or burn <= cfg.degrade_release_burn
         # projected utilization of a ONE-SMALLER fleet: scale-down must not
         # immediately re-trigger scale-up (the flap the bands exist to stop)
@@ -280,6 +300,7 @@ class SLOAutoscaler:
         trough = (
             not overload
             and burn_calm
+            and itl_calm
             and shed_delta == 0
             and sig["est_wait_s"] <= cfg.down_est_wait_frac * cfg.slo_ttft_p95_s
             and sig["kv_frac"] <= cfg.down_kv_frac
@@ -562,6 +583,7 @@ class SLOAutoscaler:
                 "max_replicas": self.cfg.max_replicas,
                 "replicas": len(self.router.replicas),
                 "slo_ttft_p95_s": self.cfg.slo_ttft_p95_s,
+                "slo_itl_p95_s": self.cfg.up_itl_p95_s,
                 "ticks": self.ticks,
                 "scale_ups": self.scale_ups,
                 "scale_downs": self.scale_downs,

@@ -1849,6 +1849,44 @@ class GenerationEngine:
                     n += 1
         return n
 
+    def absorb_remote_entry(self, key: tuple, length: int, k, v) -> bool:
+        """Import ONE wire-shipped prefix entry (``/fleet/kv/put`` —
+        serving/fleet.py) into this engine's HOST tier, never directly into
+        HBM: the entry enters through the same host-tier ``put`` every spill
+        uses (same ``host_put`` event for the gossip log / prefix registry /
+        flight ring) and reaches device pages only through the existing
+        restore-at-admission path — so restore bit-identity across a process
+        boundary is the SAME tested property as the local spill/restore
+        round-trip.  Geometry and dtype are validated against THIS pool
+        first: a mismatched peer's bytes would reinterpret, not restore.
+        Thread-safe (host-tier lock); returns whether the entry stored."""
+        tier = self._kv_host
+        if tier is None or not self.paged:
+            return False
+        key = tuple(int(t) for t in key)
+        k = np.asarray(k)
+        v = np.asarray(v)
+        if int(length) != len(key):
+            logger.warning(
+                "refusing remote KV entry: length %d != key tokens %d",
+                int(length), len(key),
+            )
+            return False
+        if k.ndim != 5 or v.ndim != 5 or k.shape[3] != self.kv_page_size:
+            logger.warning(
+                "refusing remote KV entry: page geometry %s does not match "
+                "this pool (page=%d)", tuple(k.shape), self.kv_page_size,
+            )
+            return False
+        expected = jnp.dtype(self.kv_cache_dtype or self.cfg.dtype)
+        if k.dtype != expected or v.dtype != expected:
+            logger.warning(
+                "refusing remote KV entry: dtype %s does not match this "
+                "pool's %s", k.dtype, expected,
+            )
+            return False
+        return tier.put(key, int(length), k, v)
+
     # ---------------------------------------------------------------- internal
     def _free_slots(self) -> List[int]:
         busy = {self._chunking.slot} if self._chunking is not None else set()

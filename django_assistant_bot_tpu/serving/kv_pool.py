@@ -75,6 +75,20 @@ TIER_HBM = "hbm"
 TIER_HOST = "host"
 TIER_DISK = "disk"
 
+# Version stamp carried by every HostPrefixEntry that crosses a process or
+# build boundary: the snapshot/absorb migration path, the fleet wire codec
+# (serving/fleet.py), and disk spill files.  Bump whenever the entry layout
+# or dtype-tagging scheme changes; absorb and the wire decoder REJECT
+# unknown versions (WireVersionError) instead of reinterpreting bytes a
+# different build wrote — a silently misread fp8 page corrupts generations,
+# a loud failure re-prefills.
+KV_WIRE_VERSION = 1
+
+
+class WireVersionError(ValueError):
+    """A KV snapshot/wire payload carries an unknown ``wire_version`` — the
+    writer was a different build.  Failing loudly beats corrupting pages."""
+
 # process-wide sequence for unique spill tmp filenames (itertools.count is
 # GIL-atomic; the pid in the final path isolates across processes)
 _TMP_SEQ = itertools.count()
@@ -108,6 +122,9 @@ class HostPrefixEntry:
     v: Any  # np.ndarray
     nbytes: int
     pages: int
+    # build-compatibility stamp (see KV_WIRE_VERSION): absorb() refuses
+    # entries stamped by a different layout generation
+    wire_version: int = KV_WIRE_VERSION
 
 
 class HostKVTier:
@@ -345,6 +362,7 @@ class HostKVTier:
                 k_shape=np.asarray(ent.k.shape, np.int64),
                 v_shape=np.asarray(ent.v.shape, np.int64),
                 dtype=np.asarray(str(ent.k.dtype)),
+                wire_version=np.asarray(KV_WIRE_VERSION, np.int64),
             )
             os.replace(tmp, path)
         except (OSError, ValueError) as e:
@@ -397,9 +415,22 @@ class HostKVTier:
 
     @staticmethod
     def _load_disk_file(path: str, key: tuple, length: int, nbytes: int, pages: int):
-        """Read one demoted entry back (no lock held).  None on failure."""
+        """Read one demoted entry back (no lock held).  None on failure.
+        A file stamped with an unknown ``wire_version`` (a different build
+        wrote into a shared spill dir) is dropped loudly — an honest miss
+        costs one re-prefill, a misread dtype layout corrupts pages."""
         try:
             with np.load(path, allow_pickle=False) as z:
+                if "wire_version" in z.files:
+                    ver = int(z["wire_version"])
+                    if ver != KV_WIRE_VERSION:
+                        logger.error(
+                            "KV disk file %s has wire_version %d (this build "
+                            "supports %d) — written by a different build; "
+                            "dropping entry",
+                            path, ver, KV_WIRE_VERSION,
+                        )
+                        return None
                 dtype = np.dtype(str(z["dtype"]))
                 k = z["k_bytes"].view(dtype).reshape(z["k_shape"])
                 v = z["v_bytes"].view(dtype).reshape(z["v_shape"])
@@ -574,6 +605,48 @@ class HostKVTier:
                 unreadable.append((key, int(length), int(pages)))
         return entries + host_entries, unreadable
 
+    def export_entry(self, key: tuple) -> Optional[HostPrefixEntry]:
+        """Read-only export of ONE entry for the fleet wire (``/fleet/kv/get``
+        and the prefill-pool push — serving/fleet.py): a host-DRAM hit is
+        returned as-is (LRU-neutral, no restore counters), a disk hit is
+        loaded from its file WITHOUT promotion or index mutation — the
+        exporting process keeps its tiers exactly as they were.  None on a
+        miss or an unreadable file."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None:
+                return ent
+            row = self._disk.get(key)
+        if row is None:
+            return None
+        path, length, nbytes, pages = row
+        return self._load_disk_file(path, key, length, nbytes, pages)
+
+    def export_match(
+        self, prompt_ids: Sequence[int], prefix_len: int, *, min_tokens: int = 1
+    ) -> Optional[HostPrefixEntry]:
+        """LONGEST stored prefix of ``prompt_ids``, exported read-only (see
+        :meth:`export_entry`) — the ``/fleet/kv/get`` by-prompt lookup, which
+        must not perturb the serving process's LRU or promotion state."""
+        if prefix_len < min_tokens:
+            return None
+        n = len(prompt_ids)
+        if n == 0:
+            return None
+        with self._lock:
+            best_key, _best_len, on_disk = self._best_match_locked(
+                prompt_ids, n
+            )
+            if best_key is None:
+                return None
+            if not on_disk:
+                return self._entries[best_key]
+            row = self._disk.get(best_key)
+        if row is None:  # demote/promote race — honest miss
+            return None
+        path, length, nbytes, pages = row
+        return self._load_disk_file(path, best_key, length, nbytes, pages)
+
     def absorb(self, entries: Sequence[HostPrefixEntry]) -> List[tuple]:
         """Import a dying replica's snapshot in its LRU order (oldest first,
         the snapshot's own order), so under THIS tier's budget the source's
@@ -582,8 +655,21 @@ class HostKVTier:
         (host DRAM or disk) after the import — a later put may evict an
         earlier one, and an oversized entry is refused wherever it sits in
         the order, so only per-key presence makes the caller's
-        migrated/lost-pages split exact."""
+        migrated/lost-pages split exact.
+
+        Every entry's ``wire_version`` is checked BEFORE anything is
+        absorbed (all-or-nothing): a snapshot stamped by a different build
+        raises :class:`WireVersionError` instead of half-importing pages
+        whose byte layout this build would misread."""
         entries = list(entries)
+        for ent in entries:
+            ver = getattr(ent, "wire_version", KV_WIRE_VERSION)
+            if ver != KV_WIRE_VERSION:
+                raise WireVersionError(
+                    f"KV snapshot entry has wire_version {ver} "
+                    f"(this build supports {KV_WIRE_VERSION}); refusing to "
+                    "absorb pages written by a different build"
+                )
         for ent in entries:
             self.put(ent.key, ent.length, ent.k, ent.v)
         keys = [e.key for e in entries]

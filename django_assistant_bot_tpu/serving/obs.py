@@ -556,6 +556,10 @@ class EngineObs:
             "prompt_tokens": result.prompt_tokens,
             "completion_tokens": result.completion_tokens,
             "restarts": req.restarts,
+            # submission stamp in the engine's monotonic clock domain: only
+            # DIFFERENCES are meaningful, which is exactly what the workload
+            # trace export needs (relative arrival offsets — workload/capture.py)
+            "t_submit_s": round(t0, 6),
             "total_s": round(now - t0, 6),
             "spans": spans,
         }
@@ -822,6 +826,51 @@ def render_prometheus(registry: Any) -> str:
         lab = {"model": model}
         x.add("dabt_embed_queue_depth", "gauge", "embedding coalescer queue depth", emb._queue.qsize(), lab)
         x.add("dabt_embed_shed_total", "counter", "embedding requests shed", getattr(emb, "shed", 0), lab)
+    # cross-process fleet plane (serving/fleet.py, docs/FLEET.md): the server
+    # side (every serve process has a plane) and — when this process also
+    # fronts the fleet — the FleetRouter's dispatch counters
+    plane = getattr(registry, "fleet_plane", None)
+    if plane is not None:
+        try:
+            ps = plane.stats()
+        except Exception:  # pragma: no cover - defensive scrape path
+            ps = None
+        if ps:
+            flab = {"peer": ps.get("name", ""), "pool": ps.get("pool", "")}
+            x.add("dabt_fleet_pool_info", "gauge", "fleet pool role of this process (labels carry identity)", 1, flab)
+            x.add("dabt_fleet_gossip_seq", "counter", "prefix gossip delta-log sequence", ps.get("gossip_seq"), flab)
+            x.add("dabt_fleet_kv_puts_total", "counter", "KV wire entries absorbed from peers", ps.get("kv_puts"), flab)
+            x.add("dabt_fleet_kv_gets_total", "counter", "KV wire entries exported to peers", ps.get("kv_gets"), flab)
+            x.add("dabt_fleet_kv_put_rejects_total", "counter", "KV wire entries refused at absorb", ps.get("kv_put_rejects"), flab)
+            x.add("dabt_fleet_pages_in_total", "counter", "KV pages received over the fleet wire", ps.get("pages_in"), flab)
+            x.add("dabt_fleet_pages_out_total", "counter", "KV pages shipped over the fleet wire", ps.get("pages_out"), flab)
+            x.add("dabt_fleet_handoff_pushes_total", "counter", "prefill->decode handoff pushes", ps.get("pushes"), flab)
+            x.add("dabt_fleet_handoff_push_failures_total", "counter", "failed handoff pushes", ps.get("push_failures"), flab)
+            x.add("dabt_fleet_pool_rejects_total", "counter", "requests shed by the pool-role guard", ps.get("pool_rejects"), flab)
+            x.add("dabt_fleet_pool_bypasses_total", "counter", "forced requests past the pool-role guard", ps.get("pool_bypasses"), flab)
+    frouter = getattr(registry, "fleet_router", None)
+    if frouter is not None:
+        try:
+            fs = frouter.stats()
+        except Exception:  # pragma: no cover - defensive scrape path
+            fs = None
+        if fs:
+            flab = {"model": fs.get("model", "")}
+            x.add("dabt_fleet_peers_total", "gauge", "configured fleet peers", fs.get("peers_total"), flab)
+            x.add("dabt_fleet_peers_healthy", "gauge", "fleet peers passing health refresh", fs.get("peers_healthy"), flab)
+            x.add("dabt_fleet_reroutes_total", "counter", "token-less cross-peer re-routes", fs.get("reroutes"), flab)
+            x.add("dabt_fleet_rerouted_failed_total", "counter", "requests failed after exhausting re-routes", fs.get("rerouted_failed"), flab)
+            x.add("dabt_fleet_no_peer_available_total", "counter", "dispatches that found no live peer", fs.get("no_peer_available"), flab)
+            x.add("dabt_fleet_affinity_hits_total", "counter", "dispatches landing on a prefix-holder peer", fs.get("affinity_hits"), flab)
+            x.add("dabt_fleet_affinity_misses_total", "counter", "dispatches missing every holder peer", fs.get("affinity_misses"), flab)
+            x.add("dabt_fleet_prefix_pulls_total", "counter", "cross-process prefix pulls completed", fs.get("prefix_pulls"), flab)
+            x.add("dabt_fleet_pages_shipped_total", "counter", "KV pages shipped by pulls and handoffs", fs.get("pages_shipped"), flab)
+            x.add("dabt_fleet_handoffs_total", "counter", "disaggregated prefill->decode handoffs", fs.get("handoffs"), flab)
+            x.add("dabt_fleet_handoff_fallbacks_total", "counter", "handoffs that fell back to unified dispatch", fs.get("handoff_fallbacks"), flab)
+            for peer in fs.get("peers", []):
+                plab = {"model": fs.get("model", ""), "peer": peer["name"], "pool": peer.get("pool", "")}
+                x.add("dabt_fleet_peer_healthy", "gauge", "peer health from the last refresh", 1 if peer.get("healthy") else 0, plab)
+                x.add("dabt_fleet_peer_dispatched_total", "counter", "requests dispatched to this peer", peer.get("dispatched"), plab)
     _render_task_plane(x)
     return x.render()
 

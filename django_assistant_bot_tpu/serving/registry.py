@@ -229,6 +229,20 @@ class ModelSpec:
     autoscale_up_cooldown_s: float = 5.0
     autoscale_down_cooldown_s: float = 30.0
     autoscale_degrade_max_tokens: int = 256
+    # --- cross-process fleet plane (serving/fleet.py; docs/FLEET.md) --------
+    # pool role for disaggregated prefill/decode serving: "unified" (default,
+    # the single-pool behavior) | "prefill" (chunked prefill only — serves
+    # prefill_only handoff requests, pushes finished prefix pages to the
+    # decode pool over /fleet/kv/put) | "decode" (admits via warm-prefix
+    # restore; long prefill sheds with reason "pool_role" so the FleetRouter
+    # hands it off).  A prefill pool with kv_host_bytes=0 gets a default
+    # host-tier budget — finished prefixes need somewhere durable to live
+    # before they ship.
+    pool: str = "unified"
+    # decode-pool autoscaling signal: scale up when p95 inter-token latency
+    # burns past this (the decode pool's SLO is ITL, not TTFT — TTFT lives
+    # in the prefill pool); also read by unified fleets when set via config
+    autoscale_slo_itl_p95_s: float = 0.25
 
     @classmethod
     def from_dict(cls, name: str, d: Mapping[str, Any]) -> "ModelSpec":
@@ -376,6 +390,23 @@ class ModelRegistry:
                 f"model {name}: replica_devices is decoder-only (the "
                 "embedding coalescer runs one engine on the global mesh)"
             )
+        if spec.pool not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"model {name}: pool must be 'unified', 'prefill' or "
+                f"'decode' (got {spec.pool!r})"
+            )
+        if spec.pool != "unified" and spec.kind == "encoder":
+            raise ValueError(f"model {name}: pool is decoder-only")
+        if spec.pool == "prefill" and not spec.kv_host_bytes:
+            # finished prefill pages must survive in the host tier long
+            # enough to ship to the decode pool; a prefill pool with no
+            # tier would prefill into HBM and have nothing to hand off
+            logger.info(
+                "model %s: pool='prefill' with kv_host_bytes=0 — defaulting "
+                "the host KV tier to 256 MiB so handoff pages have a home",
+                name,
+            )
+            spec.kv_host_bytes = 1 << 28
         tokenizer_path = spec.path
         logger.info("loading model %r (%s, tiny=%s)", name, spec.kind, spec.tiny)
 
@@ -710,6 +741,13 @@ class ModelRegistry:
                             up_cooldown_s=spec.autoscale_up_cooldown_s,
                             down_cooldown_s=spec.autoscale_down_cooldown_s,
                             degrade_max_tokens=spec.autoscale_degrade_max_tokens,
+                            # decode pools scale on their OWN signal: p95
+                            # inter-token latency, not TTFT (docs/FLEET.md)
+                            up_itl_p95_s=(
+                                spec.autoscale_slo_itl_p95_s
+                                if spec.pool == "decode"
+                                else None
+                            ),
                         ),
                         name=f"{name}-autoscaler",
                     ).start()

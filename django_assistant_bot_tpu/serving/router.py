@@ -153,6 +153,21 @@ class FleetPrefixRegistry:
                 self._entries.pop(key, None)
                 self._index_drop_locked(key)
 
+    def apply_holding(
+        self, replica: str, key: tuple, length: int, tier: str
+    ) -> None:
+        """Directly assert one (replica, key, tier) holding — the fleet
+        plane's SNAPSHOT application path (serving/fleet.py): when a peer's
+        gossip delta log has been trimmed past the follower's cursor, the
+        follower drops that peer's holdings and re-applies the full holdings
+        snapshot through here instead of replaying events it never saw."""
+        if tier not in self._RANK or length <= 0:
+            return
+        with self._lock:
+            holders = self._entries.setdefault(key, {})
+            self._index_add_locked(key)
+            holders.setdefault(replica, set()).add(tier)
+
     def drop_replica(self, replica: str) -> int:
         """Forget every entry held only by ``replica`` (detach epilogue —
         migrated entries were already re-pointed by the target's absorb
@@ -419,21 +434,39 @@ class EngineRouter:
         self.pages_migrated = 0
         self.entries_migrated = 0
         self.detach_migrations = 0
+        # cross-process fleet plane tap (set_event_tap): forwarded a copy of
+        # every tier event so the gossip delta log sees what the registry saw
+        self._event_tap: Optional[Callable[..., None]] = None
         for rep in self.replicas:
             self._wire_replica(rep)
 
     def _wire_replica(self, rep: "_Replica") -> None:
         """Subscribe the fleet prefix registry to this replica's KV
         tier-transition events (no-op for engines without the hook — stub
-        engines in tests)."""
+        engines in tests).  When an event tap is attached
+        (:meth:`set_event_tap` — the cross-process fleet plane's gossip
+        log), every event ALSO forwards there after the registry update."""
         setter = getattr(rep.engine, "set_prefix_listener", None)
         if callable(setter):
             name = rep.name
-            setter(
-                lambda event, key, length, pages, _n=name: (
-                    self.prefix_registry.on_event(_n, event, key, length)
-                )
-            )
+
+            def _listener(event, key, length, pages, _n=name):
+                self.prefix_registry.on_event(_n, event, key, length)
+                tap = self._event_tap
+                if tap is not None:
+                    try:
+                        tap(_n, event, key, length)
+                    except Exception:
+                        logger.exception("router event tap failed (%s)", event)
+
+            setter(_listener)
+
+    def set_event_tap(self, fn: Optional[Callable[..., None]]) -> None:
+        """Attach ``fn(replica, event, key, length)`` to ride every KV
+        tier-transition event AFTER the local prefix-registry update — how
+        the cross-process fleet plane (serving/fleet.py) builds its gossip
+        delta log without stealing the engines' single prefix listener."""
+        self._event_tap = fn
 
     # engine.generate / generate_stream only touch self.tokenizer and
     # self.submit — both present here, so the router reuses them verbatim

@@ -29,6 +29,7 @@ from typing import Any, Mapping, Optional
 from aiohttp import web
 
 from .engine import EngineUnavailable
+from .kv_pool import WireVersionError
 from .obs import new_trace_id, render_prometheus
 from .registry import ModelRegistry
 from .scheduler import DeadlineExceeded, SchedulerRejected
@@ -37,6 +38,7 @@ logger = logging.getLogger(__name__)
 
 REGISTRY_KEY: web.AppKey[ModelRegistry] = web.AppKey("registry", ModelRegistry)
 DRAIN_KEY: web.AppKey[dict] = web.AppKey("drain_state", dict)
+FLEET_KEY: web.AppKey[Any] = web.AppKey("fleet_plane", object)
 
 MAX_MAX_TOKENS = 1 << 17  # sanity ceiling; engines clamp to max_seq_len anyway
 PRIORITIES = ("interactive", "background")
@@ -501,6 +503,215 @@ def create_app(
             headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
         )
 
+    # ---------------------------------------------------------- fleet plane
+    # The cross-process wire (serving/fleet.py, docs/FLEET.md).  A plane
+    # attached by the CLI (pool role, peer list) is reused; otherwise a
+    # default unified plane is created so every serve process speaks the
+    # fleet protocol out of the box.
+    plane = getattr(registry, "fleet_plane", None)
+    if plane is None:
+        from .fleet import FleetPlane
+
+        plane = FleetPlane(registry)
+        registry.fleet_plane = plane
+    app[FLEET_KEY] = plane
+
+    def _validate_prompt_ids(body: Mapping[str, Any]) -> list:
+        ids = body.get("prompt_ids")
+        if (
+            not isinstance(ids, list)
+            or not ids
+            or len(ids) > MAX_MAX_TOKENS
+            or not all(
+                isinstance(t, int) and not isinstance(t, bool) and t >= 0
+                for t in ids
+            )
+        ):
+            raise _BadRequest(
+                "prompt_ids must be a non-empty list of non-negative ints"
+            )
+        return ids
+
+    async def fleet_generate(request: web.Request) -> web.Response:
+        """Token-level dialog contract for FleetRouter peers: prompt_ids in,
+        token_ids + usage out (detokenized text rides along).  Honors the
+        same sampling/scheduling validation as /dialog/, plus the fleet
+        extras: prefix_len (warm-prefix restore), prefill_only + push_to
+        (the disaggregated handoff), and force (pool-role bypass)."""
+        rid = _request_id(request)
+        if drain["draining"]:
+            return _draining_response(rid)
+        try:
+            body = await request.json()
+            model = body["model"]
+            if not isinstance(model, str):
+                raise _BadRequest("model must be a string")
+            prompt_ids = _validate_prompt_ids(body)
+            temperature, top_p, max_tokens = _validate_sampling(body)
+            priority, tenant, deadline_s = _scheduling_fields(request, body)
+            json_format = bool(body.get("json_format", False))
+            prefill_only = bool(body.get("prefill_only", False))
+            force = bool(body.get("force", False))
+            push_to = body.get("push_to")
+            if push_to is not None and not isinstance(push_to, str):
+                raise _BadRequest("push_to must be a string URL")
+            prefix_len = body.get("prefix_len", 0)
+            if (
+                isinstance(prefix_len, bool)
+                or not isinstance(prefix_len, int)
+                or prefix_len < 0
+            ):
+                raise _BadRequest("prefix_len must be a non-negative integer")
+            trace_id = body.get("trace_id") or rid
+            if not isinstance(trace_id, str) or not _REQ_ID_RE.match(trace_id):
+                trace_id = rid
+        except _BadRequest as e:
+            return _error_response(str(e), 422, rid)
+        except Exception:
+            return _error_response("invalid request", 422, rid)
+        eng = registry.get_generator(model)
+        if eng is None:
+            return _error_response("Model is not supported", 400, rid)
+        rej = plane.admission_guard(
+            model,
+            eng,
+            prompt_ids,
+            prefix_len,
+            prefill_only=prefill_only,
+            force=force,
+        )
+        if rej is not None:
+            return _shed_response(rej, rid)
+        if prefill_only:
+            # the handoff contract: full-prefix chunked prefill, one token
+            # emitted, background class — the scheduler tag that keeps
+            # handoff traffic distinct from interactive decode
+            max_tokens = 1
+            temperature = 0.0
+            priority = "background"
+            prefix_len = max(prefix_len, len(prompt_ids) - 1)
+        try:
+            fut = eng.submit(
+                prompt_ids,
+                max_tokens=max_tokens,
+                temperature=temperature,
+                top_p=top_p,
+                json_format=json_format,
+                prefix_len=prefix_len,
+                priority=priority,
+                tenant=tenant,
+                deadline_s=deadline_s,
+                trace_id=trace_id,
+            )
+            result = await asyncio.wrap_future(fut)
+        except SchedulerRejected as e:
+            return _shed_response(e, rid)
+        except EngineUnavailable as e:
+            return _unavailable_response(e, rid)
+        except DeadlineExceeded as e:
+            return _error_response(str(e), 504, rid)
+        except ValueError as e:
+            return _error_response(str(e), 422, rid)
+        except Exception as e:
+            logger.exception("fleet generate failed")
+            return _error_response(str(e), 500, rid)
+        resp = {
+            "token_ids": [int(t) for t in result.token_ids],
+            "result": result.text,
+            "usage": _usage(model, result),
+            "length_limited": result.length_limited,
+            "request_id": rid,
+            "trace_id": trace_id,
+        }
+        if prefill_only:
+            # export + push the finished prefix pages off the event loop
+            resp["handoff"] = await asyncio.get_running_loop().run_in_executor(
+                None, plane.handoff_export, model, prompt_ids, prefix_len, push_to
+            )
+        return web.json_response(resp, headers={"X-Request-Id": rid})
+
+    async def fleet_healthz(request: web.Request) -> web.Response:
+        check = request.query.get("peers", "1") not in ("0", "false")
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: plane.healthz(check_peers=check)
+        )
+        if drain["draining"]:
+            body["status"] = "draining"
+        return web.json_response(body)
+
+    async def fleet_prefix(request: web.Request) -> web.Response:
+        try:
+            since = int(request.query.get("since", "0"))
+        except ValueError:
+            return web.json_response(
+                {"detail": "since must be an integer"}, status=422
+            )
+        return web.json_response(plane.prefix_events(since))
+
+    async def fleet_kv_get(request: web.Request) -> web.Response:
+        # deliberately NOT drain-gated: page migration off a draining peer
+        # is exactly when this endpoint matters
+        try:
+            body = await request.json()
+            model = body["model"]
+            if not isinstance(model, str):
+                raise _BadRequest("model must be a string")
+            prompt_ids = _validate_prompt_ids(body)
+            prefix_len = body.get("prefix_len", 0)
+            if (
+                isinstance(prefix_len, bool)
+                or not isinstance(prefix_len, int)
+                or prefix_len < 0
+            ):
+                raise _BadRequest("prefix_len must be a non-negative integer")
+        except _BadRequest as e:
+            return web.json_response({"detail": str(e)}, status=422)
+        except Exception:
+            return web.json_response({"detail": "invalid request"}, status=422)
+        try:
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, plane.kv_get_wire, model, prompt_ids, prefix_len
+            )
+        except KeyError:
+            return web.json_response(
+                {"detail": "Model is not supported"}, status=400
+            )
+        except Exception as e:
+            logger.exception("fleet kv get failed")
+            return web.json_response({"detail": str(e)}, status=500)
+        if data is None:
+            return web.json_response({"detail": "no matching prefix"}, status=404)
+        return web.Response(
+            body=data, content_type="application/octet-stream"
+        )
+
+    async def fleet_kv_put(request: web.Request) -> web.Response:
+        model = request.query.get("model", "")
+        data = await request.read()
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, plane.kv_put_wire, model, data
+            )
+        except WireVersionError as e:
+            # cross-build peer: fail loudly, never absorb pages we cannot
+            # prove we understand (the versioned-wire contract)
+            return web.json_response({"detail": str(e)}, status=409)
+        except KeyError:
+            return web.json_response(
+                {"detail": "Model is not supported"}, status=400
+            )
+        except ValueError as e:
+            return web.json_response({"detail": str(e)}, status=422)
+        except Exception as e:
+            logger.exception("fleet kv put failed")
+            return web.json_response({"detail": str(e)}, status=500)
+        return web.json_response(out)
+
+    async def traces(request: web.Request) -> web.Response:
+        """Obs trace rings across every engine, flattened — the surface the
+        trace-export CLI replays through workload/ (cli/trace_export.py)."""
+        return web.json_response({"traces": plane.collect_traces()})
+
     app.router.add_post("/embeddings/", embeddings)
     app.router.add_post("/embeddings", embeddings)
     app.router.add_post("/dialog/", dialog)
@@ -508,6 +719,12 @@ def create_app(
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/models", models)
+    app.router.add_post("/fleet/generate", fleet_generate)
+    app.router.add_get("/fleet/healthz", fleet_healthz)
+    app.router.add_get("/fleet/prefix", fleet_prefix)
+    app.router.add_post("/fleet/kv/get", fleet_kv_get)
+    app.router.add_post("/fleet/kv/put", fleet_kv_put)
+    app.router.add_get("/traces", traces)
 
     async def on_shutdown(app):
         # SIGTERM graceful drain: web.run_app's signal handling triggers

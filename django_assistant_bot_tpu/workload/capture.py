@@ -1,0 +1,122 @@
+"""Capture: live serving telemetry -> replayable workload traces.
+
+The workload plane (:mod:`.generator`) replays SYNTHETIC traces; this module
+closes the loop from the other side — it converts what the fleet actually
+served (the obs plane's per-request trace ring, ``GET /traces`` /
+``EngineObs.traces()``, or a flight-recorder dump) into the same
+:class:`~.generator.WorkloadRequest` JSONL, so yesterday's production traffic
+replays through ``workload.replay`` against a candidate config.
+
+What survives the conversion and what doesn't:
+
+- **arrival times** — relative offsets from each trace's monotonic
+  ``t_submit_s`` stamp (only differences are meaningful in that clock
+  domain; the earliest request becomes ``t_s = 0``);
+- **shape** — tenant, priority class, prompt/completion token counts
+  (completion becomes the replayed ``max_tokens``: the budget that traffic
+  actually used);
+- **not content** — prompts are re-synthesized at replay time from a seed
+  derived stably from the trace_id (sha256, process-independent), exactly
+  like a generated trace.  Prefix relationships between requests are not
+  recorded by the obs ring, so ``prefix_len`` exports as 0 — captured
+  traces measure admission/latency shape, not prefix-affinity hit rates.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Iterable, List, Tuple
+
+from .generator import WorkloadRequest
+
+# prompt length at or past which a captured request is classed "longctx"
+# (matches the generator's default longctx_prompt_tokens floor)
+LONGCTX_PROMPT_TOKENS = 96
+
+
+def _seed_for(trace_id: str) -> int:
+    """Stable 31-bit seed from a trace id — same id, same replay prompt,
+    across processes (hash() is salted per process; sha256 is not)."""
+    digest = hashlib.sha256(str(trace_id).encode("utf-8")).digest()
+    return int.from_bytes(digest[:4], "big") & ((1 << 31) - 1)
+
+
+def requests_from_traces(
+    traces: Iterable[dict],
+    *,
+    longctx_threshold: int = LONGCTX_PROMPT_TOKENS,
+) -> Tuple[List[WorkloadRequest], int]:
+    """Obs trace dicts -> ``(requests, skipped)``.  Rows missing the fields
+    a replay needs (``t_submit_s`` and a positive ``prompt_tokens``) are
+    skipped and counted, never guessed at."""
+    rows = []
+    skipped = 0
+    for tr in traces:
+        try:
+            t_submit = float(tr["t_submit_s"])
+            prompt_tokens = int(tr["prompt_tokens"])
+            completion = int(tr.get("completion_tokens", 0))
+        except (KeyError, TypeError, ValueError):
+            skipped += 1
+            continue
+        if prompt_tokens <= 0:
+            skipped += 1
+            continue
+        rows.append((t_submit, tr, prompt_tokens, completion))
+    if not rows:
+        return [], skipped
+    rows.sort(key=lambda r: r[0])
+    t0 = rows[0][0]
+    out: List[WorkloadRequest] = []
+    for t_submit, tr, prompt_tokens, completion in rows:
+        out.append(
+            WorkloadRequest(
+                t_s=round(t_submit - t0, 6),
+                tenant=str(tr.get("tenant", "default")),
+                priority=(
+                    tr["priority"]
+                    if tr.get("priority") in ("interactive", "background")
+                    else "interactive"
+                ),
+                kind=(
+                    "longctx"
+                    if prompt_tokens >= longctx_threshold
+                    else "chat"
+                ),
+                prompt_tokens=prompt_tokens,
+                max_tokens=max(1, completion),
+                prefix_len=0,
+                seed=_seed_for(tr.get("trace_id", "")),
+            )
+        )
+    return out, skipped
+
+
+def load_flight_dump(path: str) -> List[dict]:
+    """Best-effort trace rows out of a flight-recorder dump (JSON with a
+    top-level ``events``/``traces`` list, or JSONL of records).  Only rows
+    that carry the obs-trace fields convert; the rest count as skipped in
+    :func:`requests_from_traces`."""
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        rows = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        return rows
+    if isinstance(doc, list):
+        return doc
+    if isinstance(doc, dict):
+        for key in ("traces", "events"):
+            if isinstance(doc.get(key), list):
+                return doc[key]
+    return []

@@ -28,6 +28,18 @@ except AttributeError:
     # (read at backend init) provides the 8-device CPU mesh on its own
     pass
 
+# The suite's wall-clock is dominated by XLA compiles of the SAME tiny shapes
+# repeated across modules and runs; the persistent compile cache (the same
+# wiring bench.py and a production `serve` boot use) makes warm runs fit the
+# tier-1 time budget.  Tests assert on numerics and behavior, never on
+# compile-time, so cached executables change nothing observable; set
+# DABT_COMPILE_CACHE_OFF=1 for a cold-compile measurement run.
+from django_assistant_bot_tpu.utils.compile_cache import (  # noqa: E402
+    enable_persistent_compile_cache,
+)
+
+enable_persistent_compile_cache()
+
 import pytest  # noqa: E402
 
 

@@ -424,9 +424,11 @@ def test_lost_lease_completion_is_discarded():
     """A worker that lost its lease mid-run must not clobber the reclaiming
     owner's state with its late completion (ownership-guarded transitions)."""
     gate = threading.Event()
+    started = threading.Event()
 
     @task(queue="steal", name="steal.gated")
     def gated_task():
+        started.set()
         gate.wait(10)
         return "late"
 
@@ -435,12 +437,12 @@ def test_lost_lease_completion_is_discarded():
     th = threading.Thread(target=w.run_one)
     th.start()
     try:
-        deadline = time.time() + 5.0
-        while time.time() < deadline:
-            rec.refresh()
-            if rec.status == "running":
-                break
-            time.sleep(0.02)
+        # wait for the task BODY, not just status=="running": the worker does an
+        # ownership-guarded attempts write between claim and execution, and a
+        # thief installed inside that window is counted leases_lost (the worker
+        # never runs the body), not completions_discarded
+        assert started.wait(5.0)
+        rec.refresh()
         assert rec.status == "running"
         # simulate a reclaim: another worker now owns the row
         TaskRecord.objects.filter(id=rec.id).update(lease_owner="thief")
