@@ -1036,6 +1036,116 @@ print(json.dumps(bench._knn_scale_body({n_vec}, {dim}, {nq})))
 """
 
 
+def bench_ann() -> dict:
+    """Config 4c (SMALL): the IVF-PQ body at smoke geometry, same code path
+    as the real run's 1M subprocess."""
+    return _ann_scale_body(20_000, _encoder_cfg().hidden_size, KNN_QUERIES)
+
+
+def _ann_scale_body(n_vec: int, dim: int, n_queries: int) -> dict:
+    """Config 4c: ANN (IVF-PQ, storage/ann.py) vs exact KNN on the SAME
+    corpus, query batch, and k — the recall-accounted speedup.
+
+    Every latency key is emitted alongside the recall the index was giving at
+    that moment (a latency number without its recall is meaningless for an
+    approximate index), plus build time, append latency, code bytes/vector,
+    and the recall-vs-nprobe curve an operator tunes against (docs/ANN.md).
+    The corpus is seeded CLUSTERED vectors — the geometry real embedding
+    corpora have and the one IVF pruning is honest on; uniform-random vectors
+    would understate recall and overstate pruning wins.
+    """
+    import numpy as np
+
+    from django_assistant_bot_tpu.storage.ann import ANNIndex, make_clustered
+    from django_assistant_bot_tpu.storage.knn import VectorIndex
+
+    out: dict = {}
+    rng = np.random.default_rng(17)
+    rows = make_clustered(n_vec, dim, n_clusters=max(64, n_vec // 4000), seed=17)
+
+    index = ANNIndex(dim, seed=17)
+    t0 = time.perf_counter()
+    index.add(range(n_vec), rows)
+    index.train()
+    # warmup blocks until code blocks + rerank tier are resident and the
+    # query buckets are compiled — build_s is the full cost to serveable
+    index.warmup(ks=(16,), q_rows=(8, 128))
+    out["ann_build_s"] = round(time.perf_counter() - t0, 3)
+    st = index.stats()
+    out["ann_vectors"] = n_vec
+    out["ann_nlist"] = st["nlist"]
+    out["ann_nprobe_default"] = st["nprobe"]
+    out["ann_codes_bytes_per_vec"] = round(st["codes_bytes_per_vector"], 2)
+
+    # query batch: perturbed stored rows — the RAG near-duplicate shape,
+    # matching what probe_recall scores so latency and recall line up
+    qn = 128
+    take = rng.choice(n_vec, size=qn, replace=False)
+    q = rows[take] + 0.05 * rng.standard_normal((qn, dim)).astype(np.float32)
+
+    rec = index.probe_recall(n_queries=64, k=10, seed=17)
+    out["ann_recall_at10"] = round(rec["recall_at_k"], 4)
+    index.search_batch(q, k=10)  # warm this exact shape
+    t0 = time.perf_counter()
+    index.search_batch(q, k=10)
+    out["ann_query_batched_ms_per_query"] = round(
+        (time.perf_counter() - t0) / qn * 1e3, 3
+    )
+
+    # the operator's tuning curve: recall AND latency per nprobe point
+    curve: dict = {}
+    p = 1
+    while p <= min(64, index.nlist):
+        r = index.probe_recall(n_queries=64, k=10, nprobe=p, seed=17)
+        index.search_batch(q, k=10, nprobe=p)  # warm
+        t0 = time.perf_counter()
+        index.search_batch(q, k=10, nprobe=p)
+        curve[str(p)] = {
+            "recall_at10": round(r["recall_at_k"], 4),
+            "ms_per_query": round((time.perf_counter() - t0) / qn * 1e3, 3),
+        }
+        p *= 4
+    out["ann_recall_vs_nprobe"] = curve
+
+    # exact baseline: same corpus, same query batch, same k — recall 1.0 by
+    # construction (brute force IS the ground truth probe_recall scores against)
+    exact = VectorIndex(dim)
+    exact.add(range(n_vec), rows)
+    exact.warmup(ks=(16,), q_rows=(8, 128))
+    exact.search_batch(q, k=10)
+    t0 = time.perf_counter()
+    exact.search_batch(q, k=10)
+    out["ann_exact_query_batched_ms_per_query"] = round(
+        (time.perf_counter() - t0) / qn * 1e3, 3
+    )
+    out["ann_exact_recall_at10"] = 1.0
+    out["ann_speedup_vs_exact"] = round(
+        out["ann_exact_query_batched_ms_per_query"]
+        / max(1e-9, out["ann_query_batched_ms_per_query"]),
+        2,
+    )
+    del exact
+
+    # live ingestion: 10k appended WITHOUT retrain, then recall re-probed —
+    # the append latency key ships with the recall the index has after it
+    extra = make_clustered(10_000, dim, seed=23)
+    t0 = time.perf_counter()
+    index.add(range(n_vec, n_vec + 10_000), extra)
+    index.search(extra[0], k=10)  # barrier: appended rows are searchable
+    out["ann_append_10k_s"] = round(time.perf_counter() - t0, 3)
+    rec2 = index.probe_recall(n_queries=64, k=10, seed=29)
+    out["ann_recall_at10_post_append"] = round(rec2["recall_at_k"], 4)
+    return out
+
+
+_ANN_SNIPPET = """
+import json
+import bench
+
+print(json.dumps(bench._ann_scale_body({n_vec}, {dim}, {nq})))
+"""
+
+
 def bench_core() -> dict:
     """Configs 1-3: embedding + bf16 decode + RAG, one engine build.  ONE body
     serves both the SMALL in-process run and the real run's subprocess — the
@@ -4084,6 +4194,13 @@ _COMPACT_KEYS = (
     "knn_build_cold_s",
     "knn_build_warm_s",
     "knn_query_batched_ms_per_query",
+    "ann_recall_at10",
+    "ann_query_batched_ms_per_query",
+    "ann_exact_query_batched_ms_per_query",
+    "ann_speedup_vs_exact",
+    "ann_build_s",
+    "ann_append_10k_s",
+    "ann_recall_at10_post_append",
     "ingest_docs_per_s_per_chip",
     "real_ckpt_decode_tokens_per_s",
     "longctx_prefill_32768_tokens_per_s",
@@ -4248,6 +4365,7 @@ def main() -> None:
         finally:
             moe_eng.stop()
         extras.update(bench_ingestion())
+        extras.update(bench_ann())
         extras.update(bench_overload())
         extras.update(bench_chaos())
         extras.update(bench_router())
@@ -4369,6 +4487,14 @@ def main() -> None:
             n_vec=KNN_VECTORS, dim=ecfg.hidden_size, nq=KNN_QUERIES
         ),
         cap_s=700,
+    )
+    # 4') config 4c: IVF-PQ ANN vs exact at the SAME 1M geometry — the
+    #     recall-accounted speedup, recall-vs-nprobe curve, build/append cost
+    #     (storage/ann.py + docs/ANN.md evidence)
+    run(
+        "ann_scale",
+        _ANN_SNIPPET.format(n_vec=KNN_VECTORS, dim=ecfg.hidden_size, nq=KNN_QUERIES),
+        cap_s=900,
     )
     # 5) config 5: MoE — true Mixtral per-layer expert shapes, deepest that
     #    fits first (8L ~ 11.5 GB int8 experts, measured 1057 tok/s), then 4L,

@@ -1,0 +1,96 @@
+"""ANN index operator CLI (docs/ANN.md).
+
+    dabt ann train                      # build + train IVF-PQ over a corpus
+    dabt ann stats                      # geometry / drift / recall snapshot
+    dabt ann probe-recall --curve       # recall@k vs nprobe sweep
+
+Targets a knowledge-plane model (``--model questions|sentences``) or, with
+``--synthetic N``, a seeded clustered corpus — the same generator the tests
+and bench use, so recall numbers line up across all three.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def add_parser(sub):
+    p = sub.add_parser("ann", help="train/inspect the IVF-PQ ANN index")
+    p.add_argument("action", choices=("train", "stats", "probe-recall"))
+    p.add_argument(
+        "--model", choices=("questions", "sentences"), default="questions",
+        help="knowledge-plane corpus to index",
+    )
+    p.add_argument("--field", default="embedding")
+    p.add_argument(
+        "--synthetic", type=int, default=0, metavar="N",
+        help="use a seeded synthetic clustered corpus of N rows instead of the DB",
+    )
+    p.add_argument("--dim", type=int, default=256, help="synthetic corpus dim")
+    p.add_argument("--nlist", type=int, default=0, help="IVF lists (0 = auto)")
+    p.add_argument("--m", type=int, default=0, help="PQ subquantizers (0 = auto)")
+    p.add_argument("--nprobe", type=int, default=0, help="lists probed (0 = auto)")
+    p.add_argument("--iters", type=int, default=4, help="k-means epochs at train")
+    p.add_argument("--k", type=int, default=10, help="probe-recall: recall@k")
+    p.add_argument("--queries", type=int, default=64, help="probe-recall: query count")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--curve", action="store_true",
+        help="probe-recall: sweep nprobe in 1,2,4,... up to nlist",
+    )
+    return p
+
+
+def _build(args):
+    from ..storage.ann import ANNIndex, make_clustered
+
+    t0 = time.perf_counter()
+    if args.synthetic:
+        rows = make_clustered(args.synthetic, args.dim, seed=args.seed)
+        index = ANNIndex(args.dim, nlist=args.nlist, m=args.m, nprobe=args.nprobe, seed=args.seed)
+        index.add(range(args.synthetic), rows)
+        index.train(nlist=args.nlist, iters=args.iters, seed=args.seed)
+    else:
+        from ..storage.models import Question, Sentence
+
+        model_cls = Question if args.model == "questions" else Sentence
+        index = ANNIndex.from_model(
+            model_cls, field=args.field,
+            nlist=args.nlist, m=args.m, nprobe=args.nprobe,
+        )
+    return index, time.perf_counter() - t0
+
+
+def run(args) -> int:
+    index, build_s = _build(args)
+    if not len(index):
+        print("(corpus empty — nothing to index)")
+        return 1
+
+    if args.action == "probe-recall":
+        probes = [None]
+        if args.curve:
+            probes, p = [], 1
+            while p < index.nlist:
+                probes.append(p)
+                p *= 2
+            probes.append(index.nlist)
+        for nprobe in probes:
+            t0 = time.perf_counter()
+            r = index.probe_recall(
+                n_queries=args.queries, k=args.k, nprobe=nprobe, seed=args.seed
+            )
+            ms = (time.perf_counter() - t0) * 1000 / max(1, args.queries)
+            print(
+                f"nprobe={r['nprobe']:5d}  recall@{r['k']}={r['recall_at_k']:.4f}  "
+                f"{ms:8.3f} ms/query"
+            )
+        return 0
+
+    # train and stats both end in the snapshot; train adds the build time
+    st = index.stats()
+    if args.action == "train":
+        st["build_s"] = round(build_s, 3)
+    print(json.dumps(st, indent=2, sort_keys=True, default=str))
+    return 0

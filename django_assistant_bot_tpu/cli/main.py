@@ -13,6 +13,7 @@ COMMANDS = {
     "api": ".api",
     "chat": ".chat",
     "search": ".search",
+    "ann": ".ann",
     "emb_test": ".emb_test",
     "load_csv": ".load_csv",
     "queue": ".queue_cmd",

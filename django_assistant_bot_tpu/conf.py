@@ -88,6 +88,16 @@ class Settings:
         # with an all-gather top-k merge.  Off by default — single-chip
         # deployments replicate-free either way.
         self.KNN_MESH: bool = str(_env("DABT_KNN_MESH", "0")) in ("1", "true", "True")
+        # ANN retrieval plane (storage/ann.py): corpora at or above
+        # ANN_THRESHOLD rows build an IVF-PQ index instead of the exact one.
+        # ANN=0 is the one-flag rollback to exact search everywhere.
+        self.ANN: bool = str(_env("ANN", "1")) in ("1", "true", "True")
+        self.ANN_THRESHOLD: int = int(_env("ANN_THRESHOLD", 200_000))
+        # 0 = auto (~2*sqrt(n) lists; nlist/64 probes; dim/8 subquantizers)
+        self.ANN_NLIST: int = int(_env("ANN_NLIST", 0))
+        self.ANN_M: int = int(_env("ANN_M", 0))
+        self.ANN_NPROBE: int = int(_env("ANN_NPROBE", 0))
+        self.ANN_RERANK: int = int(_env("ANN_RERANK", 256))
         # media plane (reference: settings.MEDIA_URL + MediaURLMiddleware,
         # assistant/assistant/middleware.py:4-15)
         self.MEDIA_URL: str = _env("MEDIA_URL", "/media/")

@@ -5,10 +5,16 @@ surface (`get_embedding`, `embedding_search`, `embedding_search_questions/
 sentences/documents`) and the same doc-level aggregation
 ``1 - mean(top max_scores_n distances)``, but the ANN substrate is the
 MXU-resident exact index (:class:`~django_assistant_bot_tpu.storage.knn.VectorIndex`)
-instead of pgvector HNSW inside Postgres.
+— or, at/above ``DABT_ANN_THRESHOLD`` rows, the IVF-PQ
+:class:`~django_assistant_bot_tpu.storage.ann.ANNIndex` — instead of pgvector
+HNSW inside Postgres.
 """
 
-from .index_registry import get_index, invalidate_index  # noqa: F401
+from .index_registry import (  # noqa: F401
+    get_index,
+    invalidate_index,
+    rag_plane_stats,
+)
 from .services.search_service import (  # noqa: F401
     embedding_search,
     embedding_search_documents,

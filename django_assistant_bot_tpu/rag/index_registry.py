@@ -9,24 +9,33 @@ an ingestion worker's :func:`invalidate_index` bumps a row every process
 observes on its next :func:`get_index`, so no process serves stale KNN results.
 The rebuild is one table scan + one host->HBM transfer, amortised across every
 subsequent query; the generation check is a single PK lookup.
+
+Index-type routing: corpora at or above ``settings.ANN_THRESHOLD`` non-null
+rows build an IVF-PQ :class:`~..storage.ann.ANNIndex` (approximate shortlist +
+exact rerank) instead of the exact :class:`~..storage.knn.VectorIndex`;
+``DABT_ANN=0`` is the one-flag rollback to exact search everywhere.  Both
+classes share the search surface, so callers never branch.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, Tuple, Type
+from typing import Dict, Tuple, Type, Union
 
 from ..conf import settings
+from ..storage.ann import ANNIndex
 from ..storage.db import get_database
 from ..storage.knn import VectorIndex
 from ..storage.orm import Model
+
+AnyIndex = Union[VectorIndex, ANNIndex]
 
 _SCHEMA = (
     "CREATE TABLE IF NOT EXISTS vector_index_generation ("
     "key TEXT PRIMARY KEY, generation INTEGER NOT NULL)"
 )
 
-_indexes: Dict[Tuple[str, str], VectorIndex] = {}
+_indexes: Dict[Tuple[str, str], AnyIndex] = {}
 _built_generation: Dict[Tuple[str, str], int] = {}  # generation each index was built at
 _lock = threading.Lock()
 # single-flight per key: a rebuild stages + warms a full corpus copy into HBM,
@@ -45,7 +54,30 @@ def _db_generation(key: str) -> int:
     return int(rows[0]["generation"]) if rows else 0
 
 
-def get_index(model_cls: Type[Model], field: str = "embedding") -> VectorIndex:
+def _corpus_rows(model_cls: Type[Model], field: str) -> int:
+    """Non-null vector count — the routing signal, one COUNT(*) per rebuild."""
+    return model_cls.objects.exclude(**{f"{field}__isnull": True}).count()
+
+
+def _build_index(model_cls: Type[Model], field: str, mesh) -> AnyIndex:
+    """Route by corpus size: exact below the ANN threshold, IVF-PQ at/above it
+    (train + warmup happen here, in the thread that caused the rebuild)."""
+    use_ann = bool(getattr(settings, "ANN", True))
+    threshold = int(getattr(settings, "ANN_THRESHOLD", 200_000))
+    if use_ann and _corpus_rows(model_cls, field) >= threshold:
+        return ANNIndex.from_model(
+            model_cls,
+            field=field,
+            mesh=mesh,
+            nlist=int(getattr(settings, "ANN_NLIST", 0)),
+            m=int(getattr(settings, "ANN_M", 0)),
+            nprobe=int(getattr(settings, "ANN_NPROBE", 0)),
+            rerank_depth=int(getattr(settings, "ANN_RERANK", 256)),
+        ).warmup()
+    return VectorIndex.from_model(model_cls, field=field, mesh=mesh).warmup()
+
+
+def get_index(model_cls: Type[Model], field: str = "embedding") -> AnyIndex:
     key = (model_cls.__name__, field)
     gen = _db_generation(f"{key[0]}.{key[1]}")
     with _lock:
@@ -73,7 +105,7 @@ def get_index(model_cls: Type[Model], field: str = "embedding") -> VectorIndex:
                 from ..parallel import get_mesh
 
                 mesh = get_mesh()
-            fresh = VectorIndex.from_model(model_cls, field=field, mesh=mesh).warmup()
+            fresh = _build_index(model_cls, field, mesh)
             with _lock:
                 # only adopt if no invalidation landed during the rebuild;
                 # otherwise keep the stale marker so the next caller rebuilds
@@ -103,3 +135,21 @@ def reset_indexes() -> None:
     with _lock:
         _indexes.clear()
         _built_generation.clear()
+
+
+def rag_plane_stats() -> Dict[str, dict]:
+    """Snapshot of every cached index for /metrics and /healthz.
+
+    ANN indexes expose their full stats() dict; exact indexes report kind +
+    rows so the rag block always says which engine served which corpus."""
+    with _lock:
+        items = list(_indexes.items())
+    out: Dict[str, dict] = {}
+    for (model, field), index in items:
+        name = f"{model}.{field}"
+        stats_fn = getattr(index, "stats", None)
+        if callable(stats_fn):
+            out[name] = stats_fn()
+        else:
+            out[name] = {"kind": "exact", "rows": len(index)}
+    return {"indexes": out}

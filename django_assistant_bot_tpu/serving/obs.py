@@ -43,6 +43,7 @@ import json
 import logging
 import math
 import os
+import sys
 import tempfile
 import threading
 import time
@@ -632,6 +633,62 @@ def _render_task_plane(x: "_Exposition") -> None:
     x.add("dabt_queue_inbound_deduped_total", "counter", "duplicate platform update_ids not re-enqueued", d.get("inbound_updates_deduped"))
 
 
+# RAG-plane stats provider (rag/index_registry.rag_plane_stats): same hook
+# discipline as the task plane — the vector indexes live in whatever process
+# built them (API server or ingestion worker), not in the engine registry.
+# When no provider is set, fall back to the registry module *if it is already
+# imported* — serve-only processes that never touched the rag plane pay
+# nothing on a scrape.
+_rag_plane_provider: Optional[Callable[[], Dict[str, Any]]] = None
+
+
+def set_rag_plane_provider(fn: Optional[Callable[[], Dict[str, Any]]]) -> None:
+    global _rag_plane_provider
+    _rag_plane_provider = fn
+
+
+def rag_plane_snapshot() -> Dict[str, Any]:
+    """Provider output (or the lazily-discovered registry's), never raising —
+    shared by /metrics rendering and the /healthz ``rag`` block."""
+    prov = _rag_plane_provider
+    if prov is None:
+        mod = sys.modules.get("django_assistant_bot_tpu.rag.index_registry")
+        prov = getattr(mod, "rag_plane_stats", None)
+    if prov is None:
+        return {}
+    try:
+        return prov() or {}
+    except Exception:
+        logger.warning("rag-plane stats provider failed", exc_info=True)
+        return {}
+
+
+def _render_rag_plane(x: "_Exposition") -> None:
+    snap = rag_plane_snapshot()
+    for name, st in sorted((snap.get("indexes") or {}).items()):
+        lab = {"index": name}
+        x.add("dabt_rag_index_rows", "gauge", "live vectors in this index", st.get("rows"), lab)
+        if st.get("kind") != "ivfpq":
+            continue
+        x.add("dabt_ann_trained", "gauge", "IVF-PQ structure trained (0=exact fallback)", 1 if st.get("trained") else 0, lab)
+        x.add("dabt_ann_exact_fallback", "gauge", "searches currently served by the exact tier", 1 if st.get("exact_fallback") else 0, lab)
+        x.add("dabt_ann_nlist", "gauge", "IVF coarse lists", st.get("nlist"), lab)
+        x.add("dabt_ann_nprobe", "gauge", "default lists probed per query", st.get("nprobe"), lab)
+        x.add("dabt_ann_codes_bytes", "gauge", "device bytes held by PQ code blocks", st.get("codes_bytes"), lab)
+        x.add("dabt_ann_codes_bytes_per_vector", "gauge", "PQ code bytes per stored vector", st.get("codes_bytes_per_vector"), lab)
+        x.add("dabt_ann_rerank_depth", "gauge", "exact-rerank shortlist depth", st.get("rerank_depth"), lab)
+        x.add("dabt_ann_tombstones", "gauge", "removed-but-uncompacted slots", st.get("tombstones"), lab)
+        x.add("dabt_ann_pending_appends", "gauge", "rows appended since the last train/compact", st.get("pending_appends"), lab)
+        x.add("dabt_ann_drift_frac", "gauge", "fraction of sampled rows nearer a foreign centroid", st.get("drift_frac"), lab)
+        x.add("dabt_ann_retrain_advised", "gauge", "drift gauge past the advisory threshold", 1 if st.get("retrain_advised") else 0, lab)
+        x.add("dabt_ann_searches_total", "counter", "batched searches served", st.get("searches"), lab)
+        x.add("dabt_ann_compactions_total", "counter", "tombstone compactions", st.get("compactions"), lab)
+        x.add("dabt_ann_retrains_total", "counter", "full retrains", st.get("retrains"), lab)
+        lr = st.get("last_recall") or {}
+        if lr.get("recall_at_k") is not None:
+            x.add("dabt_ann_last_recall", "gauge", "recall@k from the last probe_recall()", lr.get("recall_at_k"), lab)
+
+
 def _engine_rows(registry: Any) -> List[Tuple[str, str, Any, Optional[Any]]]:
     """(model, replica, engine, router-or-None) rows for every generator.
 
@@ -872,6 +929,7 @@ def render_prometheus(registry: Any) -> str:
                 x.add("dabt_fleet_peer_healthy", "gauge", "peer health from the last refresh", 1 if peer.get("healthy") else 0, plab)
                 x.add("dabt_fleet_peer_dispatched_total", "counter", "requests dispatched to this peer", peer.get("dispatched"), plab)
     _render_task_plane(x)
+    _render_rag_plane(x)
     return x.render()
 
 

@@ -30,7 +30,7 @@ from aiohttp import web
 
 from .engine import EngineUnavailable
 from .kv_pool import WireVersionError
-from .obs import new_trace_id, render_prometheus
+from .obs import new_trace_id, rag_plane_snapshot, render_prometheus
 from .registry import ModelRegistry
 from .scheduler import DeadlineExceeded, SchedulerRejected
 
@@ -461,22 +461,26 @@ def create_app(
                 if not sv.get("healthy", True) and status == "ok":
                     status = "degraded"
             generators[name] = g
-        return web.json_response(
-            {
-                "status": status,
-                "models": sorted(registry.specs),
-                "generators": generators,
-                "embedders": {
-                    name: {
-                        "queue_depth": eng._queue.qsize(),
-                        "max_queue": getattr(eng, "max_queue", 0),
-                        "shed": getattr(eng, "shed", 0),
-                        "dropped_cancelled": getattr(eng, "dropped_cancelled", 0),
-                    }
-                    for name, eng in registry.embedders.items()
-                },
-            }
-        )
+        payload = {
+            "status": status,
+            "models": sorted(registry.specs),
+            "generators": generators,
+            "embedders": {
+                name: {
+                    "queue_depth": eng._queue.qsize(),
+                    "max_queue": getattr(eng, "max_queue", 0),
+                    "shed": getattr(eng, "shed", 0),
+                    "dropped_cancelled": getattr(eng, "dropped_cancelled", 0),
+                }
+                for name, eng in registry.embedders.items()
+            },
+        }
+        # RAG plane (when this process has built vector indexes): per-index
+        # engine kind + the ANN recall/drift gauges (docs/ANN.md)
+        rag = rag_plane_snapshot()
+        if rag.get("indexes"):
+            payload["rag"] = rag
+        return web.json_response(payload)
 
     async def models(request: web.Request) -> web.Response:
         return web.json_response(

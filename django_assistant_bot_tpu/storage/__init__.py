@@ -12,10 +12,15 @@ Replaces the reference's Django ORM + PostgreSQL + pgvector substrate
 - :mod:`.knn` — the pgvector-HNSW replacement: an exact brute-force cosine KNN
   whose score matrix rides the MXU (one [N,768]x[768,Q] matmul + lax.top_k),
   device-resident between queries;
+- :mod:`.ann` — the corpus-scale tier above it: an IVF-PQ approximate index
+  (jitted k-means/PQ training, ADC shortlist scan, exact rerank) presenting
+  the same search surface, auto-routed by :mod:`..rag.index_registry` above
+  ``DABT_ANN_THRESHOLD`` rows;
 - :mod:`.locks` — per-instance advisory locks (sync + async) standing in for
   Postgres ``pg_advisory_lock`` (reference: assistant/bot/services/instance_service.py).
 """
 
 from . import db, models  # noqa: F401
+from .ann import ANNIndex  # noqa: F401
 from .knn import VectorIndex  # noqa: F401
 from .locks import InstanceLock, InstanceLockAsync  # noqa: F401

@@ -1,0 +1,391 @@
+"""ANN retrieval plane (storage/ann.py): IVF-PQ training, ADC correctness,
+recall floors, live ingestion under queries, and registry routing.
+
+Everything is seeded and CPU-sized — this file doubles as the CI "ANN smoke"
+step, so the recall floors here are the regression net for the quantizer."""
+
+import asyncio
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from django_assistant_bot_tpu.conf import settings
+from django_assistant_bot_tpu.storage.ann import (
+    ANNIndex,
+    _adc_shortlist,
+    _kmeans_step,
+    _pq_step,
+    _spill_assign,
+    make_clustered,
+)
+from django_assistant_bot_tpu.storage.knn import VectorIndex, _normalize
+
+
+# ----------------------------------------------------------------- training
+def test_kmeans_step_separates_clusters_and_stays_normalized():
+    rng = np.random.default_rng(0)
+    a = np.array([1.0, 0.0, 0.0, 0.0], np.float32)
+    b = np.array([0.0, 1.0, 0.0, 0.0], np.float32)
+    batch = np.concatenate(
+        [
+            a + 0.05 * rng.standard_normal((64, 4)).astype(np.float32),
+            b + 0.05 * rng.standard_normal((64, 4)).astype(np.float32),
+        ]
+    )
+    batch = _normalize(batch)
+    # seeded-from-data init, as _learn does (random init can collapse both
+    # centroids into one cluster and the never-hit one keeps its old value)
+    cents = jnp.asarray(batch[[0, 64]])
+    counts = jnp.zeros((2,), jnp.float32)
+    for _ in range(8):
+        cents, counts = _kmeans_step(cents, counts, jnp.asarray(batch))
+    cents = np.asarray(cents)
+    np.testing.assert_allclose(np.linalg.norm(cents, axis=1), 1.0, atol=1e-5)
+    # each true center must be close (cos > 0.98) to exactly one centroid
+    sims = np.stack([a, b]) @ cents.T
+    assert sims.max(axis=1).min() > 0.98
+    assert set(sims.argmax(axis=1)) == {0, 1}
+
+
+def test_pq_step_reduces_quantization_error():
+    rng = np.random.default_rng(1)
+    m, sub = 2, 4
+    batch = rng.standard_normal((512, m, sub)).astype(np.float32) * 0.1
+    cb = jnp.asarray(rng.standard_normal((m, 256, sub)).astype(np.float32))
+    counts = jnp.zeros((m, 256), jnp.float32)
+
+    def err(codebooks):
+        c = np.asarray(codebooks)
+        d = ((batch[:, :, None, :] - c[None]) ** 2).sum(-1)  # [B, m, 256]
+        return d.min(axis=2).mean()
+
+    e0 = err(cb)
+    for _ in range(6):
+        cb, counts = _pq_step(cb, counts, jnp.asarray(batch))
+    assert err(cb) < e0 * 0.5
+
+
+def test_spill_assign_respects_soft_cap():
+    # 3 lists; every row's nearest is list 0, runner-up alternates 1/2
+    n, cap = 90, 20
+    lists2 = np.zeros((n, 2), np.int64)
+    lists2[:, 1] = np.where(np.arange(n) % 2 == 0, 1, 2)
+    fill = np.zeros(3, np.int64)
+    out = _spill_assign(lists2, fill, cap)
+    counts = np.bincount(out, minlength=3)
+    # runners-up each absorb up to cap; the rest stay at the (soft) nearest
+    assert counts[1] == cap and counts[2] == cap
+    assert counts[0] == n - 2 * cap
+    assert counts.sum() == n  # no row lost
+    np.testing.assert_array_equal(counts, fill[:3])  # fill mutated in step
+
+
+def test_spill_overflow_stays_at_nearest_when_runner_up_full():
+    # both candidate lists below cap only for the first rows: the tail must
+    # stay in its nearest list (soft cap) rather than being dropped
+    n, cap = 50, 10
+    lists2 = np.zeros((n, 2), np.int64)
+    lists2[:, 1] = 1
+    fill = np.zeros(2, np.int64)
+    out = _spill_assign(lists2, fill, cap)
+    counts = np.bincount(out, minlength=2)
+    assert counts[0] == n - cap and counts[1] == cap
+    assert counts.sum() == n  # no row lost
+
+
+# ---------------------------------------------------------- ADC correctness
+def test_adc_scores_match_dequantized_reference():
+    dim, n = 32, 512
+    rows = make_clustered(n, dim, n_clusters=8, seed=3)
+    index = ANNIndex(dim, nlist=8, m=4, seed=3)
+    index.add(range(n), rows)
+    index.train()
+
+    cent = np.asarray(index._centroids, np.float32)
+    cb = np.asarray(index._codebooks, np.float32)
+    codes = np.asarray(index._codes)
+    lvalid = np.asarray(index._lvalid)
+    rowpos = np.asarray(index._rowpos)
+    nlist, list_cap, m = codes.shape
+    sub = cb.shape[2]
+
+    q = _normalize(make_clustered(4, dim, n_clusters=8, seed=7))
+    sl = nlist * list_cap
+    sl_scores, sl_pos = _adc_shortlist(
+        index._centroids, index._codebooks, index._codes, index._lvalid,
+        index._rowpos, jnp.asarray(q), nlist, sl,
+    )
+    sl_scores, sl_pos = np.asarray(sl_scores), np.asarray(sl_pos)
+
+    # reference: score = q . c_list + sum_m lut[m, code_m], per occupied slot
+    ref = {}
+    q_sub = q.reshape(4, m, sub)
+    for li in range(nlist):
+        for si in range(list_cap):
+            if not lvalid[li, si]:
+                continue
+            dec = cb[np.arange(m), codes[li, si]]  # [m, sub]
+            for qi in range(4):
+                ref[(qi, int(rowpos[li, si]))] = float(
+                    q[qi] @ cent[li] + (q_sub[qi] * dec).sum()
+                )
+    checked = 0
+    for qi in range(4):
+        for j in range(sl):
+            if not np.isfinite(sl_scores[qi, j]):
+                continue
+            assert ref[(qi, int(sl_pos[qi, j]))] == pytest.approx(
+                float(sl_scores[qi, j]), abs=2e-3
+            )
+            checked += 1
+    assert checked >= 4 * n  # every live slot scored for every query
+
+
+# ------------------------------------------------------------ recall floors
+def test_recall_floor_at_default_nprobe():
+    dim, n = 64, 6000
+    index = ANNIndex(dim, seed=0)
+    index.add(range(n), make_clustered(n, dim, seed=0))
+    index.train()
+    rec = index.probe_recall(n_queries=64, k=10, seed=0)
+    assert rec["recall_at_k"] >= 0.9
+    assert index.stats()["last_recall"]["recall_at_k"] == rec["recall_at_k"]
+
+
+def test_untrained_index_serves_exact_results():
+    dim, n = 32, 300
+    rows = make_clustered(n, dim, seed=5)
+    ann = ANNIndex(dim)
+    ann.add(range(n), rows)  # never trained -> exact fallback
+    exact = VectorIndex(dim)
+    exact.add(range(n), rows)
+    q = rows[17] + 0.01
+    a, e = ann.search(q, k=5), exact.search(q, k=5)
+    assert [i for i, _ in a] == [i for i, _ in e]
+    assert a[0][0] == 17
+    assert ann.stats()["exact_fallback"] is True
+
+
+def test_allowed_ids_uses_exact_tier_on_trained_index():
+    dim, n = 32, 1000
+    rows = make_clustered(n, dim, seed=6)
+    index = ANNIndex(dim, seed=6)
+    index.add(range(n), rows)
+    index.train()
+    allowed = set(range(0, n, 7))
+    hits = index.search(rows[21], k=5, allowed_ids=allowed)
+    assert hits and all(i in allowed for i, _ in hits)
+    assert hits[0][0] == 21  # 21 is allowed; exact tier must find itself
+    fenced = index.search(rows[22], k=5, allowed_ids=allowed)
+    assert fenced and all(i in allowed and i != 22 for i, _ in fenced)
+
+
+# --------------------------------------------------------------- liveness
+def test_append_after_train_is_searchable_without_retrain():
+    dim, n = 32, 2000
+    index = ANNIndex(dim, seed=1)
+    index.add(range(n), make_clustered(n, dim, seed=1))
+    index.train()
+    retrains0 = index.stats()["retrains"]
+    extra = make_clustered(200, dim, seed=11)
+    index.add(range(n, n + 200), extra)
+    assert index.stats()["pending_appends"] == 200
+    assert index.stats()["retrains"] == retrains0  # append, not retrain
+    hits = index.search(extra[5], k=3)
+    assert hits[0][0] == n + 5
+    assert hits[0][1] == pytest.approx(1.0, abs=5e-3)
+
+
+def test_append_under_concurrent_queries():
+    dim, n = 32, 2000
+    rows = make_clustered(n, dim, seed=2)
+    index = ANNIndex(dim, seed=2)
+    index.add(range(n), rows)
+    index.train()
+
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        qs = rows[:8] + 0.01
+        while not stop.is_set():
+            try:
+                out = index.search_batch(qs, k=5)
+                assert len(out) == 8 and all(r for r in out)
+            except Exception as e:  # noqa: BLE001 - surface to the main thread
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        for b in range(4):
+            start = n + b * 100
+            index.add(range(start, start + 100), make_clustered(100, dim, seed=20 + b))
+        index.train()  # full retrain while queries are in flight
+    finally:
+        stop.set()
+        t.join()
+    assert not errors
+    assert len(index) == n + 400
+    hit = index.search(make_clustered(100, dim, seed=23)[7], k=1)[0]
+    assert hit[0] == n + 307
+
+
+def test_remove_tombstones_then_compaction():
+    dim, n = 32, 1200
+    rows = make_clustered(n, dim, seed=4)
+    index = ANNIndex(dim, seed=4)
+    index.add(range(n), rows)
+    index.train()
+    index.remove(range(0, 100))
+    assert len(index) == n - 100
+    assert index.stats()["tombstones"] == 100
+    hits = index.search(rows[13], k=10)  # removed row must never come back
+    assert all(i >= 100 for i, _ in hits)
+    # crossing the dead fraction triggers automatic compaction
+    index.remove(range(100, 400))
+    st = index.stats()
+    assert st["compactions"] >= 1
+    assert st["tombstones"] == 0
+    assert len(index) == n - 400
+    hits = index.search(rows[500], k=3)
+    assert hits[0][0] == 500
+
+
+def test_add_same_id_overwrites_old_vector():
+    dim = 32
+    rows = make_clustered(64, dim, seed=8)
+    index = ANNIndex(dim, nlist=8, m=4, seed=8)
+    index.add(range(64), rows)
+    index.train()
+    new_vec = -rows[3]
+    index.add([3], new_vec[None, :])
+    assert len(index) == 64
+    assert index.search(new_vec, k=1)[0][0] == 3
+    # the stale encoding must not satisfy the old vector anymore
+    top_old = index.search(rows[3], k=1)[0]
+    assert top_old[0] != 3 or top_old[1] < 0.9
+
+
+def test_clear_resets_to_empty_untrained():
+    index = ANNIndex(16, nlist=8, m=4)
+    index.add(range(128), make_clustered(128, 16, seed=9))
+    index.train()
+    index.clear()
+    assert len(index) == 0
+    assert index.search_batch(np.ones((1, 16), np.float32), k=3) == [[]]
+    st = index.stats()
+    assert not st["trained"] and st["rows"] == 0
+
+
+# ---------------------------------------------------------------- sharding
+def test_sharded_scan_matches_plain(mesh8):
+    dim, n = 64, 4000
+    rows = make_clustered(n, dim, seed=12)
+    plain = ANNIndex(dim, seed=12)
+    plain.add(range(n), rows)
+    plain.train()
+    sharded = ANNIndex(dim, mesh=mesh8, seed=12)
+    sharded.add(range(n), rows)
+    sharded.train()
+    assert sharded.nlist % mesh8.shape["data"] == 0
+    qs = rows[::500] + 0.01
+    p_out = plain.search_batch(qs, k=10)
+    s_out = sharded.search_batch(qs, k=10)
+    for p_row, s_row in zip(p_out, s_out):
+        assert p_row[0][0] == s_row[0][0]  # same top-1
+        overlap = {i for i, _ in p_row} & {i for i, _ in s_row}
+        assert len(overlap) >= 9  # overlap@10
+
+
+# ------------------------------------------------------- registry + service
+@pytest.fixture
+def fresh_indexes():
+    from django_assistant_bot_tpu.rag.index_registry import reset_indexes
+
+    reset_indexes()
+    yield
+    reset_indexes()
+
+
+def _seed_questions(n_docs=2, per_doc=12):
+    from django_assistant_bot_tpu.ai.providers.echo import HashEmbedder
+    from django_assistant_bot_tpu.storage import models
+
+    bot = models.Bot.objects.create(codename="ann-bot")
+    wiki = models.WikiDocument.objects.create(bot=bot, title="wiki")
+    emb = HashEmbedder(dim=768)
+    centers = []
+    for d in range(n_docs):
+        doc = models.Document.objects.create(
+            wiki=wiki, name=f"doc{d}", content=f"content {d}"
+        )
+        center_text = f"topic-{d}"
+        center = np.asarray(asyncio.run(emb.embeddings([center_text]))[0])
+        for i in range(per_doc):
+            noise = np.random.default_rng(d * 100 + i).normal(size=768) * 0.05
+            models.Question.objects.create(
+                document=doc,
+                text=f"q{d}-{i}",
+                order=i,
+                embedding=(center + noise).astype(np.float32),
+            )
+        centers.append(center_text)
+    return centers
+
+
+def test_registry_routes_by_threshold_with_rollback(tmp_db, fresh_indexes):
+    from django_assistant_bot_tpu.rag.index_registry import (
+        get_index,
+        rag_plane_stats,
+        reset_indexes,
+    )
+    from django_assistant_bot_tpu.storage import models
+
+    _seed_questions()
+    # corpus below the (default) threshold -> exact index
+    assert isinstance(get_index(models.Question), VectorIndex)
+    reset_indexes()
+    with settings.override(ANN_THRESHOLD=1):
+        index = get_index(models.Question)
+        assert isinstance(index, ANNIndex)
+        st = rag_plane_stats()["indexes"]["Question.embedding"]
+        assert st["kind"] == "ivfpq" and st["trained"]
+    reset_indexes()
+    # DABT_ANN=0 rollback beats the threshold
+    with settings.override(ANN=False, ANN_THRESHOLD=1):
+        assert isinstance(get_index(models.Question), VectorIndex)
+
+
+def test_search_service_schema_parity_across_index_types(tmp_db, fresh_indexes):
+    """The one shared test through BOTH engines: search_service must return
+    identical result schemas (and the same top hit) whether the registry
+    routed to VectorIndex or ANNIndex."""
+    from django_assistant_bot_tpu.rag import embedding_search_questions, get_embedding
+    from django_assistant_bot_tpu.rag.index_registry import get_index, reset_indexes
+    from django_assistant_bot_tpu.storage import models
+
+    centers = _seed_questions()
+    q_emb = asyncio.run(get_embedding(centers[1]))
+
+    def run_once():
+        hits = asyncio.run(embedding_search_questions(q_emb, n=5))
+        assert len(hits) == 5
+        for h in hits:
+            assert isinstance(h, models.Question)
+            assert isinstance(h.distance, float) and 0.0 <= h.distance <= 2.0
+        assert [h.distance for h in hits] == sorted(h.distance for h in hits)
+        return [(h.id, h.text) for h in hits]
+
+    exact_hits = run_once()
+    assert isinstance(get_index(models.Question), VectorIndex)
+    reset_indexes()
+    with settings.override(ANN_THRESHOLD=1):
+        ann_hits = run_once()
+        assert isinstance(get_index(models.Question), ANNIndex)
+    assert exact_hits[0] == ann_hits[0]
+    assert {t for _, t in exact_hits} == {t for _, t in ann_hits}
+    assert all(t.startswith("q1-") for _, t in ann_hits[:3])
