@@ -2654,6 +2654,270 @@ print(json.dumps(bench.bench_fleet()))
 """
 
 
+def bench_fleet_netchaos() -> dict:
+    """fleet_chaos_net_* section (serving/fleet.py + serving/faults.py net
+    sites; docs/FLEET.md "Failure modes" evidence): the pinned fleet trace
+    replayed over two REAL localhost serve stacks under a seeded network
+    chaos schedule — the messy middle the peer-kill arm can't reach (both
+    peers alive, the wire misbehaving).
+
+    Phases on the SAME trace as bench_fleet, driven by an offset clock the
+    arm shares between the injector and the router (jumping the offset
+    crosses window/TTL/breaker thresholds deterministically, no wall-clock
+    sleeps):
+
+    - **partition**: the ``netchaos->bench0`` edge alone drops at connect
+      time (a seeded ``net_partition`` window); every affected request must
+      re-route token-lessly to bench1, refresh failures are classified, and
+      after ``registry_ttl_s`` of unreachability bench0's gossip-learned
+      affinity claims age out of the prefix registry (TTL drop);
+    - **heal**: the window closes; the next refresh forces the anti-entropy
+      reset-snapshot resync and the convergence time lands in
+      ``reconcile_last_s``;
+    - **dedup probe**: ``net_drop`` armed once — the request is executed by
+      the peer but the response is lost, the router retries the SAME peer
+      under the idempotency key, and the ledger answers (criterion:
+      duplicate executions == 0);
+    - **corrupt probe**: ``net_corrupt`` armed for three ``/fleet/kv/put``
+      transfers — the CRC32C envelope must reject all three (criterion:
+      zero corrupt payloads absorbed).
+    """
+    from django_assistant_bot_tpu.serving.faults import FaultInjector
+    from django_assistant_bot_tpu.serving.fleet import (
+        FleetPlane,
+        FleetRouter,
+        PeerClient,
+        PeerHTTPError,
+    )
+    from django_assistant_bot_tpu.serving.registry import ModelRegistry
+    from django_assistant_bot_tpu.serving.server import create_app
+    from django_assistant_bot_tpu.workload.generator import prompt_ids_for
+
+    offset = [0.0]
+
+    def clk():
+        return time.monotonic() + offset[0]
+
+    inj = FaultInjector(
+        {
+            "net_partition": {
+                "start_after_s": 1000.0,
+                "duration_s": 1000.0,
+                "edges": ["netchaos->bench0"],
+            }
+        },
+        seed=0,
+        clock=clk,
+    )
+
+    def _peer(i):
+        reg = ModelRegistry.from_config(
+            {
+                "tiny-chat": {
+                    "kind": "decoder",
+                    "tiny": True,
+                    "max_slots": 4,
+                    "max_seq_len": 256,
+                    "kv_host_bytes": 1 << 26,
+                    "prefix_min_tokens": 16,
+                }
+            }
+        )
+        plane = FleetPlane(reg, name=f"bench{i}", pool="unified")
+        reg.fleet_plane = plane
+        url, stop = _serve_app_thread(create_app(reg))
+        return {"reg": reg, "plane": plane, "url": url, "stop": stop}
+
+    reqs = _fleet_trace()
+    peers = [_peer(0), _peer(1)]
+    router = FleetRouter(
+        [(f"bench{i}", p["url"]) for i, p in enumerate(peers)],
+        model="tiny-chat",
+        name="netchaos",
+        refresh_interval_s=1e9,  # the arm drives refresh itself
+        request_timeout_s=600.0,
+        registry_ttl_s=5.0,
+        timeout_retries=1,
+        clock=clk,
+        injector=inj,
+    )
+    out: dict = {}
+    try:
+        router.refresh()
+        router._last_refresh = router._clock()
+        # warm both peers' compile buckets off the clock
+        for p in peers:
+            for rep in router.peers:
+                rep.draining = rep.base_url != p["url"]
+            for warm in ([3] * 12, _FLEET_IDENT_PROMPT):
+                try:
+                    router.submit(
+                        list(warm), max_tokens=2, temperature=0.0
+                    ).result(timeout=600)
+                except Exception:
+                    pass
+        for rep in router.peers:
+            rep.draining = False
+        idem0 = sum(p["plane"].stats()["idem_executions"] for p in peers)
+
+        def _replay(chunk):
+            futs = [
+                router.submit(
+                    prompt_ids_for(r),
+                    max_tokens=r.max_tokens,
+                    temperature=0.0,
+                    prefix_len=r.prefix_len,
+                    priority=r.priority,
+                    tenant=r.tenant,
+                )
+                for r in chunk
+            ]
+            ok = failed = 0
+            for f in futs:
+                try:
+                    f.result(timeout=900)
+                    ok += 1
+                except Exception:
+                    failed += 1
+            return ok, failed
+
+        third = max(1, len(reqs) // 3)
+        ok = failed = 0
+        # phase A: clean wire
+        a_ok, a_failed = _replay(reqs[:third])
+        ok, failed = ok + a_ok, failed + a_failed
+        # partition ON (jump into the seeded window): the first slice of
+        # phase B dispatches while the router still believes bench0 is
+        # healthy — those hops fail at connect and re-route token-lessly
+        offset[0] += 1000.0
+        half_b = reqs[third : third + max(1, third // 2)]
+        b_ok, b_failed = _replay(half_b)
+        ok, failed = ok + b_ok, failed + b_failed
+        # TTL crossing: refresh stamps unreachable_since, the offset jump
+        # ages it past the TTL, the second refresh drops bench0's
+        # gossip-learned holdings from the prefix registry
+        router.refresh()
+        offset[0] += 10.0
+        router.refresh()
+        ttl_dropped_during = router.stats()["ttl_drops"]
+        b2_ok, b2_failed = _replay(reqs[third + len(half_b) : 2 * third])
+        ok, failed = ok + b2_ok, failed + b2_failed
+        # HEAL (jump past the window's end): the next refresh reconciles the
+        # diverged gossip view via the forced reset-snapshot exchange
+        offset[0] += 1000.0
+        router.refresh()
+        c_ok, c_failed = _replay(reqs[2 * third :])
+        ok, failed = ok + c_ok, failed + c_failed
+        # dedup probe: the response is lost AFTER the peer executed — the
+        # same-peer retry must be answered from the idempotency ledger
+        for rep in router.peers:
+            inj.arm("net_drop", 1, key=f"netchaos->{rep.name}")
+        probe_ok = 0
+        try:
+            router.submit(
+                list(_FLEET_IDENT_PROMPT), max_tokens=4, temperature=0.0
+            ).result(timeout=600)
+            probe_ok = 1
+        except Exception:
+            pass
+        idem_execs = (
+            sum(p["plane"].stats()["idem_executions"] for p in peers) - idem0
+        )
+        executed_unique = ok + probe_ok
+        duplicates = max(0, idem_execs - executed_unique)
+        dedup_hits = sum(
+            p["plane"].stats()["idem_hits"] + p["plane"].stats()["idem_coalesced"]
+            for p in peers
+        )
+        # corrupt probe: one wire entry (a real warm export when available,
+        # else a locally encoded envelope — the CRC rejection under test
+        # happens at decode, before any geometry check) re-put three times
+        # through a corrupting edge — the checksum must reject every one
+        wire = None
+        for p in peers:
+            wire = PeerClient(p["url"], timeout_s=60.0).post_for_bytes(
+                "/fleet/kv/get",
+                {
+                    "model": "tiny-chat",
+                    "prompt_ids": list(_FLEET_IDENT_PROMPT),
+                    "prefix_len": len(_FLEET_IDENT_PROMPT) - 1,
+                },
+                timeout_s=60.0,
+            )
+            if wire is not None:
+                break
+        if wire is None:
+            import numpy as np
+
+            from django_assistant_bot_tpu.serving.fleet import encode_kv_entry
+            from django_assistant_bot_tpu.serving.kv_pool import HostPrefixEntry
+
+            k = np.arange(2 * 24 * 8, dtype=np.float16).reshape(2, 24, 1, 8, 1)
+            wire = encode_kv_entry(
+                HostPrefixEntry(
+                    key=tuple(range(24)),
+                    length=24,
+                    k=k,
+                    v=k + 1,
+                    nbytes=2 * k.nbytes,
+                    pages=3,
+                )
+            )
+        probe_client = PeerClient(
+            peers[1]["url"], timeout_s=60.0, injector=inj, fault_key="probe"
+        )
+        rejects0 = peers[1]["plane"].stats()["kv_integrity_rejects"]
+        corrupt_injected = corrupt_rejected = corrupt_absorbed = 0
+        for _ in range(3):
+            inj.arm("net_corrupt", 1, key="probe")
+            corrupt_injected += 1
+            try:
+                res = probe_client.post_bytes(
+                    "/fleet/kv/put?model=tiny-chat", wire, timeout_s=60.0
+                )
+                if res.get("stored"):
+                    corrupt_absorbed += 1
+            except PeerHTTPError as e:
+                if e.reason == "wire_integrity":
+                    corrupt_rejected += 1
+        server_rejects = (
+            peers[1]["plane"].stats()["kv_integrity_rejects"] - rejects0
+        )
+        rs = router.stats()
+        out = {
+            "fleet_chaos_net_requests": len(reqs),
+            "fleet_chaos_net_goodput_frac": round(ok / len(reqs), 4),
+            "fleet_chaos_net_failed": failed,
+            "fleet_chaos_net_reroutes": rs["reroutes"],
+            "fleet_chaos_duplicate_execs": duplicates,
+            "fleet_chaos_dedup_hits": dedup_hits,
+            "fleet_chaos_dedup_probe_ok": probe_ok,
+            "fleet_chaos_corrupt_injected": corrupt_injected,
+            "fleet_chaos_corrupt_rejected": corrupt_rejected,
+            "fleet_chaos_corrupt_absorbed": corrupt_absorbed,
+            "fleet_chaos_corrupt_server_rejects": server_rejects,
+            "fleet_chaos_ttl_drops": rs["ttl_drops"],
+            "fleet_chaos_ttl_dropped_in_partition": ttl_dropped_during,
+            "fleet_chaos_reconciles": rs["reconciles"],
+            "fleet_chaos_reconcile_s": rs["reconcile_last_s"],
+            "fleet_chaos_timeout_retries": rs["timeout_retries"],
+            "fleet_chaos_refresh_reasons": dict(rs["refresh_failure_reasons"]),
+        }
+    finally:
+        router.close()
+        for p in peers:
+            p["stop"]()
+            p["reg"].stop()
+    return out
+
+
+_FLEET_NETCHAOS_SNIPPET = """
+import json
+import bench
+print(json.dumps(bench.bench_fleet_netchaos()))
+"""
+
+
 def bench_autoscale() -> dict:
     """autoscale_* section (serving/autoscaler.py + workload/ evidence): the
     closed-loop A/B.  ONE seeded diurnal-ramp trace (workload/generator.py,
@@ -4231,6 +4495,14 @@ _COMPACT_KEYS = (
     "fleet_output_identical",
     "fleet_handoffs",
     "fleet_pages_shipped",
+    "fleet_chaos_net_goodput_frac",
+    "fleet_chaos_duplicate_execs",
+    "fleet_chaos_corrupt_injected",
+    "fleet_chaos_corrupt_rejected",
+    "fleet_chaos_corrupt_absorbed",
+    "fleet_chaos_reconcile_s",
+    "fleet_chaos_ttl_drops",
+    "fleet_chaos_timeout_retries",
     "multichip_agg_tok_s",
     "multichip_tok_s_1slice",
     "multichip_scaling_frac",
@@ -4449,6 +4721,15 @@ def main() -> None:
     #        re-route goodput — serving/fleet.py + docs/FLEET.md evidence;
     #        CPU-friendly tiny peers by design)
     run("fleet", _FLEET_SNIPPET, cap_s=420)
+    # 3c''+n) fleet_netchaos: the fleet wire under seeded NETWORK chaos —
+    #         a mid-trace single-edge partition + heal (TTL aging of the
+    #         partitioned peer's affinity claims, classified refresh
+    #         failures, post-heal anti-entropy reconcile), an armed
+    #         net_drop dedup probe (idempotent dispatch: duplicate
+    #         executions must be 0), and an armed net_corrupt KV probe
+    #         (CRC32C envelope: zero corrupt payloads absorbed) —
+    #         serving/fleet.py + serving/faults.py net-site evidence
+    run("fleet_netchaos", _FLEET_NETCHAOS_SNIPPET, cap_s=420)
     # 3c''a) multichip: the mesh-sliced fleet A/B — 4 replicas x TP-2 on
     #        disjoint slices of a forced-8-device host vs the 1-slice arm
     #        (per-slice steady rates, placement-asserted disjointness,

@@ -223,6 +223,16 @@ def add_parser(sub):
         "'pool_role' (default 64)",
     )
     p.add_argument(
+        "--fleet-idem-ledger-size",
+        type=int,
+        default=None,
+        metavar="N",
+        help="bounded /fleet/generate idempotency ledger: how many recent "
+        "idempotency keys this process remembers so a peer's timeout-retry "
+        "returns the original result instead of re-executing (default 512; "
+        "docs/FLEET.md 'Failure modes')",
+    )
+    p.add_argument(
         "--slo-itl-p95-s",
         type=float,
         default=None,
@@ -599,6 +609,8 @@ def run(args) -> int:
     plane_kwargs = {}
     if getattr(args, "decode_max_prefill_tokens", None) is not None:
         plane_kwargs["decode_max_prefill_tokens"] = args.decode_max_prefill_tokens
+    if getattr(args, "fleet_idem_ledger_size", None) is not None:
+        plane_kwargs["idem_ledger_size"] = args.fleet_idem_ledger_size
     registry.fleet_plane = FleetPlane(
         registry,
         name=fleet_self_name(getattr(args, "fleet_name", None)),

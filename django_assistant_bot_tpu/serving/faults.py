@@ -45,14 +45,44 @@ Sites (the full set — unknown names are a config error, not a silent no-op):
 ``platform_http_5xx``  bot delivery: the platform answers a transient 5xx-
                   shaped connection error — exercises delivery re-raise +
                   queue retry
+``net_drop``      fleet wire (serving/fleet.py PeerClient): the connection
+                  drops AFTER the request was sent but before the response is
+                  read — the server may have executed it, so this is the
+                  idempotent-dispatch chaos case (timeout-retry must not
+                  double-execute)
+``net_delay``     fleet wire: the link stalls ``delay_s`` before the request
+                  goes out (slow-link evidence for the connect/read timeout
+                  split)
+``net_corrupt``   fleet wire: one byte of a KV payload (octet-stream request
+                  body, or octet-stream response body) is flipped in flight —
+                  the CRC32C integrity check must reject it
+``net_partition`` fleet wire: the peer is unreachable at connect time (both
+                  sides alive, the link is down) — usually driven by a
+                  ``start_after_s``/``duration_s`` window so the bench gets a
+                  partition AND a heal
+``net_blackhole`` fleet wire: the SYN black-holes (connect times out, nothing
+                  answers) — distinct from ``net_partition`` only in detail
+                  text; exercises the fast connect-timeout path
 ================  ============================================================
 
 Each site's spec is either a bare float (fire probability) or a mapping with
 any of: ``p`` (probability), ``fire_on`` (exact 1-based call indices),
 ``every`` (fire every Nth call), ``max_fires`` (stop after N fires),
-``delay_s`` (sleep length for latency sites).  Schedules compose: a call fires
-if it matches ``fire_on`` OR ``every`` OR the probability draw, until
-``max_fires`` is exhausted.
+``delay_s`` (sleep length for latency sites), ``start_after_s``/``duration_s``
+(a clock window measured from the site's first consult — the partition/heal
+schedule shape; fires for the whole window, composes with the other triggers,
+and ignores ``max_fires`` so a window is never cut short by earlier fires),
+and ``edges`` (restrict a site to specific consult keys — see below).
+Schedules compose: a call fires if it matches ``fire_on`` OR ``every`` OR the
+window OR the probability draw, until ``max_fires`` is exhausted.
+
+Network sites are consulted **per edge**: ``should_fire(site, key=edge)``
+where the edge is the caller's ``"{self}->{peer}"`` string.  Each (site, key)
+pair keeps its own schedule state and its own RNG seeded
+``f"{seed}:{site}:{key}"`` — the same seed reproduces the same per-edge
+partition schedule across processes regardless of how edges interleave, which
+is what makes a two-process chaos bench replayable.  A spec's ``edges`` list
+scopes the site to those keys only (other edges never fire).
 
 Gating: engines take an injector from ``ModelSpec.faults`` (explicit) or from
 the ``DABT_FAULTS`` env var (JSON, with ``DABT_FAULT_SEED``); the HTTP client
@@ -80,7 +110,10 @@ ROUTER_SITES = ("replica_dead", "replica_slow")
 # consulted by the task plane (tasks/queue.py Worker.execute + bot/tasks.py
 # delivery) via the lazy global-injector discipline — no engine involved
 TASK_SITES = ("task_raise", "task_worker_lost", "platform_http_429", "platform_http_5xx")
-ALL_SITES = ENGINE_SITES + HTTP_SITES + ROUTER_SITES + TASK_SITES
+# consulted by the fleet-wire PeerClient (serving/fleet.py) per edge — every
+# consult carries a ``key`` ("router->peer" string) with its own seeded state
+NET_SITES = ("net_drop", "net_delay", "net_corrupt", "net_partition", "net_blackhole")
+ALL_SITES = ENGINE_SITES + HTTP_SITES + ROUTER_SITES + TASK_SITES + NET_SITES
 
 ENV_FAULTS = "DABT_FAULTS"
 ENV_SEED = "DABT_FAULT_SEED"
@@ -102,9 +135,16 @@ class _Site:
     every: int = 0
     max_fires: int = 0  # 0 = unlimited
     delay_s: float = 0.05
+    # clock window measured from the site's first consult: fires while
+    # start_after_s <= elapsed < start_after_s + duration_s (negative = off)
+    start_after_s: float = -1.0
+    duration_s: float = 0.0
+    # consult keys (edges) this site is scoped to; empty = all
+    edges: frozenset = frozenset()
     calls: int = 0
     fires: int = 0
     armed: int = 0  # fire unconditionally on the next N calls (tests)
+    first_consult: Optional[float] = None
     last_fire_monotonic: Optional[float] = None
 
 
@@ -115,7 +155,10 @@ def _parse_site(name: str, spec: Any) -> _Site:
         spec = {"p": float(spec)}
     if not isinstance(spec, Mapping):
         raise ValueError(f"fault site {name!r}: unparseable spec {spec!r}")
-    unknown = set(spec) - {"p", "probability", "fire_on", "every", "max_fires", "delay_s"}
+    unknown = set(spec) - {
+        "p", "probability", "fire_on", "every", "max_fires", "delay_s",
+        "start_after_s", "duration_s", "edges",
+    }
     if unknown:
         raise ValueError(f"fault site {name!r}: unknown keys {sorted(unknown)}")
     p = float(spec.get("p", spec.get("probability", 0.0)))
@@ -124,6 +167,13 @@ def _parse_site(name: str, spec: Any) -> _Site:
     fire_on = frozenset(int(n) for n in spec.get("fire_on", ()))
     if any(n < 1 for n in fire_on):
         raise ValueError(f"fault site {name!r}: fire_on indices are 1-based")
+    start_after_s = float(spec.get("start_after_s", -1.0))
+    duration_s = max(0.0, float(spec.get("duration_s", 0.0)))
+    if start_after_s >= 0.0 and duration_s <= 0.0:
+        raise ValueError(f"fault site {name!r}: start_after_s needs duration_s > 0")
+    edges = spec.get("edges", ())
+    if isinstance(edges, str) or not all(isinstance(e, str) for e in edges):
+        raise ValueError(f"fault site {name!r}: edges must be a list of key strings")
     return _Site(
         name=name,
         probability=p,
@@ -131,6 +181,9 @@ def _parse_site(name: str, spec: Any) -> _Site:
         every=max(0, int(spec.get("every", 0))),
         max_fires=max(0, int(spec.get("max_fires", 0))),
         delay_s=max(0.0, float(spec.get("delay_s", 0.05))),
+        start_after_s=start_after_s,
+        duration_s=duration_s,
+        edges=frozenset(edges),
     )
 
 
@@ -156,6 +209,11 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._sites: Dict[str, _Site] = {}
         self._rngs: Dict[str, random.Random] = {}
+        # per-(site, key) substates for edge-scoped consults: each edge clones
+        # the base spec lazily and draws from its own str-seeded RNG, so one
+        # edge's consult pattern can never perturb another's schedule
+        self._subs: Dict[tuple, _Site] = {}
+        self._sub_rngs: Dict[tuple, random.Random] = {}
         for name, site_spec in (spec or {}).items():
             if name not in ALL_SITES:
                 raise ValueError(
@@ -192,10 +250,11 @@ class FaultInjector:
     def enabled(self, site: str) -> bool:
         return site in self._sites
 
-    def arm(self, site: str, n: int = 1) -> None:
+    def arm(self, site: str, n: int = 1, *, key: str = "") -> None:
         """Fire unconditionally on the next ``n`` calls of ``site`` (tests:
         exact one-shot faults without counting call indices).  Arming a site
-        absent from the spec registers it."""
+        absent from the spec registers it.  ``key`` arms one edge's substate
+        only (other edges keep their own schedules)."""
         with self._lock:
             s = self._sites.get(site)
             if s is None:
@@ -203,42 +262,76 @@ class FaultInjector:
                     raise ValueError(f"unknown fault site {site!r}")
                 s = self._sites[site] = _Site(name=site)
                 self._rngs[site] = random.Random(f"{self.seed}:{site}")
+            if key:
+                s = self._state(site, key)
             s.armed += int(n)
 
-    def should_fire(self, site: str) -> bool:
+    def _state(self, site: str, key: str) -> _Site:
+        """The (site, key) substate, lazily cloned from the base spec with
+        fresh counters and its own cross-process-stable RNG.  Caller holds
+        ``self._lock``; the base site must exist."""
+        sub = self._subs.get((site, key))
+        if sub is None:
+            sub = dataclasses.replace(
+                self._sites[site],
+                calls=0, fires=0, armed=0,
+                first_consult=None, last_fire_monotonic=None,
+            )
+            self._subs[(site, key)] = sub
+            self._sub_rngs[(site, key)] = random.Random(f"{self.seed}:{site}:{key}")
+        return sub
+
+    def should_fire(self, site: str, key: str = "") -> bool:
         """Consult (and advance) a site's schedule.  Unconfigured sites never
-        fire and keep no state."""
+        fire and keep no state.  ``key`` selects a per-edge substate (network
+        sites) — each edge advances independently and deterministically."""
         with self._lock:
-            s = self._sites.get(site)
-            if s is None:
+            base = self._sites.get(site)
+            if base is None:
                 return False
+            if base.edges and key not in base.edges:
+                return False
+            s = self._state(site, key) if key else base
+            rng = self._sub_rngs[(site, key)] if key else self._rngs[site]
+            now = self._clock()
+            if s.first_consult is None:
+                s.first_consult = now
             s.calls += 1
-            if s.max_fires and s.fires >= s.max_fires:
-                return False
+            in_window = (
+                s.start_after_s >= 0.0
+                and s.start_after_s <= (now - s.first_consult) < s.start_after_s + s.duration_s
+            )
             fire = False
-            if s.armed > 0:
+            if in_window:
+                # windows model link state (partitions), not discrete events —
+                # they hold for the full duration regardless of max_fires
+                fire = True
+            elif s.max_fires and s.fires >= s.max_fires:
+                return False
+            elif s.armed > 0:
                 s.armed -= 1
                 fire = True
             elif s.calls in s.fire_on:
                 fire = True
             elif s.every and s.calls % s.every == 0:
                 fire = True
-            elif s.probability and self._rngs[site].random() < s.probability:
+            elif s.probability and rng.random() < s.probability:
                 fire = True
             if fire:
                 s.fires += 1
-                s.last_fire_monotonic = self._clock()
+                s.last_fire_monotonic = now
             return fire
 
-    def maybe_raise(self, site: str, detail: str = "") -> None:
-        if self.should_fire(site):
+    def maybe_raise(self, site: str, detail: str = "", *, key: str = "") -> None:
+        if self.should_fire(site, key):
             raise FaultInjected(site, detail)
 
-    def sleep_s(self, site: str) -> float:
+    def sleep_s(self, site: str, key: str = "") -> float:
         """Latency sites: the injected delay for this call (0.0 = no fire)."""
-        if self.should_fire(site):
+        if self.should_fire(site, key):
             with self._lock:
-                return self._sites[site].delay_s
+                s = self._subs[(site, key)] if key else self._sites[site]
+                return s.delay_s
         return 0.0
 
     def raise_http_fault(self, url: str = "") -> None:
@@ -260,19 +353,25 @@ class FaultInjector:
                 message=f"injected fault: http_5xx ({url})",
             )
 
-    def last_fire_at(self, site: str) -> Optional[float]:
+    def last_fire_at(self, site: str, key: str = "") -> Optional[float]:
         """clock() stamp (default time.monotonic) of the site's most recent fire (bench: recovery
         time is measured from here to the next successful completion)."""
         with self._lock:
-            s = self._sites.get(site)
+            s = self._subs.get((site, key)) if key else self._sites.get(site)
             return s.last_fire_monotonic if s is not None else None
 
     def stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-site call/fire counts; edge substates appear as ``site[key]``
+        rows beside the base site (the chaos bench's injected-vs-rejected
+        accounting reads the edge rows)."""
         with self._lock:
-            return {
+            out = {
                 name: {"calls": s.calls, "fires": s.fires}
                 for name, s in self._sites.items()
             }
+            for (site, key), s in self._subs.items():
+                out[f"{site}[{key}]"] = {"calls": s.calls, "fires": s.fires}
+            return out
 
 
 # Process-global injector for call sites without a per-engine spec (the HTTP

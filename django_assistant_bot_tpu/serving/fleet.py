@@ -48,15 +48,14 @@ flows through the injectable ``clock``/``sleep`` (DABT105).
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import os
 import threading
 import time
-import urllib.error
 import urllib.parse
-import urllib.request
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -64,13 +63,19 @@ import numpy as np
 
 from ..ai.providers.failover import CircuitBreaker
 from .engine import EngineUnavailable
+from .faults import FaultInjector, global_injector
 from .kv_pool import (
+    KV_WIRE_COMPAT_VERSIONS,
     KV_WIRE_VERSION,
     TIER_DISK,
     TIER_HBM,
     TIER_HOST,
     HostPrefixEntry,
+    WireDecodeError,
+    WireIntegrityError,
     WireVersionError,
+    crc32c,
+    entry_crc32c,
 )
 from .obs import FlightRecorder, new_trace_id
 from .scheduler import DeadlineExceeded, SchedulerRejected
@@ -84,8 +89,14 @@ _TIER_RANK = {TIER_HBM: 0, TIER_HOST: 1, TIER_DISK: 2}
 # The header is dtype-tagged exactly like the PR 12 disk format (raw uint8
 # views + a dtype STRING re-resolved on the receiver), so fp8/bf16/int8
 # pools round-trip bit-exactly across processes and builds that agree on
-# KV_WIRE_VERSION — and fail loudly across builds that don't.
+# KV_WIRE_VERSION — and fail loudly across builds that don't.  Since wire v2
+# the header also carries a CRC-32C of the k+v body, verified on decode; v1
+# payloads (no checksum) still decode, per KV_WIRE_COMPAT_VERSIONS.
 KV_WIRE_MAGIC = b"DABTKV"
+
+# The versions THIS decoder accepts (module-level so a test can emulate an
+# old decoder meeting a new payload by narrowing it).
+WIRE_ACCEPT_VERSIONS = KV_WIRE_COMPAT_VERSIONS
 
 
 def _resolve_dtype(name: str) -> np.dtype:
@@ -112,6 +123,7 @@ def encode_kv_entry(entry: HostPrefixEntry) -> bytes:
         "v_shape": list(v.shape),
         "k_nbytes": int(k.nbytes),
         "v_nbytes": int(v.nbytes),
+        "crc32c": entry_crc32c(k, v),
     }
     hb = json.dumps(header, separators=(",", ":")).encode("utf-8")
     return b"".join(
@@ -128,47 +140,75 @@ def encode_kv_entry(entry: HostPrefixEntry) -> bytes:
 def decode_kv_entry(data: bytes) -> HostPrefixEntry:
     """Wire bytes -> :class:`HostPrefixEntry` (numpy arrays in the sender's
     exact dtype).  Raises :class:`WireVersionError` for a payload stamped by
-    a different build, ``ValueError`` for anything malformed — the receiver
-    must never guess at bytes it cannot prove it understands."""
+    a build outside ``WIRE_ACCEPT_VERSIONS``, :class:`WireIntegrityError`
+    when the payload's CRC-32C does not match its bytes, and
+    :class:`WireDecodeError` for anything malformed (truncation at any
+    envelope boundary, bad magic, unreadable header, body/metadata mismatch)
+    — the receiver must never guess at bytes it cannot prove it understands.
+    All three are ``ValueError`` subclasses, so pre-CRC callers still catch
+    them."""
     m = len(KV_WIRE_MAGIC)
     if len(data) < m + 4 or data[:m] != KV_WIRE_MAGIC:
-        raise ValueError("not a DABT KV wire payload (bad magic)")
+        raise WireDecodeError("not a DABT KV wire payload (bad magic)")
     hlen = int.from_bytes(data[m : m + 4], "little")
     if len(data) < m + 4 + hlen:
-        raise ValueError("truncated KV wire payload (header)")
+        raise WireDecodeError("truncated KV wire payload (header)")
     try:
         header = json.loads(data[m + 4 : m + 4 + hlen].decode("utf-8"))
     except (UnicodeDecodeError, json.JSONDecodeError) as e:
-        raise ValueError(f"unreadable KV wire header: {e}") from None
+        raise WireDecodeError(f"unreadable KV wire header: {e}") from None
+    if not isinstance(header, dict):
+        raise WireDecodeError("unreadable KV wire header: not a JSON object")
     ver = header.get("wire_version")
-    if ver != KV_WIRE_VERSION:
+    if ver not in WIRE_ACCEPT_VERSIONS:
         raise WireVersionError(
-            f"KV wire payload has wire_version {ver!r} (this build supports "
-            f"{KV_WIRE_VERSION}); refusing to decode cross-build pages"
+            f"KV wire payload has wire_version {ver!r} (this build accepts "
+            f"{tuple(WIRE_ACCEPT_VERSIONS)}); refusing to decode cross-build "
+            "pages"
         )
-    dtype = _resolve_dtype(str(header["dtype"]))
-    k_nbytes = int(header["k_nbytes"])
-    v_nbytes = int(header["v_nbytes"])
+    try:
+        dtype = _resolve_dtype(str(header["dtype"]))
+        k_nbytes = int(header["k_nbytes"])
+        v_nbytes = int(header["v_nbytes"])
+        k_shape = [int(d) for d in header["k_shape"]]
+        v_shape = [int(d) for d in header["v_shape"]]
+        key = tuple(int(t) for t in header["key"])
+        length = int(header["length"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireDecodeError(f"malformed KV wire header: {e}") from None
     body = data[m + 4 + hlen :]
     if len(body) != k_nbytes + v_nbytes:
-        raise ValueError(
+        raise WireDecodeError(
             f"KV wire payload body is {len(body)} bytes; header promised "
             f"{k_nbytes + v_nbytes}"
         )
-    k = (
-        np.frombuffer(body, np.uint8, count=k_nbytes)
-        .view(dtype)
-        .reshape(header["k_shape"])
-    )
-    v = (
-        np.frombuffer(body, np.uint8, count=v_nbytes, offset=k_nbytes)
-        .view(dtype)
-        .reshape(header["v_shape"])
-    )
-    key = tuple(int(t) for t in header["key"])
-    length = int(header["length"])
+    # v2+: the body must prove itself against the header checksum BEFORE any
+    # bytes are reinterpreted as pages.  v1 carried none — accepted as-is.
+    crc = header.get("crc32c")
+    if ver >= 2:
+        if not isinstance(crc, int):
+            raise WireDecodeError("KV wire v2 payload is missing its crc32c")
+        actual = crc32c(body)
+        if actual != crc:
+            raise WireIntegrityError(
+                f"KV wire payload failed its CRC-32C (stored {crc:#010x}, "
+                f"computed {actual:#010x}) — corrupt in flight; rejecting"
+            )
+    try:
+        k = (
+            np.frombuffer(body, np.uint8, count=k_nbytes)
+            .view(dtype)
+            .reshape(k_shape)
+        )
+        v = (
+            np.frombuffer(body, np.uint8, count=v_nbytes, offset=k_nbytes)
+            .view(dtype)
+            .reshape(v_shape)
+        )
+    except ValueError as e:
+        raise WireDecodeError(f"KV wire payload shape mismatch: {e}") from None
     if length != len(key) or length <= 0:
-        raise ValueError("KV wire payload key/length mismatch")
+        raise WireDecodeError("KV wire payload key/length mismatch")
     return HostPrefixEntry(
         key=key,
         length=length,
@@ -176,6 +216,8 @@ def decode_kv_entry(data: bytes) -> HostPrefixEntry:
         v=v,
         nbytes=k_nbytes + v_nbytes,
         pages=0,  # receiver recomputes against its OWN page size on put
+        wire_version=int(ver),
+        crc32c=crc if isinstance(crc, int) else None,
     )
 
 
@@ -183,7 +225,18 @@ def decode_kv_entry(data: bytes) -> HostPrefixEntry:
 class PeerUnreachable(RuntimeError):
     """Connection-level failure: the peer process is dead, unreachable, or
     timed out before producing a status line — replica-death-shaped, so the
-    router may re-route a token-less request."""
+    router may re-route a token-less request.
+
+    ``phase`` distinguishes WHERE the wire died, because the safe recovery
+    differs: ``"connect"`` means the request never left this process (free to
+    retry or re-route), ``"read"`` means it was already on the wire when the
+    connection died — the peer may well have executed it, so the router
+    retries the SAME peer under the request's idempotency key instead of
+    re-routing into a double execution."""
+
+    def __init__(self, detail: str, *, phase: str = "connect"):
+        super().__init__(detail)
+        self.phase = phase
 
 
 class PeerHTTPError(RuntimeError):
@@ -206,15 +259,70 @@ class PeerHTTPError(RuntimeError):
         self.reason = reason
 
 
+def _chain_digest(digest: int, ev: dict) -> int:
+    """Fold one gossip event into a rolling CRC32C chain.  Both sides (the
+    plane's append path and the router's delta-apply path) fold the SAME
+    canonical JSON encoding, so equal logs yield equal digests and a
+    diverged ``/fleet/prefix`` log is detectable in one integer compare."""
+    blob = json.dumps(ev, sort_keys=True, separators=(",", ":")).encode("utf-8")
+    return crc32c(blob, digest)
+
+
+def _flip_one_byte(data: bytes) -> bytes:
+    """The ``net_corrupt`` payload mutation: one bit of the middle byte —
+    exactly the failure a checksum exists to catch, deterministic so the
+    chaos bench's injected-vs-rejected accounting is exact."""
+    if not data:
+        return data
+    out = bytearray(data)
+    out[len(out) // 2] ^= 0x01
+    return bytes(out)
+
+
 class PeerClient:
     """Tiny synchronous HTTP client for the fleet wire (stdlib only — the
     serving container ships no HTTP client library).  One request per call,
     no connection reuse: peers are long-lived but requests must never share
-    failure state across threads."""
+    failure state across threads.
 
-    def __init__(self, base_url: str, *, timeout_s: float = 30.0):
+    The single legacy ``timeout_s`` is split: ``connect_timeout_s`` bounds
+    the TCP connect (a black-holed SYN fails in seconds, not the full
+    request budget) while ``timeout_s`` — overridable per call — bounds the
+    read, so a long KV transfer still completes.  Failures carry the phase
+    (:class:`PeerUnreachable`); ``retries`` re-attempts CONNECT-phase
+    failures only (nothing reached the peer) with exponential backoff
+    through the injectable ``sleep``.
+
+    Network chaos: when a :class:`~.faults.FaultInjector` is attached (or
+    the env-gated global one exists), the ``net_*`` sites are consulted per
+    request under ``fault_key`` — the caller's ``"self->peer"`` edge string
+    — so each edge replays its own seeded schedule (see serving/faults.py)."""
+
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        timeout_s: float = 30.0,
+        connect_timeout_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        injector: Optional[FaultInjector] = None,
+        fault_key: str = "",
+    ):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = float(timeout_s)
+        self.connect_timeout_s = (
+            float(connect_timeout_s)
+            if connect_timeout_s is not None
+            else min(5.0, self.timeout_s)
+        )
+        self._clock = clock
+        self._sleep = sleep
+        self._injector = injector
+        self.fault_key = fault_key
+
+    def _inj(self) -> Optional[FaultInjector]:
+        return self._injector if self._injector is not None else global_injector()
 
     def _request(
         self,
@@ -225,43 +333,141 @@ class PeerClient:
         content_type: str = "application/json",
         timeout_s: Optional[float] = None,
         headers: Optional[Dict[str, str]] = None,
+        retries: int = 0,
     ) -> Tuple[int, bytes]:
-        req = urllib.request.Request(
-            self.base_url + path,
-            data=body,
-            method=method,
-            headers={"Content-Type": content_type, **(headers or {})},
-        )
-        try:
-            with urllib.request.urlopen(
-                req, timeout=timeout_s if timeout_s is not None else self.timeout_s
-            ) as resp:
-                return resp.status, resp.read()
-        except urllib.error.HTTPError as e:
-            detail, reason, retry = f"HTTP {e.code}", "", None
+        attempt = 0
+        while True:
             try:
-                payload = json.loads(e.read().decode("utf-8"))
+                return self._request_once(
+                    method,
+                    path,
+                    body=body,
+                    content_type=content_type,
+                    timeout_s=timeout_s,
+                    headers=headers,
+                )
+            except PeerUnreachable as e:
+                # only connect-phase failures are provably un-executed and
+                # safe to blindly re-send; read-phase recovery belongs to the
+                # router, which holds the idempotency key
+                if attempt >= int(retries) or e.phase != "connect":
+                    raise
+                attempt += 1
+                self._sleep(min(1.0, 0.05 * (2 ** (attempt - 1))))
+
+    def _request_once(
+        self,
+        method: str,
+        path: str,
+        *,
+        body: Optional[bytes],
+        content_type: str,
+        timeout_s: Optional[float],
+        headers: Optional[Dict[str, str]],
+    ) -> Tuple[int, bytes]:
+        inj = self._inj()
+        edge = self.fault_key
+        if inj is not None:
+            if inj.should_fire("net_partition", edge):
+                raise PeerUnreachable(
+                    f"{self.base_url}: injected net_partition (connection refused)",
+                    phase="connect",
+                )
+            if inj.should_fire("net_blackhole", edge):
+                raise PeerUnreachable(
+                    f"{self.base_url}: injected net_blackhole (connect timed "
+                    f"out after {self.connect_timeout_s}s)",
+                    phase="connect",
+                )
+            d = inj.sleep_s("net_delay", edge)
+            if d > 0:
+                self._sleep(d)
+            if (
+                body is not None
+                and content_type == "application/octet-stream"
+                and inj.should_fire("net_corrupt", edge)
+            ):
+                body = _flip_one_byte(body)
+        sp = urllib.parse.urlsplit(self.base_url + path)
+        conn_cls = (
+            http.client.HTTPSConnection
+            if sp.scheme == "https"
+            else http.client.HTTPConnection
+        )
+        conn = conn_cls(sp.netloc, timeout=self.connect_timeout_s)
+        try:
+            try:
+                conn.connect()
+            except (OSError, TimeoutError) as e:
+                raise PeerUnreachable(
+                    f"{self.base_url}: {e}", phase="connect"
+                ) from None
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                read_timeout = (
+                    float(timeout_s) if timeout_s is not None else self.timeout_s
+                )
+                sock.settimeout(max(0.001, read_timeout))
+            target = (sp.path or "/") + (f"?{sp.query}" if sp.query else "")
+            try:
+                conn.request(
+                    method,
+                    target,
+                    body=body,
+                    headers={"Content-Type": content_type, **(headers or {})},
+                )
+                if inj is not None and inj.should_fire("net_drop", edge):
+                    # the request is already on the wire: the peer may be
+                    # executing it right now — read-phase failure semantics
+                    raise PeerUnreachable(
+                        f"{self.base_url}: injected net_drop (connection lost "
+                        "awaiting response)",
+                        phase="read",
+                    )
+                resp = conn.getresponse()
+                data = resp.read()
+                status = int(resp.status)
+                resp_ct = resp.headers.get("Content-Type", "") or ""
+                retry_hdr = resp.headers.get("Retry-After")
+            except PeerUnreachable:
+                raise
+            except (http.client.HTTPException, OSError, TimeoutError) as e:
+                # post-connect death: the request MAY have been received and
+                # executed — the phase tells the router to dedup, not re-run
+                raise PeerUnreachable(
+                    f"{self.base_url}: {e!r}", phase="read"
+                ) from None
+        finally:
+            conn.close()
+        if (
+            inj is not None
+            and status < 400
+            and resp_ct.startswith("application/octet-stream")
+            and inj.should_fire("net_corrupt", edge)
+        ):
+            data = _flip_one_byte(data)
+        if status >= 400:
+            detail, reason, retry = f"HTTP {status}", "", None
+            try:
+                payload = json.loads(data.decode("utf-8"))
                 detail = str(payload.get("detail", detail))
                 reason = str(payload.get("reason", ""))
                 if "retry_after_s" in payload:
                     retry = float(payload["retry_after_s"])
             except Exception:
                 pass
-            if retry is None:
-                ra = e.headers.get("Retry-After") if e.headers else None
-                if ra is not None:
-                    try:
-                        retry = float(ra)
-                    except ValueError:
-                        retry = None
-            raise PeerHTTPError(
-                e.code, detail, retry_after_s=retry, reason=reason
-            ) from None
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
-            raise PeerUnreachable(f"{self.base_url}: {e}") from None
+            if retry is None and retry_hdr is not None:
+                try:
+                    retry = float(retry_hdr)
+                except ValueError:
+                    retry = None
+            raise PeerHTTPError(status, detail, retry_after_s=retry, reason=reason)
+        return status, data
 
-    def get_json(self, path: str, *, timeout_s: Optional[float] = None) -> dict:
-        _, data = self._request("GET", path, timeout_s=timeout_s)
+    def get_json(
+        self, path: str, *, timeout_s: Optional[float] = None, retries: int = 0
+    ) -> dict:
+        _, data = self._request("GET", path, timeout_s=timeout_s, retries=retries)
         return json.loads(data.decode("utf-8"))
 
     def post_json(
@@ -331,8 +537,19 @@ class FleetPeer:
         self.queued = 0
         self.active = 0
         self.prefix_seq = 0  # gossip cursor into the peer's delta log
+        self.prefix_digest = 0  # running CRC chain over the peer's gossip log
         self.dispatched = 0
         self.last_refresh_ok = False
+        # partition-tolerance state (FleetRouter.refresh owns all of it):
+        # when the peer was last CONFIRMED reachable, when the current
+        # unreachable streak began, whether its gossip-learned holdings were
+        # TTL-dropped, why the last refresh failed, and — on heal — when the
+        # forced anti-entropy resync started (convergence gauge)
+        self.last_confirmed: Optional[float] = None
+        self.unreachable_since: Optional[float] = None
+        self.ttl_dropped = False
+        self.last_failure_reason = ""
+        self.resync_started_at: Optional[float] = None
 
     def load(self) -> int:
         return self.queued + self.active
@@ -350,6 +567,7 @@ class _FleetRequest:
         "hops",
         "affinity_hit",
         "forced",
+        "timeout_retries_used",
     )
 
     def __init__(self, prompt_ids, body, prefix_len, deadline_at, trace_id):
@@ -361,6 +579,7 @@ class _FleetRequest:
         self.hops = 0
         self.affinity_hit = False
         self.forced = False
+        self.timeout_retries_used = 0
 
 
 class FleetResult:
@@ -421,25 +640,40 @@ class FleetRouter:
         peers: Sequence[Any],
         *,
         model: str,
+        name: str = "router",
         breaker_threshold: int = 3,
         breaker_reset_s: float = 10.0,
         max_reroutes: int = 2,
         request_timeout_s: float = 300.0,
+        connect_timeout_s: float = 5.0,
         health_timeout_s: float = 5.0,
         refresh_interval_s: float = 2.0,
+        registry_ttl_s: float = 30.0,
+        timeout_retries: int = 1,
         handoff_suffix_tokens: int = 64,
         pull_min_tokens: int = 1,
         max_workers: int = 8,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        injector: Optional[FaultInjector] = None,
     ):
         from .router import FleetPrefixRegistry
 
         self.model = model
+        self.name = str(name)
         self.max_reroutes = max(0, int(max_reroutes))
         self.request_timeout_s = float(request_timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
         self.health_timeout_s = float(health_timeout_s)
         self.refresh_interval_s = float(refresh_interval_s)
+        # how long a peer may stay unreachable before the affinity claims we
+        # learned from its gossip age out of the registry (partition
+        # tolerance: a dead link must stop attracting traffic)
+        self.registry_ttl_s = float(registry_ttl_s)
+        # read-phase failures re-try the SAME peer this many times before the
+        # peer counts as dead — paired with the idempotency key, the retry
+        # returns the original result instead of double-executing
+        self.timeout_retries = max(0, int(timeout_retries))
         self.handoff_suffix_tokens = int(handoff_suffix_tokens)
         self.pull_min_tokens = max(1, int(pull_min_tokens))
         self._clock = clock
@@ -449,15 +683,23 @@ class FleetRouter:
             if isinstance(p, FleetPeer):
                 self.peers.append(p)
             else:
-                name, url = p
+                peer_name, url = p
                 self.peers.append(
                     FleetPeer(
-                        name,
+                        peer_name,
                         url,
                         breaker=CircuitBreaker(
                             breaker_threshold, breaker_reset_s, clock=clock
                         ),
-                        timeout_s=request_timeout_s,
+                        client=PeerClient(
+                            url,
+                            timeout_s=request_timeout_s,
+                            connect_timeout_s=connect_timeout_s,
+                            clock=clock,
+                            sleep=sleep,
+                            injector=injector,
+                            fault_key=f"{self.name}->{peer_name}",
+                        ),
                     )
                 )
         if not self.peers:
@@ -489,6 +731,14 @@ class FleetRouter:
         self.handoff_fallbacks = 0
         self.pool_role_bypasses = 0
         self.refresh_failures = 0
+        self.refresh_failure_reasons: Dict[str, int] = {}
+        self.ttl_drops = 0
+        self.gossip_digest_mismatches = 0
+        self.reconciles = 0
+        self.reconcile_last_s = 0.0  # heal -> snapshot-applied convergence
+        self.timeout_retries_total = 0
+        self.pull_integrity_rejects = 0
+        self.pull_refetches = 0
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "FleetRouter":
@@ -526,20 +776,22 @@ class FleetRouter:
         """One poll of every peer: health/load off ``/fleet/healthz``,
         prefix gossip off ``/fleet/prefix?since=<cursor>``.  An unreachable
         peer is marked unhealthy AND fed to its breaker, so dispatch skips
-        it without paying a connect timeout per request."""
+        it without paying a connect timeout per request; WHY it failed
+        (timeout vs conn-refused vs 5xx) is classified into
+        ``refresh_failure_reasons`` and the flight recorder.  A peer
+        unreachable past ``registry_ttl_s`` has its gossip-learned holdings
+        dropped (its affinity claims stop attracting traffic); on heal its
+        log is reconciled via a forced reset-snapshot exchange and the
+        convergence time lands in ``reconcile_last_s``."""
         for peer in list(self.peers):
             try:
                 hz = peer.client.get_json(
                     "/fleet/healthz?peers=0", timeout_s=self.health_timeout_s
                 )
-            except (PeerUnreachable, PeerHTTPError, ValueError):
-                if peer.healthy or not peer.last_refresh_ok:
-                    peer.breaker.record_failure()
-                peer.healthy = False
-                peer.last_refresh_ok = False
-                with self._lock:
-                    self.refresh_failures += 1
+            except (PeerUnreachable, PeerHTTPError, ValueError) as e:
+                self._note_refresh_failure(peer, e)
                 continue
+            self._note_refresh_success(peer)
             status = hz.get("status", "ok")
             peer.healthy = status in ("ok", "degraded")
             peer.draining = status == "draining"
@@ -559,22 +811,98 @@ class FleetRouter:
         with self._lock:
             self._last_refresh = self._clock()
 
+    @staticmethod
+    def _failure_reason(exc: BaseException) -> str:
+        """Classify a refresh failure for the reason-labelled gauge: the
+        operator triaging a partition needs 'timeout' vs 'conn_refused' vs
+        'http_5xx' at a glance, not a generic failure count."""
+        if isinstance(exc, PeerHTTPError):
+            return f"http_{exc.status // 100}xx"
+        if isinstance(exc, PeerUnreachable):
+            if getattr(exc, "phase", "connect") == "read":
+                return "timeout"
+            text = str(exc).lower()
+            if "refused" in text:
+                return "conn_refused"
+            if "timed out" in text or "timeout" in text:
+                return "timeout"
+            return "unreachable"
+        return "bad_payload"
+
+    def _note_refresh_failure(self, peer: FleetPeer, exc: BaseException) -> None:
+        reason = self._failure_reason(exc)
+        was_healthy = peer.healthy
+        if peer.healthy or not peer.last_refresh_ok:
+            peer.breaker.record_failure()
+        peer.healthy = False
+        peer.last_refresh_ok = False
+        peer.last_failure_reason = reason
+        now = self._clock()
+        if peer.unreachable_since is None:
+            peer.unreachable_since = now
+        with self._lock:
+            self.refresh_failures += 1
+            self.refresh_failure_reasons[reason] = (
+                self.refresh_failure_reasons.get(reason, 0) + 1
+            )
+        if was_healthy:
+            self.flight.record(
+                "peer_unhealthy", peer=peer.name, reason=reason,
+                detail=str(exc)[:200],
+            )
+        if (
+            not peer.ttl_dropped
+            and now - peer.unreachable_since >= self.registry_ttl_s
+        ):
+            dropped = self._drop_peer_holdings(peer)
+            peer.ttl_dropped = True
+            with self._lock:
+                self.ttl_drops += 1
+            self.flight.record(
+                "registry_ttl_drop",
+                peer=peer.name,
+                reason=reason,
+                entries=dropped,
+                unreachable_s=round(now - peer.unreachable_since, 3),
+            )
+
+    def _note_refresh_success(self, peer: FleetPeer) -> None:
+        now = self._clock()
+        if peer.unreachable_since is not None and peer.ttl_dropped:
+            # heal after a TTL drop: our view of the peer's log is stale by
+            # construction — force the anti-entropy reset-snapshot exchange
+            # and time the convergence (resync_started_at -> snapshot applied)
+            peer.resync_started_at = now
+            peer.prefix_seq = -1  # always predates the log window -> reset
+        peer.unreachable_since = None
+        peer.ttl_dropped = False
+        peer.last_failure_reason = ""
+        peer.last_confirmed = now
+
+    def _drop_peer_holdings(self, peer: FleetPeer) -> int:
+        """Drop every registry holding learned from this peer's gossip
+        (namespaced sub-replicas aggregate to the process)."""
+        with self._lock:
+            names = set(self._peer_reps.get(peer.name, ()))
+        dropped = 0
+        for nm in names:
+            dropped += int(self.prefix_registry.drop_replica(nm) or 0)
+        return dropped
+
     def _note_rep(self, peer_name: str, namespaced: str) -> None:
         with self._lock:
             self._peer_reps.setdefault(peer_name, set()).add(namespaced)
 
-    def _poll_prefix(self, peer: FleetPeer) -> None:
+    def _poll_prefix(self, peer: FleetPeer, *, depth: int = 0) -> None:
         pj = peer.client.get_json(
             f"/fleet/prefix?since={peer.prefix_seq}",
             timeout_s=self.health_timeout_s,
         )
+        server_digest = pj.get("digest")
         if pj.get("reset"):
             # the peer's delta log was trimmed (or restarted) past our
             # cursor: drop its holdings and re-apply the snapshot
-            with self._lock:
-                names = set(self._peer_reps.get(peer.name, ()))
-            for nm in names:
-                self.prefix_registry.drop_replica(nm)
+            self._drop_peer_holdings(peer)
             for h in pj.get("holdings", []):
                 if h.get("model") != self.model:
                     continue
@@ -583,8 +911,27 @@ class FleetRouter:
                 self.prefix_registry.apply_holding(
                     nm, tuple(h["key"]), int(h["length"]), h.get("tier", TIER_HOST)
                 )
+            # a snapshot is authoritative: adopt the server's digest as the
+            # new chain base for subsequent deltas
+            if server_digest is not None:
+                peer.prefix_digest = int(server_digest)
+            if peer.resync_started_at is not None:
+                elapsed = self._clock() - peer.resync_started_at
+                peer.resync_started_at = None
+                with self._lock:
+                    self.reconciles += 1
+                    self.reconcile_last_s = float(elapsed)
+                self.flight.record(
+                    "gossip_reconciled",
+                    peer=peer.name,
+                    reconcile_s=round(elapsed, 4),
+                )
         else:
+            # chain the digest over EVERY event in the delta (the server
+            # digest covers its whole log, not one model's slice)
+            d = peer.prefix_digest
             for ev in pj.get("events", []):
+                d = _chain_digest(d, ev)
                 if ev.get("model") != self.model:
                     continue
                 nm = f"{peer.name}/{ev['replica']}"
@@ -592,6 +939,26 @@ class FleetRouter:
                 self.prefix_registry.on_event(
                     nm, ev["event"], tuple(ev["key"]), int(ev["length"])
                 )
+            peer.prefix_digest = d
+            if (
+                server_digest is not None
+                and int(server_digest) != d
+                and depth == 0
+            ):
+                # diverged logs (missed delta, disagreeing builds): never
+                # skew affinity silently — force the reset-snapshot path now
+                with self._lock:
+                    self.gossip_digest_mismatches += 1
+                self.flight.record(
+                    "gossip_digest_mismatch",
+                    peer=peer.name,
+                    ours=d,
+                    theirs=int(server_digest),
+                )
+                if peer.resync_started_at is None:
+                    peer.resync_started_at = self._clock()
+                peer.prefix_seq = -1
+                return self._poll_prefix(peer, depth=depth + 1)
         peer.prefix_seq = int(pj.get("seq", peer.prefix_seq))
 
     def _maybe_refresh(self) -> None:
@@ -615,10 +982,16 @@ class FleetRouter:
         deadline_s: Optional[float] = None,
         stream: Any = None,
         trace_id: Optional[str] = None,
+        attempt: int = 0,
     ) -> Future:
         """The :meth:`EngineRouter.submit` contract over the wire.  Returns
         a ``Future[FleetResult]``; raises synchronously only for contract
-        violations (streams do not cross the wire — attach them at a peer)."""
+        violations (streams do not cross the wire — attach them at a peer).
+
+        ``attempt`` is the CALLER's retry ordinal: it feeds the idempotency
+        key (``trace_id:attempt``), so a caller-level retry that WANTS a
+        fresh execution bumps it, while the router's own internal
+        timeout-retries reuse the same key and dedup server-side."""
         if stream is not None:
             raise ValueError(
                 "FleetRouter does not stream across processes; send streaming "
@@ -638,6 +1011,7 @@ class FleetRouter:
             "priority": priority,
             "tenant": tenant,
             "trace_id": trace_id,
+            "idem_key": f"{trace_id}:{int(attempt)}",
         }
         deadline_at = (
             self._clock() + float(deadline_s) if deadline_s is not None else None
@@ -830,6 +1204,26 @@ class FleetRouter:
                 self._note_peer_failure(peer, excluded, st, str(e))
                 continue
             except PeerUnreachable as e:
+                if (
+                    getattr(e, "phase", "connect") == "read"
+                    and st.timeout_retries_used < self.timeout_retries
+                ):
+                    # the request was already on the wire — the peer may have
+                    # executed it.  Retry the SAME peer under the request's
+                    # idempotency key (a dup returns the original result);
+                    # re-routing here is what double-executes.
+                    st.timeout_retries_used += 1
+                    with self._lock:
+                        self.timeout_retries_total += 1
+                    self.flight.record(
+                        "timeout_retry",
+                        trace_id=st.trace_id,
+                        peer=peer.name,
+                        attempt=st.timeout_retries_used,
+                        detail=str(e)[:200],
+                    )
+                    prefer = peer.name
+                    continue
                 self._note_peer_failure(peer, excluded, st, str(e))
                 continue
             peer.breaker.record_success()
@@ -883,29 +1277,55 @@ class FleetRouter:
                 break
         if src is None:
             return
-        try:
-            data = src.client.post_for_bytes(
-                "/fleet/kv/get",
-                {
-                    "model": self.model,
-                    "prompt_ids": st.prompt_ids,
-                    "prefix_len": st.prefix_len,
-                },
-                timeout_s=self.health_timeout_s * 4,
-            )
-            if data is None:
+        out = None
+        for fetch in range(2):  # original pull + ONE integrity re-fetch
+            try:
+                data = src.client.post_for_bytes(
+                    "/fleet/kv/get",
+                    {
+                        "model": self.model,
+                        "prompt_ids": st.prompt_ids,
+                        "prefix_len": st.prefix_len,
+                    },
+                    timeout_s=self.health_timeout_s * 4,
+                )
+                if data is None:
+                    with self._lock:
+                        self.pull_misses += 1
+                    return
+                out = peer.client.post_bytes(
+                    f"/fleet/kv/put?model={urllib.parse.quote(self.model)}",
+                    data,
+                    timeout_s=self.health_timeout_s * 4,
+                )
+                break
+            except PeerHTTPError as e:
+                if e.reason == "wire_integrity":
+                    # the payload rotted on THIS transfer — the holder still
+                    # has the intact entry, so one clean re-fetch is cheap;
+                    # a second corruption means cold prefill (never garbage)
+                    with self._lock:
+                        self.pull_integrity_rejects += 1
+                    if fetch == 0:
+                        with self._lock:
+                            self.pull_refetches += 1
+                        self.flight.record(
+                            "pull_integrity_refetch",
+                            trace_id=st.trace_id,
+                            from_peer=src.name,
+                            to_peer=peer.name,
+                        )
+                        continue
                 with self._lock:
-                    self.pull_misses += 1
+                    self.pull_failures += 1
+                logger.warning("fleet prefix pull failed: %s", e)
                 return
-            out = peer.client.post_bytes(
-                f"/fleet/kv/put?model={urllib.parse.quote(self.model)}",
-                data,
-                timeout_s=self.health_timeout_s * 4,
-            )
-        except (PeerUnreachable, PeerHTTPError, ValueError) as e:
-            with self._lock:
-                self.pull_failures += 1
-            logger.warning("fleet prefix pull failed: %s", e)
+            except (PeerUnreachable, ValueError) as e:
+                with self._lock:
+                    self.pull_failures += 1
+                logger.warning("fleet prefix pull failed: %s", e)
+                return
+        if out is None:
             return
         if out.get("stored"):
             with self._lock:
@@ -1008,6 +1428,8 @@ class FleetRouter:
                     "queued": p.queued,
                     "active": p.active,
                     "dispatched": p.dispatched,
+                    "last_failure_reason": p.last_failure_reason,
+                    "ttl_dropped": p.ttl_dropped,
                 }
                 for p in self.peers
             ]
@@ -1030,6 +1452,14 @@ class FleetRouter:
                 "handoff_fallbacks": self.handoff_fallbacks,
                 "pool_role_bypasses": self.pool_role_bypasses,
                 "refresh_failures": self.refresh_failures,
+                "refresh_failure_reasons": dict(self.refresh_failure_reasons),
+                "ttl_drops": self.ttl_drops,
+                "gossip_digest_mismatches": self.gossip_digest_mismatches,
+                "reconciles": self.reconciles,
+                "reconcile_last_s": self.reconcile_last_s,
+                "timeout_retries": self.timeout_retries_total,
+                "pull_integrity_rejects": self.pull_integrity_rejects,
+                "pull_refetches": self.pull_refetches,
             }
         out["prefix_registry"] = self.prefix_registry.stats()
         return out
@@ -1054,6 +1484,7 @@ class FleetPlane:
         peers: Sequence[Tuple[str, str]] = (),
         decode_max_prefill_tokens: int = 64,
         log_size: int = 4096,
+        idem_ledger_size: int = 512,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.registry = registry
@@ -1065,16 +1496,27 @@ class FleetPlane:
         self._lock = threading.Lock()
         self._log: deque = deque(maxlen=max(16, int(log_size)))
         self._seq = 0  # seq of the NEWEST event in the log
+        self._digest = 0  # rolling CRC32C chain over the WHOLE event log
         self.events_total = 0
         self.kv_puts = 0
         self.kv_gets = 0
         self.kv_put_rejects = 0
+        self.kv_integrity_rejects = 0
         self.pages_in = 0
         self.pages_out = 0
         self.pushes = 0
         self.push_failures = 0
         self.pool_rejects = 0
         self.pool_bypasses = 0
+        # idempotency ledger: idem_key -> (Future, done_flag).  Bounded and
+        # insertion-ordered; completed entries evict first so an in-flight
+        # execution is never forgotten while a dup could still arrive.
+        self._idem: "OrderedDict[str, list]" = OrderedDict()
+        self._idem_cap = max(8, int(idem_ledger_size))
+        self.idem_executions = 0
+        self.idem_hits = 0
+        self.idem_coalesced = 0
+        self.idem_evictions = 0
         self._wire(registry)
 
     @staticmethod
@@ -1126,18 +1568,75 @@ class FleetPlane:
             self._seq += 1
             self.events_total += 1
             self._log.append((self._seq, ev))
+            self._digest = _chain_digest(self._digest, ev)
 
     def prefix_events(self, since: int) -> dict:
         """Delta log entries past ``since``; when the cursor predates the
         log window (trim or process restart), a ``reset`` with the full
-        warm-holdings snapshot instead — followers drop-and-reapply."""
+        warm-holdings snapshot instead — followers drop-and-reapply.  Both
+        shapes carry the log's rolling ``digest`` so a follower whose own
+        chain diverges (missed delta, disagreeing builds) can detect it and
+        force this reset path instead of silently skewing affinity."""
         with self._lock:
             seq = self._seq
+            digest = self._digest
             oldest = self._log[0][0] if self._log else self._seq + 1
             if since >= oldest - 1:
                 events = [ev for s, ev in self._log if s > since]
-                return {"seq": seq, "events": events}
-        return {"seq": seq, "reset": True, "holdings": self._holdings()}
+                return {"seq": seq, "digest": digest, "events": events}
+        return {
+            "seq": seq,
+            "digest": digest,
+            "reset": True,
+            "holdings": self._holdings(),
+        }
+
+    # ----------------------------------------------------- idempotent dispatch
+    def idem_claim(self, key: str) -> Tuple[str, Future]:
+        """Claim an idempotency key.  ``("mine", fut)`` means the caller owns
+        the execution and must later :meth:`idem_complete` (success) or
+        :meth:`idem_release` (failure) the SAME future; ``("wait", fut)``
+        means another execution owns it — await the future, a non-``None``
+        result is the original response to return verbatim."""
+        with self._lock:
+            rec = self._idem.get(key)
+            if rec is not None:
+                if rec[1]:
+                    self.idem_hits += 1
+                else:
+                    self.idem_coalesced += 1
+                return ("wait", rec[0])
+            fut: Future = Future()
+            self._idem[key] = [fut, False]
+            self.idem_executions += 1
+            while len(self._idem) > self._idem_cap:
+                victim = next(
+                    (k for k, r in self._idem.items() if r[1]), None
+                ) or next(iter(self._idem))
+                del self._idem[victim]
+                self.idem_evictions += 1
+            return ("mine", fut)
+
+    def idem_complete(self, key: str, fut: Future, payload: dict) -> None:
+        """Record a successful execution: dups arriving later (or already
+        awaiting) get ``payload`` back instead of a re-execution."""
+        with self._lock:
+            rec = self._idem.get(key)
+            if rec is not None and rec[0] is fut:
+                rec[1] = True
+        # resolve OUTSIDE the lock — waiter callbacks run inline (DABT102)
+        if not fut.done():
+            fut.set_result(payload)
+
+    def idem_release(self, key: str, fut: Future) -> None:
+        """Failed execution: drop the ledger entry so a retry re-executes,
+        and resolve waiters with ``None`` (their cue to claim afresh)."""
+        with self._lock:
+            rec = self._idem.get(key)
+            if rec is not None and rec[0] is fut:
+                del self._idem[key]
+        if not fut.done():
+            fut.set_result(None)
 
     def _holdings(self) -> List[dict]:
         """Warm holdings across every generator's HOST tier (host DRAM +
@@ -1204,9 +1703,17 @@ class FleetPlane:
     def kv_put_wire(self, model: str, data: bytes) -> dict:
         """Decode + absorb one wire entry into the least-loaded replica's
         host tier (geometry/dtype validated by the engine).  Raises
-        :class:`WireVersionError` for cross-build payloads, ``ValueError``
-        for malformed ones, ``KeyError`` for an unknown model."""
-        entry = decode_kv_entry(data)
+        :class:`WireVersionError` for cross-build payloads,
+        :class:`WireIntegrityError` for checksum-failed ones (counted —
+        the chaos bench's rejected-corruption criterion reads it here),
+        ``ValueError`` for malformed ones, ``KeyError`` for an unknown
+        model."""
+        try:
+            entry = decode_kv_entry(data)
+        except WireIntegrityError:
+            with self._lock:
+                self.kv_integrity_rejects += 1
+            raise
         engines = self._model_engines(model)
         engines.sort(key=lambda e: e.queued_depth() + e.num_active)
         stored = False
@@ -1410,6 +1917,9 @@ class FleetPlane:
             models[name] = m
         with self._lock:
             seq = self._seq
+            digest = self._digest
+            integrity_rejects = self.kv_integrity_rejects
+            idem_hits = self.idem_hits
         out = {
             "status": status,
             "name": self.name,
@@ -1418,7 +1928,10 @@ class FleetPlane:
             "fleet": {
                 "pool": self.pool,
                 "seq": seq,
+                "digest": digest,
                 "peers_total": len(self.peers),
+                "kv_integrity_rejects": integrity_rejects,
+                "idem_hits": idem_hits,
             },
         }
         if check_peers and self.peers:
@@ -1462,10 +1975,17 @@ class FleetPlane:
                 "pool": self.pool,
                 "peers_total": len(self.peers),
                 "gossip_seq": self._seq,
+                "gossip_digest": self._digest,
                 "gossip_events_total": self.events_total,
                 "kv_puts": self.kv_puts,
                 "kv_gets": self.kv_gets,
                 "kv_put_rejects": self.kv_put_rejects,
+                "kv_integrity_rejects": self.kv_integrity_rejects,
+                "idem_executions": self.idem_executions,
+                "idem_hits": self.idem_hits,
+                "idem_coalesced": self.idem_coalesced,
+                "idem_evictions": self.idem_evictions,
+                "idem_ledger": len(self._idem),
                 "pages_in": self.pages_in,
                 "pages_out": self.pages_out,
                 "pushes": self.pushes,

@@ -63,6 +63,7 @@ import itertools
 import logging
 import os
 import re
+import struct
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -82,12 +83,90 @@ TIER_DISK = "disk"
 # unknown versions (WireVersionError) instead of reinterpreting bytes a
 # different build wrote — a silently misread fp8 page corrupts generations,
 # a loud failure re-prefills.
-KV_WIRE_VERSION = 1
+#
+# v1: magic + JSON header + raw k/v bytes.
+# v2: v1 + a CRC-32C of the page payload in the header/file, verified on
+#     decode, absorb, and disk promote.  Decoders ACCEPT the prior version
+#     (a v1 payload simply carries no checksum) so a rolling fleet upgrade
+#     never partitions on wire format; encoders always write the current one.
+KV_WIRE_VERSION = 2
+KV_WIRE_COMPAT_VERSIONS = (1, 2)
 
 
-class WireVersionError(ValueError):
+class WireDecodeError(ValueError):
+    """A KV wire payload failed to decode: truncated envelope, bad magic,
+    unreadable header, or body/metadata mismatch.  Subclasses ValueError so
+    pre-existing callers that caught ValueError keep working."""
+
+
+class WireVersionError(WireDecodeError):
     """A KV snapshot/wire payload carries an unknown ``wire_version`` — the
     writer was a different build.  Failing loudly beats corrupting pages."""
+
+
+class WireIntegrityError(WireDecodeError):
+    """A KV payload's CRC-32C does not match its bytes — corruption in
+    flight or at rest.  The payload is rejected wholesale: a garbage page
+    absorbed into the pool poisons every generation that shares the prefix,
+    while a loud reject costs one re-fetch or one cold prefill."""
+
+
+def _crc32c_tables() -> tuple:
+    # slicing-by-8 tables (Intel's algorithm, reflected): T[0] is the classic
+    # byte-at-a-time table, T[j][b] the CRC of byte b followed by j zero bytes
+    poly = 0x82F63B78  # Castagnoli, reflected
+    base = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ poly if (c & 1) else (c >> 1)
+        base.append(c)
+    tables = [tuple(base)]
+    for _ in range(7):
+        prev = tables[-1]
+        tables.append(tuple((p >> 8) ^ base[p & 0xFF] for p in prev))
+    return tuple(tables)
+
+
+_CRC32C_TABLES = _crc32c_tables()
+
+try:  # hardware/C implementation when the host has one (same polynomial)
+    from crc32c import crc32c as _crc32c_hw  # type: ignore
+except ImportError:
+    _crc32c_hw = None
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC-32C (Castagnoli) of bytes-like ``data``; ``crc`` chains a
+    running checksum across buffers (k bytes then v bytes, no concat copy).
+    Slicing-by-8 software fallback — payloads here are page-sized, and the
+    C path is picked up automatically when a ``crc32c`` module exists."""
+    if _crc32c_hw is not None:
+        return _crc32c_hw(bytes(data), crc)
+    if not isinstance(data, (bytes, bytearray)):
+        data = bytes(data)
+    t0, t1, t2, t3, t4, t5, t6, t7 = _CRC32C_TABLES
+    c = ~crc & 0xFFFFFFFF
+    n8 = len(data) - (len(data) % 8)
+    for w0, w1 in struct.iter_unpack("<II", memoryview(data)[:n8]):
+        c ^= w0
+        c = (
+            t7[c & 0xFF] ^ t6[(c >> 8) & 0xFF]
+            ^ t5[(c >> 16) & 0xFF] ^ t4[(c >> 24) & 0xFF]
+            ^ t3[w1 & 0xFF] ^ t2[(w1 >> 8) & 0xFF]
+            ^ t1[(w1 >> 16) & 0xFF] ^ t0[(w1 >> 24) & 0xFF]
+        )
+    for b in memoryview(data)[n8:]:
+        c = t0[(c ^ b) & 0xFF] ^ (c >> 8)
+    return ~c & 0xFFFFFFFF
+
+
+def entry_crc32c(k, v) -> int:
+    """The checksum stamped on a wire/disk entry: CRC-32C over the K page
+    bytes chained into the V page bytes, exactly the byte order the wire
+    envelope and the spill file store them in."""
+    c = crc32c(np.ascontiguousarray(k).view(np.uint8).reshape(-1).tobytes())
+    return crc32c(np.ascontiguousarray(v).view(np.uint8).reshape(-1).tobytes(), c)
 
 # process-wide sequence for unique spill tmp filenames (itertools.count is
 # GIL-atomic; the pid in the final path isolates across processes)
@@ -125,6 +204,10 @@ class HostPrefixEntry:
     # build-compatibility stamp (see KV_WIRE_VERSION): absorb() refuses
     # entries stamped by a different layout generation
     wire_version: int = KV_WIRE_VERSION
+    # CRC-32C over the k+v page bytes (entry_crc32c) for entries that crossed
+    # a wire or disk boundary; None for entries minted in-process.  absorb()
+    # re-verifies any entry that carries one.
+    crc32c: Optional[int] = None
 
 
 class HostKVTier:
@@ -182,6 +265,7 @@ class HostKVTier:
         self.disk_promotes = 0  # disk entries promoted back to host DRAM
         self.dropped = 0  # entries lost (no disk tier / disk failure / budget)
         self.migrated_in = 0  # entries absorbed from a dying replica
+        self.integrity_rejects = 0  # CRC-mismatched entries refused (wire/disk)
         # tier-transition listener: fn(event, key, length, pages).  Fired
         # OUTSIDE the lock; set once at wiring time (engine/router).
         self.on_event: Optional[Callable[..., None]] = None
@@ -363,6 +447,7 @@ class HostKVTier:
                 v_shape=np.asarray(ent.v.shape, np.int64),
                 dtype=np.asarray(str(ent.k.dtype)),
                 wire_version=np.asarray(KV_WIRE_VERSION, np.int64),
+                crc32c=np.asarray(entry_crc32c(ent.k, ent.v), np.int64),
             )
             os.replace(tmp, path)
         except (OSError, ValueError) as e:
@@ -413,30 +498,42 @@ class HostKVTier:
                     stale.append(old_path)
         self._remove_files(stale)
 
-    @staticmethod
-    def _load_disk_file(path: str, key: tuple, length: int, nbytes: int, pages: int):
+    def _load_disk_file(self, path: str, key: tuple, length: int, nbytes: int, pages: int):
         """Read one demoted entry back (no lock held).  None on failure.
         A file stamped with an unknown ``wire_version`` (a different build
         wrote into a shared spill dir) is dropped loudly — an honest miss
-        costs one re-prefill, a misread dtype layout corrupts pages."""
+        costs one re-prefill, a misread dtype layout corrupts pages.  A file
+        whose stored CRC-32C no longer matches its bytes (at-rest corruption)
+        is likewise dropped, counted in ``integrity_rejects``; files from the
+        pre-CRC layout carry no checksum and load as before."""
         try:
             with np.load(path, allow_pickle=False) as z:
                 if "wire_version" in z.files:
                     ver = int(z["wire_version"])
-                    if ver != KV_WIRE_VERSION:
+                    if ver not in KV_WIRE_COMPAT_VERSIONS:
                         logger.error(
                             "KV disk file %s has wire_version %d (this build "
-                            "supports %d) — written by a different build; "
+                            "accepts %s) — written by a different build; "
                             "dropping entry",
-                            path, ver, KV_WIRE_VERSION,
+                            path, ver, KV_WIRE_COMPAT_VERSIONS,
                         )
                         return None
+                stored_crc = int(z["crc32c"]) if "crc32c" in z.files else None
                 dtype = np.dtype(str(z["dtype"]))
                 k = z["k_bytes"].view(dtype).reshape(z["k_shape"])
                 v = z["v_bytes"].view(dtype).reshape(z["v_shape"])
+            if stored_crc is not None and entry_crc32c(k, v) != stored_crc:
+                logger.error(
+                    "KV disk file %s failed its CRC-32C — corrupt at rest; "
+                    "dropping entry (re-prefill beats a garbage page)", path,
+                )
+                with self._lock:
+                    self.integrity_rejects += 1
+                return None
             return HostPrefixEntry(
                 key=key, length=int(length), k=k, v=v,
                 nbytes=int(nbytes), pages=int(pages),
+                crc32c=stored_crc,
             )
         except (OSError, ValueError, KeyError) as e:
             logger.warning("KV disk promote failed (%s): %s", path, e)
@@ -657,18 +754,28 @@ class HostKVTier:
         the order, so only per-key presence makes the caller's
         migrated/lost-pages split exact.
 
-        Every entry's ``wire_version`` is checked BEFORE anything is
+        Every entry's ``wire_version`` — and, for entries that crossed a
+        wire or disk boundary, its CRC-32C — is checked BEFORE anything is
         absorbed (all-or-nothing): a snapshot stamped by a different build
-        raises :class:`WireVersionError` instead of half-importing pages
-        whose byte layout this build would misread."""
+        raises :class:`WireVersionError`, a checksum mismatch raises
+        :class:`WireIntegrityError`, and in neither case are pages
+        half-imported whose bytes this build would misread."""
         entries = list(entries)
         for ent in entries:
             ver = getattr(ent, "wire_version", KV_WIRE_VERSION)
-            if ver != KV_WIRE_VERSION:
+            if ver not in KV_WIRE_COMPAT_VERSIONS:
                 raise WireVersionError(
                     f"KV snapshot entry has wire_version {ver} "
-                    f"(this build supports {KV_WIRE_VERSION}); refusing to "
-                    "absorb pages written by a different build"
+                    f"(this build accepts {KV_WIRE_COMPAT_VERSIONS}); refusing "
+                    "to absorb pages written by a different build"
+                )
+            crc = getattr(ent, "crc32c", None)
+            if crc is not None and entry_crc32c(ent.k, ent.v) != crc:
+                with self._lock:
+                    self.integrity_rejects += 1
+                raise WireIntegrityError(
+                    f"KV entry {ent.key[:4]!r}... failed its CRC-32C; refusing "
+                    "to absorb a corrupt page payload"
                 )
         for ent in entries:
             self.put(ent.key, ent.length, ent.k, ent.v)
@@ -702,6 +809,7 @@ class HostKVTier:
                 "kv_disk_promotes": self.disk_promotes,
                 "kv_tier_dropped": self.dropped,
                 "kv_migrated_in": self.migrated_in,
+                "kv_integrity_rejects": self.integrity_rejects,
             }
 
 

@@ -905,6 +905,11 @@ def render_prometheus(registry: Any) -> str:
             x.add("dabt_fleet_handoff_push_failures_total", "counter", "failed handoff pushes", ps.get("push_failures"), flab)
             x.add("dabt_fleet_pool_rejects_total", "counter", "requests shed by the pool-role guard", ps.get("pool_rejects"), flab)
             x.add("dabt_fleet_pool_bypasses_total", "counter", "forced requests past the pool-role guard", ps.get("pool_bypasses"), flab)
+            x.add("dabt_fleet_kv_integrity_rejects_total", "counter", "checksum-failed KV wire payloads rejected", ps.get("kv_integrity_rejects"), flab)
+            x.add("dabt_fleet_idem_executions_total", "counter", "idempotency-keyed executions owned by this process", ps.get("idem_executions"), flab)
+            x.add("dabt_fleet_idem_hits_total", "counter", "duplicate dispatches answered from the idempotency ledger", ps.get("idem_hits"), flab)
+            x.add("dabt_fleet_idem_coalesced_total", "counter", "duplicate dispatches coalesced onto an in-flight execution", ps.get("idem_coalesced"), flab)
+            x.add("dabt_fleet_idem_ledger_entries", "gauge", "live idempotency ledger entries", ps.get("idem_ledger"), flab)
     frouter = getattr(registry, "fleet_router", None)
     if frouter is not None:
         try:
@@ -924,10 +929,20 @@ def render_prometheus(registry: Any) -> str:
             x.add("dabt_fleet_pages_shipped_total", "counter", "KV pages shipped by pulls and handoffs", fs.get("pages_shipped"), flab)
             x.add("dabt_fleet_handoffs_total", "counter", "disaggregated prefill->decode handoffs", fs.get("handoffs"), flab)
             x.add("dabt_fleet_handoff_fallbacks_total", "counter", "handoffs that fell back to unified dispatch", fs.get("handoff_fallbacks"), flab)
+            x.add("dabt_fleet_net_timeout_retries_total", "counter", "same-peer retries after a read-phase wire death", fs.get("timeout_retries"), flab)
+            x.add("dabt_fleet_net_ttl_drops_total", "counter", "partitioned peers whose gossip holdings aged out", fs.get("ttl_drops"), flab)
+            x.add("dabt_fleet_net_gossip_digest_mismatches_total", "counter", "diverged gossip logs forced onto the reset-snapshot path", fs.get("gossip_digest_mismatches"), flab)
+            x.add("dabt_fleet_net_reconciles_total", "counter", "post-heal anti-entropy reconciliations completed", fs.get("reconciles"), flab)
+            x.add("dabt_fleet_net_reconcile_last_seconds", "gauge", "last heal-to-converged reconciliation time", fs.get("reconcile_last_s"), flab)
+            x.add("dabt_fleet_pull_integrity_rejects_total", "counter", "prefix pulls rejected by the receiver's checksum", fs.get("pull_integrity_rejects"), flab)
+            x.add("dabt_fleet_pull_refetches_total", "counter", "prefix pulls re-fetched after a corrupt transfer", fs.get("pull_refetches"), flab)
+            for reason, n in sorted((fs.get("refresh_failure_reasons") or {}).items()):
+                x.add("dabt_fleet_refresh_failures_total", "counter", "peer refresh failures by classified reason", n, {"model": fs.get("model", ""), "reason": reason})
             for peer in fs.get("peers", []):
                 plab = {"model": fs.get("model", ""), "peer": peer["name"], "pool": peer.get("pool", "")}
                 x.add("dabt_fleet_peer_healthy", "gauge", "peer health from the last refresh", 1 if peer.get("healthy") else 0, plab)
                 x.add("dabt_fleet_peer_dispatched_total", "counter", "requests dispatched to this peer", peer.get("dispatched"), plab)
+                x.add("dabt_fleet_peer_ttl_dropped", "gauge", "peer currently aged out of the prefix registry", 1 if peer.get("ttl_dropped") else 0, plab)
     _render_task_plane(x)
     _render_rag_plane(x)
     return x.render()

@@ -29,7 +29,7 @@ from typing import Any, Mapping, Optional
 from aiohttp import web
 
 from .engine import EngineUnavailable
-from .kv_pool import WireVersionError
+from .kv_pool import WireIntegrityError, WireVersionError
 from .obs import new_trace_id, rag_plane_snapshot, render_prometheus
 from .registry import ModelRegistry
 from .scheduler import DeadlineExceeded, SchedulerRejected
@@ -47,6 +47,10 @@ PRIORITIES = ("interactive", "background")
 # only token-safe shapes pass through (anything else — or nothing — gets a
 # generated id), so a hostile header cannot smuggle CR/LF or grow unbounded
 _REQ_ID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,64}$")
+
+# fleet idempotency keys (trace_id:attempt) share the token-safe shape but
+# allow a little more length for the appended attempt ordinal
+_IDEM_KEY_RE = re.compile(r"^[A-Za-z0-9._:-]{1,80}$")
 
 
 def _request_id(request: web.Request) -> str:
@@ -569,6 +573,12 @@ def create_app(
             trace_id = body.get("trace_id") or rid
             if not isinstance(trace_id, str) or not _REQ_ID_RE.match(trace_id):
                 trace_id = rid
+            idem_key = body.get("idem_key")
+            if idem_key is not None and (
+                not isinstance(idem_key, str)
+                or not _IDEM_KEY_RE.match(idem_key)
+            ):
+                idem_key = None  # malformed keys never gate execution
         except _BadRequest as e:
             return _error_response(str(e), 422, rid)
         except Exception:
@@ -576,63 +586,91 @@ def create_app(
         eng = registry.get_generator(model)
         if eng is None:
             return _error_response("Model is not supported", 400, rid)
-        rej = plane.admission_guard(
-            model,
-            eng,
-            prompt_ids,
-            prefix_len,
-            prefill_only=prefill_only,
-            force=force,
-        )
-        if rej is not None:
-            return _shed_response(rej, rid)
-        if prefill_only:
-            # the handoff contract: full-prefix chunked prefill, one token
-            # emitted, background class — the scheduler tag that keeps
-            # handoff traffic distinct from interactive decode
-            max_tokens = 1
-            temperature = 0.0
-            priority = "background"
-            prefix_len = max(prefix_len, len(prompt_ids) - 1)
+        # idempotent dispatch: a timeout-retry carrying the same key gets the
+        # ORIGINAL result back (or coalesces onto the in-flight execution)
+        # instead of re-executing — double execution is the failure the chaos
+        # bench counts to zero
+        idem_fut = None
+        if idem_key is not None:
+            for _ in range(2):
+                state, f = plane.idem_claim(idem_key)
+                if state == "mine":
+                    idem_fut = f
+                    break
+                prior = await asyncio.wrap_future(f)
+                if prior is not None:
+                    return web.json_response(
+                        {**prior, "deduped": True, "request_id": rid},
+                        headers={"X-Request-Id": rid},
+                    )
+                # the owning execution failed and released — claim afresh
+        completed = False
         try:
-            fut = eng.submit(
+            rej = plane.admission_guard(
+                model,
+                eng,
                 prompt_ids,
-                max_tokens=max_tokens,
-                temperature=temperature,
-                top_p=top_p,
-                json_format=json_format,
-                prefix_len=prefix_len,
-                priority=priority,
-                tenant=tenant,
-                deadline_s=deadline_s,
-                trace_id=trace_id,
+                prefix_len,
+                prefill_only=prefill_only,
+                force=force,
             )
-            result = await asyncio.wrap_future(fut)
-        except SchedulerRejected as e:
-            return _shed_response(e, rid)
-        except EngineUnavailable as e:
-            return _unavailable_response(e, rid)
-        except DeadlineExceeded as e:
-            return _error_response(str(e), 504, rid)
-        except ValueError as e:
-            return _error_response(str(e), 422, rid)
-        except Exception as e:
-            logger.exception("fleet generate failed")
-            return _error_response(str(e), 500, rid)
-        resp = {
-            "token_ids": [int(t) for t in result.token_ids],
-            "result": result.text,
-            "usage": _usage(model, result),
-            "length_limited": result.length_limited,
-            "request_id": rid,
-            "trace_id": trace_id,
-        }
-        if prefill_only:
-            # export + push the finished prefix pages off the event loop
-            resp["handoff"] = await asyncio.get_running_loop().run_in_executor(
-                None, plane.handoff_export, model, prompt_ids, prefix_len, push_to
-            )
-        return web.json_response(resp, headers={"X-Request-Id": rid})
+            if rej is not None:
+                return _shed_response(rej, rid)
+            if prefill_only:
+                # the handoff contract: full-prefix chunked prefill, one token
+                # emitted, background class — the scheduler tag that keeps
+                # handoff traffic distinct from interactive decode
+                max_tokens = 1
+                temperature = 0.0
+                priority = "background"
+                prefix_len = max(prefix_len, len(prompt_ids) - 1)
+            try:
+                fut = eng.submit(
+                    prompt_ids,
+                    max_tokens=max_tokens,
+                    temperature=temperature,
+                    top_p=top_p,
+                    json_format=json_format,
+                    prefix_len=prefix_len,
+                    priority=priority,
+                    tenant=tenant,
+                    deadline_s=deadline_s,
+                    trace_id=trace_id,
+                )
+                result = await asyncio.wrap_future(fut)
+            except SchedulerRejected as e:
+                return _shed_response(e, rid)
+            except EngineUnavailable as e:
+                return _unavailable_response(e, rid)
+            except DeadlineExceeded as e:
+                return _error_response(str(e), 504, rid)
+            except ValueError as e:
+                return _error_response(str(e), 422, rid)
+            except Exception as e:
+                logger.exception("fleet generate failed")
+                return _error_response(str(e), 500, rid)
+            resp = {
+                "token_ids": [int(t) for t in result.token_ids],
+                "result": result.text,
+                "usage": _usage(model, result),
+                "length_limited": result.length_limited,
+                "request_id": rid,
+                "trace_id": trace_id,
+            }
+            if prefill_only:
+                # export + push the finished prefix pages off the event loop
+                resp["handoff"] = await asyncio.get_running_loop().run_in_executor(
+                    None, plane.handoff_export, model, prompt_ids, prefix_len, push_to
+                )
+            if idem_fut is not None:
+                plane.idem_complete(idem_key, idem_fut, resp)
+                completed = True
+            return web.json_response(resp, headers={"X-Request-Id": rid})
+        finally:
+            # every non-success exit (shed, 5xx, deadline, cancellation)
+            # releases the ledger entry so a retry re-executes cleanly
+            if idem_fut is not None and not completed:
+                plane.idem_release(idem_key, idem_fut)
 
     async def fleet_healthz(request: web.Request) -> web.Response:
         check = request.query.get("peers", "1") not in ("0", "false")
@@ -700,6 +738,12 @@ def create_app(
             # cross-build peer: fail loudly, never absorb pages we cannot
             # prove we understand (the versioned-wire contract)
             return web.json_response({"detail": str(e)}, status=409)
+        except WireIntegrityError as e:
+            # checksum-failed payload: machine-readable reason so the
+            # puller's one-re-fetch-then-cold-prefill policy can key off it
+            return web.json_response(
+                {"detail": str(e), "reason": "wire_integrity"}, status=422
+            )
         except KeyError:
             return web.json_response(
                 {"detail": "Model is not supported"}, status=400

@@ -385,6 +385,53 @@ def test_real_obs_module_is_hot_path_clean_and_clock_disciplined():
     assert raw_calls == []
 
 
+def test_real_fleet_module_is_clock_disciplined_for_dabt105():
+    """The fleet wire (serving/fleet.py): PeerClient's connect-retry backoff
+    and the router's TTL/reconcile timing are injectable — the module opts
+    into the DABT105 convention and the real sweep convicts nothing in it,
+    which is what lets the chaos bench drive partitions, backoff, and
+    registry TTLs on an offset clock with zero wall sleeps."""
+    import ast
+
+    from dabtlint.checks import _module_has_clock_convention
+    from dabtlint.project import Project
+
+    fleet_path = REPO_ROOT / "django_assistant_bot_tpu" / "serving" / "fleet.py"
+    proj = Project.load([str(fleet_path)])
+    (mod,) = proj.modules
+    assert _module_has_clock_convention(mod)
+    # the retry/backoff and partition-tolerance surfaces under the sweep
+    # really exist (a rename would silently un-cover them)
+    qualnames = set(mod.functions)
+    for want in (
+        "PeerClient._request",
+        "PeerClient._request_once",
+        "FleetRouter._note_refresh_failure",
+        "FleetRouter._poll_prefix",
+    ):
+        assert any(q.endswith(want) for q in qualnames), want
+    # the REAL serving-dir DABT105 sweep: zero findings against fleet.py
+    serving_dir = REPO_ROOT / "django_assistant_bot_tpu" / "serving"
+    found = [
+        f
+        for f in run_analysis([str(serving_dir)], select={"DABT105"})
+        if f.module.endswith("fleet.py")
+    ]
+    assert found == []
+    # and no raw time.time()/monotonic()/sleep() CALLS anywhere in the
+    # module — injectable defaults are attribute references, not calls
+    tree = ast.parse(fleet_path.read_text())
+    raw_calls = [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and isinstance(n.func.value, ast.Name)
+        and n.func.value.id == "time"
+    ]
+    assert raw_calls == []
+
+
 # --------------------------------------------------------------------- DABT105
 def test_dabt105_convention_and_dir_scoping(tmp_path):
     files = {

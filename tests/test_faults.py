@@ -97,6 +97,72 @@ def test_injector_rejects_unknown_sites_and_bad_specs():
     assert FaultInjector.from_spec({}) is None
 
 
+def test_injector_window_models_link_state():
+    """start_after_s/duration_s model a PARTITION: the site holds for the
+    whole window (elapsed from first consult, on the injectable clock) and
+    releases after — max_fires never truncates a window."""
+    t = [0.0]
+    inj = FaultInjector(
+        {"net_partition": {"start_after_s": 5.0, "duration_s": 3.0, "max_fires": 1}},
+        clock=lambda: t[0],
+    )
+    assert not inj.should_fire("net_partition")  # stamps first consult at 0
+    t[0] = 4.9
+    assert not inj.should_fire("net_partition")
+    for now in (5.0, 6.5, 7.9):  # window holds, max_fires=1 notwithstanding
+        t[0] = now
+        assert inj.should_fire("net_partition")
+    t[0] = 8.0  # heal: start_after + duration reached
+    assert not inj.should_fire("net_partition")
+    # a window needs a duration — a partition that never heals is a typo
+    with pytest.raises(ValueError, match="duration_s"):
+        FaultInjector({"net_partition": {"start_after_s": 1.0}})
+
+
+def test_injector_edges_scope_sites_to_keys():
+    """A spec's edges list scopes the site to those consult keys; other
+    edges never fire (and edges must be a list, not a bare string)."""
+    inj = FaultInjector({"net_drop": {"fire_on": [1, 2], "edges": ["r->a"]}})
+    assert not inj.should_fire("net_drop", "r->b")
+    assert inj.should_fire("net_drop", "r->a")
+    assert inj.should_fire("net_drop", "r->a")
+    assert not inj.should_fire("net_drop", "r->a")
+    with pytest.raises(ValueError, match="edges"):
+        FaultInjector({"net_drop": {"edges": "r->a"}})
+
+
+def test_injector_per_edge_streams_deterministic():
+    """Each edge draws from its own str-seeded RNG: the same seed replays
+    the same per-edge schedule regardless of how OTHER edges' consults
+    interleave — what makes a two-process chaos bench replayable."""
+    spec = {"net_drop": {"p": 0.5}}
+    i1, i2 = FaultInjector(spec, seed=5), FaultInjector(spec, seed=5)
+    pa = [i1.should_fire("net_drop", "x->a") for _ in range(100)]
+    pb = []
+    for _ in range(100):
+        i2.should_fire("net_drop", "x->b")  # interleaved other-edge consults
+        pb.append(i2.should_fire("net_drop", "x->a"))
+    assert pa == pb
+    # distinct edges follow distinct (still deterministic) schedules
+    i3 = FaultInjector(spec, seed=5)
+    assert [i3.should_fire("net_drop", "x->b") for _ in range(100)] != pa
+
+
+def test_injector_arm_with_key_and_edge_stats():
+    """arm(site, key=...) auto-registers the site and arms ONE edge's
+    substate; the edge appears as a site[key] row in stats() — the chaos
+    bench's injected-vs-rejected accounting reads those rows."""
+    inj = FaultInjector({})
+    inj.arm("net_corrupt", 2, key="probe")
+    assert not inj.should_fire("net_corrupt", "other")  # other edges inert
+    assert inj.should_fire("net_corrupt", "probe")
+    assert inj.should_fire("net_corrupt", "probe")
+    assert not inj.should_fire("net_corrupt", "probe")
+    st = inj.stats()
+    assert st["net_corrupt[probe]"] == {"calls": 3, "fires": 2}
+    assert st["net_corrupt[other]"]["fires"] == 0
+
+
 def test_injector_env_gate(monkeypatch):
     reset_global_injector()
     try:
