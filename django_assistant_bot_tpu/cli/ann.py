@@ -3,10 +3,18 @@
     dabt ann train                      # build + train IVF-PQ over a corpus
     dabt ann stats                      # geometry / drift / recall snapshot
     dabt ann probe-recall --curve       # recall@k vs nprobe sweep
+    dabt ann snapshot                   # force an atomic snapshot + WAL prune
+    dabt ann restore                    # recovery drill: replay + report
+    dabt ann verify                     # walk manifest digests + WAL CRCs
 
 Targets a knowledge-plane model (``--model questions|sentences``) or, with
 ``--synthetic N``, a seeded clustered corpus — the same generator the tests
 and bench use, so recall numbers line up across all three.
+
+The durable trio operates on a WAL+snapshot directory (storage/durable.py,
+docs/DURABILITY.md): default ``$DABT_ANN_DURABLE_DIR/<Model>.<field>``, or an
+explicit ``--dir``.  ``verify`` is read-only and exits non-zero on any digest
+or CRC mismatch — safe to run against a directory another process is serving.
 """
 
 from __future__ import annotations
@@ -17,7 +25,15 @@ import time
 
 def add_parser(sub):
     p = sub.add_parser("ann", help="train/inspect the IVF-PQ ANN index")
-    p.add_argument("action", choices=("train", "stats", "probe-recall"))
+    p.add_argument(
+        "action",
+        choices=("train", "stats", "probe-recall", "snapshot", "restore", "verify"),
+    )
+    p.add_argument(
+        "--dir", default=None,
+        help="durable WAL+snapshot directory (default: settings ANN_DURABLE_DIR "
+        "joined with <Model>.<field>; with --dir, --dim gives the vector dim)",
+    )
     p.add_argument(
         "--model", choices=("questions", "sentences"), default="questions",
         help="knowledge-plane corpus to index",
@@ -62,7 +78,66 @@ def _build(args):
     return index, time.perf_counter() - t0
 
 
+def _model_cls(args):
+    from ..storage.models import Question, Sentence
+
+    return Question if args.model == "questions" else Sentence
+
+
+def _durable_target(args):
+    """(directory, dim) for the durable trio — explicit --dir/--dim, or the
+    settings-derived per-corpus directory and the model field's dim."""
+    import os
+
+    from ..conf import settings
+
+    if args.dir:
+        return args.dir, args.dim
+    base = getattr(settings, "ANN_DURABLE_DIR", None)
+    if not base:
+        raise SystemExit(
+            "ann: no --dir and DABT_ANN_DURABLE_DIR is unset — nothing to target"
+        )
+    model_cls = _model_cls(args)
+    return (
+        os.path.join(base, f"{model_cls.__name__}.{args.field}"),
+        model_cls._fields[args.field].dim,
+    )
+
+
+def _run_durable(args) -> int:
+    from ..storage.durable import DurableANN, verify_dir
+
+    directory, dim = _durable_target(args)
+    if args.action == "verify":
+        report = verify_dir(directory)
+        print(json.dumps(report, indent=2, sort_keys=True, default=str))
+        return 0 if report["ok"] else 1
+
+    # snapshot + restore both start with a recovery (latest valid snapshot +
+    # WAL-tail replay) — restore stops there and reports; snapshot goes on to
+    # commit a fresh snapshot and prune the replayed WAL tail behind it
+    dur = DurableANN(directory, dim=dim, nlist=args.nlist, m=args.m, nprobe=args.nprobe, seed=args.seed)
+    try:
+        if args.action == "snapshot":
+            if not dur.writable:
+                print(f"(another process holds the WAL lock on {directory})")
+                return 1
+            dur.snapshot()
+        st = dur.durability_stats()
+        st["rows"] = len(dur)
+        # a restore resets the drift gauge (restore_state): advisory retrain
+        # starts from a clean slate on the recovered placement
+        st["retrain_advised"] = bool(dur.index.stats().get("retrain_advised"))
+        print(json.dumps(st, indent=2, sort_keys=True, default=str))
+    finally:
+        dur.close()
+    return 0
+
+
 def run(args) -> int:
+    if args.action in ("snapshot", "restore", "verify"):
+        return _run_durable(args)
     index, build_s = _build(args)
     if not len(index):
         print("(corpus empty — nothing to index)")

@@ -98,6 +98,23 @@ class Settings:
         self.ANN_M: int = int(_env("ANN_M", 0))
         self.ANN_NPROBE: int = int(_env("ANN_NPROBE", 0))
         self.ANN_RERANK: int = int(_env("ANN_RERANK", 256))
+        # durable retrieval plane (storage/durable.py): set a directory and
+        # every ANN-routed index gets a WAL + atomic snapshots + per-document
+        # idempotency ledger — crash recovery replays to the pre-crash index
+        # instead of re-embedding/retraining.  Unset keeps the volatile
+        # in-RAM behavior (the DB rebuild is then the only durability).
+        self.ANN_DURABLE_DIR: Optional[str] = _env("ANN_DURABLE_DIR")
+        # WAL fsync policy: "always" (every record durable before the append
+        # returns), "interval" (batched fsync), "never" (page cache decides —
+        # bulk-load/bench mode)
+        self.ANN_WAL_FSYNC: str = str(_env("ANN_WAL_FSYNC", "always"))
+        # auto-snapshot after this many WAL records (0 = manual/CLI only);
+        # keep the newest N snapshots on disk
+        self.ANN_SNAPSHOT_EVERY: int = int(_env("ANN_SNAPSHOT_EVERY", 512))
+        self.ANN_SNAPSHOT_KEEP: int = int(_env("ANN_SNAPSHOT_KEEP", 2))
+        # mmap-back the host f32 row tier (corpora past host RAM page from
+        # disk; the device bf16 rerank tier stays in HBM)
+        self.ANN_MMAP_ROWS: bool = str(_env("ANN_MMAP_ROWS", "0")) in ("1", "true", "True")
         # media plane (reference: settings.MEDIA_URL + MediaURLMiddleware,
         # assistant/assistant/middleware.py:4-15)
         self.MEDIA_URL: str = _env("MEDIA_URL", "/media/")

@@ -12,9 +12,20 @@ import numpy as np
 
 from ....ai.services.ai_service import get_ai_embedder
 from ....conf import settings
-from ....rag.index_registry import invalidate_index
+from ....rag.index_registry import ingest_document
 from ....storage.models import Question, Sentence
 from .base import DocumentProcessingStep
+
+
+def _doc_key(model_cls, document, rows) -> str:
+    """Idempotency-ledger key for one document's batch: the document id plus
+    a content version derived from the row ids (a re-split rewrites the rows,
+    so the max id + count move and the key changes with them).  Same
+    ``doc_id:version`` discipline as the task ledger (tasks/queue.py)."""
+    return (
+        f"{model_cls.__name__}:{document.id}:"
+        f"{max(r.id for r in rows)}:{len(rows)}"
+    )
 
 
 class SentencesEmbeddingsStep(DocumentProcessingStep):
@@ -31,7 +42,17 @@ class SentencesEmbeddingsStep(DocumentProcessingStep):
         for s, e in zip(sentences, embeddings):
             s.embedding = np.asarray(e, np.float32)
             s.save()
-        invalidate_index(Sentence)
+        # rows are saved (DB = source of truth) BEFORE the index sees them:
+        # durable corpora get a WAL-logged ledgered append (re-runs of this
+        # step after a worker crash dedup on the key), everything else falls
+        # back to generation invalidation inside ingest_document
+        ingest_document(
+            Sentence,
+            "embedding",
+            _doc_key(Sentence, self._document, sentences),
+            [s.id for s in sentences],
+            np.stack([s.embedding for s in sentences]),
+        )
 
 
 class QuestionsEmbeddingsStep(DocumentProcessingStep):
@@ -48,7 +69,13 @@ class QuestionsEmbeddingsStep(DocumentProcessingStep):
         for q, e in zip(questions, embeddings):
             q.embedding = np.asarray(e, np.float32)
             q.save()
-        invalidate_index(Question)
+        ingest_document(
+            Question,
+            "embedding",
+            _doc_key(Question, self._document, questions),
+            [q.id for q in questions],
+            np.stack([q.embedding for q in questions]),
+        )
 
 
 class ContentEmbeddingsStep(DocumentProcessingStep):

@@ -12,7 +12,7 @@ from typing import List
 
 from ....ai.dialog import AIDialog
 from ....conf import settings
-from ....rag.index_registry import invalidate_index
+from ....rag.index_registry import invalidate_index, remove_rows
 from ....rag.services.search_service import embedding_search_questions
 from ....storage.models import Document, Question, WikiDocument
 from ....utils.repeat_until import repeat_until
@@ -153,8 +153,9 @@ class MergeQuestionsStep(DocumentProcessingStep):
             json_format=True,
             condition=lambda resp: resp.result.get("result") in (1, 2),
         )
-        if response.result["result"] == 1:
-            similar_question.delete()
-        else:
-            question.delete()
-        invalidate_index(Question)
+        drop = similar_question if response.result["result"] == 1 else question
+        drop_id = drop.id
+        drop.delete()
+        # WAL-logged tombstone on durable corpora (the delete survives a
+        # crash), generation invalidation otherwise
+        remove_rows(Question, "embedding", [drop_id])

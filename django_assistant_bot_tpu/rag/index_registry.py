@@ -19,8 +19,11 @@ classes share the search surface, so callers never branch.
 
 from __future__ import annotations
 
+import os
 import threading
-from typing import Dict, Tuple, Type, Union
+from typing import Dict, Sequence, Tuple, Type, Union
+
+import numpy as np
 
 from ..conf import settings
 from ..storage.ann import ANNIndex
@@ -59,22 +62,93 @@ def _corpus_rows(model_cls: Type[Model], field: str) -> int:
     return model_cls.objects.exclude(**{f"{field}__isnull": True}).count()
 
 
-def _build_index(model_cls: Type[Model], field: str, mesh) -> AnyIndex:
+def _build_index(model_cls: Type[Model], field: str, mesh, prev=None) -> AnyIndex:
     """Route by corpus size: exact below the ANN threshold, IVF-PQ at/above it
-    (train + warmup happen here, in the thread that caused the rebuild)."""
+    (train + warmup happen here, in the thread that caused the rebuild).  With
+    ``ANN_DURABLE_DIR`` set, ANN-routed corpora get the WAL+snapshot-backed
+    wrapper: a rebuild is then a recovery (replay, no re-train) instead of a
+    from-scratch scan+train."""
     use_ann = bool(getattr(settings, "ANN", True))
     threshold = int(getattr(settings, "ANN_THRESHOLD", 200_000))
+    ann_kw = dict(
+        nlist=int(getattr(settings, "ANN_NLIST", 0)),
+        m=int(getattr(settings, "ANN_M", 0)),
+        nprobe=int(getattr(settings, "ANN_NPROBE", 0)),
+        rerank_depth=int(getattr(settings, "ANN_RERANK", 256)),
+    )
     if use_ann and _corpus_rows(model_cls, field) >= threshold:
+        durable_dir = getattr(settings, "ANN_DURABLE_DIR", None)
+        if durable_dir:
+            return _build_durable(model_cls, field, mesh, durable_dir, ann_kw, prev=prev)
         return ANNIndex.from_model(
-            model_cls,
-            field=field,
-            mesh=mesh,
-            nlist=int(getattr(settings, "ANN_NLIST", 0)),
-            m=int(getattr(settings, "ANN_M", 0)),
-            nprobe=int(getattr(settings, "ANN_NPROBE", 0)),
-            rerank_depth=int(getattr(settings, "ANN_RERANK", 256)),
+            model_cls, field=field, mesh=mesh, **ann_kw
         ).warmup()
     return VectorIndex.from_model(model_cls, field=field, mesh=mesh).warmup()
+
+
+def _build_durable(
+    model_cls: Type[Model], field: str, mesh, durable_dir: str, ann_kw: dict, prev=None
+):
+    """Recover a WAL+snapshot-backed ANN index, then reconcile with the DB.
+
+    Recovery replays the durable state exactly (no re-embed, no re-train).
+    The DB stays the source of truth, so the reconcile pass catches the two
+    drift cases recovery alone can't see: rows embedded while the durable
+    plane was off or owned by another process (ingested now), and rows
+    deleted from the DB (tombstoned now).  A read-only opener (another
+    process holds the WAL flock) applies the catch-up to its in-RAM index
+    only — the writer owns logging it.
+    """
+    from ..storage.durable import DurableANN
+
+    want_dir = os.path.join(durable_dir, f"{model_cls.__name__}.{field}")
+    if isinstance(prev, DurableANN) and prev.writable and prev.dir == want_dir:
+        # this process already OWNS the WAL (flock): a generation bump means
+        # the DB moved, not that our state is stale — reopening would deadlock
+        # into a read-only second instance, so refresh = reconcile in place
+        dur = prev
+    else:
+        if isinstance(prev, DurableANN):
+            prev.close()  # reader reopen: release fds before the fresh scan
+        dur = DurableANN(
+            want_dir,
+            dim=model_cls._fields[field].dim,
+            mesh=mesh,
+            fsync=str(getattr(settings, "ANN_WAL_FSYNC", "always")),
+            snapshot_every_records=int(getattr(settings, "ANN_SNAPSHOT_EVERY", 512)),
+            snapshot_keep=int(getattr(settings, "ANN_SNAPSHOT_KEEP", 2)),
+            mmap_rows=bool(getattr(settings, "ANN_MMAP_ROWS", False)),
+            **ann_kw,
+        )
+    have = set(dur.index.live_ids())
+    db_ids = set()
+    missing_ids: list = []
+    missing_rows: list = []
+    qs = model_cls.objects.exclude(**{f"{field}__isnull": True})
+    for obj in qs:
+        vec = getattr(obj, field)
+        if vec is None:
+            continue
+        db_ids.add(obj.id)
+        if obj.id not in have:
+            missing_ids.append(obj.id)
+            missing_rows.append(vec)
+    stale = sorted(have - db_ids)
+    if dur.writable:
+        if missing_ids:
+            dur.ingest(missing_ids, np.stack(missing_rows))
+        if stale:
+            dur.remove(stale)
+        if not dur.index.stats()["trained"] and len(dur):
+            dur.train()
+        if missing_ids or stale:
+            dur.snapshot()
+    else:
+        if missing_ids:
+            dur.index.add(missing_ids, np.stack(missing_rows))
+        if stale:
+            dur.index.remove(stale)
+    return dur.warmup()
 
 
 def get_index(model_cls: Type[Model], field: str = "embedding") -> AnyIndex:
@@ -105,7 +179,7 @@ def get_index(model_cls: Type[Model], field: str = "embedding") -> AnyIndex:
                 from ..parallel import get_mesh
 
                 mesh = get_mesh()
-            fresh = _build_index(model_cls, field, mesh)
+            fresh = _build_index(model_cls, field, mesh, prev=index)
             with _lock:
                 # only adopt if no invalidation landed during the rebuild;
                 # otherwise keep the stale marker so the next caller rebuilds
@@ -118,9 +192,11 @@ def get_index(model_cls: Type[Model], field: str = "embedding") -> AnyIndex:
     return index
 
 
-def invalidate_index(model_cls: Type[Model], field: str = "embedding") -> None:
+def invalidate_index(model_cls: Type[Model], field: str = "embedding") -> int:
     """Bump the persistent generation — every process (API server, query
-    workers, other ingestion workers) rebuilds on its next lookup."""
+    workers, other ingestion workers) rebuilds on its next lookup.  Returns
+    the new generation so in-place ingesters (:func:`ingest_document`) can
+    adopt it without a self-inflicted rebuild."""
     key = f"{model_cls.__name__}.{field}"
     db = get_database()
     db.ensure_schema("vector_index_generation", _SCHEMA)
@@ -129,6 +205,69 @@ def invalidate_index(model_cls: Type[Model], field: str = "embedding") -> None:
         "ON CONFLICT(key) DO UPDATE SET generation = generation + 1",
         (key,),
     )
+    return _db_generation(key)
+
+
+def ingest_document(
+    model_cls: Type[Model],
+    field: str,
+    doc_key: str,
+    ids: Sequence[int],
+    vectors,
+) -> bool:
+    """Crash-resumable ingestion entry point for task-plane workers.
+
+    Durable ANN corpora get a WAL-logged, ledger-deduped live append keyed by
+    ``doc_key`` (a ``doc_id:version`` string): a worker SIGKILLed mid-task
+    re-runs its whole step after lease reclaim, and every already-applied
+    document no-ops — the task ledger's exactly-once discipline (PR 13)
+    carried down into the index.  Exact-routed / non-durable corpora fall
+    back to generation invalidation: their rebuild-from-DB path is already
+    durable because the DB rows (saved before this call) are the source of
+    truth.  Returns True when rows were applied or an invalidation ran,
+    False on a ledger dedup no-op.
+    """
+    key = (model_cls.__name__, field)
+    index = get_index(model_cls, field)
+    ingest = getattr(index, "ingest", None)
+    if ingest is None or not getattr(index, "writable", True):
+        invalidate_index(model_cls, field)
+        return True
+    applied = ingest(ids, vectors, ledger_key=doc_key)
+    if applied:
+        # other processes observe the bumped generation and rebuild (their
+        # rebuild is a recovery from the durable dir, which now holds these
+        # rows); THIS process already serves them, so it adopts the new
+        # generation in place and skips the self-inflicted rebuild
+        gen = invalidate_index(model_cls, field)
+        with _lock:
+            if _indexes.get(key) is index:
+                _built_generation[key] = gen
+    return applied > 0
+
+
+def remove_rows(model_cls: Type[Model], field: str, ids: Sequence[int]) -> None:
+    """Tombstone deleted rows in the live index.
+
+    Durable corpora get a WAL-logged removal (the delete survives a crash —
+    and cannot resurrect across a snapshot boundary, see storage/durable.py);
+    everything else falls back to generation invalidation, whose rebuild
+    simply no longer finds the DB rows."""
+    key = (model_cls.__name__, field)
+    with _lock:
+        index = _indexes.get(key)  # never BUILD an index just to delete from it
+    if (
+        index is not None
+        and hasattr(index, "ingest")
+        and getattr(index, "writable", True)
+    ):
+        index.remove([int(i) for i in ids])
+        gen = invalidate_index(model_cls, field)
+        with _lock:
+            if _indexes.get(key) is index:
+                _built_generation[key] = gen
+    else:
+        invalidate_index(model_cls, field)
 
 
 def reset_indexes() -> None:

@@ -63,6 +63,16 @@ Sites (the full set — unknown names are a config error, not a silent no-op):
 ``net_blackhole`` fleet wire: the SYN black-holes (connect times out, nothing
                   answers) — distinct from ``net_partition`` only in detail
                   text; exercises the fast connect-timeout path
+``disk_write_fail``  durability plane (storage/durable.py): a WAL append or
+                  snapshot write fails up front (ENOSPC, EIO) — the mutation
+                  must be rejected whole, never half-applied
+``disk_torn_write``  durability plane: a WAL record write is cut mid-record
+                  (power loss between write and fsync) — the file keeps a
+                  torn tail that recovery must truncate, not trust
+``snapshot_corrupt``  durability plane: one byte of a just-written snapshot
+                  artifact flips (bit rot, partial page) — the manifest
+                  digest walk must reject the snapshot and fall back to the
+                  previous valid one
 ================  ============================================================
 
 Each site's spec is either a bare float (fire probability) or a mapping with
@@ -113,7 +123,11 @@ TASK_SITES = ("task_raise", "task_worker_lost", "platform_http_429", "platform_h
 # consulted by the fleet-wire PeerClient (serving/fleet.py) per edge — every
 # consult carries a ``key`` ("router->peer" string) with its own seeded state
 NET_SITES = ("net_drop", "net_delay", "net_corrupt", "net_partition", "net_blackhole")
-ALL_SITES = ENGINE_SITES + HTTP_SITES + ROUTER_SITES + TASK_SITES + NET_SITES
+# consulted by the retrieval durability plane (storage/durable.py) around WAL
+# appends and snapshot writes, via the same lazy global-injector discipline as
+# the task plane — the storage package never imports this module eagerly
+STORAGE_SITES = ("disk_write_fail", "disk_torn_write", "snapshot_corrupt")
+ALL_SITES = ENGINE_SITES + HTTP_SITES + ROUTER_SITES + TASK_SITES + NET_SITES + STORAGE_SITES
 
 ENV_FAULTS = "DABT_FAULTS"
 ENV_SEED = "DABT_FAULT_SEED"

@@ -74,9 +74,8 @@ from .kv_pool import (
     WireDecodeError,
     WireIntegrityError,
     WireVersionError,
-    crc32c,
-    entry_crc32c,
 )
+from ..storage.integrity import crc32c, entry_crc32c
 from .obs import FlightRecorder, new_trace_id
 from .scheduler import DeadlineExceeded, SchedulerRejected
 

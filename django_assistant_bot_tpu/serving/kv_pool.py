@@ -63,11 +63,12 @@ import itertools
 import logging
 import os
 import re
-import struct
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..storage.integrity import crc32c, entry_crc32c  # noqa: F401 - re-exported
 
 logger = logging.getLogger(__name__)
 
@@ -111,62 +112,10 @@ class WireIntegrityError(WireDecodeError):
     while a loud reject costs one re-fetch or one cold prefill."""
 
 
-def _crc32c_tables() -> tuple:
-    # slicing-by-8 tables (Intel's algorithm, reflected): T[0] is the classic
-    # byte-at-a-time table, T[j][b] the CRC of byte b followed by j zero bytes
-    poly = 0x82F63B78  # Castagnoli, reflected
-    base = []
-    for i in range(256):
-        c = i
-        for _ in range(8):
-            c = (c >> 1) ^ poly if (c & 1) else (c >> 1)
-        base.append(c)
-    tables = [tuple(base)]
-    for _ in range(7):
-        prev = tables[-1]
-        tables.append(tuple((p >> 8) ^ base[p & 0xFF] for p in prev))
-    return tuple(tables)
-
-
-_CRC32C_TABLES = _crc32c_tables()
-
-try:  # hardware/C implementation when the host has one (same polynomial)
-    from crc32c import crc32c as _crc32c_hw  # type: ignore
-except ImportError:
-    _crc32c_hw = None
-
-
-def crc32c(data, crc: int = 0) -> int:
-    """CRC-32C (Castagnoli) of bytes-like ``data``; ``crc`` chains a
-    running checksum across buffers (k bytes then v bytes, no concat copy).
-    Slicing-by-8 software fallback — payloads here are page-sized, and the
-    C path is picked up automatically when a ``crc32c`` module exists."""
-    if _crc32c_hw is not None:
-        return _crc32c_hw(bytes(data), crc)
-    if not isinstance(data, (bytes, bytearray)):
-        data = bytes(data)
-    t0, t1, t2, t3, t4, t5, t6, t7 = _CRC32C_TABLES
-    c = ~crc & 0xFFFFFFFF
-    n8 = len(data) - (len(data) % 8)
-    for w0, w1 in struct.iter_unpack("<II", memoryview(data)[:n8]):
-        c ^= w0
-        c = (
-            t7[c & 0xFF] ^ t6[(c >> 8) & 0xFF]
-            ^ t5[(c >> 16) & 0xFF] ^ t4[(c >> 24) & 0xFF]
-            ^ t3[w1 & 0xFF] ^ t2[(w1 >> 8) & 0xFF]
-            ^ t1[(w1 >> 16) & 0xFF] ^ t0[(w1 >> 24) & 0xFF]
-        )
-    for b in memoryview(data)[n8:]:
-        c = t0[(c ^ b) & 0xFF] ^ (c >> 8)
-    return ~c & 0xFFFFFFFF
-
-
-def entry_crc32c(k, v) -> int:
-    """The checksum stamped on a wire/disk entry: CRC-32C over the K page
-    bytes chained into the V page bytes, exactly the byte order the wire
-    envelope and the spill file store them in."""
-    c = crc32c(np.ascontiguousarray(k).view(np.uint8).reshape(-1).tobytes())
-    return crc32c(np.ascontiguousarray(v).view(np.uint8).reshape(-1).tobytes(), c)
+# The CRC-32C implementation itself (``crc32c`` / ``entry_crc32c``) lives in
+# storage/integrity.py — one copy shared by this disk-spill path, the fleet
+# wire v2 codec, and the ANN durability WAL.  Imported + re-exported above so
+# pre-unification importers of ``kv_pool.crc32c`` keep working.
 
 # process-wide sequence for unique spill tmp filenames (itertools.count is
 # GIL-atomic; the pid in the final path isolates across processes)

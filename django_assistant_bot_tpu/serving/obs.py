@@ -687,6 +687,24 @@ def _render_rag_plane(x: "_Exposition") -> None:
         lr = st.get("last_recall") or {}
         if lr.get("recall_at_k") is not None:
             x.add("dabt_ann_last_recall", "gauge", "recall@k from the last probe_recall()", lr.get("recall_at_k"), lab)
+        dur = st.get("durability")
+        if dur:
+            # WAL+snapshot plane (storage/durable.py, docs/DURABILITY.md):
+            # wal_records is the writer's sequence high-water mark; snapshot
+            # age only renders once a snapshot exists (None until then)
+            x.add("dabt_ann_wal_records", "gauge", "WAL sequence high-water mark", dur.get("wal_records"), lab)
+            x.add("dabt_ann_wal_bytes", "gauge", "bytes across live WAL segments", dur.get("wal_bytes"), lab)
+            x.add("dabt_ann_wal_segments", "gauge", "live WAL segment files", dur.get("wal_segments"), lab)
+            if dur.get("snapshot_age_s") is not None:
+                x.add("dabt_ann_snapshot_age_s", "gauge", "seconds since the last committed snapshot", dur.get("snapshot_age_s"), lab)
+            x.add("dabt_ann_snapshot_count", "gauge", "committed snapshots on disk", dur.get("snapshot_count"), lab)
+            x.add("dabt_ann_writable", "gauge", "this process owns the WAL flock (0=read-only recovery)", 1 if dur.get("writable") else 0, lab)
+            x.add("dabt_ann_recovery_replayed_records", "gauge", "WAL records replayed at last startup recovery", dur.get("replayed_records"), lab)
+            x.add("dabt_ann_recovery_s", "gauge", "wall seconds spent in last startup recovery", dur.get("recovery_s"), lab)
+            x.add("dabt_ann_snapshot_fallbacks_total", "counter", "corrupt snapshots skipped for an older valid one", dur.get("snapshot_fallbacks"), lab)
+            x.add("dabt_ann_wal_torn_tail_truncations_total", "counter", "torn WAL tails healed at open", dur.get("torn_tail_truncations"), lab)
+            x.add("dabt_ann_ledger_entries", "gauge", "idempotency-ledger keys tracked", dur.get("ledger_entries"), lab)
+            x.add("dabt_ann_ledger_dedup_hits_total", "counter", "ingests no-opped by the idempotency ledger", dur.get("ledger_dedup_hits"), lab)
 
 
 def _engine_rows(registry: Any) -> List[Tuple[str, str, Any, Optional[Any]]]:

@@ -483,6 +483,30 @@ def create_app(
         # engine kind + the ANN recall/drift gauges (docs/ANN.md)
         rag = rag_plane_snapshot()
         if rag.get("indexes"):
+            # durability roll-up across WAL+snapshot-backed indexes
+            # (docs/DURABILITY.md): one block an operator can alert on
+            # without walking per-index stats.  A corrupt-snapshot fallback
+            # or a lost WAL flock degrades health — both mean the durable
+            # plane is serving, but not the way it was configured to.
+            durables = [
+                (name, st["durability"])
+                for name, st in sorted(rag["indexes"].items())
+                if isinstance(st, dict) and st.get("durability")
+            ]
+            if durables:
+                ages = [d["snapshot_age_s"] for _, d in durables if d.get("snapshot_age_s") is not None]
+                rag["durability"] = {
+                    "indexes": len(durables),
+                    "writable": sum(1 for _, d in durables if d.get("writable")),
+                    "wal_records": sum(int(d.get("wal_records") or 0) for _, d in durables),
+                    "wal_bytes": sum(int(d.get("wal_bytes") or 0) for _, d in durables),
+                    "oldest_snapshot_age_s": max(ages) if ages else None,
+                    "replayed_records": sum(int(d.get("replayed_records") or 0) for _, d in durables),
+                    "snapshot_fallbacks": sum(int(d.get("snapshot_fallbacks") or 0) for _, d in durables),
+                    "torn_tail_truncations": sum(int(d.get("torn_tail_truncations") or 0) for _, d in durables),
+                }
+                if rag["durability"]["snapshot_fallbacks"] and status == "ok":
+                    payload["status"] = status = "degraded"
             payload["rag"] = rag
         return web.json_response(payload)
 

@@ -401,6 +401,7 @@ class ANNIndex:
         nprobe: int = 0,
         rerank_depth: int = _DEF_RERANK,
         seed: int = 0,
+        mat_alloc=None,
     ):
         self.dim = dim
         self.mesh = mesh
@@ -414,10 +415,14 @@ class ANNIndex:
         self.seed = int(seed)
         self.drift_threshold = _DRIFT_ADVISE_FRAC
         self._lock = threading.Lock()
+        # host f32 row tier allocator — the durability plane injects an
+        # mmap-backed allocator here so corpora past host RAM page from disk;
+        # the device rerank tier is unaffected (bf16 copies still live in HBM)
+        self._mat_alloc = mat_alloc or (lambda shape: np.empty(shape, np.float32))
         # host row tier (raw f32, positions append-only between restages)
         self._ids: list[int] = []
         self._id_pos: dict[int, int] = {}
-        self._mat = np.empty((0, dim), np.float32)
+        self._mat = self._mat_alloc((0, dim))
         self._n = 0
         self._dead: set[int] = set()
         # device rerank tier (bf16 normalized rows + validity)
@@ -475,7 +480,7 @@ class ANNIndex:
     def _grow_host(self, need: int) -> None:
         cap = _next_cap(max(1024, self._mat.shape[0]), need)
         if cap != self._mat.shape[0]:
-            new_mat = np.empty((cap, self.dim), np.float32)
+            new_mat = self._mat_alloc((cap, self.dim))
             new_mat[: self._n] = self._mat[: self._n]
             self._mat = new_mat
             for name in ("_row_list", "_row_slot"):
@@ -537,7 +542,7 @@ class ANNIndex:
     def clear(self) -> None:
         with self._lock:
             self._ids, self._id_pos = [], {}
-            self._mat = np.empty((0, self.dim), np.float32)
+            self._mat = self._mat_alloc((0, self.dim))
             self._n = 0
             self._dead = set()
             self._rerank = self._rvalid = None
@@ -786,6 +791,51 @@ class ANNIndex:
                 codebooks, ccounts = _pq_step(codebooks, ccounts, batch)
         return self._put(centroids, sharded=False), self._put(codebooks, sharded=False)
 
+    def _encode_pack(self, live_rows: np.ndarray, all_lists: np.ndarray,
+                     centroids, codebooks, nlist_eff: int):
+        """Encode every row against its ASSIGNED list and pack the device code
+        blocks — shared by ``_restage`` (fresh spill assignment) and
+        ``restore_state`` (assignment read back from a snapshot, so restored
+        placement — and therefore every ADC score — matches pre-crash bits).
+
+        Returns ``(codes_d, lvalid_d, rowpos_d, counts, row_slot, sums)``.
+        """
+        n = live_rows.shape[0]
+        all_codes = np.empty((n, self.m), np.uint8)
+        for s in range(0, n, _ENCODE_BATCH):
+            e = min(n, s + _ENCODE_BATCH)
+            all_codes[s:e] = jax.device_get(
+                _encode_assigned(
+                    centroids,
+                    codebooks,
+                    jnp.asarray(_normalize(live_rows[s:e])),
+                    jnp.asarray(all_lists[s:e]),
+                )
+            )
+        counts = np.bincount(all_lists, minlength=nlist_eff).astype(np.int64)
+        # tight rounding (multiple of 128, not power of two): list_cap directly
+        # multiplies every probe's scan cost; append-time growth stays geometric
+        list_cap = max(32, -(-int(counts.max(initial=0)) // 128) * 128)
+        # vectorized host-side packing (stable argsort gives each row its slot
+        # within its list), then one sharded device_put per array
+        order = np.argsort(all_lists, kind="stable")
+        cum = np.concatenate([[0], np.cumsum(counts)])
+        row_slot = np.empty((n,), np.int32)
+        row_slot[order] = (np.arange(n) - cum[all_lists[order]]).astype(np.int32)
+        codes_h = np.zeros((nlist_eff, list_cap, self.m), np.uint8)
+        lvalid_h = np.zeros((nlist_eff, list_cap), bool)
+        rowpos_h = np.zeros((nlist_eff, list_cap), np.int32)
+        codes_h[all_lists, row_slot] = all_codes
+        lvalid_h[all_lists, row_slot] = True
+        rowpos_h[all_lists, row_slot] = np.arange(n, dtype=np.int32)
+        codes_d = self._put(jnp.asarray(codes_h), sharded=True)
+        lvalid_d = self._put(jnp.asarray(lvalid_h), sharded=True)
+        rowpos_d = self._put(jnp.asarray(rowpos_h), sharded=True)
+        # drift gauge restarts from the fresh assignment
+        sums = np.zeros((nlist_eff, self.dim), np.float32)
+        np.add.at(sums, all_lists, _normalize(live_rows))
+        return codes_d, lvalid_d, rowpos_d, counts, row_slot, sums
+
     def _restage(
         self,
         retrain: bool,
@@ -836,39 +886,9 @@ class ANNIndex:
         cap_soft = max(32, _next_cap(32, 2 * max(1, -(-n // nlist_eff))))
         fill = np.zeros((nlist_eff,), np.int64)
         all_lists = _spill_assign(all_lists2, fill, cap_soft)
-        all_codes = np.empty((n, self.m), np.uint8)
-        for s in range(0, n, _ENCODE_BATCH):
-            e = min(n, s + _ENCODE_BATCH)
-            all_codes[s:e] = jax.device_get(
-                _encode_assigned(
-                    centroids,
-                    codebooks,
-                    jnp.asarray(_normalize(live_rows[s:e])),
-                    jnp.asarray(all_lists[s:e]),
-                )
-            )
-        counts = fill
-        # tight rounding (multiple of 128, not power of two): list_cap directly
-        # multiplies every probe's scan cost; append-time growth stays geometric
-        list_cap = max(32, -(-int(counts.max()) // 128) * 128)
-        # vectorized host-side packing (stable argsort gives each row its slot
-        # within its list), then one sharded device_put per array
-        order = np.argsort(all_lists, kind="stable")
-        cum = np.concatenate([[0], np.cumsum(counts)])
-        row_slot = np.empty((n,), np.int32)
-        row_slot[order] = (np.arange(n) - cum[all_lists[order]]).astype(np.int32)
-        codes_h = np.zeros((nlist_eff, list_cap, self.m), np.uint8)
-        lvalid_h = np.zeros((nlist_eff, list_cap), bool)
-        rowpos_h = np.zeros((nlist_eff, list_cap), np.int32)
-        codes_h[all_lists, row_slot] = all_codes
-        lvalid_h[all_lists, row_slot] = True
-        rowpos_h[all_lists, row_slot] = np.arange(n, dtype=np.int32)
-        codes_d = self._put(jnp.asarray(codes_h), sharded=True)
-        lvalid_d = self._put(jnp.asarray(lvalid_h), sharded=True)
-        rowpos_d = self._put(jnp.asarray(rowpos_h), sharded=True)
-        # drift gauge restarts from the fresh assignment
-        sums = np.zeros((nlist_eff, self.dim), np.float32)
-        np.add.at(sums, all_lists, _normalize(live_rows))
+        (codes_d, lvalid_d, rowpos_d, counts, row_slot, sums) = self._encode_pack(
+            live_rows, all_lists, centroids, codebooks, nlist_eff
+        )
         with self._lock:
             was_trained = self._trained
             # capture mutations that raced the rebuild, replayed after the swap
@@ -881,7 +901,7 @@ class ANNIndex:
             self._ids = live_ids
             self._id_pos = {i: p for p, i in enumerate(live_ids)}
             cap = _next_cap(1024, n)
-            mat = np.empty((cap, self.dim), np.float32)
+            mat = self._mat_alloc((cap, self.dim))
             mat[:n] = live_rows
             self._mat = mat
             self._n = n
@@ -926,7 +946,7 @@ class ANNIndex:
     def _swap_empty_locked(self) -> None:
         """Everything was removed while (re)staging: reset to untrained empty."""
         self._ids, self._id_pos = [], {}
-        self._mat = np.empty((0, self.dim), np.float32)
+        self._mat = self._mat_alloc((0, self.dim))
         self._n = 0
         self._dead = set()
         self._rerank = self._rvalid = None
@@ -1213,6 +1233,151 @@ class ANNIndex:
                 "list_cap": list_cap,
                 "list_fill_max": list_fill_max,
             }
+
+    # -------------------------------------------------------------- durability
+    def snapshot_state(self) -> dict:
+        """Host-side state for an atomic snapshot (storage/durable.py).
+
+        Live rows only, in position order — a snapshot is semantically a
+        compaction point: tombstoned rows are simply absent, so pre-snapshot
+        tombstones can never resurrect on WAL-tail replay.  ``row_list``
+        stores each live row's ASSIGNED IVF list verbatim; restore re-encodes
+        against that stored assignment rather than re-running spill balancing,
+        because the pre-crash spill decisions depended on occupancy counters
+        that included since-tombstoned slots — recomputing would move rows
+        between lists and shift their ADC scores off the pre-crash bits.
+        """
+        with self._lock:
+            n0 = self._n
+            live_mask = np.ones((n0,), bool)
+            for p in self._dead:
+                if p < n0:
+                    live_mask[p] = False
+            state = {
+                "ids": np.asarray(
+                    [i for p, i in enumerate(self._ids[:n0]) if live_mask[p]], np.int64
+                ),
+                "vectors": np.ascontiguousarray(
+                    self._mat[:n0][live_mask], dtype=np.float32
+                ),
+                "trained": bool(self._trained),
+                "nlist": int(self.nlist),
+                "m": int(self.m),
+                "dim": int(self.dim),
+                "seed": int(self.seed),
+            }
+            if self._trained and self._centroids is not None:
+                state["centroids"] = np.asarray(
+                    jax.device_get(self._centroids), np.float32
+                )
+                state["codebooks"] = np.asarray(
+                    jax.device_get(self._codebooks), np.float32
+                )
+                state["row_list"] = np.ascontiguousarray(
+                    self._row_list[:n0][live_mask], np.int32
+                )
+            return state
+
+    def restore_state(self, state) -> None:
+        """Rebuild the whole index from a ``snapshot_state`` dict.
+
+        The stored per-row list assignment is adopted verbatim (no re-spill;
+        see ``snapshot_state``), the rerank tier is restaged at the restored
+        positions, and the drift gauge + advisory-retrain state restart from
+        the restored assignment — a just-restored index must not immediately
+        advise the retrain it just persisted.
+        """
+        ids = [int(i) for i in np.asarray(state["ids"]).reshape(-1).tolist()]
+        vectors = np.asarray(state["vectors"], np.float32).reshape(-1, self.dim)
+        if len(ids) != vectors.shape[0]:
+            raise ValueError("snapshot ids/vectors length mismatch")
+        n = len(ids)
+        with self._lock:
+            self._swap_empty_locked()
+            if n == 0:
+                return
+            cap = _next_cap(1024, n)
+            mat = self._mat_alloc((cap, self.dim))
+            mat[:n] = vectors
+            self._mat = mat
+            self._n = n
+            self._ids = ids
+            self._id_pos = {i: p for p, i in enumerate(ids)}
+            rl = np.full((cap,), -1, np.int32)
+            rs = np.full((cap,), -1, np.int32)
+            if not bool(state.get("trained")):
+                self._row_list, self._row_slot = rl, rs
+                self._rerank_dirty = True
+                return
+            nlist_eff = int(state["nlist"])
+            centroids = self._put(
+                jnp.asarray(np.asarray(state["centroids"], np.float32)), sharded=False
+            )
+            codebooks = self._put(
+                jnp.asarray(np.asarray(state["codebooks"], np.float32)), sharded=False
+            )
+            all_lists = np.asarray(state["row_list"], np.int32).reshape(-1)
+            (codes_d, lvalid_d, rowpos_d, counts, row_slot, sums) = self._encode_pack(
+                vectors, all_lists, centroids, codebooks, nlist_eff
+            )
+            self.nlist = nlist_eff
+            self._centroids, self._codebooks = centroids, codebooks
+            self._codes, self._lvalid, self._rowpos = codes_d, lvalid_d, rowpos_d
+            self._list_counts = counts
+            rl[:n] = all_lists
+            rs[:n] = row_slot
+            self._row_list, self._row_slot = rl, rs
+            self._list_sums = sums
+            self._list_nums = counts.copy()
+            self._drift_frac = 0.0
+            self._drift_stale = 0
+            self._trained = True
+            self.appended_since_train = 0
+            self._rerank = self._rvalid = None
+            self._rerank_count = 0
+            for s in range(0, n, _ENCODE_BATCH):
+                e = min(n, s + _ENCODE_BATCH)
+                self._append_rerank_locked(s, vectors[s:e])
+            self._snapshot_ids = self._ids
+            self._rerank_dirty = False
+
+    def install_trained(self, centroids, codebooks, nlist: int) -> "ANNIndex":
+        """Adopt quantizers learned elsewhere and restage against them — the
+        WAL-replay twin of ``train()``.  Recovery must not re-LEARN (mini-batch
+        k-means over the recovered corpus would not reproduce the pre-crash
+        centroids bit-for-bit); it re-INSTALLS the exact arrays the crashed
+        process logged in its retrain-install record, then the deterministic
+        assign+spill+encode restage reproduces the pre-crash placement."""
+        with self._lock:
+            self.nlist = int(nlist)
+            self._centroids = self._put(
+                jnp.asarray(np.asarray(centroids, np.float32)), sharded=False
+            )
+            self._codebooks = self._put(
+                jnp.asarray(np.asarray(codebooks, np.float32)), sharded=False
+            )
+            # _trained flips inside _restage's locked swap — flipping it here
+            # would let a concurrent search snapshot trained=True with no codes
+        self._restage(retrain=False)
+        return self
+
+    def live_ids(self) -> list[int]:
+        """Ids currently serving (tombstoned ones excluded) — the registry's
+        durable-recovery reconcile diffs this against the DB."""
+        with self._lock:
+            return list(self._id_pos.keys())
+
+    def trained_arrays(self):
+        """Host copies of the learned quantizers ``(centroids, codebooks,
+        nlist)`` for a WAL retrain-install record; None while untrained."""
+        with self._lock:
+            if not self._trained or self._centroids is None:
+                return None
+            return (
+                np.asarray(jax.device_get(self._centroids), np.float32),
+                np.asarray(jax.device_get(self._codebooks), np.float32),
+                int(self.nlist),
+            )
 
     # ----------------------------------------------------------------- loading
     @classmethod

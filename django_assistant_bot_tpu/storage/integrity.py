@@ -1,0 +1,98 @@
+"""CRC-32C (Castagnoli) — the one checksum implementation for every plane.
+
+Three subsystems stamp and verify CRC-32C over byte payloads: the fleet KV
+wire v2 (serving/fleet.py — corruption in flight), the HostKVTier disk spill
+files (serving/kv_pool.py — corruption at rest), and the retrieval plane's
+write-ahead log + snapshot manifests (storage/durable.py — torn writes and
+bit rot under the ANN corpus).  They used to share one copy that lived in
+``serving/kv_pool.py``; it lives here now so the storage plane does not import
+the jax-heavy serving package just to checksum a log record, and so the three
+call sites can never drift onto different polynomials.
+
+The software path is slicing-by-8 (Intel's algorithm, reflected polynomial
+``0x82F63B78``); a hardware/C ``crc32c`` module is picked up automatically when
+the host has one — both produce identical values (same polynomial), which the
+unification test in tests/test_durable.py pins with known vectors.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+import numpy as np
+
+
+def _crc32c_tables() -> tuple:
+    # slicing-by-8 tables (Intel's algorithm, reflected): T[0] is the classic
+    # byte-at-a-time table, T[j][b] the CRC of byte b followed by j zero bytes
+    poly = 0x82F63B78  # Castagnoli, reflected
+    base = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ poly if (c & 1) else (c >> 1)
+        base.append(c)
+    tables = [tuple(base)]
+    for _ in range(7):
+        prev = tables[-1]
+        tables.append(tuple((p >> 8) ^ base[p & 0xFF] for p in prev))
+    return tuple(tables)
+
+
+_CRC32C_TABLES = _crc32c_tables()
+
+try:  # hardware/C implementation when the host has one (same polynomial)
+    from crc32c import crc32c as _crc32c_hw  # type: ignore
+except ImportError:
+    _crc32c_hw = None
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC-32C (Castagnoli) of bytes-like ``data``; ``crc`` chains a
+    running checksum across buffers (k bytes then v bytes, no concat copy).
+    Slicing-by-8 software fallback — payloads here are page/record-sized, and
+    the C path is picked up automatically when a ``crc32c`` module exists."""
+    if _crc32c_hw is not None:
+        return _crc32c_hw(bytes(data), crc)
+    if not isinstance(data, (bytes, bytearray)):
+        data = bytes(data)
+    t0, t1, t2, t3, t4, t5, t6, t7 = _CRC32C_TABLES
+    c = ~crc & 0xFFFFFFFF
+    n8 = len(data) - (len(data) % 8)
+    for w0, w1 in struct.iter_unpack("<II", memoryview(data)[:n8]):
+        c ^= w0
+        c = (
+            t7[c & 0xFF] ^ t6[(c >> 8) & 0xFF]
+            ^ t5[(c >> 16) & 0xFF] ^ t4[(c >> 24) & 0xFF]
+            ^ t3[w1 & 0xFF] ^ t2[(w1 >> 8) & 0xFF]
+            ^ t1[(w1 >> 16) & 0xFF] ^ t0[(w1 >> 24) & 0xFF]
+        )
+    for b in memoryview(data)[n8:]:
+        c = t0[(c ^ b) & 0xFF] ^ (c >> 8)
+    return ~c & 0xFFFFFFFF
+
+
+def entry_crc32c(k, v) -> int:
+    """The checksum stamped on a KV wire/disk entry: CRC-32C over the K page
+    bytes chained into the V page bytes, exactly the byte order the wire
+    envelope and the spill file store them in."""
+    c = crc32c(np.ascontiguousarray(k).view(np.uint8).reshape(-1).tobytes())
+    return crc32c(np.ascontiguousarray(v).view(np.uint8).reshape(-1).tobytes(), c)
+
+
+def file_crc32c(path: str, chunk_bytes: int = 1 << 20) -> Optional[int]:
+    """CRC-32C of a whole file, streamed (snapshot-manifest artifact digests).
+    Returns None when the file cannot be read — the caller decides whether a
+    missing artifact is corruption (manifest says it should exist) or not."""
+    try:
+        c = 0
+        with open(path, "rb") as f:
+            while True:
+                block = f.read(chunk_bytes)
+                if not block:
+                    break
+                c = crc32c(block, c)
+        return c
+    except OSError:
+        return None
